@@ -1,0 +1,90 @@
+"""ray_tpu — a TPU-native distributed AI framework with Ray's capabilities.
+
+Core API parity target: reference `python/ray/__init__.py` `__all__`
+(see SURVEY.md Appendix A). Compute parallelism is jit-compiled XLA over
+`jax.sharding.Mesh` (see `ray_tpu.parallel`), not NCCL process groups.
+"""
+
+from ._version import __version__
+from .core.api import (
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    timeline,
+    wait,
+)
+from .core.actor import ActorClass, ActorHandle, method
+from .core.exceptions import (
+    ActorDiedError,
+    ActorUnavailableError,
+    GetTimeoutError,
+    ObjectLostError,
+    OutOfMemoryError,
+    RayTpuError,
+    TaskCancelledError,
+    TaskError,
+    WorkerCrashedError,
+)
+from .core.ids import (
+    ActorID,
+    JobID,
+    NodeID,
+    ObjectID,
+    PlacementGroupID,
+    TaskID,
+    UniqueID,
+    WorkerID,
+)
+from .core.object_ref import DynamicObjectRefGenerator, ObjectRef, ObjectRefGenerator
+from .core.runtime_context import get_runtime_context
+
+__all__ = [
+    "__version__",
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "get",
+    "put",
+    "wait",
+    "kill",
+    "cancel",
+    "method",
+    "get_actor",
+    "nodes",
+    "cluster_resources",
+    "available_resources",
+    "timeline",
+    "get_runtime_context",
+    "ObjectRef",
+    "ObjectRefGenerator",
+    "DynamicObjectRefGenerator",
+    "ActorClass",
+    "ActorHandle",
+    "JobID",
+    "TaskID",
+    "ActorID",
+    "ObjectID",
+    "NodeID",
+    "WorkerID",
+    "PlacementGroupID",
+    "UniqueID",
+    "RayTpuError",
+    "TaskError",
+    "ActorDiedError",
+    "ActorUnavailableError",
+    "WorkerCrashedError",
+    "ObjectLostError",
+    "GetTimeoutError",
+    "TaskCancelledError",
+    "OutOfMemoryError",
+]

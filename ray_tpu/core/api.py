@@ -1,0 +1,255 @@
+"""Module-level public API (reference: `python/ray/_private/worker.py`).
+
+`init` (`worker.py:1227`), `get` (`:2575`), `put` (`:2687`), `wait`, `kill`,
+`cancel`, `remote`, `get_actor`, `nodes`, `cluster_resources`,
+`available_resources`, `shutdown`, `is_initialized`.
+"""
+
+from __future__ import annotations
+
+import atexit
+import inspect
+import os
+import threading
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import cloudpickle
+
+from .actor import ActorClass, ActorHandle
+from .exceptions import RayTpuError
+from .ids import JobID
+from .object_ref import ObjectRef
+from .remote_function import RemoteFunction, options_from_kwargs
+from .runtime import Runtime
+from .task_spec import TaskOptions
+
+_runtime: Optional[Runtime] = None
+_runtime_lock = threading.RLock()
+_job_counter = 0
+
+
+def _global_runtime() -> Runtime:
+    global _runtime
+    if _runtime is None:
+        with _runtime_lock:
+            if _runtime is None:
+                init()
+    return _runtime
+
+
+def set_global_runtime(runtime: Optional[Runtime]):
+    """Install the process-wide runtime (used by worker bootstrap)."""
+    global _runtime
+    _runtime = runtime
+
+
+def is_initialized() -> bool:
+    return _runtime is not None
+
+
+def init(
+    address: Optional[str] = None,
+    *,
+    num_cpus: Optional[float] = None,
+    num_tpus: Optional[float] = None,
+    resources: Optional[dict] = None,
+    local_mode: bool = False,
+    namespace: Optional[str] = None,
+    ignore_reinit_error: bool = False,
+    object_store_memory: Optional[int] = None,
+    log_to_driver: bool = True,
+    _node_cpus: Optional[float] = None,
+    **_ignored,
+) -> "RuntimeContextInfo":
+    """Start (or connect to) the runtime.
+
+    * ``local_mode=True`` → in-process thread-pool plane.
+    * default → per-machine cluster plane (shared-memory store + worker
+      processes), auto-started if ``address`` is None.
+    * ``address="<host:port>"`` → connect to an existing controller.
+    """
+    global _runtime, _job_counter
+    with _runtime_lock:
+        if _runtime is not None:
+            if ignore_reinit_error:
+                return RuntimeContextInfo(_runtime)
+            raise RuntimeError("ray_tpu.init() called twice; pass ignore_reinit_error=True.")
+
+        _job_counter += 1
+        job_id = JobID.from_int(os.getpid() % (2**24) * 100 + _job_counter)
+
+        env_local = os.environ.get("RAY_TPU_LOCAL_MODE", "")
+        if env_local == "1":
+            local_mode = True
+
+        if not local_mode:
+            try:
+                from .cluster_backend import ClusterBackend  # noqa: F401
+            except ImportError:
+                local_mode = True  # cluster plane not built yet; fall back
+
+        if local_mode:
+            from .local_backend import LocalBackend
+
+            cpus = num_cpus if num_cpus is not None else float(os.cpu_count() or 8)
+            backend = LocalBackend(num_cpus=max(cpus, 4.0), resources=_with_tpus(resources, num_tpus))
+            runtime = Runtime(backend, job_id, address="local")
+            backend.set_runtime(runtime)
+        else:
+            from .cluster_backend import ClusterBackend
+
+            backend = ClusterBackend.connect_or_start(
+                address=address,
+                num_cpus=num_cpus if _node_cpus is None else _node_cpus,
+                resources=_with_tpus(resources, num_tpus),
+                object_store_memory=object_store_memory,
+            )
+            runtime = Runtime(backend, job_id, address=backend.client_address)
+            backend.set_runtime(runtime)
+
+        _runtime = runtime
+        atexit.register(_atexit_shutdown)
+        return RuntimeContextInfo(runtime)
+
+
+def _with_tpus(resources: Optional[dict], num_tpus: Optional[float]) -> dict:
+    resources = dict(resources or {})
+    if num_tpus is not None:
+        resources["TPU"] = float(num_tpus)
+    elif "TPU" not in resources:
+        # Autodetect local TPU chips (reference: `_private/accelerators/tpu.py`).
+        try:
+            from ..util.accelerators import tpu as tpu_util
+
+            n = tpu_util.detect_num_chips()
+            if n:
+                resources["TPU"] = float(n)
+        except Exception:  # noqa: BLE001
+            pass
+    return resources
+
+
+def _atexit_shutdown():
+    try:
+        shutdown()
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def shutdown():
+    global _runtime
+    with _runtime_lock:
+        if _runtime is not None:
+            _runtime.shutdown()
+            _runtime = None
+
+
+class RuntimeContextInfo:
+    """Returned by `init`; context-manager for scoped clusters."""
+
+    def __init__(self, runtime: Runtime):
+        self._runtime = runtime
+
+    @property
+    def address_info(self) -> dict:
+        return {"address": self._runtime.address, "job_id": self._runtime.job_id.hex()}
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        shutdown()
+
+
+# ----------------------------------------------------------------- core ops
+def put(value: Any) -> ObjectRef:
+    return _global_runtime().put(value)
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float] = None):
+    return _global_runtime().get(refs, timeout)
+
+
+def wait(
+    refs: Sequence[ObjectRef],
+    *,
+    num_returns: int = 1,
+    timeout: Optional[float] = None,
+    fetch_local: bool = True,
+) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+    return _global_runtime().wait(refs, num_returns, timeout, fetch_local)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True):
+    if not isinstance(actor, ActorHandle):
+        raise TypeError("kill() expects an ActorHandle; use cancel() for tasks.")
+    _global_runtime().backend.kill_actor(actor._id, no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
+    _global_runtime().backend.cancel(ref, force, recursive)
+
+
+def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
+    handle = get_actor_or_none(name, namespace)
+    if handle is None:
+        raise ValueError(f"Failed to look up actor with name '{name}'")
+    return handle
+
+
+def get_actor_or_none(name: str, namespace: Optional[str] = None) -> Optional[ActorHandle]:
+    state = _global_runtime().backend.get_named_actor(name, namespace or "default")
+    if state is None:
+        return None
+    handle = cloudpickle.loads(state)
+    assert isinstance(handle, ActorHandle), type(handle)
+    return handle
+
+
+# ----------------------------------------------------------------- cluster
+def nodes() -> List[dict]:
+    return _global_runtime().backend.nodes()
+
+
+def cluster_resources() -> dict:
+    return _global_runtime().backend.cluster_resources()
+
+
+def available_resources() -> dict:
+    return _global_runtime().backend.available_resources()
+
+
+def timeline(filename: Optional[str] = None):
+    """Export task events as chrome://tracing JSON (reference: `ray.timeline`)."""
+    events = _global_runtime().backend.state_summary().get("timeline", [])
+    if filename:
+        import json
+
+        with open(filename, "w") as f:
+            json.dump(events, f)
+    return events
+
+
+# ----------------------------------------------------------------- remote
+def remote(*args, **kwargs):
+    """`@remote` / `@remote(num_cpus=..., ...)` for functions and classes."""
+
+    def make(target):
+        opts = TaskOptions()
+        if kwargs:
+            opts = options_from_kwargs(opts, **{k: v for k, v in kwargs.items() if k not in ("name", "namespace")})
+        if inspect.isclass(target):
+            ac = ActorClass(target, opts)
+            if "name" in kwargs or "namespace" in kwargs:
+                ac._pending_name = kwargs.get("name")
+                ac._pending_namespace = kwargs.get("namespace")
+            return ac
+        if callable(target):
+            return RemoteFunction(target, opts)
+        raise TypeError(f"@remote target must be a function or class, got {type(target)}")
+
+    if len(args) == 1 and callable(args[0]) and not kwargs:
+        return make(args[0])
+    if args:
+        raise TypeError("@remote accepts only keyword options, e.g. @remote(num_cpus=2)")
+    return make

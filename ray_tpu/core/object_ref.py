@@ -1,0 +1,85 @@
+"""ObjectRef: a first-class future handle to an immutable object.
+
+Equivalent of the reference's `ray.ObjectRef` (`python/ray/includes/object_ref.pxi`)
+— holds the binary ObjectID (which encodes the creating task, see ids.py) plus
+the owner's address hint so any process can resolve it without a directory hop.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .ids import ObjectID
+
+
+class ObjectRef:
+    __slots__ = ("id", "owner_address", "_weak")
+
+    def __init__(self, object_id: ObjectID, owner_address: Optional[str] = None):
+        self.id = object_id
+        self.owner_address = owner_address
+        self._weak = False
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def binary(self) -> bytes:
+        return self.id.binary()
+
+    def task_id(self):
+        return self.id.task_id()
+
+    def job_id(self):
+        return self.id.job_id()
+
+    def future(self):
+        """Return a concurrent.futures.Future resolving to the object value."""
+        from . import api
+
+        return api._global_runtime().as_future(self)
+
+    def __await__(self):
+        from . import api
+
+        runtime = api._global_runtime()
+        return runtime.as_asyncio_future(self).__await__()
+
+    def __hash__(self) -> int:
+        return hash(self.id)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __repr__(self) -> str:
+        return f"ObjectRef({self.id.hex()})"
+
+    def __reduce__(self):
+        return (ObjectRef, (self.id, self.owner_address))
+
+
+class ObjectRefGenerator:
+    """Streaming generator handle (reference: `_raylet.pyx:272` ObjectRefGenerator).
+
+    Yields ObjectRefs for the results of a generator task as they are produced.
+    """
+
+    def __init__(self, refs):
+        self._refs = list(refs)
+        self._index = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> ObjectRef:
+        if self._index >= len(self._refs):
+            raise StopIteration
+        ref = self._refs[self._index]
+        self._index += 1
+        return ref
+
+    def __len__(self) -> int:
+        return len(self._refs)
+
+
+# Alias kept for API parity with the reference (`DynamicObjectRefGenerator`).
+DynamicObjectRefGenerator = ObjectRefGenerator

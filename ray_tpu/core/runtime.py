@@ -1,0 +1,258 @@
+"""Runtime — per-process façade that turns API calls into TaskSpecs.
+
+Equivalent role to the reference's CoreWorker (`src/ray/core_worker/core_worker.cc`):
+it owns the process's job/task context, builds TaskSpecs (`SubmitTask`
+`core_worker.cc:1935`), allocates deterministic return ObjectIDs (object index
+within creating task — `common/id.h:272`), and routes to the backend.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import cloudpickle
+
+from .backend import RuntimeBackend
+from .exceptions import TaskError
+from .ids import ActorID, JobID, ObjectID, TaskID
+from .object_ref import ObjectRef
+from .task_spec import TaskOptions, TaskSpec, TaskType
+
+# Default CPU demand for tasks / actors (matches the reference's defaults:
+# tasks require 1 CPU, actors require 0 by default for scheduling).
+DEFAULT_TASK_CPUS = 1.0
+DEFAULT_ACTOR_CPUS = 0.0
+
+
+class _ArgRefMarker:
+    """Placeholder for a top-level ObjectRef arg; resolved before execution."""
+
+    __slots__ = ("index",)
+
+    def __init__(self, index: int):
+        self.index = index
+
+    def __reduce__(self):
+        return (_ArgRefMarker, (self.index,))
+
+
+class TaskContext(threading.local):
+    """Per-thread execution context: which task is running here."""
+
+    def __init__(self):
+        self.task_id: Optional[TaskID] = None
+        self.actor_id: Optional[ActorID] = None
+
+
+class Runtime:
+    def __init__(self, backend: RuntimeBackend, job_id: JobID, address: str = "local"):
+        self.backend = backend
+        self.job_id = job_id
+        self.address = address
+        self.driver_task_id = TaskID.for_driver(job_id)
+        self._context = TaskContext()
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------ ctx
+    @property
+    def current_task_id(self) -> TaskID:
+        return self._context.task_id or self.driver_task_id
+
+    def set_task_context(self, task_id: Optional[TaskID], actor_id: Optional[ActorID] = None):
+        self._context.task_id = task_id
+        self._context.actor_id = actor_id
+
+    # ------------------------------------------------------------------ put
+    def put(self, value: Any) -> ObjectRef:
+        if isinstance(value, ObjectRef):
+            raise TypeError("Calling put() on an ObjectRef is not allowed.")
+        return self.backend.put(value, self.current_task_id.hex())
+
+    # ------------------------------------------------------------------ get
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ObjectRef)
+        refs = [refs] if single else list(refs)
+        if not all(isinstance(r, ObjectRef) for r in refs):
+            raise TypeError(
+                "get() expects an ObjectRef or a list of ObjectRefs, got "
+                f"{[type(r).__name__ for r in refs if not isinstance(r, ObjectRef)]}"
+            )
+        values = self.backend.get(refs, timeout)
+        out = []
+        for v in values:
+            if isinstance(v, TaskError):
+                raise v.as_instanceof_cause()
+            out.append(v)
+        return out[0] if single else out
+
+    def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        if isinstance(refs, ObjectRef):
+            raise TypeError("wait() expects a list of ObjectRefs.")
+        refs = list(refs)
+        if len(set(refs)) != len(refs):
+            raise ValueError("wait() expects a list of unique ObjectRefs.")
+        if num_returns > len(refs):
+            raise ValueError(f"num_returns={num_returns} > len(refs)={len(refs)}")
+        return self.backend.wait(refs, num_returns, timeout)
+
+    # ---------------------------------------------------------------- tasks
+    def _next_task_id(self) -> TaskID:
+        return TaskID.of(
+            self._context.actor_id
+            or ActorID(b"\xff" * 12 + self.job_id.binary())
+        )
+
+    def _build_payload(
+        self, func_or_none: Any, args: tuple, kwargs: dict
+    ) -> Tuple[bytes, List[ObjectRef]]:
+        """Serialize (func, args, kwargs), extracting top-level ObjectRef args.
+
+        Top-level ObjectRefs become markers resolved to values before execution
+        (reference semantics); nested refs travel as refs.
+        """
+        refs: List[ObjectRef] = []
+
+        def sub(x):
+            if isinstance(x, ObjectRef):
+                refs.append(x)
+                return _ArgRefMarker(len(refs) - 1)
+            return x
+
+        args2 = tuple(sub(a) for a in args)
+        kwargs2 = {k: sub(v) for k, v in kwargs.items()}
+        payload = cloudpickle.dumps((func_or_none, args2, kwargs2))
+        return payload, refs
+
+    def submit_task(
+        self,
+        func: Any,
+        args: tuple,
+        kwargs: dict,
+        options: TaskOptions,
+    ) -> List[ObjectRef]:
+        task_id = self._next_task_id()
+        payload, arg_refs = self._build_payload(func, args, kwargs)
+        num_returns = options.num_returns
+        return_ids = [ObjectID.of(task_id, i) for i in range(max(num_returns, 1))]
+        spec = TaskSpec(
+            task_id=task_id,
+            job_id=self.job_id,
+            task_type=TaskType.NORMAL_TASK,
+            func_payload=payload,
+            arg_refs=[r.id for r in arg_refs],
+            num_returns=num_returns,
+            return_ids=return_ids,
+            resources=options.resource_demand(DEFAULT_TASK_CPUS),
+            options=options,
+            name=options.name or getattr(func, "__name__", "task"),
+            owner_address=self.address,
+        )
+        self.backend.submit_task(spec)
+        refs = [ObjectRef(oid, self.address) for oid in return_ids]
+        return refs
+
+    # --------------------------------------------------------------- actors
+    def create_actor(
+        self,
+        cls: Any,
+        args: tuple,
+        kwargs: dict,
+        options: TaskOptions,
+        name: str = "",
+        namespace: str = "",
+        method_meta: Optional[Dict[str, int]] = None,
+    ) -> ActorID:
+        actor_id = ActorID.of(self.job_id)
+        task_id = self._next_task_id()
+        payload, arg_refs = self._build_payload(cls, args, kwargs)
+        spec = TaskSpec(
+            task_id=task_id,
+            job_id=self.job_id,
+            task_type=TaskType.ACTOR_CREATION_TASK,
+            func_payload=payload,
+            arg_refs=[r.id for r in arg_refs],
+            num_returns=0,
+            return_ids=[],
+            resources=options.resource_demand(DEFAULT_ACTOR_CPUS),
+            options=options,
+            name=name or getattr(cls, "__name__", "Actor"),
+            actor_id=actor_id,
+            owner_address=self.address,
+            method_meta=method_meta or {},
+        )
+        self.backend.create_actor(spec, name, namespace)
+        return actor_id
+
+    def submit_actor_task(
+        self,
+        actor_id: ActorID,
+        method_name: str,
+        args: tuple,
+        kwargs: dict,
+        options: TaskOptions,
+        sequence_number: int,
+    ) -> List[ObjectRef]:
+        task_id = TaskID.of(actor_id)
+        payload, arg_refs = self._build_payload(None, args, kwargs)
+        num_returns = options.num_returns
+        return_ids = [ObjectID.of(task_id, i) for i in range(max(num_returns, 1))]
+        spec = TaskSpec(
+            task_id=task_id,
+            job_id=self.job_id,
+            task_type=TaskType.ACTOR_TASK,
+            func_payload=payload,
+            arg_refs=[r.id for r in arg_refs],
+            num_returns=num_returns,
+            return_ids=return_ids,
+            resources={},
+            options=options,
+            name=method_name,
+            actor_id=actor_id,
+            method_name=method_name,
+            sequence_number=sequence_number,
+            owner_address=self.address,
+        )
+        self.backend.submit_actor_task(spec)
+        return [ObjectRef(oid, self.address) for oid in return_ids]
+
+    # -------------------------------------------------------------- futures
+    def as_future(self, ref: ObjectRef) -> concurrent.futures.Future:
+        fut = concurrent.futures.Future()
+
+        def worker():
+            try:
+                fut.set_result(self.get(ref))
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        return fut
+
+    def as_asyncio_future(self, ref: ObjectRef):
+        import asyncio
+
+        loop = asyncio.get_event_loop()
+        return asyncio.wrap_future(self.as_future(ref), loop=loop)
+
+    def shutdown(self):
+        self.backend.shutdown()
+
+
+def resolve_payload(payload: bytes, resolved_args: List[Any]):
+    """Deserialize a task payload, substituting resolved top-level arg values."""
+    func, args, kwargs = cloudpickle.loads(payload)
+
+    def sub(x):
+        if isinstance(x, _ArgRefMarker):
+            val = resolved_args[x.index]
+            if isinstance(val, TaskError):
+                raise val.as_instanceof_cause()
+            return val
+        return x
+
+    args = tuple(sub(a) for a in args)
+    kwargs = {k: sub(v) for k, v in kwargs.items()}
+    return func, args, kwargs

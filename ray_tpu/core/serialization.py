@@ -1,0 +1,98 @@
+"""Serialization: cloudpickle + out-of-band zero-copy buffers.
+
+Mirrors the capability of the reference's `python/ray/_private/serialization.py`
+(cloudpickle fork + pickle5 out-of-band buffers, zero-copy numpy reads from
+plasma) without its plasma-specific framing. We use pickle protocol 5 with
+`buffer_callback` so large numpy / jax host arrays are carried as raw buffers
+next to a small pickle payload; on the read side the arrays are reconstructed
+as views over the (possibly shared-memory) buffer — no copy.
+
+Wire format:
+    [u32 npayload][payload][u32 nbufs]{[u64 len][buffer bytes]}*
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import struct
+from typing import Any, List, Tuple
+
+import cloudpickle
+
+# Protocol 5 gives us out-of-band buffer support.
+_PROTO = 5
+
+
+def serialize(value: Any) -> Tuple[bytes, List[pickle.PickleBuffer]]:
+    """Serialize to (payload, out_of_band_buffers)."""
+    buffers: List[pickle.PickleBuffer] = []
+    payload = cloudpickle.dumps(value, protocol=_PROTO, buffer_callback=buffers.append)
+    return payload, buffers
+
+
+def deserialize(payload: bytes, buffers: List[Any]) -> Any:
+    return pickle.loads(payload, buffers=buffers)
+
+
+def pack(value: Any) -> bytes:
+    """Serialize into a single contiguous frame (copies buffers once)."""
+    payload, buffers = serialize(value)
+    out = io.BytesIO()
+    out.write(struct.pack("<I", len(payload)))
+    out.write(payload)
+    out.write(struct.pack("<I", len(buffers)))
+    for buf in buffers:
+        raw = buf.raw()
+        out.write(struct.pack("<Q", raw.nbytes))
+        out.write(raw)
+    return out.getvalue()
+
+
+def packed_size(payload: bytes, buffers: List[pickle.PickleBuffer]) -> int:
+    total = 4 + len(payload) + 4
+    for buf in buffers:
+        total += 8 + buf.raw().nbytes
+    return total
+
+
+def pack_into(payload: bytes, buffers: List[pickle.PickleBuffer], mv: memoryview) -> int:
+    """Pack a pre-serialized value into a writable memoryview (e.g. shm segment).
+
+    Returns bytes written. The large-buffer copy happens exactly once, directly
+    into the destination mapping.
+    """
+    offset = 0
+    struct.pack_into("<I", mv, offset, len(payload))
+    offset += 4
+    mv[offset : offset + len(payload)] = payload
+    offset += len(payload)
+    struct.pack_into("<I", mv, offset, len(buffers))
+    offset += 4
+    for buf in buffers:
+        raw = buf.raw()
+        n = raw.nbytes
+        struct.pack_into("<Q", mv, offset, n)
+        offset += 8
+        mv[offset : offset + n] = raw.cast("B") if raw.ndim != 1 else raw
+        offset += n
+    return offset
+
+
+def unpack(frame: memoryview | bytes) -> Any:
+    """Deserialize from a frame; numpy arrays view the frame buffer (zero-copy)."""
+    mv = memoryview(frame)
+    offset = 0
+    (npayload,) = struct.unpack_from("<I", mv, offset)
+    offset += 4
+    payload = bytes(mv[offset : offset + npayload])
+    offset += npayload
+    (nbufs,) = struct.unpack_from("<I", mv, offset)
+    offset += 4
+    buffers = []
+    for _ in range(nbufs):
+        (n,) = struct.unpack_from("<Q", mv, offset)
+        offset += 8
+        buffers.append(mv[offset : offset + n])
+        offset += n
+    return deserialize(payload, buffers)

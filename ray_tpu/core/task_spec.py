@@ -1,0 +1,109 @@
+"""TaskSpec / ActorSpec — the unit of work handed to the scheduler.
+
+Python-dataclass analog of the reference's `TaskSpecification`
+(`src/ray/protobuf/common.proto:398+`, `src/ray/common/task/task_spec.h`):
+function payload, ids, args (inline values or ObjectRefs), resource demand,
+scheduling strategy, retry policy, and streaming-generator flags.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from .ids import ActorID, JobID, ObjectID, TaskID
+
+
+class TaskType(enum.Enum):
+    NORMAL_TASK = 0
+    ACTOR_CREATION_TASK = 1
+    ACTOR_TASK = 2
+
+
+@dataclass
+class SchedulingStrategy:
+    """Base for scheduling strategies (reference: `util/scheduling_strategies.py`)."""
+
+
+@dataclass
+class DefaultSchedulingStrategy(SchedulingStrategy):
+    pass
+
+
+@dataclass
+class SpreadSchedulingStrategy(SchedulingStrategy):
+    pass
+
+
+@dataclass
+class NodeAffinitySchedulingStrategy(SchedulingStrategy):
+    node_id: str = ""
+    soft: bool = False
+
+
+@dataclass
+class PlacementGroupSchedulingStrategy(SchedulingStrategy):
+    placement_group: Any = None
+    placement_group_bundle_index: int = -1
+    placement_group_capture_child_tasks: bool = False
+
+
+@dataclass
+class TaskOptions:
+    num_cpus: Optional[float] = None
+    num_gpus: Optional[float] = None
+    num_tpus: Optional[float] = None
+    resources: Dict[str, float] = field(default_factory=dict)
+    num_returns: int = 1
+    max_retries: int = 3
+    retry_exceptions: bool | list = False
+    name: str = ""
+    scheduling_strategy: Optional[SchedulingStrategy] = None
+    runtime_env: Optional[dict] = None
+    # Actor-only options.
+    max_restarts: int = 0
+    max_task_retries: int = 0
+    max_concurrency: int = 1
+    lifetime: Optional[str] = None  # None | "detached"
+    namespace: Optional[str] = None
+    get_if_exists: bool = False
+
+    def resource_demand(self, default_num_cpus: float) -> Dict[str, float]:
+        demand = dict(self.resources)
+        cpus = self.num_cpus if self.num_cpus is not None else default_num_cpus
+        if cpus:
+            demand["CPU"] = float(cpus)
+        if self.num_gpus:
+            demand["GPU"] = float(self.num_gpus)
+        if self.num_tpus:
+            demand["TPU"] = float(self.num_tpus)
+        return demand
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    job_id: JobID
+    task_type: TaskType
+    # Serialized (function, args, kwargs) payload; refs listed separately so the
+    # scheduler can resolve dependencies before dispatch.
+    func_payload: bytes
+    arg_refs: List[ObjectID]
+    num_returns: int
+    return_ids: List[ObjectID]
+    resources: Dict[str, float]
+    options: TaskOptions
+    name: str = ""
+    # Actor fields.
+    actor_id: Optional[ActorID] = None
+    method_name: str = ""
+    sequence_number: int = 0
+    # Per-method metadata (e.g. num_returns from @method) so named-actor
+    # lookups can reconstruct a full-fidelity handle.
+    method_meta: Dict[str, int] = field(default_factory=dict)
+    # Retry bookkeeping.
+    attempt_number: int = 0
+    # Owner (submitter) address for result routing.
+    owner_address: str = ""
+    depth: int = 0

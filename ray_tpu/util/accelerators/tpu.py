@@ -1,0 +1,78 @@
+"""TPU accelerator discovery & pod helpers.
+
+Reference: `python/ray/_private/accelerators/tpu.py` (autodetect via GCE
+metadata `:22-28`, `TPU_VISIBLE_CHIPS` isolation `:30`, pod resources
+`:199,277-313`) and `python/ray/util/accelerators/tpu.py`
+(`get_current_pod_name` `:7`, `get_current_pod_worker_count` `:18`).
+
+Here detection prefers live JAX device enumeration (works under the axon
+tunnel and on TPU VMs alike) and falls back to GCE metadata env vars.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+TPU_VISIBLE_CHIPS_ENV = "TPU_VISIBLE_CHIPS"
+# GCE TPU-VM metadata environment mirrors.
+_ACCEL_TYPE_ENV = "TPU_ACCELERATOR_TYPE"  # e.g. "v5litepod-16"
+_WORKER_ID_ENV = "TPU_WORKER_ID"
+_POD_NAME_ENV = "TPU_NAME"
+
+# chips per host for each generation (v5e/v6e: 1,4, or 8; default 4).
+_DEFAULT_CHIPS_PER_HOST = 4
+
+
+@functools.lru_cache(maxsize=1)
+def detect_num_chips() -> int:
+    """Number of local TPU chips visible to this process."""
+    visible = os.environ.get(TPU_VISIBLE_CHIPS_ENV)
+    if visible:
+        return len([c for c in visible.split(",") if c.strip() != ""])
+    try:
+        import jax
+
+        devices = jax.devices()
+        return sum(1 for d in devices if "tpu" in d.platform.lower() or "TPU" in str(d))
+    except Exception:  # noqa: BLE001
+        return 0
+
+
+def get_accelerator_type() -> Optional[str]:
+    """e.g. 'v5litepod-16'; None when not on a TPU VM."""
+    return os.environ.get(_ACCEL_TYPE_ENV)
+
+
+def pod_type_and_chip_count(accelerator_type: str) -> tuple[str, int]:
+    """'v5litepod-16' → ('v5litepod', 16)."""
+    head, _, count = accelerator_type.rpartition("-")
+    return head, int(count)
+
+
+def get_current_pod_name() -> Optional[str]:
+    return os.environ.get(_POD_NAME_ENV)
+
+
+def get_current_pod_worker_count() -> Optional[int]:
+    accel = get_accelerator_type()
+    if accel is None:
+        return None
+    _, chips = pod_type_and_chip_count(accel)
+    per_host = chips_per_host()
+    return max(1, chips // per_host)
+
+
+def chips_per_host() -> int:
+    n = detect_num_chips()
+    return n if n > 0 else _DEFAULT_CHIPS_PER_HOST
+
+
+def get_worker_id() -> int:
+    return int(os.environ.get(_WORKER_ID_ENV, "0"))
+
+
+def pod_resource_name(accelerator_type: str) -> str:
+    """Custom resource advertised by pod head workers, e.g. 'TPU-v5litepod-16-head'."""
+    return f"TPU-{accelerator_type}-head"

@@ -1,0 +1,41 @@
+"""Test fixtures (reference analog: `python/ray/tests/conftest.py`).
+
+CI runs on CPU JAX with a forced 8-device host platform so multi-chip SPMD
+logic is exercised without TPUs (SURVEY.md §4 "fake mesh" requirement).
+"""
+
+import os
+
+# Must be set before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+import ray_tpu  # noqa: E402
+
+
+@pytest.fixture
+def local_runtime():
+    """In-process runtime (reference analog: `ray_start_regular` local-mode)."""
+    ray_tpu.init(local_mode=True, ignore_reinit_error=False)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def cluster_runtime():
+    """Full multiprocess runtime on this machine."""
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def shutdown_only():
+    yield
+    ray_tpu.shutdown()

@@ -1,0 +1,120 @@
+"""Actor semantics (reference analog: `python/ray/tests/test_actor.py`)."""
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(autouse=True)
+def _rt(local_runtime):
+    yield
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.value = start
+
+    def increment(self, by=1):
+        self.value += by
+        return self.value
+
+    def get(self):
+        return self.value
+
+
+def test_actor_basic():
+    c = Counter.remote()
+    assert ray_tpu.get(c.increment.remote()) == 1
+    assert ray_tpu.get(c.increment.remote(5)) == 6
+    assert ray_tpu.get(c.get.remote()) == 6
+
+
+def test_actor_init_args():
+    c = Counter.remote(100)
+    assert ray_tpu.get(c.get.remote()) == 100
+
+
+def test_actor_ordering():
+    c = Counter.remote()
+    refs = [c.increment.remote() for _ in range(50)]
+    results = ray_tpu.get(refs)
+    assert results == list(range(1, 51))
+
+
+def test_actor_method_error():
+    @ray_tpu.remote
+    class Bad:
+        def fail(self):
+            raise RuntimeError("actor method failed")
+
+        def ok(self):
+            return "ok"
+
+    b = Bad.remote()
+    with pytest.raises(RuntimeError, match="actor method failed"):
+        ray_tpu.get(b.fail.remote())
+    # Actor survives a method error.
+    assert ray_tpu.get(b.ok.remote()) == "ok"
+
+
+def test_actor_init_error():
+    @ray_tpu.remote
+    class BadInit:
+        def __init__(self):
+            raise ValueError("init failed")
+
+        def m(self):
+            return 1
+
+    b = BadInit.remote()
+    with pytest.raises(Exception):
+        ray_tpu.get(b.m.remote(), timeout=10)
+
+
+def test_named_actor():
+    c = Counter.options(name="global_counter").remote(7)
+    ray_tpu.get(c.get.remote())  # ensure created
+    c2 = ray_tpu.get_actor("global_counter")
+    assert ray_tpu.get(c2.get.remote()) == 7
+
+
+def test_get_actor_missing():
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("no_such_actor")
+
+
+def test_kill_actor():
+    c = Counter.options(name="killme").remote()
+    ray_tpu.get(c.increment.remote())
+    ray_tpu.kill(c)
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("killme")
+
+
+def test_pass_handle_to_task():
+    c = Counter.remote()
+
+    @ray_tpu.remote
+    def bump(counter):
+        return ray_tpu.get(counter.increment.remote())
+
+    assert ray_tpu.get(bump.remote(c)) == 1
+    assert ray_tpu.get(c.get.remote()) == 1
+
+
+def test_actor_direct_instantiation_raises():
+    with pytest.raises(TypeError):
+        Counter()
+
+
+def test_method_num_returns():
+    @ray_tpu.remote
+    class Multi:
+        @ray_tpu.method(num_returns=2)
+        def pair(self):
+            return 1, 2
+
+    m = Multi.remote()
+    a, b = m.pair.remote()
+    assert ray_tpu.get([a, b]) == [1, 2]

@@ -1,0 +1,166 @@
+"""Core API semantics (reference analog: `python/ray/tests/test_basic.py`)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(autouse=True)
+def _rt(local_runtime):
+    yield
+
+
+def test_put_get():
+    ref = ray_tpu.put(42)
+    assert ray_tpu.get(ref) == 42
+
+
+def test_put_get_numpy():
+    arr = np.arange(1000, dtype=np.float32)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    np.testing.assert_array_equal(arr, out)
+
+
+def test_put_objectref_rejected():
+    ref = ray_tpu.put(1)
+    with pytest.raises(TypeError):
+        ray_tpu.put(ref)
+
+
+def test_simple_task():
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_task_with_ref_args():
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    x = ray_tpu.put(10)
+    y = add.remote(x, 5)
+    z = add.remote(y, y)
+    assert ray_tpu.get(z) == 30
+
+
+def test_task_chain():
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    ref = ray_tpu.put(0)
+    for _ in range(10):
+        ref = inc.remote(ref)
+    assert ray_tpu.get(ref) == 10
+
+
+def test_num_returns():
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_task_error_propagates():
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kaboom")
+
+    with pytest.raises(ValueError, match="kaboom"):
+        ray_tpu.get(boom.remote())
+
+
+def test_error_propagates_through_chain():
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kaboom")
+
+    @ray_tpu.remote
+    def identity(x):
+        return x
+
+    with pytest.raises(ValueError):
+        ray_tpu.get(identity.remote(boom.remote()))
+
+
+def test_wait():
+    @ray_tpu.remote
+    def fast():
+        return "fast"
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+        return "slow"
+
+    f, s = fast.remote(), slow.remote()
+    ready, not_ready = ray_tpu.wait([f, s], num_returns=1, timeout=3)
+    assert ready == [f]
+    assert not_ready == [s]
+
+
+def test_wait_timeout_empty():
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+
+    ready, not_ready = ray_tpu.wait([slow.remote()], num_returns=1, timeout=0.1)
+    assert ready == []
+    assert len(not_ready) == 1
+
+
+def test_get_timeout():
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+
+    with pytest.raises(ray_tpu.GetTimeoutError):
+        ray_tpu.get(slow.remote(), timeout=0.2)
+
+
+def test_nested_tasks():
+    @ray_tpu.remote
+    def child(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def parent(x):
+        return ray_tpu.get(child.remote(x)) + 1
+
+    assert ray_tpu.get(parent.remote(5)) == 11
+
+
+def test_options_override():
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    assert ray_tpu.get(f.options(num_cpus=2, name="custom").remote()) == 1
+
+
+def test_remote_direct_call_raises():
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(TypeError):
+        f()
+
+
+def test_cluster_resources():
+    res = ray_tpu.cluster_resources()
+    assert res.get("CPU", 0) >= 1
+
+
+def test_nodes():
+    ns = ray_tpu.nodes()
+    assert len(ns) == 1 and ns[0]["Alive"]

@@ -1,0 +1,35 @@
+"""ID bit-layout tests (reference: `src/ray/common/id.h` layout invariants)."""
+
+from ray_tpu.core.ids import ActorID, JobID, ObjectID, TaskID
+
+
+def test_sizes():
+    assert JobID.SIZE == 4
+    assert ActorID.SIZE == 16
+    assert TaskID.SIZE == 24
+    assert ObjectID.SIZE == 28
+
+
+def test_object_id_encodes_task():
+    job = JobID.from_int(7)
+    actor = ActorID.of(job)
+    task = TaskID.of(actor)
+    obj = ObjectID.of(task, 3)
+    assert obj.task_id() == task
+    assert obj.index() == 3
+    assert obj.job_id() == job
+    assert task.actor_id() == actor
+    assert actor.job_id() == job
+
+
+def test_hash_eq_roundtrip():
+    job = JobID.from_int(1)
+    t = TaskID.for_driver(job)
+    t2 = TaskID.from_hex(t.hex())
+    assert t == t2 and hash(t) == hash(t2)
+    assert t.job_id() == job
+
+
+def test_nil():
+    assert TaskID.nil().is_nil()
+    assert not TaskID.for_driver(JobID.from_int(1)).is_nil()
