@@ -1,0 +1,300 @@
+"""ClusterBackend — client of the controller; used by drivers and workers.
+
+Reference analog: the Cython CoreWorker client surface (`_raylet.pyx`
+`submit_task`/`get_objects`) plus the plasma client: metadata over the control
+socket, bulk data via direct shm access (zero-copy on read).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import cloudpickle
+
+from . import serialization, store
+from .backend import RuntimeBackend
+from .exceptions import GetTimeoutError, RayTpuError
+from .ids import ActorID, ObjectID, PlacementGroupID, TaskID
+from .object_ref import ObjectRef
+from .rpc import Connection, EventLoopThread
+from .task_spec import TaskSpec
+
+
+class ClusterBackend(RuntimeBackend):
+    def __init__(self, address: str, role: str = "driver", worker=None):
+        self.address = address
+        self.client_address = address
+        self.role = role
+        self.worker = worker  # WorkerProcess when role == "worker"
+        self.local_store = store.LocalStore()
+        self.io = EventLoopThread(name="client-io")
+        self.conn: Optional[Connection] = None
+        self._controller_proc: Optional[subprocess.Popen] = None
+        self._runtime = None
+        self._put_idx = 0
+        self._put_lock = __import__("threading").Lock()
+
+    def set_runtime(self, runtime):
+        self._runtime = runtime
+
+    # ------------------------------------------------------------- connect
+    @classmethod
+    def connect_or_start(
+        cls,
+        address: Optional[str],
+        num_cpus: Optional[float],
+        resources: Optional[dict],
+        object_store_memory: Optional[int],
+    ) -> "ClusterBackend":
+        proc = None
+        if address is None:
+            address, proc = cls._start_controller(
+                num_cpus if num_cpus is not None else float(os.cpu_count() or 4),
+                resources or {},
+                object_store_memory,
+            )
+        backend = cls(address, role="driver")
+        backend._controller_proc = proc
+        backend._connect(register_as="register_driver")
+        return backend
+
+    @classmethod
+    def connect(cls, address: str, role: str = "client", worker=None) -> "ClusterBackend":
+        backend = cls(address, role=role, worker=worker)
+        backend._connect(register_as="register_client")
+        return backend
+
+    @staticmethod
+    def _start_controller(
+        num_cpus: float, resources: dict, object_store_memory: Optional[int]
+    ) -> Tuple[str, subprocess.Popen]:
+        session_dir = os.path.join(
+            "/tmp/ray_tpu", f"session_{int(time.time() * 1000)}_{os.getpid()}"
+        )
+        os.makedirs(session_dir, exist_ok=True)
+        args = {
+            "num_cpus": num_cpus,
+            "resources": resources,
+            "session_dir": session_dir,
+            "object_store_memory": object_store_memory,
+            "port": 0,
+        }
+        env = dict(os.environ)
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        env["RAY_TPU_CONTROLLER_ARGS"] = cloudpickle.dumps(args).hex()
+        log_f = open(os.path.join(session_dir, "controller.log"), "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.controller_main"],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=log_f,
+            cwd=pkg_root,
+        )
+        # Handshake: controller prints its bound port on stdout.
+        deadline = time.monotonic() + 30
+        port = None
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline().decode()
+            if line.startswith("RAY_TPU_CONTROLLER_PORT="):
+                port = int(line.strip().split("=", 1)[1])
+                break
+            if not line and proc.poll() is not None:
+                raise RayTpuError(
+                    f"Controller failed to start; see {session_dir}/controller.log"
+                )
+        if port is None:
+            proc.terminate()
+            raise RayTpuError("Controller startup timed out")
+        return f"127.0.0.1:{port}", proc
+
+    def _connect(self, register_as: str):
+        async def go():
+            host, port = self.address.rsplit(":", 1)
+            reader, writer = await asyncio.open_connection(host, int(port))
+            conn = Connection(reader, writer)
+            conn.start()
+            self.conn = conn
+            payload = {"type": register_as}
+            if register_as == "register_worker" and self.worker is not None:
+                payload["worker_id"] = self.worker.worker_id
+            return await conn.request(payload, timeout=15)
+
+        result = self.io.call(go(), timeout=20)
+        if not (result or {}).get("ok"):
+            raise RayTpuError(f"Failed to register with controller: {result}")
+        if result.get("session_tag"):
+            store.set_session_tag(result["session_tag"])
+
+    def _request(self, msg: dict, timeout: Optional[float] = None) -> Any:
+        # Leave generous slack over the server-side timeout.
+        client_timeout = None if timeout is None else timeout + 30
+        try:
+            return self.io.call(self.conn.request(msg, timeout), client_timeout)
+        except ConnectionError as e:
+            raise RayTpuError(f"Lost connection to controller: {e}") from e
+
+    def _send(self, msg: dict):
+        self.io.call(self.conn.send(msg))
+
+    # ----------------------------------------------------------------- put
+    def put(self, value: Any, owner_task_hex: str) -> ObjectRef:
+        # Counter-based index: collision-free within an owner task (random
+        # indices hit 24-bit birthday collisions after a few thousand puts).
+        with self._put_lock:
+            self._put_idx += 1
+            idx = self._put_idx
+        oid = ObjectID.of(TaskID.from_hex(owner_task_hex), 2**24 + idx)
+        hex_id = oid.hex()
+        shm_name, inline, size = self.local_store.put(hex_id, value)
+        if inline is not None:
+            self._request({"type": "put_inline", "id": hex_id, "data": inline})
+        else:
+            self._request({"type": "register_object", "id": hex_id, "name": shm_name, "size": size})
+        return ObjectRef(oid, self.client_address)
+
+    # ----------------------------------------------------------------- get
+    def _read_location(self, loc: dict, hex_id: str) -> Any:
+        status = loc["status"]
+        if status == "inline":
+            return serialization.unpack(loc["data"])
+        if status == "shm":
+            return self.local_store.read(loc["name"])
+        if status == "spilled":
+            return self.local_store.read_from_file(loc["path"])
+        raise RayTpuError(f"Object {hex_id} unavailable: {status}")
+
+    def get(self, refs: Sequence[ObjectRef], timeout: Optional[float]) -> List[Any]:
+        if not refs:
+            return []
+        blocked = False
+        if self.role == "worker" and self.worker is not None:
+            blocked = True
+            self.worker.send({"type": "worker_blocked", "worker_id": self.worker.worker_id})
+        try:
+            async def gather():
+                reqs = [
+                    self.conn.request({"type": "get_object", "id": r.id.hex(), "timeout": timeout})
+                    for r in refs
+                ]
+                return await asyncio.gather(*reqs)
+
+            locs = self.io.call(gather(), None if timeout is None else timeout + 30)
+        finally:
+            if blocked:
+                self.worker.send(
+                    {"type": "worker_unblocked", "worker_id": self.worker.worker_id}
+                )
+        out = []
+        for r, loc in zip(refs, locs):
+            if loc["status"] == "timeout":
+                raise GetTimeoutError(f"Timed out getting {r.id.hex()}")
+            out.append(self._read_location(loc, r.id.hex()))
+        return out
+
+    def wait(self, refs, num_returns, timeout):
+        ids = [r.id.hex() for r in refs]
+        resp = self._request(
+            {"type": "wait_objects", "ids": ids, "num_returns": num_returns, "timeout": timeout},
+            timeout=None,
+        )
+        ready_set = set(resp["ready"])
+        ready = [r for r in refs if r.id.hex() in ready_set][:num_returns]
+        chosen = {r.id.hex() for r in ready}
+        not_ready = [r for r in refs if r.id.hex() not in chosen]
+        return ready, not_ready
+
+    # --------------------------------------------------------------- tasks
+    def submit_task(self, spec: TaskSpec) -> None:
+        self._send({"type": "submit_task", "spec": cloudpickle.dumps(spec)})
+
+    def create_actor(self, spec: TaskSpec, name: str, namespace: str) -> None:
+        from .actor import ActorHandle
+
+        handle = ActorHandle(spec.actor_id, spec.name, dict(spec.method_meta))
+        resp = self._request(
+            {
+                "type": "create_actor",
+                "spec": cloudpickle.dumps(spec),
+                "name": name,
+                "namespace": namespace or "default",
+                "handle": cloudpickle.dumps(handle),
+            }
+        )
+        if resp and resp.get("error"):
+            raise ValueError(resp["error"])
+
+    def submit_actor_task(self, spec: TaskSpec) -> None:
+        self._send({"type": "submit_actor_task", "spec": cloudpickle.dumps(spec)})
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool) -> None:
+        self._request({"type": "kill_actor", "actor": actor_id.hex(), "no_restart": no_restart})
+
+    def cancel(self, ref: ObjectRef, force: bool, recursive: bool) -> None:
+        self._request({"type": "cancel", "task": ref.id.task_id().hex(), "force": force})
+
+    def get_named_actor(self, name: str, namespace: str) -> Optional[bytes]:
+        resp = self._request({"type": "get_named_actor", "name": name, "namespace": namespace})
+        return resp.get("handle")
+
+    # ------------------------------------------------------------- cluster
+    def cluster_resources(self) -> Dict[str, float]:
+        return self._request({"type": "cluster_resources"})["total"]
+
+    def available_resources(self) -> Dict[str, float]:
+        return self._request({"type": "cluster_resources"})["available"]
+
+    def nodes(self) -> List[dict]:
+        return self._request({"type": "nodes"})["nodes"]
+
+    def state_summary(self) -> dict:
+        return self._request({"type": "state_summary"})
+
+    # ----------------------------------------------------- placement groups
+    def create_placement_group(self, pg_id, bundles, strategy, name) -> None:
+        self._request(
+            {
+                "type": "create_pg",
+                "id": pg_id.hex(),
+                "bundles": bundles,
+                "strategy": strategy,
+                "name": name,
+            }
+        )
+
+    def placement_group_ready(self, pg_id, timeout) -> bool:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if self._request({"type": "pg_ready", "id": pg_id.hex()})["ready"]:
+                return True
+            if deadline is not None and time.monotonic() >= deadline:
+                return False
+            time.sleep(0.05)
+
+    def remove_placement_group(self, pg_id) -> None:
+        self._request({"type": "remove_pg", "id": pg_id.hex()})
+
+    def free_objects(self, refs: Sequence[ObjectRef]) -> None:
+        self._request({"type": "free_objects", "ids": [r.id.hex() for r in refs]})
+
+    # ------------------------------------------------------------ shutdown
+    def shutdown(self) -> None:
+        if self.role == "driver":
+            try:
+                self._request({"type": "shutdown"}, timeout=2)
+            except Exception:  # noqa: BLE001
+                pass
+            if self._controller_proc is not None:
+                try:
+                    self._controller_proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    self._controller_proc.terminate()
+        if self.conn is not None:
+            self.conn.close()
+        self.local_store.close_all()
+        self.io.stop()

@@ -1,0 +1,994 @@
+"""Controller — head process combining the reference's GCS + raylet roles.
+
+Reference analogs:
+  * cluster/actor/PG/object directories — GCS (`src/ray/gcs/gcs_server`)
+  * task queueing, dispatch, worker pool  — raylet (`src/ray/raylet/node_manager.cc`,
+    `worker_pool.h:156`, `local_task_manager.cc`)
+  * object lifetime/spill — `LocalObjectManager` + plasma eviction
+
+Redesign rationale (TPU-first): one asyncio process owns all cluster state —
+no cross-process GCS↔raylet protocol on a single machine; the multi-node seam
+is the node-registration handler (`register_node`), which remote node daemons
+use, keeping scheduler state per-node the way `ClusterResourceManager` does.
+
+Data plane stays OUT of this process: objects ride named shm segments
+(store.py); the controller holds only locations, sizes, refstate, and waiters.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import os
+import subprocess
+import sys
+import time
+import traceback
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+import cloudpickle
+
+from . import serialization, store
+from .exceptions import (
+    ActorDiedError,
+    TaskCancelledError,
+    TaskError,
+    WorkerCrashedError,
+)
+from .rpc import Connection, read_msg
+from .task_spec import TaskSpec, TaskType
+
+IDLE = "idle"
+BUSY = "busy"
+STARTING = "starting"
+ACTOR = "actor"
+DEAD = "dead"
+
+
+@dataclass
+class WorkerState:
+    worker_id: str
+    conn: Optional[Connection] = None
+    pid: int = 0
+    state: str = STARTING
+    current_task: Optional[str] = None  # task hex
+    actor_hex: Optional[str] = None
+    assigned: Dict[str, float] = field(default_factory=dict)
+    blocked: bool = False
+    node_id: str = "node0"
+    has_tpu: bool = False
+
+
+@dataclass
+class ObjectState:
+    status: str = "pending"  # pending | ready
+    inline: Optional[bytes] = None
+    shm_name: Optional[str] = None
+    spilled_path: Optional[str] = None
+    size: int = 0
+    last_access: float = 0.0
+    events: List[asyncio.Event] = field(default_factory=list)
+    # Tasks blocked on this object (by task hex).
+    dependents: Set[str] = field(default_factory=set)
+
+
+@dataclass
+class ActorState:
+    actor_hex: str
+    spec: Optional[TaskSpec] = None  # creation spec kept for restarts
+    worker_id: Optional[str] = None
+    state: str = "pending"  # pending | alive | restarting | dead
+    name: str = ""
+    namespace: str = "default"
+    handle_bytes: bytes = b""
+    restarts_used: int = 0
+    # Submission-ordered calls not yet delivered to the worker. A single pump
+    # coroutine drains this FIFO so per-actor call order is preserved even
+    # when some calls wait on unready args (reference analog: the ordered
+    # `ActorSchedulingQueue`).
+    send_queue: deque = field(default_factory=deque)
+    # Calls delivered to the worker and not yet completed: task hex -> spec.
+    inflight: Dict[str, TaskSpec] = field(default_factory=dict)
+    pump_active: bool = False
+    state_event: asyncio.Event = field(default_factory=asyncio.Event)
+    detached: bool = False
+    init_error: Optional[TaskError] = None
+
+
+@dataclass
+class PendingTask:
+    spec: TaskSpec
+    deps_remaining: Set[str] = field(default_factory=set)
+    retries_left: int = 0
+
+
+class Controller:
+    def __init__(
+        self,
+        num_cpus: float,
+        resources: Dict[str, float],
+        session_dir: str,
+        object_store_memory: Optional[int] = None,
+        port: int = 0,
+    ):
+        self.session_dir = session_dir
+        os.makedirs(session_dir, exist_ok=True)
+        self.spill_dir = os.path.join(session_dir, "spill")
+        self.port = port
+        self.total_resources = {"CPU": float(num_cpus), **resources}
+        self.available = dict(self.total_resources)
+        self.object_store_memory = object_store_memory or int(
+            min(0.3 * os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES"), 64 << 30)
+        )
+        self.store_bytes_used = 0
+        self.local_store = store.LocalStore()
+
+        self.objects: Dict[str, ObjectState] = {}
+        self.workers: Dict[str, WorkerState] = {}
+        self.actors: Dict[str, ActorState] = {}
+        self.named_actors: Dict[Tuple[str, str], str] = {}
+        self.pgs: Dict[str, dict] = {}
+        self.ready_queue: deque = deque()  # PendingTask with no deps
+        self.waiting_tasks: Dict[str, PendingTask] = {}  # task hex -> waiting on deps
+        self.running: Dict[str, Tuple[str, PendingTask]] = {}  # task hex -> (worker, pt)
+        self.cancelled: Set[str] = set()
+        self.timeline: List[dict] = []
+        self.drivers: Set[Connection] = set()
+        self._worker_counter = itertools.count()
+        self._spawning = 0
+        self._spawning_tpu = 0
+        self._max_workers = max(int(num_cpus) * 4, 8)
+        self._min_workers = 2
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._shutdown_event = asyncio.Event()
+        self._worker_procs: Dict[str, subprocess.Popen] = {}
+
+    # ------------------------------------------------------------ lifecycle
+    async def start(self):
+        store.set_session_tag(str(os.getpid()))
+        store.cleanup_stale_segments()
+        self._server = await asyncio.start_server(
+            self._on_connection, host="127.0.0.1", port=self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        for _ in range(self._min_workers):
+            self._spawn_worker()
+
+    async def serve_forever(self):
+        await self._shutdown_event.wait()
+        await self._teardown()
+
+    async def _teardown(self):
+        for ws in self.workers.values():
+            if ws.conn is not None:
+                try:
+                    await ws.conn.send({"type": "exit"})
+                except Exception:  # noqa: BLE001
+                    pass
+        await asyncio.sleep(0.05)
+        for proc in self._worker_procs.values():
+            if proc.poll() is None:
+                proc.terminate()
+        for obj in self.objects.values():
+            if obj.shm_name:
+                self.local_store.release(obj.shm_name, unlink=True)
+        self.local_store.close_all(unlink=False)
+        if self._server:
+            self._server.close()
+
+    # ------------------------------------------------------------- workers
+    def _spawn_worker(self, tpu: bool = False):
+        if tpu:
+            if self._spawning_tpu > 0:
+                return
+            self._spawning_tpu += 1
+        elif (
+            self._spawning + len([w for w in self.workers.values() if w.state != DEAD])
+            >= self._max_workers
+        ):
+            return
+        self._spawning += 1
+        worker_id = f"w{next(self._worker_counter)}"
+        env = dict(os.environ)
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        env["RAY_TPU_WORKER_ID"] = worker_id
+        env["RAY_TPU_ADDRESS"] = f"127.0.0.1:{self.port}"
+        env["RAY_TPU_SESSION_DIR"] = self.session_dir
+        env["RAY_TPU_SESSION_TAG"] = store.SESSION_TAG
+        if tpu:
+            env["RAY_TPU_WORKER_TPU"] = "1"
+        else:
+            # CPU worker: strip the TPU plugin hookup. This both (a) isolates
+            # the chip — only workers granted a TPU resource may attach it
+            # (reference precedent: TPU_VISIBLE_CHIPS, `accelerators/tpu.py:30`)
+            # — and (b) keeps worker startup fast (the site-level TPU plugin
+            # registration imports jax, ~2s of CPU per process).
+            env["RAY_TPU_WORKER_TPU"] = "0"
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            if env.get("JAX_PLATFORMS", "").lower() in ("", "axon", "tpu"):
+                env["JAX_PLATFORMS"] = "cpu"
+        log_path = os.path.join(self.session_dir, f"worker-{worker_id}.log")
+        log_f = open(log_path, "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.worker_main"],
+            env=env,
+            stdout=log_f,
+            stderr=subprocess.STDOUT,
+            cwd=pkg_root,
+        )
+        self._worker_procs[worker_id] = proc
+
+    # ---------------------------------------------------------- connection
+    async def _on_connection(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        conn = Connection(reader, writer)
+        meta = {"kind": None, "worker_id": None}
+
+        async def on_push(msg: dict):
+            try:
+                await self._dispatch_msg(conn, meta, msg)
+            except Exception:  # noqa: BLE001
+                traceback.print_exc()
+
+        async def on_close():
+            await self._on_disconnect(conn, meta)
+
+        conn.on_push = on_push
+        conn.on_close = on_close
+        conn.start()
+
+    # Handlers that may await object readiness. They only READ shared state, so
+    # they run as detached tasks — otherwise a long-poll would block the
+    # connection's read loop and deadlock clients that get() on one thread
+    # while another thread produces the object.
+    _LONG_POLL = frozenset({"get_object", "wait_objects"})
+
+    async def _dispatch_msg(self, conn: Connection, meta: dict, msg: dict):
+        mtype = msg["type"]
+        handler = getattr(self, f"h_{mtype}", None)
+        if handler is None:
+            if msg.get("req_id") is not None:
+                await conn.respond(msg["req_id"], {"error": f"unknown message {mtype}"})
+            return
+
+        async def run():
+            result = await handler(conn, meta, msg)
+            if msg.get("req_id") is not None:
+                await conn.respond(msg["req_id"], result)
+
+        if mtype in self._LONG_POLL:
+            asyncio.ensure_future(run())
+        else:
+            await run()
+
+    async def _on_disconnect(self, conn: Connection, meta: dict):
+        if meta["kind"] == "worker":
+            await self._on_worker_death(meta["worker_id"])
+        elif meta["kind"] == "driver":
+            self.drivers.discard(conn)
+            if not self.drivers:
+                # Last driver gone → end the session.
+                self._shutdown_event.set()
+
+    # ----------------------------------------------------------- handlers
+    async def h_register_driver(self, conn, meta, msg):
+        meta["kind"] = "driver"
+        self.drivers.add(conn)
+        return {
+            "ok": True,
+            "session_dir": self.session_dir,
+            "session_tag": store.SESSION_TAG,
+        }
+
+    async def h_register_client(self, conn, meta, msg):
+        # Secondary connection from a worker's nested-API backend.
+        meta["kind"] = "client"
+        return {"ok": True}
+
+    async def h_register_worker(self, conn, meta, msg):
+        worker_id = msg["worker_id"]
+        meta["kind"] = "worker"
+        meta["worker_id"] = worker_id
+        ws = WorkerState(
+            worker_id=worker_id,
+            conn=conn,
+            pid=msg.get("pid", 0),
+            state=IDLE,
+            has_tpu=bool(msg.get("has_tpu")),
+        )
+        self.workers[worker_id] = ws
+        self._spawning = max(0, self._spawning - 1)
+        if ws.has_tpu:
+            self._spawning_tpu = max(0, self._spawning_tpu - 1)
+        self._schedule()
+        return {"ok": True}
+
+    async def h_shutdown(self, conn, meta, msg):
+        self._shutdown_event.set()
+        return {"ok": True}
+
+    # ------------------------------------------------------------- objects
+    def _obj(self, hex_id: str) -> ObjectState:
+        obj = self.objects.get(hex_id)
+        if obj is None:
+            obj = self.objects[hex_id] = ObjectState()
+        return obj
+
+    def _mark_ready(
+        self,
+        hex_id: str,
+        inline: Optional[bytes] = None,
+        shm_name: Optional[str] = None,
+        size: int = 0,
+    ):
+        obj = self._obj(hex_id)
+        obj.status = "ready"
+        obj.inline = inline
+        obj.shm_name = shm_name
+        obj.size = size
+        obj.last_access = time.monotonic()
+        if shm_name:
+            self.store_bytes_used += size
+        for ev in obj.events:
+            ev.set()
+        obj.events.clear()
+        # Unblock tasks waiting on this object.
+        for task_hex in list(obj.dependents):
+            pt = self.waiting_tasks.get(task_hex)
+            if pt is not None:
+                pt.deps_remaining.discard(hex_id)
+                if not pt.deps_remaining:
+                    del self.waiting_tasks[task_hex]
+                    self.ready_queue.append(pt)
+        obj.dependents.clear()
+        self._maybe_spill()
+        self._schedule()
+
+    def _store_error_object(self, hex_id: str, err: TaskError):
+        frame = serialization.pack(err)
+        self._mark_ready(hex_id, inline=frame)
+
+    def _location_payload(self, obj: ObjectState) -> dict:
+        obj.last_access = time.monotonic()
+        if obj.inline is not None:
+            return {"status": "inline", "data": obj.inline}
+        if obj.shm_name is not None:
+            return {"status": "shm", "name": obj.shm_name, "size": obj.size}
+        if obj.spilled_path is not None:
+            return {"status": "spilled", "path": obj.spilled_path}
+        return {"status": "lost"}
+
+    async def h_put_inline(self, conn, meta, msg):
+        self._mark_ready(msg["id"], inline=msg["data"], size=len(msg["data"]))
+        return {"ok": True}
+
+    async def h_register_object(self, conn, meta, msg):
+        self._mark_ready(msg["id"], shm_name=msg["name"], size=msg["size"])
+        return {"ok": True}
+
+    async def h_get_object(self, conn, meta, msg):
+        hex_id = msg["id"]
+        timeout = msg.get("timeout")
+        obj = self._obj(hex_id)
+        if obj.status != "ready":
+            ev = asyncio.Event()
+            obj.events.append(ev)
+            try:
+                if timeout is None:
+                    await ev.wait()
+                else:
+                    await asyncio.wait_for(ev.wait(), timeout)
+            except asyncio.TimeoutError:
+                return {"status": "timeout"}
+            finally:
+                # _mark_ready clears the list; on timeout remove ourselves so
+                # never-produced objects don't accumulate dead events.
+                if ev in obj.events:
+                    obj.events.remove(ev)
+        return self._location_payload(obj)
+
+    async def h_wait_objects(self, conn, meta, msg):
+        ids: List[str] = msg["ids"]
+        num_returns: int = msg["num_returns"]
+        timeout = msg.get("timeout")
+        deadline = None if timeout is None else time.monotonic() + timeout
+
+        def ready_ids():
+            return [h for h in ids if self.objects.get(h) and self.objects[h].status == "ready"]
+
+        # Register one event per not-ready object up front; wake on any.
+        registered: List[Tuple[ObjectState, asyncio.Event]] = []
+        waiters: Dict[asyncio.Task, None] = {}
+        try:
+            for h in ids:
+                obj = self._obj(h)
+                if obj.status != "ready":
+                    ev = asyncio.Event()
+                    obj.events.append(ev)
+                    registered.append((obj, ev))
+                    waiters[asyncio.ensure_future(ev.wait())] = None
+            while True:
+                ready = ready_ids()
+                if len(ready) >= num_returns or not waiters:
+                    return {"ready": ready}
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return {"ready": ready}
+                done, _ = await asyncio.wait(
+                    list(waiters), timeout=remaining, return_when=asyncio.FIRST_COMPLETED
+                )
+                if not done:
+                    return {"ready": ready_ids()}
+                for t in done:
+                    waiters.pop(t, None)
+        finally:
+            for t in waiters:
+                t.cancel()
+            for obj, ev in registered:
+                if ev in obj.events:
+                    obj.events.remove(ev)
+
+    async def h_free_objects(self, conn, meta, msg):
+        for hex_id in msg["ids"]:
+            obj = self.objects.pop(hex_id, None)
+            if obj and obj.shm_name:
+                self.store_bytes_used -= obj.size
+                self.local_store.release(obj.shm_name, unlink=True)
+        return {"ok": True}
+
+    # ------------------------------------------------------------ spilling
+    def _maybe_spill(self):
+        if self.store_bytes_used <= self.object_store_memory:
+            return
+        candidates = sorted(
+            (
+                (o.last_access, h, o)
+                for h, o in self.objects.items()
+                if o.status == "ready" and o.shm_name
+            ),
+        )
+        for _, hex_id, obj in candidates:
+            if self.store_bytes_used <= self.object_store_memory * 0.8:
+                break
+            try:
+                path = self.local_store.spill(obj.shm_name, self.spill_dir)
+            except FileNotFoundError:
+                continue
+            self.store_bytes_used -= obj.size
+            obj.spilled_path = path
+            obj.shm_name = None
+            self._event("object_spilled", object=hex_id, size=obj.size)
+
+    # --------------------------------------------------------------- tasks
+    def _infeasible(self, demand: Dict[str, float]) -> Dict[str, float]:
+        return {k: v for k, v in demand.items() if self.total_resources.get(k, 0.0) < v}
+
+    async def h_submit_task(self, conn, meta, msg):
+        spec: TaskSpec = cloudpickle.loads(msg["spec"])
+        bad = self._infeasible(spec.resources)
+        if bad:
+            err = TaskError(
+                RuntimeError(
+                    f"Task {spec.name} demands {bad} but the cluster total is "
+                    f"{self.total_resources} — infeasible, will never schedule."
+                ),
+                "",
+                spec.name,
+            )
+            for oid in spec.return_ids:
+                self._store_error_object(oid.hex(), err)
+            return {"ok": False}
+        pt = PendingTask(spec=spec, retries_left=spec.options.max_retries)
+        self._event("task_submitted", task=spec.task_id.hex(), name=spec.name)
+        self._enqueue(pt)
+        self._schedule()
+        return {"ok": True}
+
+    def _enqueue(self, pt: PendingTask):
+        spec = pt.spec
+        deps = set()
+        for oid in spec.arg_refs:
+            h = oid.hex()
+            obj = self._obj(h)
+            if obj.status != "ready":
+                deps.add(h)
+                obj.dependents.add(spec.task_id.hex())
+        pt.deps_remaining = deps
+        if deps:
+            self.waiting_tasks[spec.task_id.hex()] = pt
+        else:
+            self.ready_queue.append(pt)
+
+    def _resources_fit(self, demand: Dict[str, float]) -> bool:
+        return all(self.available.get(k, 0.0) + 1e-9 >= v for k, v in demand.items())
+
+    def _acquire(self, demand: Dict[str, float]):
+        for k, v in demand.items():
+            self.available[k] = self.available.get(k, 0.0) - v
+
+    def _release(self, demand: Dict[str, float]):
+        for k, v in demand.items():
+            self.available[k] = self.available.get(k, 0.0) + v
+
+    def _idle_worker(self, need_tpu: bool = False) -> Optional[WorkerState]:
+        fallback = None
+        for ws in self.workers.values():
+            if ws.state != IDLE:
+                continue
+            if need_tpu:
+                if ws.has_tpu:
+                    return ws
+            else:
+                # Prefer CPU workers; keep TPU workers free for TPU tasks.
+                if not ws.has_tpu:
+                    return ws
+                fallback = ws
+        return None if need_tpu else fallback
+
+    def _deps_payload(self, spec: TaskSpec) -> dict:
+        locs = {}
+        for oid in spec.arg_refs:
+            h = oid.hex()
+            locs[h] = self._location_payload(self.objects[h])
+        return locs
+
+    def _schedule(self):
+        """Dispatch as many ready tasks as resources + workers allow.
+
+        Reference analog: `LocalTaskManager::ScheduleAndDispatchTasks`.
+        """
+        made_progress = True
+        while made_progress and self.ready_queue:
+            made_progress = False
+            # Bounded head scan: dispatch FIFO, skipping over at most a small
+            # window of blocked tasks (so a TPU task at the head can't starve
+            # CPU tasks behind it, but a long queue isn't rescanned per event).
+            scan = min(len(self.ready_queue), 64)
+            no_idle_worker = False
+            for _ in range(scan):
+                if no_idle_worker:
+                    break
+                pt = self.ready_queue.popleft()
+                spec = pt.spec
+                if spec.task_id.hex() in self.cancelled:
+                    self._finish_cancelled(pt)
+                    made_progress = True
+                    continue
+                demand = spec.resources
+                if not self._resources_fit(demand):
+                    self.ready_queue.append(pt)
+                    continue
+                need_tpu = demand.get("TPU", 0) > 0
+                ws = self._idle_worker(need_tpu)
+                if ws is None:
+                    self.ready_queue.append(pt)
+                    if need_tpu:
+                        self._spawn_worker(tpu=True)
+                    else:
+                        # No idle CPU worker — scanning further is pointless.
+                        no_idle_worker = True
+                    continue
+                self._acquire(demand)
+                ws.assigned = dict(demand)
+                task_hex = spec.task_id.hex()
+                self.running[task_hex] = (ws.worker_id, pt)
+                if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+                    ws.state = ACTOR
+                    ws.actor_hex = spec.actor_id.hex()
+                    asyncio.ensure_future(
+                        ws.conn.send(
+                            {
+                                "type": "create_actor",
+                                "spec": cloudpickle.dumps(spec),
+                                "deps": self._deps_payload(spec),
+                            }
+                        )
+                    )
+                else:
+                    ws.state = BUSY
+                    ws.current_task = task_hex
+                    asyncio.ensure_future(
+                        ws.conn.send(
+                            {
+                                "type": "execute_task",
+                                "spec": cloudpickle.dumps(spec),
+                                "deps": self._deps_payload(spec),
+                            }
+                        )
+                    )
+                self._event("task_dispatched", task=task_hex, worker=ws.worker_id)
+                made_progress = True
+        # Top the pool up to the queue depth (reference analog: worker_pool
+        # PrestartWorkers on backlog hints, `worker_pool.h:354`).
+        starting = self._spawning + sum(1 for w in self.workers.values() if w.state == STARTING)
+        cpu_backlog = sum(1 for pt in self.ready_queue if pt.spec.resources.get("TPU", 0) == 0)
+        deficit = cpu_backlog - starting
+        for _ in range(max(0, min(deficit, 6))):
+            self._spawn_worker()
+
+    def _finish_cancelled(self, pt: PendingTask):
+        err = TaskError(TaskCancelledError(), "", pt.spec.name)
+        for oid in pt.spec.return_ids:
+            self._store_error_object(oid.hex(), err)
+
+    async def h_task_done(self, conn, meta, msg):
+        task_hex = msg["task"]
+        self.running.pop(task_hex, None)
+        ws = self.workers.get(meta["worker_id"]) if meta["worker_id"] else None
+        if ws is not None and ws.state == BUSY:
+            ws.state = IDLE
+            ws.current_task = None
+            self._release(ws.assigned)
+            ws.assigned = {}
+        if ws is not None and ws.actor_hex:
+            astate = self.actors.get(ws.actor_hex)
+            if astate is not None:
+                astate.inflight.pop(task_hex, None)
+        for item in msg["results"]:
+            if item.get("inline") is not None:
+                self._mark_ready(item["id"], inline=item["inline"], size=len(item["inline"]))
+            else:
+                self._mark_ready(item["id"], shm_name=item["name"], size=item["size"])
+        self._event("task_done", task=task_hex)
+        self._schedule()
+        return None
+
+    async def h_actor_ready(self, conn, meta, msg):
+        actor_hex = msg["actor"]
+        astate = self.actors.get(actor_hex)
+        task_hex = msg.get("task")
+        if task_hex:
+            self.running.pop(task_hex, None)
+        if astate is None:
+            return None
+        if msg.get("error") is not None:
+            err = serialization.unpack(msg["error"])
+            astate.init_error = err
+            self._set_actor_state(astate, "dead")
+            self._drain_actor_queue(astate, err)
+            return None
+        ws = self.workers.get(meta["worker_id"])
+        if ws is not None:
+            astate.worker_id = ws.worker_id
+        self._set_actor_state(astate, "alive")
+        self._event("actor_alive", actor=actor_hex)
+        return None
+
+    def _set_actor_state(self, astate: ActorState, state: str):
+        astate.state = state
+        astate.state_event.set()
+
+    def _drain_actor_queue(self, astate: ActorState, err: TaskError):
+        while astate.send_queue:
+            spec = astate.send_queue.popleft()
+            for oid in spec.return_ids:
+                self._store_error_object(oid.hex(), err)
+
+    # -------------------------------------------------------------- actors
+    async def h_create_actor(self, conn, meta, msg):
+        spec: TaskSpec = cloudpickle.loads(msg["spec"])
+        actor_hex = spec.actor_id.hex()
+        bad = self._infeasible(spec.resources)
+        if bad:
+            astate = ActorState(actor_hex=actor_hex, spec=None, state="dead")
+            astate.init_error = TaskError(
+                RuntimeError(
+                    f"Actor {spec.name} demands {bad} but the cluster total is "
+                    f"{self.total_resources} — infeasible."
+                ),
+                "",
+                spec.name,
+            )
+            self.actors[actor_hex] = astate
+            return {"ok": False}
+        astate = ActorState(
+            actor_hex=actor_hex,
+            spec=spec,
+            name=msg.get("name", ""),
+            namespace=msg.get("namespace", "default"),
+            handle_bytes=msg.get("handle", b""),
+            detached=spec.options.lifetime == "detached",
+        )
+        self.actors[actor_hex] = astate
+        if astate.name:
+            key = (astate.namespace, astate.name)
+            if key in self.named_actors:
+                return {"error": f"Actor name '{astate.name}' already taken"}
+            self.named_actors[key] = actor_hex
+        pt = PendingTask(spec=spec, retries_left=0)
+        self._event("actor_created", actor=actor_hex, name=astate.name)
+        self._enqueue(pt)
+        self._schedule()
+        return {"ok": True}
+
+    async def _send_actor_task(self, astate: ActorState, spec: TaskSpec):
+        ws = self.workers.get(astate.worker_id)
+        if ws is None or ws.conn is None or ws.state == DEAD:
+            err = TaskError(ActorDiedError(), "", spec.name)
+            for oid in spec.return_ids:
+                self._store_error_object(oid.hex(), err)
+            return
+        await ws.conn.send(
+            {
+                "type": "execute_actor_task",
+                "spec": cloudpickle.dumps(spec),
+                "deps": self._deps_payload_safe(spec),
+            }
+        )
+
+    def _deps_payload_safe(self, spec: TaskSpec) -> dict:
+        locs = {}
+        for oid in spec.arg_refs:
+            h = oid.hex()
+            obj = self.objects.get(h)
+            locs[h] = self._location_payload(obj) if obj and obj.status == "ready" else {"status": "pending"}
+        return locs
+
+    async def h_submit_actor_task(self, conn, meta, msg):
+        spec: TaskSpec = cloudpickle.loads(msg["spec"])
+        actor_hex = spec.actor_id.hex()
+        astate = self.actors.get(actor_hex)
+        if astate is None or astate.state == "dead":
+            err = astate.init_error if astate else None
+            err = err or TaskError(ActorDiedError(), "", spec.name)
+            for oid in spec.return_ids:
+                self._store_error_object(oid.hex(), err)
+            return {"ok": False}
+        astate.send_queue.append(spec)
+        if not astate.pump_active:
+            asyncio.ensure_future(self._pump_actor(astate))
+        return {"ok": True}
+
+    async def _pump_actor(self, astate: ActorState):
+        """Deliver this actor's calls strictly in submission order: wait for
+        each call's args and for the actor to be alive before sending."""
+        if astate.pump_active:
+            return
+        astate.pump_active = True
+        try:
+            while astate.send_queue:
+                spec = astate.send_queue[0]
+                for oid in spec.arg_refs:
+                    obj = self._obj(oid.hex())
+                    while obj.status != "ready":
+                        ev = asyncio.Event()
+                        obj.events.append(ev)
+                        await ev.wait()
+                while astate.state in ("pending", "restarting"):
+                    astate.state_event.clear()
+                    await astate.state_event.wait()
+                if not astate.send_queue or astate.send_queue[0] is not spec:
+                    continue  # queue drained by a death path while we waited
+                astate.send_queue.popleft()
+                if astate.state == "dead":
+                    err = astate.init_error or TaskError(ActorDiedError(), "", spec.name)
+                    for oid in spec.return_ids:
+                        self._store_error_object(oid.hex(), err)
+                    continue
+                astate.inflight[spec.task_id.hex()] = spec
+                await self._send_actor_task(astate, spec)
+        finally:
+            astate.pump_active = False
+
+    async def h_kill_actor(self, conn, meta, msg):
+        actor_hex = msg["actor"]
+        no_restart = msg.get("no_restart", True)
+        astate = self.actors.get(actor_hex)
+        if astate is None:
+            return {"ok": False}
+        self._set_actor_state(astate, "dead")
+        if no_restart:
+            astate.spec = None
+        self._drain_actor_queue(
+            astate, TaskError(ActorDiedError("Actor was killed."), "", "actor task")
+        )
+        for key, ah in list(self.named_actors.items()):
+            if ah == actor_hex:
+                del self.named_actors[key]
+        ws = self.workers.get(astate.worker_id)
+        if ws is not None:
+            proc = self._worker_procs.get(ws.worker_id)
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+        return {"ok": True}
+
+    async def h_get_named_actor(self, conn, meta, msg):
+        key = (msg.get("namespace", "default"), msg["name"])
+        actor_hex = self.named_actors.get(key)
+        if actor_hex is None:
+            return {"handle": None}
+        astate = self.actors.get(actor_hex)
+        return {"handle": astate.handle_bytes if astate else None}
+
+    # -------------------------------------------------------- worker death
+    async def _on_worker_death(self, worker_id: str):
+        ws = self.workers.get(worker_id)
+        if ws is None:
+            return
+        prev_state = ws.state
+        ws.state = DEAD
+        if ws.assigned:
+            if not ws.blocked:
+                self._release(ws.assigned)
+            ws.assigned = {}
+        self._worker_procs.pop(worker_id, None)
+        if prev_state == BUSY and ws.current_task:
+            entry = self.running.pop(ws.current_task, None)
+            if entry is not None:
+                _, pt = entry
+                if ws.current_task in self.cancelled:
+                    self._finish_cancelled(pt)
+                elif pt.retries_left > 0:
+                    pt.retries_left -= 1
+                    pt.spec.attempt_number += 1
+                    self._event("task_retry", task=ws.current_task)
+                    self._enqueue(pt)
+                else:
+                    err = TaskError(
+                        WorkerCrashedError(f"Worker {worker_id} died executing task"),
+                        "",
+                        pt.spec.name,
+                    )
+                    for oid in pt.spec.return_ids:
+                        self._store_error_object(oid.hex(), err)
+        if prev_state == ACTOR and ws.actor_hex:
+            await self._on_actor_worker_death(ws.actor_hex)
+        # Keep the pool topped up.
+        alive = [w for w in self.workers.values() if w.state in (IDLE, STARTING)]
+        if not alive and (self.ready_queue or self.waiting_tasks):
+            self._spawn_worker()
+        self._schedule()
+
+    async def _on_actor_worker_death(self, actor_hex: str):
+        astate = self.actors.get(actor_hex)
+        if astate is None or astate.state == "dead":
+            return
+        spec = astate.spec
+        max_restarts = spec.options.max_restarts if spec else 0
+        # Calls delivered to the dead worker can never complete — fail exactly
+        # those (tracked in `inflight`; queued-but-unsent calls are unaffected).
+        from .exceptions import ActorUnavailableError
+
+        if spec is not None and (max_restarts == -1 or astate.restarts_used < max_restarts):
+            astate.restarts_used += 1
+            self._set_actor_state(astate, "restarting")
+            self._event("actor_restarting", actor=actor_hex)
+            err = TaskError(
+                ActorUnavailableError(f"actor {actor_hex[:12]} restarting"), "", "actor task"
+            )
+            for ispec in astate.inflight.values():
+                for oid in ispec.return_ids:
+                    if self._obj(oid.hex()).status != "ready":
+                        self._store_error_object(oid.hex(), err)
+            astate.inflight.clear()
+            pt = PendingTask(spec=spec, retries_left=0)
+            self._enqueue(pt)
+            self._schedule()
+        else:
+            self._set_actor_state(astate, "dead")
+            err = TaskError(ActorDiedError(), "", f"actor {actor_hex[:12]}")
+            self._drain_actor_queue(astate, err)
+            for ispec in astate.inflight.values():
+                for oid in ispec.return_ids:
+                    if self._obj(oid.hex()).status != "ready":
+                        self._store_error_object(oid.hex(), err)
+            astate.inflight.clear()
+
+    # ------------------------------------------------------------ blocking
+    async def h_worker_blocked(self, conn, meta, msg):
+        ws = self.workers.get(msg["worker_id"])
+        if ws is not None and not ws.blocked:
+            ws.blocked = True
+            self._release(ws.assigned)
+            self._schedule()
+        return None
+
+    async def h_worker_unblocked(self, conn, meta, msg):
+        ws = self.workers.get(msg["worker_id"])
+        if ws is not None and ws.blocked:
+            ws.blocked = False
+            self._acquire(ws.assigned)
+        return None
+
+    # ------------------------------------------------------------- cancel
+    async def h_cancel(self, conn, meta, msg):
+        task_hex = msg["task"]
+        self.cancelled.add(task_hex)
+        entry = self.running.get(task_hex)
+        if entry is not None and msg.get("force"):
+            worker_id, _ = entry
+            proc = self._worker_procs.get(worker_id)
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+        # Pending-in-queue tasks are culled in _schedule.
+        pt = self.waiting_tasks.pop(task_hex, None)
+        if pt is not None:
+            self._finish_cancelled(pt)
+        self._schedule()
+        return {"ok": True}
+
+    # ---------------------------------------------------- placement groups
+    async def h_create_pg(self, conn, meta, msg):
+        bundles: List[Dict[str, float]] = msg["bundles"]
+        strategy = msg["strategy"]
+        feasible = True
+        if strategy == "STRICT_SPREAD" and len(bundles) > 1:
+            feasible = False  # single-node cluster cannot strictly spread
+        total: Dict[str, float] = {}
+        for b in bundles:
+            for k, v in b.items():
+                total[k] = total.get(k, 0.0) + v
+        if not all(self.total_resources.get(k, 0.0) >= v for k, v in total.items()):
+            feasible = False
+        if feasible:
+            self._acquire(total)
+        self.pgs[msg["id"]] = {
+            "bundles": bundles,
+            "strategy": strategy,
+            "name": msg.get("name", ""),
+            "ready": feasible,
+            "reserved": total if feasible else {},
+        }
+        return {"ok": feasible}
+
+    async def h_pg_ready(self, conn, meta, msg):
+        pg = self.pgs.get(msg["id"])
+        return {"ready": bool(pg and pg["ready"])}
+
+    async def h_remove_pg(self, conn, meta, msg):
+        pg = self.pgs.pop(msg["id"], None)
+        if pg and pg["ready"]:
+            self._release(pg["reserved"])
+            self._schedule()
+        return {"ok": True}
+
+    # -------------------------------------------------------------- state
+    async def h_cluster_resources(self, conn, meta, msg):
+        return {"total": dict(self.total_resources), "available": dict(self.available)}
+
+    async def h_nodes(self, conn, meta, msg):
+        return {
+            "nodes": [
+                {
+                    "NodeID": "node0",
+                    "Alive": True,
+                    "Resources": dict(self.total_resources),
+                    "NodeManagerAddress": "127.0.0.1",
+                    "object_store_memory": self.object_store_memory,
+                }
+            ]
+        }
+
+    async def h_state_summary(self, conn, meta, msg):
+        return {
+            "timeline": list(self.timeline[-10000:]),
+            "num_workers": len([w for w in self.workers.values() if w.state != DEAD]),
+            "objects": len(self.objects),
+            "store_bytes": self.store_bytes_used,
+            "actors": {
+                h: {"state": a.state, "name": a.name} for h, a in self.actors.items()
+            },
+            "pending_tasks": len(self.ready_queue) + len(self.waiting_tasks),
+            "running_tasks": len(self.running),
+        }
+
+    def _event(self, kind: str, **fields):
+        self.timeline.append({"ts": time.time(), "event": kind, **fields})
+        if len(self.timeline) > 100_000:
+            del self.timeline[:50_000]
+
+
+async def run_controller(args: dict):
+    ctrl = Controller(
+        num_cpus=args["num_cpus"],
+        resources=args.get("resources", {}),
+        session_dir=args["session_dir"],
+        object_store_memory=args.get("object_store_memory"),
+        port=args.get("port", 0),
+    )
+    await ctrl.start()
+    # Handshake: parent reads this line to learn the port.
+    print(f"RAY_TPU_CONTROLLER_PORT={ctrl.port}", flush=True)
+    await ctrl.serve_forever()

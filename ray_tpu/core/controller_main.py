@@ -1,0 +1,18 @@
+"""Controller process entrypoint (reference analog: `gcs_server_main.cc` +
+`raylet/main.cc` combined — see controller.py for the redesign rationale)."""
+
+import asyncio
+import os
+
+import cloudpickle
+
+from .controller import run_controller
+
+
+def main():
+    args = cloudpickle.loads(bytes.fromhex(os.environ["RAY_TPU_CONTROLLER_ARGS"]))
+    asyncio.run(run_controller(args))
+
+
+if __name__ == "__main__":
+    main()

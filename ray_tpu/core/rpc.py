@@ -1,0 +1,134 @@
+"""Minimal async message-passing layer for the control plane.
+
+Reference analog: `src/ray/rpc` (gRPC wrappers). Here: length-prefixed
+pickled dicts over TCP (loopback) — the control plane carries only small
+metadata messages; bulk data rides the shared-memory object store
+(`store.py`), mirroring the reference's plasma/gRPC split.
+
+Wire format: [u32 length][pickle(dict)]. Every message dict has:
+    type: str           — handler name
+    req_id: int | None  — set for request/response pairs
+Responses echo req_id with type="__response__".
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import pickle
+import struct
+import threading
+from typing import Any, Awaitable, Callable, Dict, Optional
+
+_LEN = struct.Struct("<I")
+
+
+async def read_msg(reader: asyncio.StreamReader) -> dict:
+    header = await reader.readexactly(4)
+    (n,) = _LEN.unpack(header)
+    body = await reader.readexactly(n)
+    return pickle.loads(body)
+
+
+def encode_msg(msg: dict) -> bytes:
+    body = pickle.dumps(msg, protocol=5)
+    return _LEN.pack(len(body)) + body
+
+
+class Connection:
+    """One side of a persistent connection; request/response + push handling."""
+
+    def __init__(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        on_push: Optional[Callable[[dict], Awaitable[None]]] = None,
+        on_close: Optional[Callable[[], Awaitable[None]]] = None,
+    ):
+        self.reader = reader
+        self.writer = writer
+        self.on_push = on_push
+        self.on_close = on_close
+        self._req_ids = itertools.count(1)
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._write_lock = asyncio.Lock()
+        self._closed = False
+        self._reader_task: Optional[asyncio.Task] = None
+
+    def start(self):
+        self._reader_task = asyncio.get_running_loop().create_task(self._read_loop())
+
+    async def _read_loop(self):
+        try:
+            while True:
+                msg = await read_msg(self.reader)
+                if msg.get("type") == "__response__":
+                    fut = self._pending.pop(msg["req_id"], None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(msg.get("payload"))
+                elif self.on_push is not None:
+                    await self.on_push(msg)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        finally:
+            self._closed = True
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionError("connection closed"))
+            self._pending.clear()
+            if self.on_close is not None:
+                try:
+                    await self.on_close()
+                except Exception:  # noqa: BLE001
+                    pass
+
+    async def send(self, msg: dict):
+        """One-way message."""
+        async with self._write_lock:
+            self.writer.write(encode_msg(msg))
+            await self.writer.drain()
+
+    async def request(self, msg: dict, timeout: Optional[float] = None) -> Any:
+        req_id = next(self._req_ids)
+        msg = dict(msg, req_id=req_id)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[req_id] = fut
+        await self.send(msg)
+        if timeout is None:
+            return await fut
+        return await asyncio.wait_for(fut, timeout)
+
+    async def respond(self, req_id: int, payload: Any):
+        await self.send({"type": "__response__", "req_id": req_id, "payload": payload})
+
+    def close(self):
+        self._closed = True
+        try:
+            self.writer.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+class EventLoopThread:
+    """A dedicated asyncio loop on a daemon thread; sync entrypoints for clients."""
+
+    def __init__(self, name: str = "rtpu-io"):
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def call(self, coro, timeout: Optional[float] = None):
+        """Run a coroutine on the loop from a sync thread; block for result."""
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def call_nowait(self, coro):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    def stop(self):
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self._thread.join(timeout=2)

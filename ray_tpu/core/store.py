@@ -1,0 +1,203 @@
+"""PlasmaLite — shared-memory object store for one machine.
+
+Reference analog: the plasma store (`src/ray/object_manager/plasma/store.h:55`)
+— per-node immutable shared-memory objects, zero-copy reads, LRU eviction with
+disk spilling (`raylet LocalObjectManager`). Redesign: instead of a store
+server process brokered over a unix socket, each object is its own named POSIX
+shm segment (`/dev/shm/rtpu-<hex>`); creators write the serialized frame
+directly into the mapping, readers attach by name and deserialize zero-copy
+(numpy arrays view the mapping). Lifetime/refcounts live in the controller;
+this module is the mechanical mmap layer used by every process.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from multiprocessing import shared_memory
+from typing import Any, Dict, Optional, Tuple
+
+from . import serialization
+
+# Objects smaller than this ride the control plane inline instead of shm
+# (reference: small objects go to the in-process memory store, big to plasma).
+INLINE_THRESHOLD = 64 * 1024
+
+_SHM_PREFIX = "rtpu-"
+
+# Per-session tag (the controller's pid) baked into segment names so (a) a
+# second session on the machine can never collide and (b) leaked segments are
+# attributable to a session whose liveness /proc can answer.
+SESSION_TAG = ""
+
+
+def set_session_tag(tag: str):
+    global SESSION_TAG
+    SESSION_TAG = str(tag)
+
+
+def _untrack(seg: shared_memory.SharedMemory):
+    """Detach the segment from Python's resource tracker.
+
+    Without this (3.12 has no ``track=False``), the tracker of whichever
+    process merely *attached* the segment unlinks it at that process's exit,
+    yanking shared objects out from under live readers. Lifetime is owned by
+    the controller instead.
+    """
+    try:
+        from multiprocessing import resource_tracker
+
+        resource_tracker.unregister(seg._name, "shared_memory")
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def shm_name_for(object_hex: str) -> str:
+    # shm_open names are limited (~255 incl. leading /); 28-byte ids are 56 hex.
+    return f"{_SHM_PREFIX}{SESSION_TAG}-{object_hex}"
+
+
+class LocalStore:
+    """Per-process handle cache over the machine-wide shm segments."""
+
+    def __init__(self):
+        self._open: Dict[str, shared_memory.SharedMemory] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- creation
+    def create_packed(self, object_hex: str, payload: bytes, buffers) -> Tuple[str, int]:
+        """Write a pre-serialized value into a fresh segment; returns (name, size)."""
+        size = serialization.packed_size(payload, buffers)
+        name = shm_name_for(object_hex)
+        try:
+            seg = shared_memory.SharedMemory(name=name, create=True, size=max(size, 1))
+        except FileExistsError:
+            # A prior attempt (e.g. a worker that died mid-write before a task
+            # retry) may have left a half-written segment — replace it.
+            try:
+                stale = shared_memory.SharedMemory(name=name)
+                _untrack(stale)
+                stale.close()
+                stale.unlink()
+            except FileNotFoundError:
+                pass
+            seg = shared_memory.SharedMemory(name=name, create=True, size=max(size, 1))
+        _untrack(seg)
+        try:
+            serialization.pack_into(payload, buffers, seg.buf)
+        except BaseException:
+            seg.close()
+            seg.unlink()
+            raise
+        with self._lock:
+            self._open[name] = seg
+        return name, size
+
+    def put(self, object_hex: str, value: Any) -> Tuple[Optional[str], Optional[bytes], int]:
+        """Serialize a value. Returns (shm_name, inline_frame, size): exactly one
+        of shm_name/inline_frame is set depending on the inline threshold."""
+        payload, buffers = serialization.serialize(value)
+        size = serialization.packed_size(payload, buffers)
+        if size <= INLINE_THRESHOLD:
+            frame = bytearray(size)
+            serialization.pack_into(payload, buffers, memoryview(frame))
+            return None, bytes(frame), size
+        name, size = self.create_packed(object_hex, payload, buffers)
+        return name, None, size
+
+    # -------------------------------------------------------------- reading
+    def read(self, shm_name: str) -> Any:
+        """Attach and deserialize. Numpy arrays are zero-copy views over the
+        mapping; the segment handle stays open in this process's cache so the
+        views remain valid."""
+        with self._lock:
+            seg = self._open.get(shm_name)
+            if seg is None:
+                seg = shared_memory.SharedMemory(name=shm_name)
+                _untrack(seg)
+                self._open[shm_name] = seg
+        return serialization.unpack(seg.buf)
+
+    def read_from_file(self, path: str) -> Any:
+        """Restore a spilled object (copies into private memory)."""
+        with open(path, "rb") as f:
+            data = f.read()
+        return serialization.unpack(data)
+
+    # ------------------------------------------------------------- lifetime
+    def spill(self, shm_name: str, spill_dir: str) -> str:
+        """Copy a segment to disk and drop the shm (controller-directed)."""
+        os.makedirs(spill_dir, exist_ok=True)
+        path = os.path.join(spill_dir, shm_name)
+        with self._lock:
+            seg = self._open.get(shm_name)
+            if seg is None:
+                seg = shared_memory.SharedMemory(name=shm_name)
+                _untrack(seg)
+                self._open[shm_name] = seg
+        with open(path, "wb") as f:
+            f.write(bytes(seg.buf))
+        self.release(shm_name, unlink=True)
+        return path
+
+    def release(self, shm_name: str, unlink: bool = False):
+        with self._lock:
+            seg = self._open.pop(shm_name, None)
+        if seg is None and unlink:
+            try:
+                seg = shared_memory.SharedMemory(name=shm_name)
+                _untrack(seg)
+            except FileNotFoundError:
+                return
+        if seg is not None:
+            try:
+                seg.close()
+            except BufferError:
+                # Live zero-copy views still reference the mapping; keep the
+                # handle open (re-cache) and skip close. Unlink below still
+                # removes the name so the memory is freed once views die.
+                with self._lock:
+                    self._open[shm_name] = seg
+                if unlink:
+                    try:
+                        seg.unlink()
+                    except FileNotFoundError:
+                        pass
+                return
+            if unlink:
+                try:
+                    seg.unlink()
+                except FileNotFoundError:
+                    pass
+
+    def close_all(self, unlink: bool = False):
+        with self._lock:
+            names = list(self._open)
+        for name in names:
+            self.release(name, unlink=unlink)
+
+
+def cleanup_stale_segments():
+    """Remove segments leaked by *dead* sessions.
+
+    Segment names embed the owning controller's pid (`rtpu-<pid>-<hex>`); a
+    segment is stale iff that pid no longer exists. Live sessions on the same
+    machine are never touched. Called at controller startup.
+    """
+    shm_dir = "/dev/shm"
+    try:
+        entries = os.listdir(shm_dir)
+    except OSError:
+        return
+    for fn in entries:
+        if not fn.startswith(_SHM_PREFIX):
+            continue
+        tag = fn[len(_SHM_PREFIX) :].split("-", 1)[0]
+        if not tag.isdigit():
+            continue
+        if os.path.exists(f"/proc/{tag}"):
+            continue  # owning controller still alive
+        try:
+            os.unlink(os.path.join(shm_dir, fn))
+        except OSError:
+            pass

@@ -1,0 +1,225 @@
+"""Worker process — executes tasks and hosts actors.
+
+Reference analog: `python/ray/_private/workers/default_worker.py` +
+`CoreWorkerProcess::RunTaskExecutionLoop` (`_raylet.pyx:3269`) + the task
+execution handler (`_raylet.pyx:2174`).
+
+Threading model: an asyncio thread owns the controller connection; user code
+runs on the MAIN thread via a queue (important for JAX/TPU: device runtimes
+prefer main-thread init). Actors with max_concurrency > 1 get a thread pool.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import os
+import queue
+import sys
+import threading
+import traceback
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+from . import serialization, store
+from .exceptions import TaskError
+from .rpc import Connection, EventLoopThread
+from .task_spec import TaskSpec
+
+
+class WorkerProcess:
+    def __init__(self, address: str, worker_id: str, session_dir: str):
+        host, port = address.rsplit(":", 1)
+        self.host, self.port = host, int(port)
+        self.worker_id = worker_id
+        self.session_dir = session_dir
+        self.local_store = store.LocalStore()
+        self.io = EventLoopThread(name=f"worker-{worker_id}-io")
+        self.conn: Optional[Connection] = None
+        self.task_queue: "queue.Queue[dict]" = queue.Queue()
+        self.actor_instance: Any = None
+        self.actor_pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
+        self._stop = False
+
+    # ----------------------------------------------------------------- io
+    async def _connect(self):
+        import asyncio
+
+        reader, writer = await asyncio.open_connection(self.host, self.port)
+        conn = Connection(reader, writer, on_push=self._on_push, on_close=self._on_close)
+        conn.start()
+        self.conn = conn
+        await conn.request(
+            {
+                "type": "register_worker",
+                "worker_id": self.worker_id,
+                "pid": os.getpid(),
+                "has_tpu": os.environ.get("RAY_TPU_WORKER_TPU") == "1",
+            }
+        )
+
+    async def _on_push(self, msg: dict):
+        self.task_queue.put(msg)
+
+    async def _on_close(self):
+        self.task_queue.put({"type": "exit"})
+
+    def send(self, msg: dict):
+        self.io.call(self.conn.send(msg))
+
+    # ------------------------------------------------------------ obj I/O
+    def read_location(self, loc: dict) -> Any:
+        status = loc["status"]
+        if status == "inline":
+            return serialization.unpack(loc["data"])
+        if status == "shm":
+            return self.local_store.read(loc["name"])
+        if status == "spilled":
+            return self.local_store.read_from_file(loc["path"])
+        raise RuntimeError(f"Cannot read object location {status}")
+
+    def store_result(self, object_hex: str, value: Any) -> dict:
+        payload, buffers = serialization.serialize(value)
+        size = serialization.packed_size(payload, buffers)
+        if size <= store.INLINE_THRESHOLD:
+            frame = bytearray(size)
+            serialization.pack_into(payload, buffers, memoryview(frame))
+            return {"id": object_hex, "inline": bytes(frame)}
+        try:
+            name, size = self.local_store.create_packed(object_hex, payload, buffers)
+        except FileExistsError:
+            name = store.shm_name_for(object_hex)
+        return {"id": object_hex, "name": name, "size": size}
+
+    # -------------------------------------------------------------- tasks
+    def _resolve(self, spec: TaskSpec, deps: Dict[str, dict]) -> List[Any]:
+        return [self.read_location(deps[oid.hex()]) for oid in spec.arg_refs]
+
+    def _execute(self, spec: TaskSpec, deps: Dict[str, dict], is_actor_method: bool):
+        from . import api
+        from .runtime import resolve_payload
+
+        runtime = api._global_runtime()
+        results: List[dict] = []
+        try:
+            resolved = self._resolve(spec, deps)
+            func, args, kwargs = resolve_payload(spec.func_payload, resolved)
+            if is_actor_method:
+                func = getattr(self.actor_instance, spec.method_name)
+            runtime.set_task_context(spec.task_id, spec.actor_id)
+            try:
+                result = func(*args, **kwargs)
+            finally:
+                runtime.set_task_context(None)
+            import inspect
+
+            if inspect.isgenerator(result):
+                result = tuple(result) if spec.num_returns > 1 else list(result)
+            n = spec.num_returns
+            if n == 1:
+                results.append(self.store_result(spec.return_ids[0].hex(), result))
+            elif n > 1:
+                if not isinstance(result, tuple) or len(result) != n:
+                    raise ValueError(
+                        f"Task {spec.name} declared num_returns={n} but returned "
+                        f"{type(result).__name__}"
+                    )
+                for oid, v in zip(spec.return_ids, result):
+                    results.append(self.store_result(oid.hex(), v))
+        except BaseException as e:  # noqa: BLE001
+            err = TaskError(e, traceback.format_exc(), spec.name)
+            results = [
+                self.store_result(oid.hex(), err) for oid in spec.return_ids
+            ]
+        self.send({"type": "task_done", "task": spec.task_id.hex(), "results": results})
+
+    def _create_actor(self, spec: TaskSpec, deps: Dict[str, dict]):
+        from . import api
+        from .runtime import resolve_payload
+
+        runtime = api._global_runtime()
+        try:
+            resolved = self._resolve(spec, deps)
+            cls, args, kwargs = resolve_payload(spec.func_payload, resolved)
+            runtime.set_task_context(spec.task_id, spec.actor_id)
+            try:
+                self.actor_instance = cls(*args, **kwargs)
+            finally:
+                runtime.set_task_context(None)
+            if spec.options.max_concurrency > 1:
+                self.actor_pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=spec.options.max_concurrency
+                )
+            self.send(
+                {
+                    "type": "actor_ready",
+                    "actor": spec.actor_id.hex(),
+                    "task": spec.task_id.hex(),
+                    "error": None,
+                }
+            )
+        except BaseException as e:  # noqa: BLE001
+            err = TaskError(e, traceback.format_exc(), spec.name)
+            self.send(
+                {
+                    "type": "actor_ready",
+                    "actor": spec.actor_id.hex(),
+                    "task": spec.task_id.hex(),
+                    "error": serialization.pack(err),
+                }
+            )
+
+    # --------------------------------------------------------------- loop
+    def run(self):
+        self.io.call(self._connect())
+        self._init_client_api()
+        while not self._stop:
+            msg = self.task_queue.get()
+            mtype = msg["type"]
+            if mtype == "exit":
+                break
+            spec: TaskSpec = cloudpickle.loads(msg["spec"])
+            deps = msg.get("deps", {})
+            if mtype == "execute_task":
+                self._execute(spec, deps, is_actor_method=False)
+            elif mtype == "create_actor":
+                self._create_actor(spec, deps)
+            elif mtype == "execute_actor_task":
+                if self.actor_pool is not None:
+                    self.actor_pool.submit(self._execute, spec, deps, True)
+                else:
+                    self._execute(spec, deps, is_actor_method=True)
+        self.local_store.close_all()
+        os._exit(0)
+
+    def _init_client_api(self):
+        """Install a Runtime so user code can call the full API from tasks."""
+        from . import api
+        from .cluster_backend import ClusterBackend
+        from .ids import JobID
+        from .runtime import Runtime
+
+        backend = ClusterBackend.connect(
+            f"{self.host}:{self.port}", role="worker", worker=self
+        )
+        runtime = Runtime(
+            backend, JobID.from_int(os.getpid() % (2**28)), address=f"{self.host}:{self.port}"
+        )
+        backend.set_runtime(runtime)
+        api.set_global_runtime(runtime)
+
+
+def main():
+    address = os.environ["RAY_TPU_ADDRESS"]
+    worker_id = os.environ["RAY_TPU_WORKER_ID"]
+    session_dir = os.environ.get("RAY_TPU_SESSION_DIR", "/tmp/ray_tpu")
+    store.set_session_tag(os.environ.get("RAY_TPU_SESSION_TAG", ""))
+    wp = WorkerProcess(address, worker_id, session_dir)
+    try:
+        wp.run()
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

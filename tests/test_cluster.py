@@ -1,0 +1,195 @@
+"""Multiprocess cluster plane tests (reference analog: `test_basic.py` +
+`test_reconstruction.py` fault paths, run against real worker processes)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+pytestmark = pytest.mark.cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_task_roundtrip(cluster):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_large_object_shm(cluster):
+    arr = np.random.rand(256, 256)
+    ref = ray_tpu.put(arr)
+    np.testing.assert_array_equal(ray_tpu.get(ref), arr)
+
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    np.testing.assert_allclose(ray_tpu.get(double.remote(ref)), arr * 2)
+
+
+def test_parallel_tasks(cluster):
+    @ray_tpu.remote
+    def sq(i):
+        return i * i
+
+    assert ray_tpu.get([sq.remote(i) for i in range(16)]) == [i * i for i in range(16)]
+
+
+def test_tasks_actually_parallel(cluster):
+    @ray_tpu.remote
+    def sleep_id():
+        time.sleep(0.5)
+        return os.getpid()
+
+    t0 = time.time()
+    pids = ray_tpu.get([sleep_id.remote() for _ in range(4)])
+    elapsed = time.time() - t0
+    assert elapsed < 1.8, f"4x0.5s sleeps took {elapsed:.2f}s — not parallel"
+    assert len(set(pids)) >= 2
+
+
+def test_actor_state_and_isolation(cluster):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.v = 0
+
+        def inc(self):
+            self.v += 1
+            return self.v
+
+        def pid(self):
+            return os.getpid()
+
+    c = Counter.remote()
+    assert ray_tpu.get([c.inc.remote() for _ in range(10)]) == list(range(1, 11))
+    assert ray_tpu.get(c.pid.remote()) != os.getpid()
+
+
+def test_nested_tasks_no_deadlock(cluster):
+    @ray_tpu.remote
+    def child(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def parent(x):
+        return ray_tpu.get(child.remote(x)) + 1
+
+    assert ray_tpu.get(parent.remote(5), timeout=60) == 11
+
+
+def test_task_retry_on_worker_crash(cluster, tmp_path):
+    marker = str(tmp_path / "marker")
+
+    @ray_tpu.remote(max_retries=2)
+    def flaky():
+        if not os.path.exists(marker):
+            open(marker, "w").close()
+            os._exit(1)
+        return "recovered"
+
+    assert ray_tpu.get(flaky.remote(), timeout=60) == "recovered"
+
+
+def test_worker_crash_error(cluster):
+    @ray_tpu.remote(max_retries=0)
+    def die():
+        os._exit(1)
+
+    with pytest.raises(ray_tpu.WorkerCrashedError):
+        ray_tpu.get(die.remote(), timeout=60)
+
+
+def test_actor_restart(cluster):
+    @ray_tpu.remote(max_restarts=1)
+    class Fragile:
+        def crash(self):
+            os._exit(1)
+
+        def ping(self):
+            return "alive"
+
+    f = Fragile.remote()
+    assert ray_tpu.get(f.ping.remote(), timeout=60) == "alive"
+    with pytest.raises(ray_tpu.RayTpuError):
+        ray_tpu.get(f.crash.remote(), timeout=30)
+    # After restart the actor serves again.
+    deadline = time.time() + 60
+    while True:
+        try:
+            assert ray_tpu.get(f.ping.remote(), timeout=30) == "alive"
+            break
+        except ray_tpu.RayTpuError:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.5)
+
+
+def test_actor_dead_after_max_restarts(cluster):
+    @ray_tpu.remote(max_restarts=0)
+    class OneShot:
+        def crash(self):
+            os._exit(1)
+
+        def ping(self):
+            return 1
+
+    a = OneShot.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == 1
+    with pytest.raises(ray_tpu.RayTpuError):
+        ray_tpu.get(a.crash.remote(), timeout=30)
+    with pytest.raises(ray_tpu.RayTpuError):
+        ray_tpu.get(a.ping.remote(), timeout=30)
+
+
+def test_remote_error_type_preserved(cluster):
+    @ray_tpu.remote
+    def boom():
+        raise KeyError("k")
+
+    with pytest.raises(KeyError):
+        ray_tpu.get(boom.remote(), timeout=60)
+
+
+def test_named_actor_cross_process(cluster):
+    @ray_tpu.remote
+    class Svc:
+        def who(self):
+            return "svc"
+
+    Svc.options(name="cluster_svc").remote()
+
+    @ray_tpu.remote
+    def lookup():
+        h = ray_tpu.get_actor("cluster_svc")
+        return ray_tpu.get(h.who.remote())
+
+    assert ray_tpu.get(lookup.remote(), timeout=60) == "svc"
+
+
+def test_wait_cluster(cluster):
+    @ray_tpu.remote
+    def fast():
+        return 1
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(10)
+        return 2
+
+    f, s = fast.remote(), slow.remote()
+    ready, not_ready = ray_tpu.wait([f, s], num_returns=1, timeout=5)
+    assert ready == [f] and not_ready == [s]
