@@ -1,0 +1,331 @@
+"""Collective communication (API parity: `ray.util.collective.collective`).
+
+The reference wires NCCL/Gloo communicators between actor processes
+(`collective.py:120 init_collective_group`, ops at `:258-615`). TPU-first
+redesign — THREE planes, matching SURVEY.md §5:
+
+1. **In-jit (ICI)**: `ops.allreduce(x, axis="dp")` etc. lower to
+   `jax.lax.p*` inside a jitted program over a Mesh — the "communicator" is
+   the XLA compiler. This is where tensor traffic belongs on TPU.
+2. **Host-level group collectives (DCN analog)**: the `ray.util.collective`
+   actor-group API (`init_collective_group` / `allreduce(tensor, group)`)
+   implemented over the object store through a rendezvous actor — for
+   control-plane-sized arrays (weight broadcast, metric reduction) between
+   gang actors, exactly the role Gloo plays in the reference.
+3. **Multi-host jax runtime bootstrap**: `init_jax_distributed` arranges
+   `jax.distributed.initialize` across a WorkerGroup so a multi-host mesh
+   can be built (the moral equivalent of `dist.init_process_group` in
+   `train/torch/config.py:106`).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from . import ops
+from .ops import (
+    all_gather,
+    all_to_all,
+    allreduce_jit,
+    barrier_jit,
+    ppermute,
+    psum,
+    reduce_scatter,
+)
+
+
+class Backend:
+    XLA = "xla"      # in-jit, over ICI — the TPU-native plane
+    HOST = "host"    # object-store host collectives (Gloo role)
+    # Aliases for reference API compatibility; both map to HOST on CPU paths.
+    GLOO = "host"
+    NCCL = "xla"
+
+
+class GroupInfo:
+    """Rendezvous + reduction state for one collective group (detached actor).
+
+    Reference analog: the named "Info" actor storing NCCL unique IDs
+    (`collective.py:40 GroupManager`). Here it is also the data plane for
+    host collectives: members push chunks, the actor reduces and serves.
+    """
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self.members: Dict[int, bool] = {}
+        self._rounds: Dict[str, dict] = {}
+
+    def join(self, rank: int) -> int:
+        self.members[rank] = True
+        return len(self.members)
+
+    def ready(self) -> bool:
+        return len(self.members) >= self.world_size
+
+    def _round(self, key: str) -> dict:
+        r = self._rounds.get(key)
+        if r is None:
+            r = self._rounds[key] = {"parts": {}, "result": None, "fetched": 0}
+        return r
+
+    def contribute(self, key: str, rank: int, value, op: str, root: int = 0):
+        """Accumulate a member's tensor for round `key`; returns #arrived."""
+        r = self._round(key)
+        r["parts"][rank] = value
+        if op == "p2p":
+            return len(r["parts"])
+        if len(r["parts"]) == self.world_size:
+            vals = [r["parts"][k] for k in sorted(r["parts"])]
+            if op == "sum":
+                out = vals[0]
+                for v in vals[1:]:
+                    out = out + v
+            elif op == "max":
+                out = np.maximum.reduce(vals)
+            elif op == "min":
+                out = np.minimum.reduce(vals)
+            elif op == "prod":
+                out = np.multiply.reduce(vals)
+            elif op == "gather":
+                out = vals
+            elif op == "broadcast":
+                out = r["parts"][root]
+            else:
+                raise ValueError(f"unknown op {op}")
+            r["result"] = out
+        return len(r["parts"])
+
+    def fetch(self, key: str):
+        r = self._round(key)
+        if r["result"] is None:
+            return None
+        result = r["result"]
+        r["fetched"] += 1
+        if r["fetched"] >= self.world_size:
+            self._rounds.pop(key, None)  # all members served — free the round
+        return result
+
+    def discard(self, key: str):
+        self._rounds.pop(key, None)
+
+    def fetch_p2p(self, key: str):
+        """One-shot point-to-point mailbox read (consumes the value)."""
+        r = self._rounds.get(key)
+        if r is None or not r["parts"]:
+            return None
+        self._rounds.pop(key, None)
+        return next(iter(r["parts"].values()))
+
+
+_LOCAL = threading.local()
+
+
+def _info_actor(group_name: str, world_size: Optional[int] = None, create: bool = False):
+    from .. import core
+    from ..core import api
+
+    name = f"__collective_{group_name}"
+    handle = api.get_actor_or_none(name)
+    if handle is None and create:
+        remote_cls = api.remote(GroupInfo)
+        try:
+            handle = remote_cls.options(name=name, lifetime="detached").remote(world_size)
+        except ValueError:
+            handle = api.get_actor(name)
+    if handle is None:
+        raise ValueError(f"Collective group '{group_name}' does not exist")
+    return handle
+
+
+def _ctx() -> dict:
+    if not hasattr(_LOCAL, "groups"):
+        _LOCAL.groups = {}
+    return _LOCAL.groups
+
+
+_VALID_BACKENDS = {"host", "gloo", "xla", "nccl"}
+
+
+def init_collective_group(
+    world_size: int,
+    rank: int,
+    backend: str = Backend.HOST,
+    group_name: str = "default",
+):
+    """Called by each member (inside its actor/task) to join a group."""
+    from ..core import api
+
+    b = str(backend).lower()
+    if b not in _VALID_BACKENDS:
+        raise ValueError(f"Unknown collective backend {backend!r}; valid: {_VALID_BACKENDS}")
+    if b in ("xla", "nccl"):
+        import warnings
+
+        warnings.warn(
+            "Device-plane collectives on TPU compile into jit programs "
+            "(ray_tpu.collective.ops.* under shard_map/pjit); group "
+            f"'{group_name}' will use the host plane for out-of-jit arrays.",
+            stacklevel=2,
+        )
+    info = _info_actor(group_name, world_size, create=True)
+    api.get(info.join.remote(rank))
+    deadline = time.time() + 60
+    while not api.get(info.ready.remote()):
+        if time.time() > deadline:
+            raise TimeoutError(f"Group {group_name} rendezvous timed out")
+        time.sleep(0.02)
+    _ctx()[group_name] = {"info": info, "rank": rank, "world_size": world_size, "seq": 0}
+
+
+def create_collective_group(
+    actors: List,
+    world_size: int,
+    ranks: List[int],
+    backend: str = Backend.HOST,
+    group_name: str = "default",
+):
+    """Declarative variant (reference `collective.py:151`): the driver
+    assigns ranks; actors must expose `init_collective_group` calls in their
+    methods (or use `ray_tpu.collective.init_collective_group` inside)."""
+    _info_actor(group_name, world_size, create=True)
+    return True
+
+
+def destroy_collective_group(group_name: str = "default"):
+    from ..core import api
+
+    try:
+        handle = api.get_actor_or_none(f"__collective_{group_name}")
+        if handle is not None:
+            api.kill(handle)
+    finally:
+        _ctx().pop(group_name, None)
+
+
+def get_rank(group_name: str = "default") -> int:
+    g = _ctx().get(group_name)
+    return g["rank"] if g else -1
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    g = _ctx().get(group_name)
+    return g["world_size"] if g else -1
+
+
+def _sync(group_name: str, op: str, value, root: int = 0):
+    from ..core import api
+
+    g = _ctx().get(group_name)
+    if g is None:
+        raise RuntimeError(
+            f"init_collective_group('{group_name}') must be called in this process first"
+        )
+    g["seq"] += 1
+    key = f"{op}:{g['seq']}"
+    info = g["info"]
+    api.get(info.contribute.remote(key, g["rank"], value, op, root))
+    deadline = time.time() + 300
+    while True:
+        result = api.get(info.fetch.remote(key))
+        if result is not None:
+            return result
+        if time.time() > deadline:
+            raise TimeoutError(f"collective {op} timed out in group {group_name}")
+        time.sleep(0.005)
+
+
+def allreduce(tensor, group_name: str = "default", op: str = "sum"):
+    """Host-plane allreduce (reference `collective.py:258`). For tensors that
+    live on-device inside jit, use `ops.psum`/`allreduce_jit` instead.
+
+    Results are defensive copies: in local mode the object table stores by
+    reference, and members must never alias each other's arrays.
+    """
+    return np.array(_sync(group_name, op, np.asarray(tensor)), copy=True)
+
+
+def allgather(tensor, group_name: str = "default"):
+    return [
+        np.array(v, copy=True)
+        for v in _sync(group_name, "gather", np.asarray(tensor))
+    ]
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    return np.array(
+        _sync(group_name, "broadcast", np.asarray(tensor), root=src_rank), copy=True
+    )
+
+
+def reducescatter(tensor, group_name: str = "default", op: str = "sum"):
+    g = _ctx()[group_name]
+    total = np.array(_sync(group_name, op, np.asarray(tensor)), copy=True)
+    chunks = np.array_split(total, g["world_size"], axis=0)
+    return chunks[g["rank"]]
+
+
+def barrier(group_name: str = "default"):
+    _sync(group_name, "sum", np.zeros((), np.int32))
+
+
+def _p2p_key(g: dict, src: int, dst: int) -> str:
+    # Both endpoints count their mutual transfers, so the pair's keys line up
+    # regardless of what other collectives either side ran in between.
+    p2p = g.setdefault("p2p", {})
+    p2p[(src, dst)] = p2p.get((src, dst), 0) + 1
+    return f"p2p:{src}->{dst}:{p2p[(src, dst)]}"
+
+
+def send(tensor, dst_rank: int, group_name: str = "default"):
+    """Point-to-point via the group actor (host plane)."""
+    from ..core import api
+
+    g = _ctx()[group_name]
+    key = _p2p_key(g, g["rank"], dst_rank)
+    api.get(g["info"].contribute.remote(key, 0, np.asarray(tensor), "p2p"))
+
+
+def recv(src_rank: int, group_name: str = "default"):
+    from ..core import api
+
+    g = _ctx()[group_name]
+    key = _p2p_key(g, src_rank, g["rank"])
+    info = g["info"]
+    deadline = time.time() + 300
+    while True:
+        result = api.get(info.fetch_p2p.remote(key))
+        if result is not None:
+            return np.array(result, copy=True)
+        if time.time() > deadline:
+            raise TimeoutError("recv timed out")
+        time.sleep(0.005)
+
+
+__all__ = [
+    "Backend",
+    "init_collective_group",
+    "create_collective_group",
+    "destroy_collective_group",
+    "get_rank",
+    "get_collective_group_size",
+    "allreduce",
+    "allgather",
+    "broadcast",
+    "reducescatter",
+    "barrier",
+    "send",
+    "recv",
+    # in-jit plane
+    "ops",
+    "psum",
+    "all_gather",
+    "reduce_scatter",
+    "all_to_all",
+    "ppermute",
+    "allreduce_jit",
+    "barrier_jit",
+]

@@ -1,0 +1,94 @@
+"""In-jit collectives — the ICI plane.
+
+The reference's NCCL calls (`nccl_collective_group.py:allreduce` etc.) map on
+TPU to XLA collective HLOs compiled into the program. These wrappers add
+nothing at runtime — they exist so framework code reads at the same level of
+intent as the reference API, and so the axis-name conventions of
+`ray_tpu.parallel.mesh.AXIS_ORDER` are applied consistently.
+
+All functions must be called under `shard_map`/`pjit` with bound axis names.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+AxisName = Union[str, Sequence[str]]
+
+
+def psum(x, axis: AxisName):
+    return jax.lax.psum(x, axis_name=axis)
+
+
+def pmean(x, axis: AxisName):
+    return jax.lax.pmean(x, axis_name=axis)
+
+def pmax(x, axis: AxisName):
+    return jax.lax.pmax(x, axis_name=axis)
+
+
+def pmin(x, axis: AxisName):
+    return jax.lax.pmin(x, axis_name=axis)
+
+
+def allreduce_jit(x, axis: AxisName, op: str = "sum"):
+    return {"sum": psum, "mean": pmean, "max": pmax, "min": pmin}[op](x, axis)
+
+
+def all_gather(x, axis: AxisName, *, tiled: bool = True, gather_axis: int = 0):
+    return jax.lax.all_gather(x, axis_name=axis, axis=gather_axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis: AxisName, *, scatter_axis: int = 0, op: str = "sum"):
+    if op != "sum":
+        raise NotImplementedError("reduce_scatter supports sum on TPU ICI")
+    return jax.lax.psum_scatter(
+        x, axis_name=axis, scatter_dimension=scatter_axis, tiled=True
+    )
+
+
+def all_to_all(
+    x,
+    axis: AxisName,
+    *,
+    split_axis: int,
+    concat_axis: int,
+    tiled: bool = True,
+):
+    """Ulysses-style head/sequence exchange rides this (`SURVEY.md §5`)."""
+    return jax.lax.all_to_all(
+        x, axis_name=axis, split_axis=split_axis, concat_axis=concat_axis, tiled=tiled
+    )
+
+
+def ppermute(x, axis: AxisName, perm: Sequence[tuple]):
+    """Neighbor exchange — the ring-attention building block."""
+    return jax.lax.ppermute(x, axis_name=axis, perm=perm)
+
+
+def ring_shift(x, axis: str, shift: int = 1):
+    """Send x to (rank+shift) mod n along `axis`; returns the received block."""
+    n = jax.lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.lax.ppermute(x, axis_name=axis, perm=perm)
+
+
+def axis_index(axis: AxisName):
+    return jax.lax.axis_index(axis)
+
+
+def axis_size(axis: str) -> int:
+    return jax.lax.axis_size(axis)
+
+
+def barrier_jit(axis: AxisName):
+    """Sync point inside jit: a zero-sized psum forces a collective."""
+    return jax.lax.psum(jnp.zeros((), jnp.int32), axis_name=axis)
+
+
+def unreplicate(tree):
+    """Take the first element along a leading device axis (host-side)."""
+    return jax.tree_util.tree_map(lambda x: x[0], tree)

@@ -181,15 +181,17 @@ class LocalBackend(RuntimeBackend):
     def create_actor(self, spec: TaskSpec, name: str, namespace: str) -> None:
         actor = _LocalActor(spec.actor_id, spec.options.max_concurrency)
         with self._lock:
-            self._actors[spec.actor_id] = actor
             if name:
+                key = (namespace or "default", name)
+                if key in self._named_actors:
+                    # Same contract as the cluster controller: duplicate names
+                    # fail the creation (callers race on get-or-create).
+                    raise ValueError(f"Actor name '{name}' already taken")
                 from .actor import ActorHandle
 
                 handle = ActorHandle(spec.actor_id, spec.name, dict(spec.method_meta))
-                self._named_actors[(namespace or "default", name)] = (
-                    spec.actor_id,
-                    cloudpickle.dumps(handle),
-                )
+                self._named_actors[key] = (spec.actor_id, cloudpickle.dumps(handle))
+            self._actors[spec.actor_id] = actor
 
         def init():
             from .runtime import resolve_payload

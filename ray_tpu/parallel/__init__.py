@@ -1,0 +1,12 @@
+from .mesh import AXIS_ORDER, MeshSpec, ShardingRules, constrain, make_mesh
+from .spmd import parallelize, shard_fn
+
+__all__ = [
+    "AXIS_ORDER",
+    "MeshSpec",
+    "ShardingRules",
+    "make_mesh",
+    "constrain",
+    "parallelize",
+    "shard_fn",
+]

@@ -1,0 +1,185 @@
+"""Device mesh construction — the substrate every parallelism strategy rides.
+
+Reference analog: none (Ray delegates in-node parallelism to NCCL process
+groups — `python/ray/util/collective/collective_group/nccl_collective_group.py`).
+TPU-first redesign: parallelism is expressed as a `jax.sharding.Mesh` with
+named axes; XLA compiles collectives onto ICI. The canonical axes:
+
+    dp    — data parallel (pure replica)
+    fsdp  — fully-sharded data parallel (ZeRO-style weight sharding)
+    tp    — tensor (model) parallel
+    sp    — sequence/context parallel (ring attention rides this axis)
+    ep    — expert parallel (MoE)
+    pp    — pipeline stage (usually across DCN, not ICI)
+
+`MeshSpec` resolves partially-specified axis sizes against the actual device
+count (one `-1` axis absorbs the remainder, like a reshape).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+AXIS_ORDER = ("pp", "dp", "fsdp", "sp", "ep", "tp")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Named-axis mesh specification.
+
+    >>> MeshSpec(dp=-1, tp=4).build()   # tp innermost → rides fastest ICI links
+    """
+
+    pp: int = 1
+    dp: int = 1
+    fsdp: int = 1
+    sp: int = 1
+    ep: int = 1
+    tp: int = 1
+
+    def sizes(self) -> Dict[str, int]:
+        return {a: getattr(self, a) for a in AXIS_ORDER}
+
+    def resolve(self, num_devices: int) -> "MeshSpec":
+        sizes = self.sizes()
+        unknown = [a for a, s in sizes.items() if s == -1]
+        if len(unknown) > 1:
+            raise ValueError(f"At most one axis may be -1, got {unknown}")
+        known = math.prod(s for s in sizes.values() if s != -1)
+        if unknown:
+            if num_devices % known != 0:
+                raise ValueError(
+                    f"{num_devices} devices not divisible by fixed axes product {known}"
+                )
+            sizes[unknown[0]] = num_devices // known
+        elif known != num_devices:
+            raise ValueError(
+                f"Mesh {sizes} wants {known} devices but {num_devices} are available"
+            )
+        return MeshSpec(**sizes)
+
+    def build(self, devices: Optional[Sequence] = None):
+        """Create the `jax.sharding.Mesh`.
+
+        Axis order puts `tp` (then `ep`, `sp`) innermost so the heaviest
+        collectives map onto nearest-neighbor ICI links; `pp`/`dp` outermost
+        (cheapest traffic, tolerates DCN hops on multi-slice).
+        """
+        import jax
+        from jax.sharding import Mesh
+
+        if devices is None:
+            devices = jax.devices()
+        spec = self.resolve(len(devices))
+        shape = tuple(spec.sizes()[a] for a in AXIS_ORDER)
+        dev_array = np.asarray(devices).reshape(shape)
+        return Mesh(dev_array, AXIS_ORDER)
+
+    @property
+    def num_devices(self) -> int:
+        return math.prod(s for s in self.sizes().values() if s != -1)
+
+
+def make_mesh(devices=None, **axis_sizes) -> "jax.sharding.Mesh":  # noqa: F821
+    """`make_mesh(dp=-1, tp=4)` → Mesh. Unmentioned axes are size 1."""
+    return MeshSpec(**axis_sizes).build(devices)
+
+
+# --------------------------------------------------------------- logical axes
+@dataclass
+class ShardingRules:
+    """Logical-axis → mesh-axis rules (the t5x/maxtext idiom, re-derived).
+
+    Model code annotates arrays with *logical* dim names; the rules decide
+    which mesh axes they shard over. One place to retarget a model from pure
+    DP to 3D DP×FSDP×TP without touching model code.
+    """
+
+    rules: Dict[str, Optional[Tuple[str, ...]]] = field(default_factory=dict)
+
+    @classmethod
+    def default(cls) -> "ShardingRules":
+        return cls(
+            rules={
+                # Activations.
+                "batch": ("dp", "fsdp"),
+                "seq": ("sp",),
+                "embed_act": None,           # activations replicated over tp...
+                "heads_act": ("tp",),        # ...but heads split over tp
+                "mlp_act": ("tp",),
+                # Weights.
+                "embed": ("fsdp",),          # ZeRO-shard the embed dim
+                "heads": ("tp",),
+                "kv_heads": ("tp",),
+                "head_dim": None,
+                "mlp": ("tp",),
+                "vocab": ("tp",),
+                "experts": ("ep",),
+                "layers": None,              # scanned layer axis stays unsharded
+                "stage": ("pp",),
+            }
+        )
+
+    def spec(self, *logical_dims: Optional[str]):
+        """Logical dims → `PartitionSpec`."""
+        from jax.sharding import PartitionSpec
+
+        out = []
+        for dim in logical_dims:
+            if dim is None:
+                out.append(None)
+            else:
+                if dim not in self.rules:
+                    # A typo'd dim silently replicating would surface only as
+                    # an OOM/perf mystery at scale — fail loudly at trace time.
+                    raise KeyError(
+                        f"Unknown logical dim {dim!r}; known: {sorted(self.rules)}. "
+                        "Map it explicitly (None = replicated) via with_rules()."
+                    )
+                axes = self.rules[dim]
+                if axes is None:
+                    out.append(None)
+                elif len(axes) == 1:
+                    out.append(axes[0])
+                else:
+                    out.append(tuple(axes))
+        return PartitionSpec(*out)
+
+    def sharding(self, mesh, *logical_dims):
+        from jax.sharding import NamedSharding
+
+        # Drop mesh axes of size 1 so specs stay valid on degenerate meshes.
+        spec = self.spec(*logical_dims)
+        cleaned = []
+        for entry in spec:
+            if entry is None:
+                cleaned.append(None)
+            elif isinstance(entry, tuple):
+                kept = tuple(a for a in entry if mesh.shape.get(a, 1) > 1)
+                cleaned.append(kept if kept else None)
+            else:
+                cleaned.append(entry if mesh.shape.get(entry, 1) > 1 else None)
+        from jax.sharding import PartitionSpec
+
+        return NamedSharding(mesh, PartitionSpec(*cleaned))
+
+    def with_rules(self, **overrides) -> "ShardingRules":
+        new = dict(self.rules)
+        for k, v in overrides.items():
+            new[k] = tuple(v) if isinstance(v, (list, tuple)) else ((v,) if v else None)
+        return ShardingRules(rules=new)
+
+
+def constrain(x, mesh, rules: ShardingRules, *logical_dims):
+    """`lax.with_sharding_constraint` via logical dims.
+
+    Errors (rank mismatch, unknown dims) propagate — silent fallback would
+    hide missing shardings until they show up as OOMs at scale.
+    """
+    import jax
+
+    return jax.lax.with_sharding_constraint(x, rules.sharding(mesh, *logical_dims))

@@ -6,13 +6,22 @@ logic is exercised without TPUs (SURVEY.md §4 "fake mesh" requirement).
 
 import os
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# CI runs on a fake 8-device CPU mesh (SURVEY.md §4). The ambient environment
+# pins the real TPU (sitecustomize imports jax and sets jax_platforms=axon at
+# interpreter start — BEFORE this file runs), so env vars alone don't cut it:
+# update jax's config directly. The XLA backend itself initializes lazily, so
+# XLA_FLAGS set here still takes effect at first device query.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # keep it out of worker subprocesses
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

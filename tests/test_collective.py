@@ -1,0 +1,99 @@
+"""Host-plane collective group tests (API parity with
+`ray.util.collective` — reference `util/collective/tests`)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import collective
+
+
+@pytest.fixture(autouse=True)
+def _rt(local_runtime):
+    yield
+
+
+@ray_tpu.remote
+class GangMember:
+    def __init__(self, rank, world):
+        self.rank = rank
+        self.world = world
+
+    def setup(self, group):
+        collective.init_collective_group(self.world, self.rank, group_name=group)
+        return self.rank
+
+    def do_allreduce(self, group):
+        x = np.full((4,), float(self.rank + 1))
+        return collective.allreduce(x, group_name=group)
+
+    def do_allgather(self, group):
+        return collective.allgather(np.array([self.rank]), group_name=group)
+
+    def do_broadcast(self, group):
+        x = np.array([100.0]) if self.rank == 0 else np.zeros(1)
+        return collective.broadcast(x, src_rank=0, group_name=group)
+
+    def do_reducescatter(self, group):
+        x = np.arange(4.0)
+        return collective.reducescatter(x, group_name=group)
+
+    def do_barrier(self, group):
+        collective.barrier(group_name=group)
+        return "past"
+
+    def do_sendrecv(self, group):
+        if self.rank == 0:
+            collective.send(np.array([7.0]), dst_rank=1, group_name=group)
+            return None
+        return collective.recv(src_rank=0, group_name=group)
+
+    def rank_info(self, group):
+        return (collective.get_rank(group), collective.get_collective_group_size(group))
+
+
+def _gang(world, group):
+    members = [GangMember.remote(r, world) for r in range(world)]
+    ray_tpu.get([m.setup.remote(group) for m in members])
+    return members
+
+
+def test_allreduce():
+    members = _gang(2, "g_ar")
+    outs = ray_tpu.get([m.do_allreduce.remote("g_ar") for m in members])
+    for out in outs:
+        np.testing.assert_allclose(out, np.full((4,), 3.0))  # 1 + 2
+
+
+def test_allgather():
+    members = _gang(2, "g_ag")
+    outs = ray_tpu.get([m.do_allgather.remote("g_ag") for m in members])
+    for out in outs:
+        assert [int(v[0]) for v in out] == [0, 1]
+
+
+def test_broadcast():
+    members = _gang(2, "g_bc")
+    outs = ray_tpu.get([m.do_broadcast.remote("g_bc") for m in members])
+    for out in outs:
+        np.testing.assert_allclose(out, [100.0])
+
+
+def test_reducescatter():
+    members = _gang(2, "g_rs")
+    outs = ray_tpu.get([m.do_reducescatter.remote("g_rs") for m in members])
+    np.testing.assert_allclose(outs[0], [0.0, 2.0])
+    np.testing.assert_allclose(outs[1], [4.0, 6.0])
+
+
+def test_barrier_and_rank():
+    members = _gang(2, "g_b")
+    assert ray_tpu.get([m.do_barrier.remote("g_b") for m in members]) == ["past", "past"]
+    infos = ray_tpu.get([m.rank_info.remote("g_b") for m in members])
+    assert infos == [(0, 2), (1, 2)]
+
+
+def test_send_recv():
+    members = _gang(2, "g_sr")
+    outs = ray_tpu.get([m.do_sendrecv.remote("g_sr") for m in members])
+    np.testing.assert_allclose(outs[1], [7.0])
