@@ -1,0 +1,152 @@
+"""Headline benchmark: GPT training throughput on the local TPU chip.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": tokens/s/chip, "unit": ..., "vs_baseline": ...}
+
+vs_baseline = achieved MFU / 0.40 — the north-star target from BASELINE.md
+(GPT-J pretraining ≥40% MFU through the Train API). The model here is the
+largest GPT-2-family config that trains comfortably on one v5e chip; the
+per-chip MFU is the quantity the multi-chip sharding is designed to hold.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def peak_flops_per_chip() -> float:
+    """bf16 peak for the local chip generation."""
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    if "v5 lite" in kind or "v5e" in kind or "v5lite" in kind:
+        return 197e12
+    if "v6" in kind:
+        return 918e12
+    if "v4" in kind:
+        return 275e12
+    return 197e12
+
+
+def _check_device_reachable(timeout_s: float = 180.0):
+    """The axon tunnel can wedge such that backend init blocks forever; a
+    hung bench is worse than a failed one — probe attach in a daemon thread
+    and exit loudly on timeout."""
+    import threading
+
+    result = {}
+
+    def probe():
+        try:
+            import jax
+
+            result["devices"] = [str(d) for d in jax.devices()]
+        except Exception as e:  # noqa: BLE001
+            result["error"] = repr(e)
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        print(
+            json.dumps(
+                {
+                    "metric": "gpt2_medium_train_tokens_per_sec_per_chip",
+                    "value": 0,
+                    "unit": "tokens/s/chip",
+                    "vs_baseline": 0,
+                    "error": f"device attach timed out after {timeout_s}s (tunnel wedged?)",
+                }
+            )
+        )
+        raise SystemExit(2)
+    if "error" in result:
+        print(
+            json.dumps(
+                {
+                    "metric": "gpt2_medium_train_tokens_per_sec_per_chip",
+                    "value": 0,
+                    "unit": "tokens/s/chip",
+                    "vs_baseline": 0,
+                    "error": result["error"],
+                }
+            )
+        )
+        raise SystemExit(2)
+
+
+def main():
+    _check_device_reachable()
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models import gpt2_medium, init_params, make_train_step
+
+    import os
+
+    if os.environ.get("RAY_TPU_BENCH_SMALL"):  # logic smoke on CPU
+        from ray_tpu.models import GPTConfig
+
+        B, S = 2, 128
+        cfg = GPTConfig(
+            vocab_size=512, n_layers=2, d_model=128, n_heads=4, d_head=32,
+            d_mlp=256, max_seq=S, attn_impl="ref", remat=False,
+        )
+    else:
+        B, S = 8, 1024
+        cfg = gpt2_medium(max_seq=S, attn_impl="flash", remat=True)
+
+    # Initialize on-device (jit) — host-side random init of 350M params on a
+    # 1-core VM costs tens of seconds.
+    params = jax.jit(lambda key: init_params(key, cfg))(jax.random.PRNGKey(0))
+    opt = optax.adamw(3e-4, weight_decay=0.1)
+    opt_state = opt.init(params)
+    step = jax.jit(make_train_step(cfg, opt), donate_argnums=(0,))
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (B, S + 1), 0, cfg.vocab_size)
+    batch = {"tokens": tokens}
+
+    state = (params, opt_state)
+    # Warmup / compile. float() forces a host transfer — under the axon
+    # tunnel, block_until_ready alone does not reliably fence execution.
+    for _ in range(2):
+        state, metrics = step(state, batch)
+    _ = float(metrics["loss"])
+
+    n_steps = 10
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, metrics = step(state, batch)
+    _ = float(metrics["loss"])
+    dt = (time.perf_counter() - t0) / n_steps
+
+    tokens_per_step = B * S
+    tok_s = tokens_per_step / dt
+    mfu = cfg.flops_per_token(S) * tok_s / peak_flops_per_chip()
+
+    print(
+        json.dumps(
+            {
+                "metric": "gpt2_medium_train_tokens_per_sec_per_chip",
+                "value": round(tok_s, 1),
+                "unit": "tokens/s/chip",
+                "vs_baseline": round(mfu / 0.40, 3),
+                "extra": {
+                    "mfu": round(mfu, 4),
+                    "step_ms": round(dt * 1000, 2),
+                    "params_m": round(cfg.n_params / 1e6, 1),
+                    "batch": B,
+                    "seq": S,
+                    "final_loss": round(float(metrics["loss"]), 3),
+                    "device": str(jax.devices()[0].device_kind),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,378 @@
+"""GPT model family (GPT-2 / GPT-J / Llama-style), TPU-first.
+
+Design (vs the reference's torch models driven through Train/DeepSpeed —
+`release/air_examples/gptj_deepspeed_finetuning`):
+  * pure-functional pytree params — no module framework between the math and
+    pjit; shardings come from `ShardingRules` logical dims.
+  * ONE stacked layer pytree + `lax.scan` over the layer axis → constant
+    compile time in depth, XLA pipelines the remat.
+  * attention is pluggable: "flash" (Pallas), "ring" (sp-axis sequence
+    parallel), "ulysses", "ref" — long context is a config flag, not a fork.
+  * bf16 params/activations, f32 optimizer state & softmax stats.
+
+Flagship configs: `gpt2_*` (LayerNorm/GELU/learned-pos), `gptj_6b`
+(parallel block + rotary), `llama_7b`-style (RMSNorm/SwiGLU/rotary).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import apply_rope, flash_attention, layernorm, ring_attention, rmsnorm, rope_frequencies
+from ..ops.attention import attention_reference, ulysses_attention
+from ..parallel.mesh import ShardingRules
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50304          # padded to a multiple of 128 for the MXU
+    n_layers: int = 12
+    d_model: int = 768
+    n_heads: int = 12
+    d_head: int = 64
+    d_mlp: int = 3072
+    max_seq: int = 1024
+    # Architecture knobs.
+    norm: str = "layernorm"          # layernorm | rmsnorm
+    activation: str = "gelu"         # gelu | swiglu
+    pos: str = "learned"             # learned | rotary
+    rotary_dim: int = 64
+    parallel_block: bool = False     # GPT-J: attn and mlp in parallel
+    tie_embeddings: bool = True
+    # Execution knobs.
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "flash"         # flash | ring | ulysses | ref
+    remat: bool = True
+    sp_axis: str = "sp"
+
+    @property
+    def n_params(self) -> int:
+        E, L, F, V, Hd = self.d_model, self.n_layers, self.d_mlp, self.vocab_size, self.n_heads * self.d_head
+        per_layer = E * 3 * Hd + Hd * E + (2 if self.activation == "swiglu" else 1) * E * F + F * E
+        per_layer += 2 * E  # norms
+        total = L * per_layer + V * E + (0 if self.tie_embeddings else E * V)
+        if self.pos == "learned":
+            total += self.max_seq * E
+        return total
+
+    def flops_per_token(self, seq_len: int) -> float:
+        """Training FLOPs/token: 6N + attention term (12·L·E·S·(S/S) approx)."""
+        return 6.0 * self.n_params + 12.0 * self.n_layers * self.d_model * seq_len
+
+
+# Canonical configs ---------------------------------------------------------
+def gpt2_small(**kw):
+    return GPTConfig(**{**dict(n_layers=12, d_model=768, n_heads=12, d_mlp=3072), **kw})
+
+
+def gpt2_medium(**kw):
+    return GPTConfig(**{**dict(n_layers=24, d_model=1024, n_heads=16, d_mlp=4096), **kw})
+
+
+def gpt2_large(**kw):
+    return GPTConfig(**{**dict(n_layers=36, d_model=1280, n_heads=20, d_mlp=5120), **kw})
+
+
+def gptj_6b(**kw):
+    return GPTConfig(
+        **{
+            **dict(
+                n_layers=28,
+                d_model=4096,
+                n_heads=16,
+                d_head=256,
+                d_mlp=16384,
+                vocab_size=50432,
+                pos="rotary",
+                rotary_dim=64,
+                parallel_block=True,
+                tie_embeddings=False,
+                max_seq=2048,
+            ),
+            **kw,
+        }
+    )
+
+
+def llama_7b(**kw):
+    return GPTConfig(
+        **{
+            **dict(
+                n_layers=32,
+                d_model=4096,
+                n_heads=32,
+                d_head=128,
+                d_mlp=11008,
+                vocab_size=32000,
+                norm="rmsnorm",
+                activation="swiglu",
+                pos="rotary",
+                rotary_dim=128,
+                tie_embeddings=False,
+                max_seq=2048,
+            ),
+            **kw,
+        }
+    )
+
+
+CONFIGS = {
+    "gpt2-small": gpt2_small,
+    "gpt2-medium": gpt2_medium,
+    "gpt2-large": gpt2_large,
+    "gptj-6b": gptj_6b,
+    "llama-7b": llama_7b,
+}
+
+
+# ------------------------------------------------------------------- params
+def param_logical_dims(cfg: GPTConfig) -> Dict[str, Tuple[Optional[str], ...]]:
+    """Logical dims per parameter — feed through ShardingRules for shardings."""
+    dims = {
+        "tok_embed": ("vocab", "embed"),
+        "ln_f_w": ("embed_act",),
+        "ln_f_b": ("embed_act",),
+        "w_qkv": ("layers", "embed", None, "heads", "head_dim"),
+        "b_qkv": ("layers", None, "heads", "head_dim"),
+        "w_o": ("layers", "heads", "head_dim", "embed"),
+        "b_o": ("layers", "embed_act"),
+        "w_in": ("layers", "embed", "mlp"),
+        "b_in": ("layers", "mlp_act"),
+        "w_out": ("layers", "mlp", "embed"),
+        "b_out": ("layers", "embed_act"),
+        "ln1_w": ("layers", "embed_act"),
+        "ln1_b": ("layers", "embed_act"),
+    }
+    if cfg.activation == "swiglu":
+        dims["w_gate"] = ("layers", "embed", "mlp")
+    if not cfg.parallel_block:
+        dims["ln2_w"] = ("layers", "embed_act")
+        dims["ln2_b"] = ("layers", "embed_act")
+    if cfg.pos == "learned":
+        dims["pos_embed"] = (None, "embed")
+    if not cfg.tie_embeddings:
+        dims["lm_head"] = ("embed", "vocab")
+    return dims
+
+
+def init_params(rng, cfg: GPTConfig) -> Dict[str, jnp.ndarray]:
+    E, L, F, V = cfg.d_model, cfg.n_layers, cfg.d_mlp, cfg.vocab_size
+    H, Dh = cfg.n_heads, cfg.d_head
+    k = jax.random.split(rng, 16)
+    std = 0.02
+    resid_std = std / math.sqrt(2 * L)
+    # Master params live in f32 (optimizer precision); forward casts each
+    # layer's weights to cfg.dtype (bf16) as the scan touches it.
+    dt = jnp.float32
+
+    def n(key, shape, s=std):
+        return (jax.random.normal(key, shape, jnp.float32) * s).astype(dt)
+
+    params = {
+        "tok_embed": n(k[0], (V, E)),
+        "ln_f_w": jnp.ones((E,), dt),
+        "ln_f_b": jnp.zeros((E,), dt),
+        "w_qkv": n(k[1], (L, E, 3, H, Dh)),
+        "b_qkv": jnp.zeros((L, 3, H, Dh), dt),
+        "w_o": n(k[2], (L, H, Dh, E), resid_std),
+        "b_o": jnp.zeros((L, E), dt),
+        "w_in": n(k[3], (L, E, F)),
+        "b_in": jnp.zeros((L, F), dt),
+        "w_out": n(k[4], (L, F, E), resid_std),
+        "b_out": jnp.zeros((L, E), dt),
+        "ln1_w": jnp.ones((L, E), dt),
+        "ln1_b": jnp.zeros((L, E), dt),
+    }
+    if cfg.activation == "swiglu":
+        params["w_gate"] = n(k[5], (L, E, F))
+    if not cfg.parallel_block:
+        params["ln2_w"] = jnp.ones((L, E), dt)
+        params["ln2_b"] = jnp.zeros((L, E), dt)
+    if cfg.pos == "learned":
+        params["pos_embed"] = n(k[6], (cfg.max_seq, E))
+    if not cfg.tie_embeddings:
+        params["lm_head"] = n(k[7], (E, V))
+    return params
+
+
+def param_shardings(cfg: GPTConfig, mesh, rules: Optional[ShardingRules] = None):
+    rules = rules or ShardingRules.default()
+    dims = param_logical_dims(cfg)
+    return {name: rules.sharding(mesh, *d) for name, d in dims.items()}
+
+
+# ------------------------------------------------------------------ forward
+def _norm(x, w, b, kind: str):
+    if kind == "rmsnorm":
+        return rmsnorm(x, w)
+    return layernorm(x, w, b)
+
+
+def _attention(cfg: GPTConfig, q, k, v, mesh=None):
+    """Two integration modes for sequence parallelism:
+
+    * mesh=None (manual SPMD): caller wrapped the whole forward in shard_map;
+      axis names are already bound — call the collective impl directly.
+    * mesh given (automatic/pjit): everything else auto-partitions; only the
+      attention core drops into a nested shard_map over the mesh so the ring
+      ppermutes ride the sp axis while XLA keeps handling dp/fsdp/tp.
+    """
+    if cfg.attn_impl in ("ring", "ulysses"):
+        impl = ring_attention if cfg.attn_impl == "ring" else ulysses_attention
+        impl = functools.partial(impl, axis=cfg.sp_axis, causal=True)
+        if mesh is None:
+            return impl(q, k, v)
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.spmd import shard_fn
+
+        spec = P(("dp", "fsdp"), "tp", cfg.sp_axis, None)
+        fn = shard_fn(impl, mesh, in_specs=(spec,) * 3, out_specs=spec)
+        return fn(q, k, v)
+    if cfg.attn_impl == "ref":
+        return attention_reference(q, k, v, causal=True)
+    return flash_attention(q, k, v, causal=True)
+
+
+def _block(cfg: GPTConfig, rope_tables, mesh, x, layer_params, positions):
+    """One transformer block; x: [B, S, E] in cfg.dtype."""
+    # Cast this layer's master weights to compute dtype (bf16 → MXU).
+    p = jax.tree_util.tree_map(lambda a: a.astype(cfg.dtype), layer_params)
+    B, S, E = x.shape
+    H, Dh = cfg.n_heads, cfg.d_head
+
+    h = _norm(x, p["ln1_w"], p["ln1_b"], cfg.norm)
+    qkv = jnp.einsum("bse,ethd->btshd", h, p["w_qkv"]) + p["b_qkv"][:, None]
+    q, k, v = (qkv[:, i].transpose(0, 2, 1, 3).reshape(B, H, S, Dh) for i in range(3))
+    # qkv[:, i] is [B, S, H, Dh] -> [B, H, S, Dh]
+    if cfg.pos == "rotary":
+        cos, sin = rope_tables
+        rd = min(cfg.rotary_dim, Dh)
+        c, s = cos[positions], sin[positions]
+        q = jnp.concatenate([apply_rope(q[..., :rd], c, s, None), q[..., rd:]], -1) \
+            if rd < Dh else apply_rope(q, c, s, None)
+        k = jnp.concatenate([apply_rope(k[..., :rd], c, s, None), k[..., rd:]], -1) \
+            if rd < Dh else apply_rope(k, c, s, None)
+    attn = _attention(cfg, q, k, v, mesh)  # [B, H, S, Dh]
+    attn_out = jnp.einsum("bhsd,hde->bse", attn, p["w_o"]) + p["b_o"]
+
+    if cfg.parallel_block:
+        mlp_in = h  # GPT-J: same normed input feeds attn and mlp
+    else:
+        x = x + attn_out
+        mlp_in = _norm(x, p["ln2_w"], p["ln2_b"], cfg.norm)
+
+    u = jnp.einsum("bse,ef->bsf", mlp_in, p["w_in"]) + p["b_in"]
+    if cfg.activation == "swiglu":
+        g = jnp.einsum("bse,ef->bsf", mlp_in, p["w_gate"])
+        u = jax.nn.silu(g) * u
+    else:
+        u = jax.nn.gelu(u)
+    mlp_out = jnp.einsum("bsf,fe->bse", u, p["w_out"]) + p["b_out"]
+
+    if cfg.parallel_block:
+        return x + attn_out + mlp_out
+    return x + mlp_out
+
+
+_LAYER_KEYS = (
+    "w_qkv", "b_qkv", "w_o", "b_o", "w_in", "b_in", "w_out", "b_out",
+    "ln1_w", "ln1_b", "ln2_w", "ln2_b", "w_gate",
+)
+
+
+def global_positions(cfg: GPTConfig, local_seq: int):
+    """Global token positions for this shard (manual-SPMD mode only). Under
+    whole-model shard_map the function body sees only the LOCAL sequence
+    chunk — positions must be offset by this device's sp-axis index or
+    RoPE/learned-pos phases are wrong on every shard but the first."""
+    if cfg.attn_impl in ("ring", "ulysses"):
+        offset = jax.lax.axis_index(cfg.sp_axis) * local_seq
+        return offset + jnp.arange(local_seq)
+    return jnp.arange(local_seq)
+
+
+def forward(params, tokens, cfg: GPTConfig, positions=None, mesh=None):
+    """tokens [B, S] → logits [B, S, V].
+
+    mesh=None → plain jit or caller-managed shard_map (manual SPMD).
+    mesh given → automatic pjit partitioning with a nested shard_map around
+    the attention core when cfg.attn_impl is ring/ulysses.
+    """
+    B, S = tokens.shape
+    if positions is None:
+        # In automatic (pjit) mode shapes are global — plain arange is right.
+        positions = jnp.arange(S) if mesh is not None else global_positions(cfg, S)
+    x = params["tok_embed"][tokens].astype(cfg.dtype)
+    if cfg.pos == "learned":
+        x = x + params["pos_embed"][positions].astype(cfg.dtype)
+
+    rope_tables = None
+    if cfg.pos == "rotary":
+        rd = min(cfg.rotary_dim, cfg.d_head)
+        rope_tables = rope_frequencies(rd, cfg.max_seq, dtype=jnp.float32)
+
+    layer_stack = {k: params[k] for k in _LAYER_KEYS if k in params}
+
+    block = functools.partial(_block, cfg, rope_tables, mesh)
+    if cfg.remat:
+        block = jax.checkpoint(block, policy=jax.checkpoint_policies.nothing_saveable)
+
+    def scan_body(x, layer_params):
+        return block(x, layer_params, positions), None
+
+    x, _ = jax.lax.scan(scan_body, x, layer_stack)
+
+    x = _norm(x, params["ln_f_w"], params["ln_f_b"], cfg.norm)
+    head = params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("bse,ev->bsv", x, head.astype(cfg.dtype))
+    return logits
+
+
+def loss_fn(params, batch, cfg: GPTConfig, mesh=None):
+    """batch: {"tokens": [B, S+1]} or {"inputs","targets"} → mean next-token
+    cross-entropy (f32)."""
+    if "inputs" in batch:
+        inputs, targets = batch["inputs"], batch["targets"]
+        mask = batch.get("mask")  # already target-aligned in this layout
+    else:
+        tokens = batch["tokens"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        mask = batch.get("mask")
+        if mask is not None:
+            mask = mask[:, 1:]
+    logits = forward(params, inputs, cfg, mesh=mesh).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is not None:
+        m = mask.astype(jnp.float32)
+        return -(ll * m).sum() / jnp.maximum(m.sum(), 1.0)
+    return -ll.mean()
+
+
+def make_train_step(cfg: GPTConfig, optimizer, mesh=None) -> Callable:
+    """Returns `step(state, batch) -> (state, metrics)`; jit at the call site
+    with shardings (see ray_tpu.train.JaxTrainer / bench.py)."""
+
+    def step(state, batch):
+        params, opt_state = state
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg, mesh)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(
+            lambda p, u: (p + u.astype(p.dtype)), params, updates
+        )
+        gnorm = optax_global_norm(grads)
+        return (params, opt_state), {"loss": loss, "grad_norm": gnorm}
+
+    return step
+
+
+def optax_global_norm(tree):
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves))

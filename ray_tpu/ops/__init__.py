@@ -1,0 +1,19 @@
+from .attention import (
+    attention_reference,
+    flash_attention,
+    ring_attention,
+    ulysses_attention,
+)
+from .norms import layernorm, rmsnorm
+from .rope import apply_rope, rope_frequencies
+
+__all__ = [
+    "flash_attention",
+    "ring_attention",
+    "ulysses_attention",
+    "attention_reference",
+    "rmsnorm",
+    "layernorm",
+    "apply_rope",
+    "rope_frequencies",
+]

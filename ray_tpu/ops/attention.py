@@ -1,0 +1,300 @@
+"""Attention kernels: Pallas flash attention + ring/Ulysses context parallelism.
+
+The reference has NO sequence-parallel attention (SURVEY.md §2.6 — grep shows
+long-context entirely delegated to DeepSpeed/FSDP inside Train workers). Here
+it is first-class:
+
+  * `flash_attention` — blockwise online-softmax kernel on the MXU
+    (Pallas; falls back to an XLA reference off-TPU).
+  * `ring_attention`  — sequence shards on the `sp` mesh axis; K/V blocks
+    rotate around the ring via `ppermute` with global-position causal
+    masking and online-softmax merging. Call under `shard_map`.
+  * `ulysses_attention` — all_to_all head<->seq exchange so each device
+    runs full-sequence attention on a head subset.
+
+Shapes follow [batch, heads, seq, head_dim] throughout.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+# --------------------------------------------------------------- reference
+def attention_reference(q, k, v, causal: bool = True, sm_scale: Optional[float] = None):
+    """XLA attention (materializes logits). Ground truth for kernels and the
+    off-TPU fallback."""
+    *_, S, D = q.shape
+    Skv = k.shape[-2]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
+    logits = jnp.einsum("bhsd,bhtd->bhst", q, k, preferred_element_type=jnp.float32)
+    logits = logits * scale
+    if causal:
+        qpos = jnp.arange(S)[:, None] + (Skv - S)  # align ends when S != Skv
+        kpos = jnp.arange(Skv)[None, :]
+        logits = jnp.where(qpos >= kpos, logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhst,bhtd->bhsd", probs.astype(v.dtype), v)
+
+
+# ------------------------------------------------------------ pallas kernel
+def _flash_fwd_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    *,
+    block_k: int,
+    causal: bool,
+    sm_scale: float,
+    seq_q: int,
+    seq_kv: int,
+):
+    """Inputs are PADDED to block multiples by the caller (pl.ds on a ragged
+    tail clamps the start index, silently misaligning data vs mask — so
+    padding + masking against the ORIGINAL lengths is the only safe layout).
+    seq_q/seq_kv are the original (unpadded) lengths."""
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    block_q = q_ref.shape[1]
+    padded_k = k_ref.shape[1]
+    # When S != Skv (decode over a cached prefix) queries are END-aligned
+    # with keys, matching attention_reference's (Skv - S) offset.
+    row_offset = seq_kv - seq_q
+    q = q_ref[0].astype(jnp.float32) * sm_scale  # [Bq, D]
+
+    num_k_blocks = pl.cdiv(padded_k, block_k)
+    if causal:
+        # Only blocks up to the (offset) diagonal contribute.
+        last = jax.lax.div((qi + 1) * block_q + row_offset + block_k - 1, block_k)
+        num_k_blocks = jnp.minimum(num_k_blocks, jnp.maximum(last, 1))
+
+    def body(j, carry):
+        acc, m_prev, l_prev = carry
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [Bq, Bk]
+        cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        valid = cols < seq_kv  # mask the zero-padded tail
+        if causal:
+            rows = (
+                row_offset
+                + qi * block_q
+                + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            )
+            valid = jnp.logical_and(valid, rows >= cols)
+        s = jnp.where(valid, s, _NEG_INF)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)  # [Bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return acc, m_new, l_new
+
+    D = q_ref.shape[2]
+    init = (
+        jnp.zeros((block_q, D), jnp.float32),
+        jnp.full((block_q, 1), _NEG_INF, jnp.float32),
+        jnp.zeros((block_q, 1), jnp.float32),
+    )
+    acc, m, l = jax.lax.fori_loop(0, num_k_blocks, body, init)
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_fwd_pallas(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: int,
+                      interpret: bool = False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, S, D = q.shape
+    Skv = k.shape[2]
+    block_q = min(block_q, max(S, 8))
+    block_k = min(block_k, Skv)
+    # Pad to block multiples (see kernel docstring for why).
+    S_p = -(-S // block_q) * block_q
+    Skv_p = -(-Skv // block_k) * block_k
+    qr = q.reshape(B * H, S, D)
+    kr = k.reshape(B * H, Skv, D)
+    vr = v.reshape(B * H, Skv, D)
+    if S_p != S:
+        qr = jnp.pad(qr, ((0, 0), (0, S_p - S), (0, 0)))
+    if Skv_p != Skv:
+        kr = jnp.pad(kr, ((0, 0), (0, Skv_p - Skv), (0, 0)))
+        vr = jnp.pad(vr, ((0, 0), (0, Skv_p - Skv), (0, 0)))
+    grid = (B * H, S_p // block_q)
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_fwd_kernel,
+            block_k=block_k,
+            causal=causal,
+            sm_scale=sm_scale,
+            seq_q=S,
+            seq_kv=Skv,
+        ),
+        out_shape=jax.ShapeDtypeStruct((B * H, S_p, D), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, Skv_p, D), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, Skv_p, D), lambda bh, i: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, i: (bh, i, 0)),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        )
+        if hasattr(pltpu, "CompilerParams")
+        else None,
+        cost_estimate=pl.CostEstimate(
+            flops=4 * B * H * S * Skv * D,
+            bytes_accessed=2 * (qr.size + kr.size + vr.size) * q.dtype.itemsize,
+            transcendentals=B * H * S * Skv,
+        ),
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out[:, :S].reshape(B, H, S, D)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:  # noqa: BLE001
+        return False
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k):
+    return _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k)
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    out = _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
+    # Backward recomputes attention under XLA autodiff (flash-bwd kernel is a
+    # planned optimization; XLA's fused softmax grad is adequate at the block
+    # sizes ring attention leaves per device).
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q_, k_, v_: attention_reference(q_, k_, v_, causal, sm_scale), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+):
+    """Blockwise attention. Pallas on TPU; XLA reference elsewhere."""
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    if not _on_tpu():
+        return attention_reference(q, k, v, causal, scale)
+    return _flash(q, k, v, causal, scale, block_q, block_k)
+
+
+# ------------------------------------------------------------ ring attention
+def _chunk_attn(q, k, v, mask, scale):
+    """One K/V chunk's contribution with softmax stats (all fp32)."""
+    s = jnp.einsum("bhsd,bhtd->bhst", q, k, preferred_element_type=jnp.float32) * scale
+    s = jnp.where(mask, s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)  # [B,H,S,1]
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bhst,bhtd->bhsd", p, v.astype(jnp.float32))
+    return o, m, l
+
+
+def ring_attention(
+    q,
+    k,
+    v,
+    axis: str = "sp",
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+):
+    """Blockwise ring attention over sequence shards (call under shard_map).
+
+    Per device: q,k,v are the LOCAL sequence shard [B, H, S_local, D]. Each of
+    the `axis_size` steps attends q against the K/V block currently resident,
+    then rotates K/V one hop around the ring (`ppermute` compiles to
+    neighbor ICI transfers, overlapped by XLA with the matmuls). Causal
+    masking uses global positions, so fully-masked steps contribute nothing.
+    """
+    n = jax.lax.axis_size(axis)
+    my = jax.lax.axis_index(axis)
+    B, H, S_local, D = q.shape
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
+    qf = q.astype(jnp.float32)
+
+    q_start = my * S_local
+    rows = q_start + jnp.arange(S_local)[:, None]  # global q positions
+
+    def step(carry, i):
+        acc, m_prev, l_prev, k_cur, v_cur = carry
+        src = (my - i) % n  # whose K/V block we hold at step i
+        kv_start = src * S_local
+        cols = kv_start + jnp.arange(S_local)[None, :]
+        mask = (rows >= cols) if causal else jnp.ones((S_local, S_local), bool)
+        o_c, m_c, l_c = _chunk_attn(qf, k_cur, v_cur, mask, scale)
+        m_new = jnp.maximum(m_prev, m_c)
+        alpha = jnp.exp(m_prev - m_new)
+        beta = jnp.exp(m_c - m_new)
+        acc = acc * alpha + o_c * beta
+        l_new = l_prev * alpha + l_c * beta
+        perm = [(d, (d + 1) % n) for d in range(n)]
+        k_nxt = jax.lax.ppermute(k_cur, axis, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis, perm)
+        return (acc, m_new, l_new, k_nxt, v_nxt), None
+
+    init = (
+        jnp.zeros((B, H, S_local, D), jnp.float32),
+        jnp.full((B, H, S_local, 1), _NEG_INF, jnp.float32),
+        jnp.zeros((B, H, S_local, 1), jnp.float32),
+        k,
+        v,
+    )
+    (acc, m, l, _, _), _ = jax.lax.scan(step, init, jnp.arange(n))
+    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def ulysses_attention(
+    q,
+    k,
+    v,
+    axis: str = "sp",
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+):
+    """DeepSpeed-Ulysses-style context parallelism (call under shard_map).
+
+    Inputs are sequence-sharded [B, H, S_local, D]; `all_to_all` swaps the
+    shard axis from sequence to heads, each device runs FULL-sequence
+    attention over H/n heads, then swaps back. Requires H % axis_size == 0.
+    """
+    # [B, H, S/n, D] -> [B, H/n, S, D]
+    q2 = jax.lax.all_to_all(q, axis, split_axis=1, concat_axis=2, tiled=True)
+    k2 = jax.lax.all_to_all(k, axis, split_axis=1, concat_axis=2, tiled=True)
+    v2 = jax.lax.all_to_all(v, axis, split_axis=1, concat_axis=2, tiled=True)
+    o2 = flash_attention(q2, k2, v2, causal=causal, sm_scale=sm_scale)
+    # [B, H/n, S, D] -> [B, H, S/n, D]
+    return jax.lax.all_to_all(o2, axis, split_axis=2, concat_axis=1, tiled=True)

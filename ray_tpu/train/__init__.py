@@ -1,0 +1,45 @@
+"""ray_tpu.train — distributed training library.
+
+API parity target: `ray.train` (`python/ray/train/__init__.py` — SURVEY.md
+Appendix A): report / get_context / get_checkpoint / get_dataset_shard,
+Checkpoint, RunConfig / ScalingConfig / CheckpointConfig / FailureConfig,
+Result, and trainers.
+
+TPU-first redesign: where the reference's `TorchTrainer` wires
+`dist.init_process_group(nccl)` into each worker (`torch/config.py:106`),
+`JaxTrainer` gangs one worker per HOST and builds a global `jax.sharding`
+Mesh across them (`jax.distributed` for multi-host); within a host, data
+parallelism is pjit over local chips — workers never see NCCL or per-chip
+process groups.
+"""
+
+from .config import (
+    CheckpointConfig,
+    DataConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from .checkpoint import Checkpoint
+from .session import get_checkpoint, get_context, get_dataset_shard, report
+from .result import Result
+from .base_trainer import BaseTrainer
+from .data_parallel_trainer import DataParallelTrainer
+from .jax_trainer import JaxTrainer
+
+__all__ = [
+    "report",
+    "get_context",
+    "get_checkpoint",
+    "get_dataset_shard",
+    "Checkpoint",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "CheckpointConfig",
+    "FailureConfig",
+    "DataConfig",
+    "BaseTrainer",
+    "DataParallelTrainer",
+    "JaxTrainer",
+]

@@ -1,0 +1,158 @@
+"""Checkpoint: a directory handle with to/from-pytree helpers.
+
+Reference analog: `ray.train.Checkpoint` (`python/ray/air/checkpoint.py`) —
+a movable directory. TPU addition: orbax-backed pytree save/restore so
+sharded jax arrays round-trip correctly (reference uses torch.save).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+import tempfile
+import time
+from typing import Any, Dict, Optional
+
+
+class Checkpoint:
+    def __init__(self, path: str):
+        self.path = path
+
+    # ----------------------------------------------------------- factories
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(os.path.abspath(path))
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Checkpoint":
+        d = tempfile.mkdtemp(prefix="rtpu-ckpt-")
+        with open(os.path.join(d, "data.pkl"), "wb") as f:
+            pickle.dump(data, f)
+        return cls(d)
+
+    @classmethod
+    def from_pytree(cls, tree: Any, path: Optional[str] = None) -> "Checkpoint":
+        """Save a jax pytree (uses orbax when available, pickle otherwise)."""
+        d = path or tempfile.mkdtemp(prefix="rtpu-ckpt-")
+        os.makedirs(d, exist_ok=True)
+        try:
+            import orbax.checkpoint as ocp
+
+            ckptr = ocp.StandardCheckpointer()
+            target = os.path.join(os.path.abspath(d), "pytree")
+            if os.path.exists(target):
+                shutil.rmtree(target)
+            ckptr.save(target, tree)
+            ckptr.wait_until_finished()
+        except Exception:  # noqa: BLE001 — orbax absent or type unsupported
+            import jax
+
+            host_tree = jax.tree_util.tree_map(lambda x: _to_host(x), tree)
+            with open(os.path.join(d, "pytree.pkl"), "wb") as f:
+                pickle.dump(host_tree, f)
+        return cls(d)
+
+    # ------------------------------------------------------------ accessors
+    def to_dict(self) -> Dict[str, Any]:
+        with open(os.path.join(self.path, "data.pkl"), "rb") as f:
+            return pickle.load(f)
+
+    def to_pytree(self, template: Any = None) -> Any:
+        orbax_path = os.path.join(self.path, "pytree")
+        if os.path.isdir(orbax_path):
+            import orbax.checkpoint as ocp
+
+            ckptr = ocp.StandardCheckpointer()
+            return ckptr.restore(os.path.abspath(orbax_path), template)
+        with open(os.path.join(self.path, "pytree.pkl"), "rb") as f:
+            return pickle.load(f)
+
+    def as_directory(self) -> str:
+        return self.path
+
+    def __repr__(self) -> str:
+        return f"Checkpoint({self.path})"
+
+    def __reduce__(self):
+        return (Checkpoint, (self.path,))
+
+
+def _to_host(x):
+    try:
+        import numpy as np
+
+        return np.asarray(x)
+    except Exception:  # noqa: BLE001
+        return x
+
+
+class CheckpointManager:
+    """Keeps top-k checkpoints by score (reference:
+    `train/_internal/checkpoint_manager.py`)."""
+
+    def __init__(self, directory: str, num_to_keep: Optional[int] = None,
+                 score_attribute: Optional[str] = None, score_order: str = "max"):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self.num_to_keep = num_to_keep
+        self.score_attribute = score_attribute
+        self.score_order = score_order
+        self._entries = []  # (score, path, metrics, order)
+        self._counter = 0
+
+    def register(self, checkpoint: Checkpoint, metrics: Dict[str, Any]) -> str:
+        self._counter += 1
+        dest = os.path.join(self.directory, f"checkpoint_{self._counter:06d}")
+        if os.path.abspath(checkpoint.path) != os.path.abspath(dest):
+            if os.path.exists(dest):
+                shutil.rmtree(dest)
+            shutil.copytree(checkpoint.path, dest)
+        score = metrics.get(self.score_attribute) if self.score_attribute else self._counter
+        self._entries.append((score, dest, dict(metrics), self._counter))
+        with open(os.path.join(dest, "metrics.json"), "w") as f:
+            json.dump({"metrics": _json_safe(metrics), "ts": time.time()}, f)
+        self._evict()
+        return dest
+
+    def _ranked(self):
+        """Entries best-first; missing scores always rank WORST."""
+        reverse = self.score_order == "max"
+        if reverse:
+            key = lambda e: (e[0] is not None, e[0] if e[0] is not None else 0)  # noqa: E731
+        else:
+            key = lambda e: (e[0] is None, e[0] if e[0] is not None else 0)  # noqa: E731
+        return sorted(self._entries, key=key, reverse=reverse)
+
+    def _evict(self):
+        if self.num_to_keep is None or len(self._entries) <= self.num_to_keep:
+            return
+        ranked = self._ranked()
+        for _, path, _, _ in ranked[self.num_to_keep :]:
+            shutil.rmtree(path, ignore_errors=True)
+        kept = ranked[: self.num_to_keep]
+        # Preserve registration order so latest() means "most recent", not
+        # "lowest-ranked survivor".
+        self._entries = sorted(kept, key=lambda e: e[3])
+
+    def best(self) -> Optional[Checkpoint]:
+        if not self._entries:
+            return None
+        return Checkpoint(self._ranked()[0][1])
+
+    def latest(self) -> Optional[Checkpoint]:
+        if not self._entries:
+            return None
+        return Checkpoint(max(self._entries, key=lambda e: e[3])[1])
+
+
+def _json_safe(d):
+    out = {}
+    for k, v in d.items():
+        try:
+            json.dumps(v)
+            out[k] = v
+        except TypeError:
+            out[k] = str(v)
+    return out

@@ -1,0 +1,67 @@
+"""Run/scaling/checkpoint/failure configs (reference: `python/ray/air/config.py`)."""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class ScalingConfig:
+    """How many workers and what each needs.
+
+    Reference analog: `air/config.py ScalingConfig` (num_workers,
+    use_gpu, resources_per_worker). TPU addition: `topology` — a mesh axis
+    dict (e.g. {"dp": 4, "tp": 4}) describing the global device mesh the
+    worker gang should assemble.
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: Dict[str, float] = field(default_factory=dict)
+    placement_strategy: str = "PACK"
+    topology: Optional[Dict[str, int]] = None
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker)
+        if self.use_tpu and "TPU" not in res:
+            res["TPU"] = 1.0
+        if "CPU" not in res:
+            res["CPU"] = 1.0
+        return res
+
+
+@dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+    checkpoint_frequency: int = 0
+
+
+@dataclass
+class FailureConfig:
+    max_failures: int = 0
+
+
+@dataclass
+class DataConfig:
+    datasets_to_split: Any = "all"
+
+
+@dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    stop: Optional[dict] = None
+    verbose: int = 1
+
+    def resolve_storage(self) -> str:
+        base = self.storage_path or os.path.join(
+            os.path.expanduser("~"), "ray_tpu_results"
+        )
+        name = self.name or "run"
+        return os.path.join(base, name)

@@ -1,0 +1,76 @@
+"""DataParallelTrainer — gang of workers running the same loop on data shards."""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Callable, Dict, Optional
+
+from .backend_executor import Backend, BackendExecutor
+from .base_trainer import BaseTrainer
+from .config import RunConfig, ScalingConfig
+from .result import Result
+
+
+class CollectiveBackend(Backend):
+    """Sets up a host-plane collective group over the gang so workers can
+    allreduce out-of-jit arrays (role of Gloo in the reference)."""
+
+    def __init__(self, group_name: Optional[str] = None):
+        self.group_name = group_name or f"train_{uuid.uuid4().hex[:8]}"
+
+    def on_start(self, worker_group, scaling):
+        if len(worker_group) > 1:
+            worker_group.setup_collective(self.group_name)
+
+
+class DataParallelTrainer(BaseTrainer):
+    """Reference analog: `python/ray/train/data_parallel_trainer.py`.
+
+    `train_loop_per_worker(config)` runs on every worker; inside it use
+    `ray_tpu.train.report/get_context/get_checkpoint`, the gang's collective
+    group (`ray_tpu.collective`, group name in config["collective_group"]),
+    and `get_dataset_shard` for per-worker data.
+    """
+
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[dict] = None,
+        backend: Optional[Backend] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+        resume_from_checkpoint=None,
+    ):
+        super().__init__(
+            scaling_config=scaling_config,
+            run_config=run_config,
+            datasets=datasets,
+            resume_from_checkpoint=resume_from_checkpoint,
+        )
+        self.train_loop_per_worker = train_loop_per_worker
+        self.train_loop_config = dict(train_loop_config or {})
+        self.backend = backend or CollectiveBackend()
+
+    def fit(self) -> Result:
+        executor = BackendExecutor(
+            self.backend,
+            self.scaling_config,
+            self.run_config,
+            experiment_name=self.run_config.name or "train",
+        )
+        if self.resume_from_checkpoint is not None:
+            executor._latest_checkpoint = self.resume_from_checkpoint
+        if self.datasets:
+            # Registered BEFORE start so gang restarts re-attach shards too.
+            executor.set_datasets(self.datasets)
+        executor.start()
+        config = dict(self.train_loop_config)
+        if isinstance(self.backend, CollectiveBackend):
+            config.setdefault("collective_group", self.backend.group_name)
+        try:
+            result = executor.run(self.train_loop_per_worker, config)
+        finally:
+            executor.shutdown()
+        return result

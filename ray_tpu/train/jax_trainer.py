@@ -1,0 +1,122 @@
+"""JaxTrainer — the TPU-native trainer.
+
+This is the component BASELINE.json's north star names: the reference's
+`TorchTrainer` + NCCL process groups (`train/torch/config.py:106,148`)
+replaced by a JAX/pjit backend. Key inversion: the reference runs one worker
+per GPU and wires a NCCL communicator between them; here one worker runs per
+HOST, owns all local chips, and the gang assembles ONE global mesh —
+in-step communication is compiled by XLA onto ICI, with `jax.distributed`
+over DCN for multi-host.
+
+Inside `train_loop_per_worker`:
+    ctx  = ray_tpu.train.get_context()
+    mesh = ray_tpu.train.jax_utils.get_mesh()        # gang-wide Mesh
+    step = jax.jit(train_step, in_shardings=..., ...)  # XLA does the rest
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from typing import Callable, Dict, Optional
+
+from .backend_executor import Backend
+from .config import RunConfig, ScalingConfig
+from .data_parallel_trainer import CollectiveBackend, DataParallelTrainer
+
+
+class JaxBackend(CollectiveBackend):
+    """Arranges `jax.distributed` env across the gang.
+
+    Worker 0 becomes the coordinator; every worker gets
+    JAX_COORDINATOR_ADDRESS / process id env so user code (or
+    `jax_utils.maybe_init_distributed`) can call
+    `jax.distributed.initialize` and see the union of all hosts' chips in
+    `jax.devices()`.
+    """
+
+    def on_start(self, worker_group, scaling):
+        super().on_start(worker_group, scaling)
+        n = len(worker_group)
+        if n <= 1:
+            return
+        port = _free_port()
+        coord = f"127.0.0.1:{port}"  # multi-node providers substitute host IPs
+        envs = [
+            {
+                "RAY_TPU_JAX_COORDINATOR": coord,
+                "RAY_TPU_JAX_NUM_PROCESSES": str(n),
+                "RAY_TPU_JAX_PROCESS_ID": str(i),
+            }
+            for i in range(n)
+        ]
+        worker_group.set_env_all(envs)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class JaxTrainer(DataParallelTrainer):
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[dict] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[Dict] = None,
+        resume_from_checkpoint=None,
+    ):
+        super().__init__(
+            train_loop_per_worker,
+            train_loop_config=train_loop_config,
+            backend=JaxBackend(),
+            scaling_config=scaling_config,
+            run_config=run_config,
+            datasets=datasets,
+            resume_from_checkpoint=resume_from_checkpoint,
+        )
+
+
+# ------------------------------------------------------------------- utils
+class jax_utils:
+    """Worker-side helpers (reference analog: `train/torch/train_loop_utils.py`
+    `prepare_model`/`get_device` — except there is nothing to wrap: sharding
+    specs replace DDP)."""
+
+    @staticmethod
+    def maybe_init_distributed():
+        """Join the gang-wide jax runtime if this gang spans hosts."""
+        import jax
+
+        # Session env is authoritative (os.environ is shared between workers
+        # in local mode and would hand every worker the last rank's id).
+        from .session import get_context
+
+        env = dict(os.environ)
+        env.update(get_context().env_vars)
+        coord = env.get("RAY_TPU_JAX_COORDINATOR")
+        if not coord:
+            return False
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(env["RAY_TPU_JAX_NUM_PROCESSES"]),
+            process_id=int(env["RAY_TPU_JAX_PROCESS_ID"]),
+        )
+        return True
+
+    @staticmethod
+    def get_mesh(**axis_sizes):
+        """Build the gang-wide mesh (default: pure dp over all chips)."""
+        import jax
+
+        from ..parallel import make_mesh
+
+        if not axis_sizes:
+            axis_sizes = {"dp": -1}
+        return make_mesh(jax.devices(), **axis_sizes)

@@ -1,0 +1,153 @@
+"""WorkerGroup — a gang of training worker actors.
+
+Reference analog: `python/ray/train/_internal/worker_group.py:102` — N actors
+created with per-worker resources, functions pushed to all workers. Gang
+placement uses a STRICT_PACK/PACK placement group like slice gangs in the
+reference's TPU pod scheduling (`_private/accelerators/tpu.py:199-313`).
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+from .. import core
+from ..core import api
+from .session import TrainContext, get_session, init_session, shutdown_session
+
+
+class TrainWorker:
+    """Actor hosting one training worker (runs user fn on a thread so the
+    actor stays responsive for result polling)."""
+
+    def __init__(self, context_kwargs: Dict[str, Any]):
+        self.context = TrainContext(**context_kwargs)
+        self.session = init_session(self.context)
+        self._thread: Optional[threading.Thread] = None
+        self._collective: Optional[tuple] = None
+
+    def set_env(self, env: Dict[str, str]):
+        import os
+
+        self.context.env_vars.update(env)
+        os.environ.update(env)
+        return True
+
+    def setup_collective(self, world_size: int, rank: int, group_name: str):
+        # Recorded only; the actual init happens on the loop thread in run()
+        # because the group context is thread-local.
+        self._collective = (world_size, rank, group_name)
+        return True
+
+    def run(self, fn_payload) -> bool:
+        import cloudpickle
+
+        from .session import bind_thread_session
+
+        fn, config = cloudpickle.loads(fn_payload)
+
+        def target():
+            bind_thread_session(self.session)
+            try:
+                if self._collective is not None:
+                    from .. import collective
+
+                    world, rank, group = self._collective
+                    collective.init_collective_group(world, rank, group_name=group)
+                if config is not None:
+                    fn(config)
+                else:
+                    fn()
+            except BaseException as e:  # noqa: BLE001
+                self.session.error = e
+                self.session.error_tb = traceback.format_exc()
+            finally:
+                self.session.finished.set()
+
+        self._thread = threading.Thread(target=target, daemon=True)
+        self._thread.start()
+        return True
+
+    def poll(self):
+        """Drain pending results; returns (results, finished, error_str)."""
+        out = []
+        while not self.session.results.empty():
+            out.append(self.session.results.get())
+        err = None
+        if self.session.error is not None:
+            err = f"{self.session.error!r}\n{getattr(self.session, 'error_tb', '')}"
+        return out, self.session.finished.is_set(), err
+
+    def set_checkpoint(self, checkpoint):
+        self.context.latest_checkpoint = checkpoint
+        return True
+
+    def execute(self, fn_payload):
+        """Synchronously run a function on the worker (for utilities)."""
+        import cloudpickle
+
+        fn = cloudpickle.loads(fn_payload)
+        return fn()
+
+
+class WorkerGroup:
+    def __init__(
+        self,
+        num_workers: int,
+        resources_per_worker: Dict[str, float],
+        contexts: List[Dict[str, Any]],
+        placement_strategy: str = "PACK",
+    ):
+        import cloudpickle
+
+        self._cloudpickle = cloudpickle
+        remote_cls = api.remote(TrainWorker)
+        opts: Dict[str, Any] = {}
+        cpus = resources_per_worker.get("CPU", 1.0)
+        tpus = resources_per_worker.get("TPU", 0.0)
+        extra = {
+            k: v for k, v in resources_per_worker.items() if k not in ("CPU", "TPU")
+        }
+        self.workers = [
+            remote_cls.options(
+                num_cpus=cpus, num_tpus=tpus or None, resources=extra or {}
+            ).remote(contexts[i])
+            for i in range(num_workers)
+        ]
+
+    def __len__(self):
+        return len(self.workers)
+
+    def run_async(self, fn: Callable, config=None):
+        payload = self._cloudpickle.dumps((fn, config))
+        return api.get([w.run.remote(payload) for w in self.workers])
+
+    def poll(self):
+        return api.get([w.poll.remote() for w in self.workers])
+
+    def execute_all(self, fn: Callable):
+        payload = self._cloudpickle.dumps(fn)
+        return api.get([w.execute.remote(payload) for w in self.workers])
+
+    def set_env_all(self, envs: List[Dict[str, str]]):
+        return api.get(
+            [w.set_env.remote(env) for w, env in zip(self.workers, envs)]
+        )
+
+    def setup_collective(self, group_name: str):
+        refs = [
+            w.setup_collective.remote(len(self.workers), i, group_name)
+            for i, w in enumerate(self.workers)
+        ]
+        return api.get(refs, timeout=120)
+
+    def set_checkpoint_all(self, checkpoint):
+        return api.get([w.set_checkpoint.remote(checkpoint) for w in self.workers])
+
+    def shutdown(self):
+        for w in self.workers:
+            try:
+                api.kill(w)
+            except Exception:  # noqa: BLE001
+                pass

@@ -1,0 +1,174 @@
+"""Trial schedulers (reference: `python/ray/tune/schedulers/`): FIFO,
+ASHA (async successive halving), HyperBand-lite, MedianStopping, PBT."""
+
+from __future__ import annotations
+
+import math
+import random
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class TrialScheduler:
+    def set_objective(self, metric: str, mode: str):
+        self.metric = metric
+        self.mode = mode
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial, result: Optional[Dict[str, Any]]):
+        pass
+
+    def _score(self, result: Dict[str, Any]) -> Optional[float]:
+        v = result.get(self.metric)
+        if v is None:
+            return None
+        return float(v) if self.mode == "max" else -float(v)
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA (reference: `schedulers/async_hyperband.py`): rungs at
+    grace_period * reduction_factor^k; a trial reaching a rung is stopped if
+    it is below the top-1/reduction_factor quantile of scores recorded there."""
+
+    def __init__(
+        self,
+        time_attr: str = "training_iteration",
+        grace_period: int = 1,
+        reduction_factor: float = 3,
+        max_t: int = 100,
+        brackets: int = 1,
+    ):
+        self.time_attr = time_attr
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        self.max_t = max_t
+        self._rungs: Dict[int, List[float]] = defaultdict(list)
+
+    def _milestones(self):
+        out = []
+        t = self.grace_period
+        while t < self.max_t:
+            out.append(int(t))
+            t *= self.rf
+        return out
+
+    def on_trial_result(self, trial, result):
+        t = result.get(self.time_attr)
+        score = self._score(result)
+        if t is None or score is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        for milestone in self._milestones():
+            if t == milestone:
+                rung = self._rungs[milestone]
+                rung.append(score)
+                k = max(1, int(len(rung) / self.rf))
+                cutoff = sorted(rung, reverse=True)[k - 1]
+                if score < cutoff:
+                    return STOP
+        return CONTINUE
+
+
+ASHAScheduler = AsyncHyperBandScheduler
+
+
+class MedianStoppingRule(TrialScheduler):
+    def __init__(self, time_attr: str = "training_iteration", grace_period: int = 1,
+                 min_samples_required: int = 3):
+        self.time_attr = time_attr
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        self._best: Dict[Any, float] = {}
+
+    def on_trial_result(self, trial, result):
+        score = self._score(result)
+        t = result.get(self.time_attr, 0)
+        if score is None:
+            return CONTINUE
+        prev = self._best.get(trial.trial_id)
+        self._best[trial.trial_id] = max(score, prev) if prev is not None else score
+        if t < self.grace_period or len(self._best) < self.min_samples:
+            return CONTINUE
+        others = [v for k, v in self._best.items() if k != trial.trial_id]
+        if not others:
+            return CONTINUE
+        median = sorted(others)[len(others) // 2]
+        return STOP if self._best[trial.trial_id] < median else CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (reference: `schedulers/pbt.py`): every `perturbation_interval`
+    the controller asks whether a trial should exploit a better one; the
+    controller performs the checkpoint copy + restart, this class decides."""
+
+    def __init__(
+        self,
+        time_attr: str = "training_iteration",
+        perturbation_interval: int = 5,
+        hyperparam_mutations: Optional[Dict[str, Any]] = None,
+        quantile_fraction: float = 0.25,
+        resample_probability: float = 0.25,
+        seed: Optional[int] = None,
+    ):
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_prob = resample_probability
+        self._scores: Dict[Any, float] = {}
+        self._last_perturb: Dict[Any, int] = {}
+        self._rng = random.Random(seed)
+
+    def on_trial_result(self, trial, result):
+        score = self._score(result)
+        if score is not None:
+            self._scores[trial.trial_id] = score
+        return CONTINUE
+
+    def should_perturb(self, trial, result) -> bool:
+        t = result.get(self.time_attr, 0)
+        last = self._last_perturb.get(trial.trial_id, 0)
+        if t - last < self.interval or len(self._scores) < 2:
+            return False
+        ranked = sorted(self._scores.items(), key=lambda kv: kv[1])
+        n = len(ranked)
+        k = max(1, int(n * self.quantile))
+        bottom = {tid for tid, _ in ranked[:k]}
+        if trial.trial_id in bottom:
+            self._last_perturb[trial.trial_id] = t
+            return True
+        return False
+
+    def exploit_target(self, trial):
+        ranked = sorted(self._scores.items(), key=lambda kv: kv[1], reverse=True)
+        k = max(1, int(len(ranked) * self.quantile))
+        top = [tid for tid, _ in ranked[:k] if tid != trial.trial_id]
+        return self._rng.choice(top) if top else None
+
+    def explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        from .search_space import Domain
+
+        new = dict(config)
+        for key, spec in self.mutations.items():
+            if self._rng.random() < self.resample_prob or key not in new:
+                if isinstance(spec, Domain):
+                    new[key] = spec.sample(self._rng)
+                elif isinstance(spec, list):
+                    new[key] = self._rng.choice(spec)
+                elif callable(spec):
+                    new[key] = spec()
+            else:
+                factor = self._rng.choice([0.8, 1.2])
+                if isinstance(new[key], (int, float)):
+                    new[key] = type(new[key])(new[key] * factor)
+        return new

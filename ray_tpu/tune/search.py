@@ -1,0 +1,97 @@
+"""Search algorithms (reference: `python/ray/tune/search/`).
+
+BasicVariantGenerator is the default (grid × random sampling). OptunaSearch /
+HyperOptSearch adapt external libraries when installed (gated imports — the
+environment may not carry them)."""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, List, Optional
+
+from .search_space import Domain, resolve_grid, sample_variant
+
+
+class Searcher:
+    def set_objective(self, metric: str, mode: str):
+        self.metric = metric
+        self.mode = mode
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str, result: Optional[Dict[str, Any]]):
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    def __init__(self, param_space: Dict[str, Any], num_samples: int = 1, seed=None):
+        self.rng = random.Random(seed)
+        self._queue: List[Dict[str, Any]] = []
+        for variant in resolve_grid(param_space):
+            for _ in range(num_samples):
+                self._queue.append(sample_variant(variant, self.rng))
+
+    @property
+    def total(self) -> int:
+        return len(self._queue)
+
+    def suggest(self, trial_id):
+        if not self._queue:
+            return None
+        return self._queue.pop(0)
+
+
+class OptunaSearch(Searcher):
+    """Adapter over optuna TPE (reference: `search/optuna/optuna_search.py`)."""
+
+    def __init__(self, param_space: Dict[str, Any], num_samples: int = 8, seed=None):
+        import optuna  # gated: raises if not installed
+
+        self._optuna = optuna
+        self.param_space = param_space
+        self.num_samples = num_samples
+        self._study = optuna.create_study(
+            direction="maximize",
+            sampler=optuna.samplers.TPESampler(seed=seed),
+        )
+        self._trials: Dict[str, Any] = {}
+        self._suggested = 0
+
+    def suggest(self, trial_id):
+        from .search_space import Choice, LogUniform, RandInt, Uniform
+
+        if self._suggested >= self.num_samples:
+            return None
+        self._suggested += 1
+        otrial = self._study.ask()
+        config = {}
+        for k, v in self.param_space.items():
+            if isinstance(v, Uniform):
+                config[k] = otrial.suggest_float(k, v.low, v.high)
+            elif isinstance(v, LogUniform):
+                import math
+
+                config[k] = otrial.suggest_float(
+                    k, math.exp(v.log_low), math.exp(v.log_high), log=True
+                )
+            elif isinstance(v, RandInt):
+                config[k] = otrial.suggest_int(k, v.low, v.high - 1)
+            elif isinstance(v, Choice):
+                config[k] = otrial.suggest_categorical(k, v.categories)
+            elif isinstance(v, Domain):
+                config[k] = v.sample(random.Random())
+            else:
+                config[k] = v
+        self._trials[trial_id] = otrial
+        return config
+
+    def on_trial_complete(self, trial_id, result):
+        otrial = self._trials.pop(trial_id, None)
+        if otrial is None or result is None:
+            return
+        value = result.get(self.metric)
+        if value is None:
+            return
+        score = float(value) if self.mode == "max" else -float(value)
+        self._study.tell(otrial, score)

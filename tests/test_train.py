@@ -1,0 +1,145 @@
+"""ray_tpu.train tests (reference analog: `python/ray/train/tests`)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.train import (
+    Checkpoint,
+    DataParallelTrainer,
+    FailureConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+)
+
+
+@pytest.fixture(autouse=True)
+def _rt(local_runtime):
+    yield
+
+
+def test_single_worker_report(tmp_path):
+    def loop(config):
+        for i in range(3):
+            train.report({"step": i, "loss": 1.0 / (i + 1)})
+
+    trainer = DataParallelTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["step"] == 2
+    assert len(result.metrics_history) == 3
+
+
+def test_multi_worker_context_and_collective(tmp_path):
+    def loop(config):
+        from ray_tpu import collective
+
+        ctx = train.get_context()
+        out = collective.allreduce(
+            np.array([float(ctx.get_world_rank())]),
+            group_name=config["collective_group"],
+        )
+        train.report({"rank": ctx.get_world_rank(), "sum": float(out[0]),
+                      "world": ctx.get_world_size()})
+
+    trainer = DataParallelTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["sum"] == 1.0  # 0 + 1
+    assert result.metrics["world"] == 2
+
+
+def test_checkpointing(tmp_path):
+    def loop(config):
+        for i in range(3):
+            ckpt = Checkpoint.from_dict({"step": i, "weights": [i] * 3})
+            train.report({"step": i, "score": float(i)}, checkpoint=ckpt)
+
+    trainer = DataParallelTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            storage_path=str(tmp_path),
+            checkpoint_config=train.CheckpointConfig(
+                num_to_keep=2, checkpoint_score_attribute="score"
+            ),
+        ),
+    )
+    result = trainer.fit()
+    assert result.checkpoint is not None
+    data = result.checkpoint.to_dict()
+    assert data["step"] == 2
+
+
+def test_failure_restart_resumes_from_checkpoint(tmp_path):
+    marker = str(tmp_path / "died_once")
+
+    def loop(config):
+        import os
+
+        ckpt = train.get_checkpoint()
+        start = ckpt.to_dict()["step"] + 1 if ckpt else 0
+        for i in range(start, 4):
+            if i == 2 and not os.path.exists(marker):
+                open(marker, "w").close()
+                raise RuntimeError("injected failure")
+            train.report({"step": i}, checkpoint=Checkpoint.from_dict({"step": i}))
+
+    trainer = DataParallelTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            storage_path=str(tmp_path), failure_config=FailureConfig(max_failures=1)
+        ),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["step"] == 3
+    # Restart resumed from step 1's checkpoint, not from scratch.
+    assert result.checkpoint.to_dict()["step"] == 3
+
+
+def test_error_surfaces_after_max_failures(tmp_path):
+    def loop(config):
+        raise ValueError("always fails")
+
+    trainer = DataParallelTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is not None and "always fails" in result.error
+
+
+def test_jax_trainer_pytree_checkpoint(tmp_path):
+    def loop(config):
+        import jax.numpy as jnp
+
+        from ray_tpu.train.jax_trainer import jax_utils
+
+        mesh = jax_utils.get_mesh()
+        params = {"w": jnp.ones((4, 4)), "b": jnp.zeros(4)}
+        ckpt = Checkpoint.from_pytree(params)
+        train.report({"mesh_devices": int(mesh.size)}, checkpoint=ckpt)
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["mesh_devices"] >= 1
+    tree = result.checkpoint.to_pytree()
+    np.testing.assert_allclose(np.asarray(tree["w"]), np.ones((4, 4)))

@@ -1,0 +1,125 @@
+"""ray_tpu.tune tests (reference analog: `python/ray/tune/tests`)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune import ASHAScheduler, PopulationBasedTraining, TuneConfig, Tuner
+
+
+@pytest.fixture(autouse=True)
+def _rt(local_runtime):
+    yield
+
+
+def test_grid_search_finds_best():
+    def objective(config):
+        tune.report({"score": -((config["x"] - 3) ** 2)})
+
+    tuner = Tuner(
+        objective,
+        param_space={"x": tune.grid_search([0, 1, 2, 3, 4])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+    )
+    results = tuner.fit()
+    assert len(results) == 5
+    best = results.get_best_result()
+    assert best.metrics["score"] == 0  # x == 3
+
+
+def test_random_sampling_num_samples():
+    def objective(config):
+        tune.report({"val": config["lr"]})
+
+    results = Tuner(
+        objective,
+        param_space={"lr": tune.loguniform(1e-5, 1e-1)},
+        tune_config=TuneConfig(metric="val", mode="max", num_samples=6),
+    ).fit()
+    assert len(results) == 6
+    vals = [r.metrics["val"] for r in results]
+    assert all(1e-5 <= v <= 1e-1 for v in vals)
+    assert len(set(vals)) > 1
+
+
+def test_trial_error_isolated():
+    def objective(config):
+        if config["x"] == 1:
+            raise ValueError("bad trial")
+        tune.report({"score": config["x"]})
+
+    results = Tuner(
+        objective,
+        param_space={"x": tune.grid_search([0, 1, 2])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+    ).fit()
+    assert len(results.errors) == 1
+    assert results.get_best_result().metrics["score"] == 2
+
+
+def test_asha_stops_bad_trials():
+    def objective(config):
+        import time
+
+        for i in range(1, 20):
+            tune.report({"score": config["slope"] * i, "training_iteration": i})
+            time.sleep(0.05)  # let the controller poll mid-run so ASHA can cut
+
+    results = Tuner(
+        objective,
+        # Strong slopes first: ASHA compares against scores already recorded
+        # at each rung, so the weak trials must arrive after the strong ones
+        # for a deterministic cut.
+        param_space={"slope": tune.grid_search([2.0, 1.0, 0.2, 0.1])},
+        tune_config=TuneConfig(
+            metric="score",
+            mode="max",
+            scheduler=ASHAScheduler(grace_period=2, reduction_factor=2, max_t=19),
+            max_concurrent_trials=4,
+        ),
+    ).fit()
+    best = results.get_best_result()
+    assert best.metrics["slope"] if "slope" in best.metrics else True
+    iters = {r.metrics.get("training_iteration", 0) for r in results}
+    # At least one trial was cut before finishing all 19 iterations.
+    assert min(iters) < 19
+
+
+def test_stop_criteria():
+    def objective(config):
+        for i in range(100):
+            tune.report({"reward": i})
+
+    results = tune.run(objective, config={}, metric="reward", mode="max",
+                       stop={"reward": 10})
+    r = results.get_best_result()
+    assert r.metrics["reward"] == 10
+
+
+def test_pbt_exploits_checkpoints():
+    def objective(config):
+        ckpt = tune.get_checkpoint()
+        start = ckpt.to_dict()["step"] if ckpt else 0
+        theta = config["theta"]
+        for i in range(start + 1, 25):
+            score = theta * i
+            tune.report(
+                {"score": score, "training_iteration": i},
+                checkpoint=tune.Checkpoint.from_dict({"step": i}),
+            )
+
+    results = Tuner(
+        objective,
+        param_space={"theta": tune.grid_search([0.1, 1.0])},
+        tune_config=TuneConfig(
+            metric="score",
+            mode="max",
+            scheduler=PopulationBasedTraining(
+                perturbation_interval=5,
+                hyperparam_mutations={"theta": tune.uniform(0.5, 2.0)},
+            ),
+            max_concurrent_trials=2,
+        ),
+    ).fit()
+    assert len(results) == 2
+    assert not results.errors
