@@ -1,0 +1,251 @@
+"""Block representation for ray_tpu.data.
+
+Reference: `python/ray/data/block.py` + `_internal/arrow_block.py`. The
+reference's canonical columnar block is an Arrow table with a tensor
+extension type; here the canonical block is a **dict of numpy columns**
+(`{"col": np.ndarray}`) — multi-dim tensors are first-class, and a block can
+be handed to `jax.device_put` without a decode step (TPU host→HBM feed is
+the hot path this library exists to serve). Arrow / pandas appear only at IO
+boundaries. Non-tabular data (arbitrary Python objects from `from_items`)
+uses "simple" blocks: plain lists.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+# pyarrow's C++ layer segfaults in this environment when entered concurrently
+# from multiple Python threads (parquet open racing a Table.to_numpy in the
+# thread-pool backend). One process-wide lock guards every pyarrow call; the
+# process-pool cluster backend is unaffected (lock per process).
+PYARROW_LOCK = threading.Lock()
+
+# A block is either a columnar dict-of-numpy or a simple list of rows.
+Block = Union[Dict[str, np.ndarray], List[Any]]
+
+
+class BlockMetadata:
+    __slots__ = ("num_rows", "size_bytes", "schema", "input_files", "exec_stats")
+
+    def __init__(self, num_rows, size_bytes, schema=None, input_files=None, exec_stats=None):
+        self.num_rows = num_rows
+        self.size_bytes = size_bytes
+        self.schema = schema
+        self.input_files = input_files or []
+        self.exec_stats = exec_stats
+
+
+def is_columnar(block: Block) -> bool:
+    return isinstance(block, dict)
+
+
+def _col_size_bytes(v: np.ndarray) -> int:
+    if isinstance(v, np.ndarray):
+        if v.dtype == object:
+            return int(sum(sys.getsizeof(x) for x in v.ravel().tolist()))
+        return int(v.nbytes)
+    return sys.getsizeof(v)
+
+
+class BlockAccessor:
+    """Uniform operations over both block kinds (reference: `BlockAccessor`)."""
+
+    def __init__(self, block: Block):
+        self._block = block
+
+    @staticmethod
+    def for_block(block: Block) -> "BlockAccessor":
+        return BlockAccessor(block)
+
+    # ------------------------------------------------------------- metadata
+    def num_rows(self) -> int:
+        b = self._block
+        if is_columnar(b):
+            if not b:
+                return 0
+            return int(len(next(iter(b.values()))))
+        return len(b)
+
+    def size_bytes(self) -> int:
+        b = self._block
+        if is_columnar(b):
+            return sum(_col_size_bytes(v) for v in b.values())
+        return int(sum(sys.getsizeof(x) for x in b))
+
+    def schema(self):
+        b = self._block
+        if is_columnar(b):
+            return {k: (str(v.dtype), tuple(v.shape[1:])) for k, v in b.items()}
+        if b:
+            return type(b[0]).__name__
+        return None
+
+    def get_metadata(self, input_files=None, exec_stats=None) -> BlockMetadata:
+        return BlockMetadata(self.num_rows(), self.size_bytes(), self.schema(), input_files, exec_stats)
+
+    # ------------------------------------------------------------- slicing
+    def slice(self, start: int, end: int) -> Block:
+        b = self._block
+        if is_columnar(b):
+            return {k: v[start:end] for k, v in b.items()}
+        return b[start:end]
+
+    def take(self, indices: np.ndarray) -> Block:
+        b = self._block
+        if is_columnar(b):
+            return {k: v[indices] for k, v in b.items()}
+        return [b[int(i)] for i in indices]
+
+    # ------------------------------------------------------------ iteration
+    def iter_rows(self) -> Iterator[Any]:
+        b = self._block
+        if is_columnar(b):
+            keys = list(b.keys())
+            for i in range(self.num_rows()):
+                yield {k: b[k][i] for k in keys}
+        else:
+            yield from iter(b)
+
+    # ----------------------------------------------------------- conversion
+    def to_batch(self, batch_format: Optional[str]) -> Any:
+        b = self._block
+        if batch_format in (None, "default", "numpy"):
+            if is_columnar(b):
+                return b
+            if batch_format == "numpy":
+                return {"item": np.asarray(b, dtype=object)}
+            return b
+        if batch_format == "pandas":
+            return self.to_pandas()
+        if batch_format == "pyarrow":
+            return self.to_arrow()
+        raise ValueError(f"Unknown batch_format: {batch_format!r}")
+
+    def to_pandas(self):
+        import pandas as pd
+
+        b = self._block
+        if is_columnar(b):
+            return pd.DataFrame({k: (list(v) if v.ndim > 1 else v) for k, v in b.items()})
+        return pd.DataFrame({"item": b})
+
+    def to_arrow(self):
+        import pyarrow as pa
+
+        b = self._block
+        with PYARROW_LOCK:
+            if is_columnar(b):
+                cols = {}
+                for k, v in b.items():
+                    cols[k] = list(v) if v.ndim > 1 else v
+                return pa.table(cols)
+            return pa.table({"item": self._block})
+
+    def to_numpy(self, column: Optional[str] = None):
+        b = self._block
+        if is_columnar(b):
+            if column is not None:
+                return b[column]
+            return b
+        return np.asarray(b)
+
+    # ---------------------------------------------------------- sort/group
+    def sort_indices(self, key: Union[str, List[str]], descending: bool = False) -> np.ndarray:
+        b = self._block
+        assert is_columnar(b), "sort requires columnar data"
+        keys = [key] if isinstance(key, str) else list(key)
+        # lexsort: last key is primary
+        order = np.lexsort(tuple(b[k] for k in reversed(keys)))
+        if descending:
+            order = order[::-1]
+        return order
+
+
+def build_block(rows_or_batch: Any) -> Block:
+    """Normalize user output (dict batch, list of rows, pandas, arrow) to a block."""
+    x = rows_or_batch
+    if isinstance(x, dict):
+        return {k: _to_column(v) for k, v in x.items()}
+    try:
+        import pandas as pd
+
+        if isinstance(x, pd.DataFrame):
+            return {k: _to_column(x[k].to_numpy()) for k in x.columns}
+    except ImportError:
+        pass
+    try:
+        import pyarrow as pa
+
+        if isinstance(x, pa.Table):
+            with PYARROW_LOCK:
+                return {
+                    name: _to_column(x[name].to_numpy(zero_copy_only=False)) for name in x.column_names
+                }
+    except ImportError:
+        pass
+    if isinstance(x, list):
+        if x and all(isinstance(r, dict) for r in x):
+            return rows_to_block(x)
+        return list(x)
+    raise TypeError(f"Cannot build a block from {type(x)}")
+
+
+def _to_column(v) -> np.ndarray:
+    if isinstance(v, np.ndarray):
+        return v
+    arr = np.asarray(v)
+    if arr.dtype == object and arr.ndim == 1:
+        # ragged rows (e.g. variable-length lists) stay object columns
+        return arr
+    return arr
+
+
+def rows_to_block(rows: Sequence[dict]) -> Block:
+    if not rows:
+        return {}
+    keys = list(rows[0].keys())
+    out = {}
+    for k in keys:
+        vals = [r[k] for r in rows]
+        try:
+            col = np.stack([np.asarray(v) for v in vals]) if isinstance(vals[0], np.ndarray) else np.asarray(vals)
+        except ValueError:
+            col = np.empty(len(vals), dtype=object)
+            for i, v in enumerate(vals):
+                col[i] = v
+        out[k] = col
+    return out
+
+
+def concat_blocks(blocks: List[Block]) -> Block:
+    blocks = [b for b in blocks if BlockAccessor(b).num_rows() > 0]
+    if not blocks:
+        return {}
+    if is_columnar(blocks[0]):
+        keys = list(blocks[0].keys())
+        return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
+    out: List[Any] = []
+    for b in blocks:
+        out.extend(b)
+    return out
+
+
+def empty_like(block: Block) -> Block:
+    if is_columnar(block):
+        return {k: v[:0] for k, v in block.items()}
+    return []
+
+
+def split_block(block: Block, num_splits: int) -> List[Block]:
+    acc = BlockAccessor(block)
+    n = acc.num_rows()
+    sizes = [n // num_splits + (1 if i < n % num_splits else 0) for i in range(num_splits)]
+    out, start = [], 0
+    for s in sizes:
+        out.append(acc.slice(start, start + s))
+        start += s
+    return out

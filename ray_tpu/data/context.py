@@ -1,0 +1,51 @@
+"""Global execution configuration (reference: `python/ray/data/context.py`)."""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class ExecutionResources:
+    cpu: Optional[float] = None
+    gpu: Optional[float] = None
+    object_store_memory: Optional[float] = None
+
+
+@dataclass
+class ExecutionOptions:
+    resource_limits: ExecutionResources = field(default_factory=ExecutionResources)
+    locality_with_output: bool = False
+    preserve_order: bool = True
+    verbose_progress: bool = False
+
+
+@dataclass
+class DataContext:
+    """Process-wide dataset execution knobs.
+
+    `max_in_flight_tasks` is the streaming-executor backpressure bound
+    (reference: backpressure policies under
+    `data/_internal/execution/backpressure_policy/`).
+    """
+
+    target_max_block_size: int = 128 * 1024 * 1024
+    target_min_block_size: int = 1 * 1024 * 1024
+    max_in_flight_tasks: int = max(2, (os.cpu_count() or 8))
+    read_op_min_num_blocks: int = 8
+    execution_options: ExecutionOptions = field(default_factory=ExecutionOptions)
+    enable_progress_bars: bool = False
+    eager_free: bool = True
+
+    _lock = threading.Lock()
+    _current: Optional["DataContext"] = None
+
+    @staticmethod
+    def get_current() -> "DataContext":
+        with DataContext._lock:
+            if DataContext._current is None:
+                DataContext._current = DataContext()
+            return DataContext._current

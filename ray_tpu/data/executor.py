@@ -1,0 +1,461 @@
+"""Streaming executor (reference: `data/_internal/execution/streaming_executor.py`).
+
+Pull-based: bundles of blocks stream through fused task chains with a
+bounded number of in-flight tasks (backpressure — reference
+`backpressure_policy/`). All-to-all ops run as a two-stage map/reduce
+exchange where the map side returns one object per output partition
+(`num_returns=P`) so each reduce task fetches only its own parts —
+the shape of the reference's push-based shuffle (`push_based_shuffle.py`).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import cloudpickle
+import numpy as np
+
+from ..core.api import get as ray_get, put as ray_put, wait as ray_wait
+from ..core.remote_function import RemoteFunction
+from ..core.task_spec import TaskOptions
+from .block import Block, BlockAccessor, concat_blocks, is_columnar
+from .context import DataContext
+from .plan import (
+    AllToAllOp,
+    InputBlocksOp,
+    LimitOp,
+    LogicalPlan,
+    OneToOneOp,
+    ReadOp,
+    apply_chain,
+)
+
+
+class RefBundle:
+    """A task's output: ref to a list of blocks + row/byte metadata."""
+
+    __slots__ = ("blocks_ref", "num_rows", "size_bytes")
+
+    def __init__(self, blocks_ref, num_rows: int, size_bytes: int):
+        self.blocks_ref = blocks_ref
+        self.num_rows = num_rows
+        self.size_bytes = size_bytes
+
+
+# --------------------------------------------------------- remote kernels
+def _meta_of(blocks: List[Block]) -> dict:
+    rows = sum(BlockAccessor(b).num_rows() for b in blocks)
+    size = sum(BlockAccessor(b).size_bytes() for b in blocks)
+    return {"num_rows": rows, "size_bytes": size}
+
+
+def _exec_read_chain(payload: bytes):
+    """Run a ReadTask then the fused chain; returns (blocks, meta)."""
+    read_task, chain = cloudpickle.loads(payload)
+    blocks = list(read_task())
+    blocks = apply_chain(chain, blocks)
+    return blocks, _meta_of(blocks)
+
+
+def _exec_chain(payload: bytes, blocks: List[Block]):
+    chain = cloudpickle.loads(payload)
+    out = apply_chain(chain, blocks)
+    return out, _meta_of(out)
+
+
+def _partition_map(payload: bytes, blocks: List[Block]):
+    """Map side of an exchange: returns P lists of blocks (one per partition)."""
+    part_fn, num_parts = cloudpickle.loads(payload)
+    parts: List[List[Block]] = [[] for _ in range(num_parts)]
+    block = concat_blocks(blocks)
+    for idx, piece in part_fn(block):
+        if BlockAccessor(piece).num_rows() > 0:
+            parts[idx].append(piece)
+    return tuple(parts) if num_parts > 1 else parts[0]
+
+
+def _exchange_reduce(payload: bytes, *parts):
+    """Reduce side: concat this partition's parts, post-process, return bundle."""
+    post_fn = cloudpickle.loads(payload)
+    blocks: List[Block] = []
+    for p in parts:
+        blocks.extend(p)
+    merged = concat_blocks(blocks) if blocks else {}
+    out = post_fn(merged)
+    out_blocks = out if isinstance(out, list) else [out]
+    out_blocks = [b for b in out_blocks if BlockAccessor(b).num_rows() > 0]
+    return out_blocks, _meta_of(out_blocks)
+
+
+def _sample_rows(blocks: List[Block], key, k: int):
+    block = concat_blocks(blocks)
+    acc = BlockAccessor(block)
+    n = acc.num_rows()
+    if n == 0:
+        return np.asarray([])
+    idx = np.linspace(0, n - 1, min(k, n)).astype(np.int64)
+    col = key if isinstance(key, str) else key[0]
+    return np.asarray(block[col])[idx]
+
+
+def _zip_blocks(left: List[Block], right: List[Block]):
+    lb, rb = concat_blocks(left), concat_blocks(right)
+    if BlockAccessor(lb).num_rows() != BlockAccessor(rb).num_rows():
+        raise ValueError("zip requires datasets with identical row counts")
+    out = dict(lb)
+    for k, v in rb.items():
+        name = k
+        while name in out:
+            name = name + "_1"
+        out[name] = v
+    return [out], _meta_of([out])
+
+
+def _remote(fn: Callable, num_returns: int = 1) -> RemoteFunction:
+    return RemoteFunction(fn, TaskOptions(num_cpus=1.0, num_returns=num_returns))
+
+
+# ------------------------------------------------------------- the executor
+class StreamingExecutor:
+    def __init__(self, ctx: Optional[DataContext] = None):
+        self._ctx = ctx or DataContext.get_current()
+
+    # ------------------------------------------------------------ streaming
+    def execute(self, plan: LogicalPlan) -> Iterator[RefBundle]:
+        """Yield output bundles, streaming wherever the plan allows."""
+        segments = plan.segments()
+        stream: Iterator[RefBundle] = iter(())
+        for i, (src, chain) in enumerate(segments):
+            if isinstance(src, ReadOp):
+                stream = self._run_read_segment(src, chain)
+            elif isinstance(src, InputBlocksOp):
+                stream = self._run_ref_segment(iter(src.bundles), chain)
+            elif isinstance(src, AllToAllOp):
+                bundles = list(stream)
+                bundles = self._run_exchange(src, bundles)
+                stream = self._run_ref_segment(iter(bundles), chain)
+            else:
+                raise TypeError(f"Unknown segment source {src}")
+        return stream
+
+    def execute_to_bundles(self, plan: LogicalPlan) -> List[RefBundle]:
+        return list(self.execute(plan))
+
+    # ----------------------------------------------------------- segments
+    def _limit_of(self, chain: List[OneToOneOp]) -> Optional[int]:
+        for op in chain:
+            if isinstance(op, LimitOp):
+                return op.n
+        return None
+
+    def _run_read_segment(self, src: ReadOp, chain) -> Iterator[RefBundle]:
+        ctx = self._ctx
+        parallelism = src.parallelism
+        if parallelism is None or parallelism < 0:
+            est = src.datasource.estimate_inmemory_data_size()
+            if est:
+                parallelism = max(ctx.read_op_min_num_blocks, est // ctx.target_max_block_size)
+            else:
+                parallelism = ctx.read_op_min_num_blocks
+        read_tasks = src.datasource.get_read_tasks(int(parallelism))
+        payloads = [cloudpickle.dumps((rt, chain)) for rt in read_tasks]
+        fn = _remote(_exec_read_chain, num_returns=2)
+        yield from self._stream_tasks(
+            (lambda p=p: fn.remote(p)) for p in payloads
+        ).with_limit(self._limit_of(chain))
+
+    def _run_ref_segment(self, bundles: Iterator[RefBundle], chain) -> Iterator[RefBundle]:
+        if not chain:
+            yield from bundles
+            return
+        payload = cloudpickle.dumps(chain)
+        fn = _remote(_exec_chain, num_returns=2)
+        yield from self._stream_tasks(
+            (lambda b=b: fn.remote(payload, b.blocks_ref)) for b in bundles
+        ).with_limit(self._limit_of(chain))
+
+    def _stream_tasks(self, submitters) -> "_TaskStream":
+        return _TaskStream(submitters, self._ctx.max_in_flight_tasks)
+
+    # ----------------------------------------------------------- exchanges
+    def _run_exchange(self, op: AllToAllOp, bundles: List[RefBundle]) -> List[RefBundle]:
+        kind = op.kind
+        if kind == "union":
+            out = list(bundles)
+            for other in op.other_plans:
+                out.extend(self.execute_to_bundles(other))
+            return out
+        if kind == "zip":
+            return self._exchange_zip(op, bundles)
+        if not bundles:
+            return []
+        if kind == "repartition":
+            return self._exchange_repartition(op, bundles)
+        if kind == "random_shuffle":
+            return self._exchange_random_shuffle(op, bundles)
+        if kind == "sort":
+            return self._exchange_sort(op, bundles)
+        if kind == "groupby":
+            return self._exchange_groupby(op, bundles)
+        raise ValueError(f"Unknown all-to-all kind {kind}")
+
+    def _map_reduce(
+        self,
+        bundles: List[RefBundle],
+        part_fns: List[Callable],
+        num_parts: int,
+        post_fn: Callable,
+    ) -> List[RefBundle]:
+        """Generic exchange: per-input partition map → per-output reduce."""
+        map_fn = _remote(_partition_map, num_returns=max(num_parts, 1))
+        part_refs: List[List[Any]] = []
+        for b, pf in zip(bundles, part_fns):
+            payload = cloudpickle.dumps((pf, num_parts))
+            refs = map_fn.remote(payload, b.blocks_ref)
+            part_refs.append(refs if num_parts > 1 else [refs])
+        reduce_fn = _remote(_exchange_reduce, num_returns=2)
+        post_payload = cloudpickle.dumps(post_fn)
+        out = []
+        for j in range(num_parts):
+            parts_j = [refs[j] for refs in part_refs]
+            blocks_ref, meta_ref = reduce_fn.remote(post_payload, *parts_j)
+            out.append((blocks_ref, meta_ref))
+        result = []
+        for blocks_ref, meta_ref in out:
+            meta = ray_get(meta_ref)
+            result.append(RefBundle(blocks_ref, meta["num_rows"], meta["size_bytes"]))
+        return result
+
+    def _exchange_repartition(self, op, bundles) -> List[RefBundle]:
+        n = op.num_outputs
+        if op.shuffle:
+            return self._exchange_random_shuffle(
+                AllToAllOp(kind="random_shuffle", num_outputs=n, seed=op.seed), bundles
+            )
+        total = sum(b.num_rows for b in bundles)
+        bounds = [round(total * (i + 1) / n) for i in range(n)]
+        part_fns, offset = [], 0
+        for b in bundles:
+            lo, hi = offset, offset + b.num_rows
+            offset = hi
+            part_fns.append(_EvenPartition(lo, hi, bounds))
+        return self._map_reduce(bundles, part_fns, n, _identity_post)
+
+    def _exchange_random_shuffle(self, op, bundles) -> List[RefBundle]:
+        n = op.num_outputs or len(bundles)
+        seed = op.seed
+        part_fns = [_RandomPartition(n, None if seed is None else seed + i) for i, _ in enumerate(bundles)]
+        return self._map_reduce(bundles, part_fns, n, _ShufflePost(seed))
+
+    def _exchange_sort(self, op, bundles) -> List[RefBundle]:
+        key, desc = op.key, op.descending
+        n = len(bundles)
+        sample_fn = _remote(_sample_rows)
+        samples = ray_get([sample_fn.remote(b.blocks_ref, key, 16) for b in bundles])
+        allsamp = np.sort(np.concatenate([s for s in samples if len(s)]))
+        if len(allsamp) == 0:
+            return bundles
+        qs = np.linspace(0, len(allsamp) - 1, n + 1).astype(np.int64)[1:-1]
+        boundaries = allsamp[qs]
+        part_fns = [_RangePartition(key, boundaries) for _ in bundles]
+        out = self._map_reduce(bundles, part_fns, n, _SortPost(key, desc))
+        return out[::-1] if desc else out
+
+    def _exchange_groupby(self, op, bundles) -> List[RefBundle]:
+        key, aggs = op.key, op.aggs
+        n = min(len(bundles), max(1, self._ctx.max_in_flight_tasks))
+        part_fns = [_HashPartition(key, n) for _ in bundles]
+        return self._map_reduce(bundles, part_fns, n, _GroupByPost(key, aggs))
+
+    def _exchange_zip(self, op, bundles) -> List[RefBundle]:
+        right = self.execute_to_bundles(op.other_plans[0])
+        left_rows = [b.num_rows for b in bundles]
+        total_r = sum(b.num_rows for b in right)
+        if sum(left_rows) != total_r:
+            raise ValueError("zip requires datasets with identical row counts")
+        # Repartition right to match left's block boundaries, then zip pairwise.
+        bounds = list(np.cumsum(left_rows))
+        part_fns, offset = [], 0
+        for b in right:
+            part_fns.append(_EvenPartition(offset, offset + b.num_rows, bounds))
+            offset += b.num_rows
+        right_re = self._map_reduce(right, part_fns, len(bundles), _identity_post)
+        zip_fn = _remote(_zip_blocks, num_returns=2)
+        out = []
+        for lb, rb in zip(bundles, right_re):
+            blocks_ref, meta_ref = zip_fn.remote(lb.blocks_ref, rb.blocks_ref)
+            meta = ray_get(meta_ref)
+            out.append(RefBundle(blocks_ref, meta["num_rows"], meta["size_bytes"]))
+        return out
+
+
+# ------------------------------------------------- partition/post functors
+# (classes, not closures, so cloudpickle payloads stay small and stable)
+class _EvenPartition:
+    def __init__(self, lo: int, hi: int, bounds: List[int]):
+        self.lo, self.hi, self.bounds = lo, hi, bounds
+
+    def __call__(self, block: Block):
+        acc = BlockAccessor(block)
+        for j, bound in enumerate(self.bounds):
+            prev = self.bounds[j - 1] if j > 0 else 0
+            start = max(self.lo, prev)
+            end = min(self.hi, bound)
+            if end > start:
+                yield j, acc.slice(start - self.lo, end - self.lo)
+
+
+class _RandomPartition:
+    def __init__(self, n: int, seed: Optional[int]):
+        self.n, self.seed = n, seed
+
+    def __call__(self, block: Block):
+        acc = BlockAccessor(block)
+        rng = np.random.default_rng(self.seed)
+        assign = rng.integers(0, self.n, acc.num_rows())
+        for j in range(self.n):
+            idx = np.nonzero(assign == j)[0]
+            if len(idx):
+                yield j, acc.take(idx)
+
+
+class _RangePartition:
+    def __init__(self, key, boundaries):
+        self.key, self.boundaries = key, boundaries
+
+    def __call__(self, block: Block):
+        acc = BlockAccessor(block)
+        col = block[self.key if isinstance(self.key, str) else self.key[0]]
+        assign = np.searchsorted(self.boundaries, col, side="right")
+        for j in np.unique(assign):
+            idx = np.nonzero(assign == j)[0]
+            yield int(j), acc.take(idx)
+
+
+def _stable_hash(x) -> int:
+    """Process-independent hash (builtin `hash` varies with PYTHONHASHSEED)."""
+    import zlib
+
+    if isinstance(x, (int, np.integer)):
+        return int(x)
+    return zlib.crc32(repr(x).encode())
+
+
+class _HashPartition:
+    def __init__(self, key, n: int):
+        self.key, self.n = key, n
+
+    def __call__(self, block: Block):
+        acc = BlockAccessor(block)
+        col = block[self.key if isinstance(self.key, str) else self.key[0]]
+        hashes = np.asarray([_stable_hash(x) % self.n for x in col.tolist()])
+        for j in np.unique(hashes):
+            idx = np.nonzero(hashes == j)[0]
+            yield int(j), acc.take(idx)
+
+
+def _identity_post(block: Block):
+    return block
+
+
+class _ShufflePost:
+    def __init__(self, seed):
+        self.seed = seed
+
+    def __call__(self, block: Block):
+        acc = BlockAccessor(block)
+        rng = np.random.default_rng(self.seed)
+        return acc.take(rng.permutation(acc.num_rows()))
+
+
+class _SortPost:
+    def __init__(self, key, descending):
+        self.key, self.descending = key, descending
+
+    def __call__(self, block: Block):
+        acc = BlockAccessor(block)
+        if acc.num_rows() == 0:
+            return block
+        return acc.take(acc.sort_indices(self.key, self.descending))
+
+
+class _GroupByPost:
+    def __init__(self, key, aggs):
+        self.key, self.aggs = key, aggs
+
+    def __call__(self, block: Block):
+        if not block or BlockAccessor(block).num_rows() == 0:
+            return block
+        from .grouped import _MapGroupsMarker
+
+        keycol = self.key if isinstance(self.key, str) else self.key[0]
+        if len(self.aggs) == 1 and isinstance(self.aggs[0], _MapGroupsMarker):
+            return self._map_groups(block, keycol, self.aggs[0])
+        col = block[keycol]
+        order = np.argsort(col, kind="stable")
+        col = col[order]
+        uniq, starts = np.unique(col, return_index=True)
+        bounds = list(starts[1:]) + [len(col)]
+        out: Dict[str, list] = {keycol: list(uniq)}
+        for agg in self.aggs:
+            out[agg.output_name()] = []
+        for gi in range(len(uniq)):
+            lo, hi = starts[gi], bounds[gi]
+            idx = order[lo:hi]
+            for agg in self.aggs:
+                out[agg.output_name()].append(agg.compute(block, idx))
+        return {k: np.asarray(v) for k, v in out.items()}
+
+    def _map_groups(self, block: Block, keycol: str, marker) -> List[Block]:
+        from .block import build_block
+
+        acc = BlockAccessor(block)
+        col = block[keycol]
+        order = np.argsort(col, kind="stable")
+        sorted_col = col[order]
+        uniq, starts = np.unique(sorted_col, return_index=True)
+        bounds = list(starts[1:]) + [len(sorted_col)]
+        out_blocks: List[Block] = []
+        for gi in range(len(uniq)):
+            idx = order[starts[gi] : bounds[gi]]
+            group = acc.take(idx)
+            res = marker.fn(BlockAccessor(group).to_batch(marker.batch_format))
+            out_blocks.append(build_block(res))
+        return out_blocks
+
+
+# ------------------------------------------------------------- task stream
+class _TaskStream:
+    """Bounded-in-flight submission with in-order yielding + early stop."""
+
+    def __init__(self, submitters, max_in_flight: int):
+        self._submitters = iter(submitters)
+        self._max = max_in_flight
+        self._limit: Optional[int] = None
+
+    def with_limit(self, n: Optional[int]) -> "_TaskStream":
+        self._limit = n
+        return self
+
+    def __iter__(self) -> Iterator[RefBundle]:
+        in_flight: collections.deque = collections.deque()
+        produced = 0
+        exhausted = False
+        while True:
+            while not exhausted and len(in_flight) < self._max:
+                try:
+                    submit = next(self._submitters)
+                except StopIteration:
+                    exhausted = True
+                    break
+                in_flight.append(submit())
+            if not in_flight:
+                return
+            blocks_ref, meta_ref = in_flight.popleft()
+            meta = ray_get(meta_ref)
+            bundle = RefBundle(blocks_ref, meta["num_rows"], meta["size_bytes"])
+            yield bundle
+            produced += bundle.num_rows
+            if self._limit is not None and produced >= self._limit:
+                return

@@ -1,0 +1,227 @@
+"""ray_tpu.data tests (reference analog: `python/ray/data/tests/`)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rdata
+
+
+@pytest.fixture(autouse=True)
+def _rt(local_runtime):
+    yield
+
+
+def test_range_count_take():
+    ds = rdata.range(100)
+    assert ds.count() == 100
+    rows = ds.take(5)
+    assert [r["id"] for r in rows] == [0, 1, 2, 3, 4]
+
+
+def test_range_tensor():
+    ds = rdata.range_tensor(8, shape=(2, 2))
+    batch = ds.take_batch(8, batch_format="numpy")
+    assert batch["data"].shape == (8, 2, 2)
+    assert batch["data"][3, 0, 0] == 3
+
+
+def test_from_items_simple_rows():
+    ds = rdata.from_items([1, 2, 3, 4])
+    assert sorted(ds.take_all()) == [1, 2, 3, 4]
+
+
+def test_map_batches_and_map():
+    ds = rdata.range(32).map_batches(lambda b: {"id": b["id"] * 2})
+    assert ds.sum("id") == 2 * sum(range(32))
+    ds2 = rdata.range(10).map(lambda row: {"x": row["id"] + 1})
+    assert ds2.min("x") == 1 and ds2.max("x") == 10
+
+
+def test_map_batches_batch_size_rebatching():
+    seen = []
+
+    def record(batch):
+        return {"n": np.asarray([len(batch["id"])])}
+
+    ds = rdata.range(50, parallelism=2).map_batches(record, batch_size=16)
+    sizes = [r["n"] for r in ds.take_all()]
+    assert sum(sizes) == 50
+    assert max(sizes) <= 16
+
+
+def test_filter_flat_map_limit():
+    ds = rdata.range(20).filter(lambda r: r["id"] % 2 == 0)
+    assert ds.count() == 10
+    ds2 = rdata.from_items([{"v": 1}, {"v": 2}]).flat_map(lambda r: [{"v": r["v"]}, {"v": r["v"] * 10}])
+    assert sorted(x["v"] for x in ds2.take_all()) == [1, 2, 10, 20]
+    assert rdata.range(1000).limit(7).count() == 7
+
+
+def test_columns_ops():
+    ds = rdata.range(5).add_column("y", lambda b: b["id"] * 3)
+    assert ds.take(1)[0]["y"] == 0
+    assert set(ds.columns()) == {"id", "y"}
+    assert ds.select_columns(["y"]).columns() == ["y"]
+    assert ds.drop_columns(["y"]).columns() == ["id"]
+    renamed = ds.rename_columns({"id": "idx"})
+    assert set(renamed.columns()) == {"idx", "y"}
+
+
+def test_repartition():
+    ds = rdata.range(100, parallelism=10).repartition(3)
+    mat = ds.materialize()
+    assert mat.num_blocks() == 3
+    assert mat.count() == 100
+    assert sorted(r["id"] for r in mat.take_all()) == list(range(100))
+
+
+def test_random_shuffle_preserves_rows():
+    ds = rdata.range(64, parallelism=4).random_shuffle(seed=7)
+    vals = [r["id"] for r in ds.take_all()]
+    assert sorted(vals) == list(range(64))
+    assert vals != list(range(64))
+
+
+def test_sort():
+    rng = np.random.default_rng(0)
+    vals = rng.permutation(50)
+    ds = rdata.from_numpy(vals, column="v").sort("v")
+    out = [int(r["v"]) for r in ds.take_all()]
+    assert out == sorted(out)
+    out_desc = [int(r["v"]) for r in rdata.from_numpy(vals, column="v").sort("v", descending=True).take_all()]
+    assert out_desc == sorted(out_desc, reverse=True)
+
+
+def test_groupby_aggregates():
+    items = [{"k": i % 3, "v": float(i)} for i in range(30)]
+    ds = rdata.from_items(items)
+    out = ds.groupby("k").sum("v").materialize()
+    got = {int(r["k"]): float(r["sum(v)"]) for r in out.take_all()}
+    want = {}
+    for r in items:
+        want[r["k"]] = want.get(r["k"], 0.0) + r["v"]
+    assert got == want
+    cnt = {int(r["k"]): int(r["count()"]) for r in ds.groupby("k").count().take_all()}
+    assert cnt == {0: 10, 1: 10, 2: 10}
+
+
+def test_groupby_map_groups():
+    items = [{"k": i % 2, "v": float(i)} for i in range(10)]
+    ds = rdata.from_items(items).groupby("k").map_groups(
+        lambda batch: {"k": batch["k"][:1], "vmax": np.asarray([batch["v"].max()])}
+    )
+    got = {int(r["k"]): float(r["vmax"]) for r in ds.take_all()}
+    assert got == {0: 8.0, 1: 9.0}
+
+
+def test_zip_union():
+    a = rdata.range(10)
+    b = rdata.range(10).map_batches(lambda x: {"sq": x["id"] ** 2})
+    z = a.zip(b)
+    rows = z.take_all()
+    assert all(r["sq"] == r["id"] ** 2 for r in rows)
+    u = rdata.range(5).union(rdata.range(5))
+    assert u.count() == 10
+
+
+def test_split():
+    parts = rdata.range(100, parallelism=10).split(3)
+    assert sum(p.count() for p in parts) == 100
+    eq = rdata.range(90, parallelism=9).split(3, equal=True)
+    assert [p.count() for p in eq] == [30, 30, 30]
+
+
+def test_split_at_indices_train_test():
+    parts = rdata.range(10).split_at_indices([3, 7])
+    assert [p.count() for p in parts] == [3, 4, 3]
+    train, test = rdata.range(100).train_test_split(0.25)
+    assert train.count() == 75 and test.count() == 25
+
+
+def test_iter_batches_local_shuffle():
+    ds = rdata.range(40, parallelism=4)
+    batches = list(ds.iter_batches(batch_size=16, batch_format="numpy"))
+    assert [len(b["id"]) for b in batches] == [16, 16, 8]
+    rows = []
+    for b in ds.iter_batches(batch_size=10, local_shuffle_buffer_size=20, prefetch_batches=0):
+        rows.extend(b["id"].tolist())
+    assert sorted(rows) == list(range(40))
+
+
+def test_iter_torch_batches():
+    import torch
+
+    ds = rdata.range(8)
+    b = next(iter(ds.iter_torch_batches(batch_size=8)))
+    assert isinstance(b["id"], torch.Tensor)
+
+
+def test_iter_jax_batches():
+    import jax
+
+    ds = rdata.range_tensor(8, shape=(4,))
+    b = next(iter(ds.iter_jax_batches(batch_size=4)))
+    assert isinstance(b["data"], jax.Array)
+    assert b["data"].shape == (4, 4)
+
+
+def test_read_write_csv_parquet_json(tmp_path):
+    ds = rdata.range(20).add_column("x", lambda b: b["id"] * 1.5)
+    for fmt, reader in [("parquet", rdata.read_parquet), ("csv", rdata.read_csv), ("json", rdata.read_json)]:
+        out = str(tmp_path / fmt)
+        getattr(ds, f"write_{fmt}")(out)
+        back = reader(out)
+        assert back.count() == 20
+        assert back.sum("id") == sum(range(20))
+
+
+def test_read_text_and_binary(tmp_path):
+    p = tmp_path / "a.txt"
+    p.write_text("hello\nworld\n\n")
+    ds = rdata.read_text(str(p))
+    assert [r["text"] for r in ds.take_all()] == ["hello", "world"]
+    binds = rdata.read_binary_files(str(p), include_paths=True)
+    row = binds.take(1)[0]
+    assert row["bytes"] == b"hello\nworld\n\n"
+
+
+def test_from_pandas_arrow_roundtrip():
+    import pandas as pd
+    import pyarrow as pa
+
+    df = pd.DataFrame({"a": [1, 2, 3], "b": ["x", "y", "z"]})
+    ds = rdata.from_pandas(df)
+    assert ds.count() == 3
+    assert ds.to_pandas()["a"].tolist() == [1, 2, 3]
+    t = pa.table({"c": [1.0, 2.0]})
+    assert rdata.from_arrow(t).count() == 2
+
+
+def test_preprocessors():
+    ds = rdata.from_items([{"a": float(i), "label": "pos" if i % 2 else "neg"} for i in range(10)])
+    sc = rdata.StandardScaler(["a"]).fit(ds)
+    out = sc.transform(ds).to_pandas()["a"]
+    assert abs(out.mean()) < 1e-6
+    le = rdata.LabelEncoder("label").fit(ds)
+    enc = le.transform(ds).unique("label")
+    assert enc == [0, 1]
+    cat = rdata.Concatenator(["a"], output_column_name="feat")
+    assert cat.transform(ds).take(1)[0]["feat"].shape == (1,)
+
+
+def test_random_sample_and_unique():
+    ds = rdata.range(1000)
+    n = ds.random_sample(0.1, seed=3).count()
+    assert 50 < n < 200
+    assert rdata.from_items([{"v": 1}, {"v": 1}, {"v": 2}]).unique("v") == [1, 2]
+
+
+def test_stats_and_schema():
+    ds = rdata.range(10)
+    assert ds.schema() == {"id": ("int64", ())}
+    assert ds.size_bytes() > 0
+    assert ds.mean("id") == 4.5
+    assert round(ds.std("id"), 3) == round(np.std(np.arange(10), ddof=1), 3)
