@@ -1,0 +1,37 @@
+"""ray_tpu.rllib — reinforcement learning library (reference: `rllib/`).
+
+TPU-first redesign of RLlib's new API stack (reference
+`rllib/core/learner/learner.py:95`, `rllib/core/rl_module/rl_module.py:228`,
+`rllib/env/env_runner.py:15`):
+
+* **EnvRunner** actors vectorize environments in numpy on CPU hosts and run
+  the policy forward pass as a jit-compiled XLA program — there is no
+  per-env Python `step()` loop over single environments.
+* **Learner** updates are ONE jit-compiled XLA program per algorithm:
+  advantage estimation, minibatch permutation, the epoch loop, and the
+  optimizer all live inside `lax.scan` — not a Python SGD loop.
+* **LearnerGroup** scales via a `jax.sharding.Mesh` (data-parallel batch
+  sharding) instead of DDP-wrapped torch modules.
+"""
+
+from .algorithms.algorithm import Algorithm
+from .algorithms.algorithm_config import AlgorithmConfig
+from .algorithms.ppo import PPO, PPOConfig
+from .algorithms.impala import IMPALA, IMPALAConfig
+from .algorithms.dqn import DQN, DQNConfig
+from .env import register_env, make_env
+from .env.env_runner import EnvRunner
+
+__all__ = [
+    "Algorithm",
+    "AlgorithmConfig",
+    "PPO",
+    "PPOConfig",
+    "IMPALA",
+    "IMPALAConfig",
+    "DQN",
+    "DQNConfig",
+    "register_env",
+    "make_env",
+    "EnvRunner",
+]

@@ -1,0 +1,197 @@
+"""Algorithm — the trainable RL driver (reference: `rllib/algorithms/algorithm.py:796`
+`step`, `:1575 training_step`).
+
+`train()` runs one iteration: sample from EnvRunners (driver-local or
+ray_tpu actors), update the Learner (one jit program), and sync weights
+back through the object store — the reference's PPO shape (SURVEY.md §3.5)
+minus torch DDP.  `Algorithm` duck-types the Tune `Trainable` contract
+(`train/save/restore/stop`) so `ray_tpu.tune.Tuner` can drive it.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import pickle
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core.learner import Learner, LearnerGroup
+from ..env import make_env
+from ..env.env_runner import EnvRunner
+from ..env.spaces import Box, Discrete
+from .algorithm_config import AlgorithmConfig
+
+
+class Algorithm:
+    config_class = AlgorithmConfig
+
+    def __init__(self, config: AlgorithmConfig):
+        self.config = config
+        self.iteration = 0
+        self._timesteps_total = 0
+        self._episode_returns = collections.deque(maxlen=100)
+        self._episode_lengths = collections.deque(maxlen=100)
+        self._remote_runners: List = []
+        self._local_runner: Optional[EnvRunner] = None
+        self._ray = None
+        self.setup()
+
+    # ---------------------------------------------------------------- setup
+    def setup(self):
+        cfg = self.config
+        probe = make_env(cfg.env, 1, **cfg.env_config)
+        self.observation_space = probe.observation_space
+        self.action_space = probe.action_space
+        probe.close()
+
+        self.module = self._make_module()
+        self.learner_group = LearnerGroup(
+            self._make_learner, remote=cfg.remote_learner
+        )
+        self._weights = self.learner_group.get_weights()
+
+        rollout_len = cfg.derived_rollout_len()
+        runner_kwargs = dict(
+            env_name=cfg.env,
+            num_envs=cfg.num_envs_per_env_runner,
+            module=self.module,
+            rollout_len=rollout_len,
+            env_kwargs=cfg.env_config,
+        )
+        if cfg.num_env_runners > 0:
+            import ray_tpu
+
+            self._ray = ray_tpu
+            if not ray_tpu.is_initialized():
+                ray_tpu.init(ignore_reinit_error=True)
+            RemoteRunner = ray_tpu.remote(EnvRunner)
+            self._remote_runners = [
+                RemoteRunner.remote(seed=cfg.seed + i, **runner_kwargs)
+                for i in range(cfg.num_env_runners)
+            ]
+            ray_tpu.get([r.ping.remote() for r in self._remote_runners])
+        else:
+            self._local_runner = EnvRunner(seed=cfg.seed, **runner_kwargs)
+
+    def _make_module(self):
+        from ..core.rl_module import DiscretePolicyModule, GaussianPolicyModule
+
+        hidden = tuple(self.config.model.get("hidden", (64, 64)))
+        obs_dim = int(np.prod(self.observation_space.shape))
+        if isinstance(self.action_space, Discrete):
+            return DiscretePolicyModule(obs_dim, self.action_space.n, hidden)
+        if isinstance(self.action_space, Box):
+            return GaussianPolicyModule(obs_dim, int(np.prod(self.action_space.shape)), hidden)
+        raise TypeError(f"Unsupported action space {self.action_space}")
+
+    def _make_learner(self) -> Learner:
+        raise NotImplementedError
+
+    # ---------------------------------------------------------------- train
+    def train(self) -> Dict:
+        t0 = time.perf_counter()
+        self.iteration += 1
+        result = self.training_step()
+        dt = time.perf_counter() - t0
+        steps_this_iter = result.pop("_env_steps_this_iter", 0)
+        self._timesteps_total += steps_this_iter
+        result.update(
+            training_iteration=self.iteration,
+            timesteps_total=self._timesteps_total,
+            num_env_steps_sampled_this_iter=steps_this_iter,
+            episode_reward_mean=(
+                float(np.mean(self._episode_returns)) if self._episode_returns else float("nan")
+            ),
+            episode_len_mean=(
+                float(np.mean(self._episode_lengths)) if self._episode_lengths else float("nan")
+            ),
+            episodes_this_iter=result.get("episodes_this_iter", 0),
+            time_this_iter_s=dt,
+            env_steps_per_sec=steps_this_iter / dt if dt > 0 else 0.0,
+        )
+        return result
+
+    def training_step(self) -> Dict:
+        raise NotImplementedError
+
+    # ------------------------------------------------------------- sampling
+    def _sample_batches(self) -> List[Dict[str, np.ndarray]]:
+        """One rollout fragment from every runner (parallel when remote)."""
+        if self._remote_runners:
+            w_ref = self._ray.put(self._weights)
+            batches = self._ray.get([r.sample.remote(w_ref) for r in self._remote_runners])
+        else:
+            batches = [self._local_runner.sample(self._weights)]
+        for b in batches:
+            self._episode_returns.extend(b.pop("episode_returns").tolist())
+            self._episode_lengths.extend(b.pop("episode_lengths").tolist())
+        return batches
+
+    @staticmethod
+    def _concat_batches(batches: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
+        """Concatenate runner fragments along the env axis (axis=1; time-major)."""
+        if len(batches) == 1:
+            return batches[0]
+        out = {}
+        for k in batches[0]:
+            axis = 0 if k == "last_obs" else 1
+            out[k] = np.concatenate([b[k] for b in batches], axis=axis)
+        return out
+
+    # ---------------------------------------------------------- evaluation
+    def evaluate(self) -> Dict:
+        runner = self._local_runner
+        if runner is None:
+            return self._ray.get(
+                self._remote_runners[0].evaluate.remote(
+                    self._weights, self.config.evaluation_num_episodes
+                )
+            )
+        return runner.evaluate(self._weights, self.config.evaluation_num_episodes)
+
+    # --------------------------------------------------------- checkpoints
+    def save(self, checkpoint_dir: str) -> str:
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        path = os.path.join(checkpoint_dir, "algorithm_state.pkl")
+        with open(path, "wb") as f:
+            pickle.dump(
+                {
+                    "learner": self.learner_group.save_state(),
+                    "iteration": self.iteration,
+                    "timesteps_total": self._timesteps_total,
+                    "config": self.config.to_dict(),
+                },
+                f,
+            )
+        return checkpoint_dir
+
+    def restore(self, checkpoint_dir: str):
+        path = os.path.join(checkpoint_dir, "algorithm_state.pkl")
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        self.learner_group.load_state(state["learner"])
+        self.iteration = state["iteration"]
+        self._timesteps_total = state["timesteps_total"]
+        self._weights = self.learner_group.get_weights()
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint_dir: str, config: AlgorithmConfig):
+        algo = cls(config)
+        algo.restore(checkpoint_dir)
+        return algo
+
+    def stop(self):
+        if self._remote_runners:
+            for r in self._remote_runners:
+                try:
+                    self._ray.kill(r)
+                except Exception:  # noqa: BLE001
+                    pass
+            self._remote_runners = []
+
+    # Tune function-trainable adapter
+    def __call__(self, _config: Optional[dict] = None):
+        return self.train()

@@ -1,0 +1,119 @@
+"""AlgorithmConfig — fluent builder (reference: `rllib/algorithms/algorithm_config.py`).
+
+Same chaining surface as the reference (`.environment().env_runners()
+.training().build()`); only TPU-relevant knobs are kept. Each algorithm
+subclasses with its own training() keys.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, Optional, Type
+
+
+class AlgorithmConfig:
+    algo_class: Optional[Type] = None
+
+    def __init__(self):
+        # environment
+        self.env: Optional[str] = None
+        self.env_config: Dict[str, Any] = {}
+        # env runners
+        self.num_env_runners: int = 0  # 0 => sample in the driver process
+        self.num_envs_per_env_runner: int = 8
+        self.rollout_fragment_length: Optional[int] = None  # derived if None
+        # training (common)
+        self.gamma: float = 0.99
+        self.lr: float = 3e-4
+        self.train_batch_size: int = 2048
+        self.model: Dict[str, Any] = {"hidden": (64, 64)}
+        self.grad_clip: Optional[float] = 0.5
+        # resources
+        self.num_learners: int = 0
+        self.use_mesh: bool = False
+        self.remote_learner: bool = False
+        # debugging
+        self.seed: int = 0
+        # evaluation
+        self.evaluation_num_episodes: int = 10
+
+    # ------------------------------------------------------- builder API
+    def environment(self, env: Optional[str] = None, *, env_config: Optional[dict] = None) -> "AlgorithmConfig":
+        if env is not None:
+            self.env = env
+        if env_config is not None:
+            self.env_config = dict(env_config)
+        return self
+
+    def env_runners(
+        self,
+        *,
+        num_env_runners: Optional[int] = None,
+        num_envs_per_env_runner: Optional[int] = None,
+        rollout_fragment_length: Optional[int] = None,
+        **_compat,
+    ) -> "AlgorithmConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_env_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    # reference old-stack alias
+    rollouts = env_runners
+
+    def training(self, **kwargs) -> "AlgorithmConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise AttributeError(f"Unknown training key {k!r} for {type(self).__name__}")
+            setattr(self, k, v)
+        return self
+
+    def resources(self, *, num_learners: Optional[int] = None, remote_learner: Optional[bool] = None, **_c) -> "AlgorithmConfig":
+        if num_learners is not None:
+            self.num_learners = num_learners
+        if remote_learner is not None:
+            self.remote_learner = remote_learner
+        return self
+
+    def framework(self, *_a, **_k) -> "AlgorithmConfig":
+        return self  # always JAX here
+
+    def debugging(self, *, seed: Optional[int] = None, **_c) -> "AlgorithmConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def evaluation(self, *, evaluation_num_episodes: Optional[int] = None, **_c) -> "AlgorithmConfig":
+        if evaluation_num_episodes is not None:
+            self.evaluation_num_episodes = evaluation_num_episodes
+        return self
+
+    # ------------------------------------------------------------ build
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: v for k, v in vars(self).items() if not k.startswith("_")}
+
+    def validate(self) -> None:
+        if self.env is None:
+            raise ValueError("config.environment(env=...) is required")
+
+    def build(self) -> "Algorithm":  # noqa: F821
+        if self.algo_class is None:
+            raise ValueError(f"{type(self).__name__} has no algo_class")
+        self.validate()
+        return self.algo_class(self.copy())
+
+    @property
+    def num_samplers(self) -> int:
+        return max(self.num_env_runners, 1)
+
+    def derived_rollout_len(self) -> int:
+        if self.rollout_fragment_length is not None:
+            return self.rollout_fragment_length
+        total_envs = self.num_samplers * self.num_envs_per_env_runner
+        return max(self.train_batch_size // total_envs, 1)

@@ -1,0 +1,225 @@
+"""DQN (reference: `rllib/algorithms/dqn`).
+
+Host-side numpy replay buffer feeding a jit-compiled double-Q update with
+Polyak target sync. Epsilon-greedy exploration rides the params pytree
+(`eps` leaf) so the stock EnvRunner sampling program needs no special case.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+
+from ..core.learner import Learner
+from ..core.rl_module import QModule, RLModule
+from ..env.spaces import Discrete
+from .algorithm import Algorithm
+from .algorithm_config import AlgorithmConfig
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 5e-4
+        self.train_batch_size = 512       # env steps sampled per iteration
+        self.replay_buffer_capacity: int = 50_000
+        self.learning_starts: int = 1_000
+        self.minibatch_size: int = 64
+        self.num_grad_steps: int = 32     # grad steps per iteration
+        self.target_network_update_tau: float = 0.01
+        self.epsilon_initial: float = 1.0
+        self.epsilon_final: float = 0.05
+        self.epsilon_decay_steps: int = 10_000
+        self.double_q: bool = True
+
+
+class QPolicyModule(RLModule):
+    """Adapts QModule to the EnvRunner interface: params carry
+    {online, target, eps}; `sample` is epsilon-greedy over online Q."""
+
+    def __init__(self, obs_dim: int, n_actions: int, hidden=(64, 64)):
+        self.q = QModule(obs_dim, n_actions, hidden)
+        self.n_actions = n_actions
+
+    def init(self, rng):
+        online = self.q.init(rng)
+        return {
+            "online": online,
+            "target": jax.tree.map(jnp.copy, online),
+            "eps": jnp.asarray(1.0, jnp.float32),
+        }
+
+    def forward(self, params, obs):
+        qvals = self.q.forward(params["online"], obs)
+        # (dist, value) interface: dist = (q, eps); value = greedy Q
+        return (qvals, params["eps"]), qvals.max(axis=-1)
+
+    @staticmethod
+    def sample(rng, dist):
+        qvals, eps = dist
+        k_expl, k_rand = jax.random.split(rng)
+        greedy = qvals.argmax(axis=-1)
+        random = jax.random.randint(k_rand, greedy.shape, 0, qvals.shape[-1])
+        explore = jax.random.uniform(k_expl, greedy.shape) < eps
+        return jnp.where(explore, random, greedy).astype(jnp.int32)
+
+    @staticmethod
+    def log_prob(dist, actions):
+        qvals, _ = dist
+        return jnp.zeros(qvals.shape[:-1], jnp.float32)  # unused by DQN
+
+    @staticmethod
+    def entropy(dist):
+        qvals, _ = dist
+        return jnp.zeros(qvals.shape[:-1], jnp.float32)
+
+
+class ReplayBuffer:
+    """Flat circular numpy buffer (reference: `rllib/utils/replay_buffers/`)."""
+
+    def __init__(self, capacity: int, obs_dim: int):
+        self.capacity = capacity
+        self.obs = np.empty((capacity, obs_dim), np.float32)
+        self.next_obs = np.empty((capacity, obs_dim), np.float32)
+        self.actions = np.empty(capacity, np.int32)
+        self.rewards = np.empty(capacity, np.float32)
+        self.dones = np.empty(capacity, np.float32)
+        self.size = 0
+        self.pos = 0
+
+    def add_fragment(self, batch: Dict[str, np.ndarray]):
+        """Flatten a time-major [T, B] rollout fragment into transitions."""
+        obs, dones = batch["obs"], batch["dones"]
+        T, B = dones.shape
+        next_obs = np.concatenate([obs[1:], batch["last_obs"][None]], axis=0)
+        flat = {
+            "obs": obs.reshape(T * B, -1),
+            "next_obs": next_obs.reshape(T * B, -1),
+            "actions": batch["actions"].reshape(T * B),
+            "rewards": batch["rewards"].reshape(T * B),
+            "dones": dones.reshape(T * B),
+        }
+        n = T * B
+        idx = (self.pos + np.arange(n)) % self.capacity
+        self.obs[idx] = flat["obs"]
+        self.next_obs[idx] = flat["next_obs"]
+        self.actions[idx] = flat["actions"]
+        self.rewards[idx] = flat["rewards"]
+        self.dones[idx] = flat["dones"]
+        self.pos = (self.pos + n) % self.capacity
+        self.size = min(self.size + n, self.capacity)
+
+    def sample(self, rng: np.random.Generator, k: int, mb: int) -> Dict[str, np.ndarray]:
+        """k minibatches of size mb, stacked [k, mb, ...]."""
+        idx = rng.integers(0, self.size, size=(k, mb))
+        return {
+            "obs": self.obs[idx],
+            "next_obs": self.next_obs[idx],
+            "actions": self.actions[idx],
+            "rewards": self.rewards[idx],
+            "dones": self.dones[idx],
+        }
+
+
+def make_dqn_update(module: QPolicyModule, opt, cfg: DQNConfig):
+    gamma, tau, double_q = cfg.gamma, cfg.target_network_update_tau, cfg.double_q
+    qnet = module.q
+
+    def loss_fn(online, target, mb):
+        q = qnet.forward(online, mb["obs"])
+        q_taken = jnp.take_along_axis(q, mb["actions"][..., None], axis=-1)[..., 0]
+        q_next_target = qnet.forward(target, mb["next_obs"])
+        if double_q:
+            next_a = qnet.forward(online, mb["next_obs"]).argmax(axis=-1)
+            q_next = jnp.take_along_axis(q_next_target, next_a[..., None], axis=-1)[..., 0]
+        else:
+            q_next = q_next_target.max(axis=-1)
+        td_target = mb["rewards"] + gamma * (1.0 - mb["dones"]) * q_next
+        td = q_taken - jax.lax.stop_gradient(td_target)
+        loss = optax.huber_loss(td).mean()
+        return loss, {"td_loss": loss, "q_mean": q_taken.mean()}
+
+    def update(state, batches, rng):
+        del rng
+        params, opt_state = state
+
+        def grad_step(carry, mb):
+            params, opt_state = carry
+            (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params["online"], params["target"], mb
+            )
+            updates, opt_state = opt.update(grads, opt_state, params["online"])
+            online = optax.apply_updates(params["online"], updates)
+            target = jax.tree.map(
+                lambda t, o: (1 - tau) * t + tau * o, params["target"], online
+            )
+            params = {"online": online, "target": target, "eps": params["eps"]}
+            return (params, opt_state), aux
+
+        (params, opt_state), auxs = lax.scan(grad_step, (params, opt_state), batches)
+        return (params, opt_state), jax.tree.map(lambda x: x.mean(), auxs)
+
+    return update
+
+
+class DQN(Algorithm):
+    config_class = DQNConfig
+
+    def setup(self):
+        super().setup()
+        obs_dim = int(np.prod(self.observation_space.shape))
+        self._buffer = ReplayBuffer(self.config.replay_buffer_capacity, obs_dim)
+        self._np_rng = np.random.default_rng(self.config.seed)
+
+    def _make_module(self):
+        if not isinstance(self.action_space, Discrete):
+            raise TypeError("DQN requires a discrete action space")
+        hidden = tuple(self.config.model.get("hidden", (64, 64)))
+        obs_dim = int(np.prod(self.observation_space.shape))
+        return QPolicyModule(obs_dim, self.action_space.n, hidden)
+
+    def _make_learner(self) -> Learner:
+        cfg = self.config
+        chain = []
+        if cfg.grad_clip is not None:
+            chain.append(optax.clip_by_global_norm(cfg.grad_clip))
+        chain.append(optax.adam(cfg.lr))
+        opt = optax.chain(*chain)
+        learner = Learner(
+            self.module, make_dqn_update(self.module, opt, cfg), seed=cfg.seed
+        )
+        learner.opt_state = opt.init(learner.params["online"])
+        return learner
+
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(self._timesteps_total / max(cfg.epsilon_decay_steps, 1), 1.0)
+        return cfg.epsilon_initial + frac * (cfg.epsilon_final - cfg.epsilon_initial)
+
+    def training_step(self) -> Dict:
+        cfg = self.config
+        self._weights = dict(self._weights)
+        self._weights["eps"] = np.asarray(self._epsilon(), np.float32)
+        batches = self._sample_batches()
+        env_steps = 0
+        for b in batches:
+            T, B = b["rewards"].shape
+            env_steps += T * B
+            self._buffer.add_fragment(b)
+
+        metrics: Dict = {"td_loss": float("nan"), "q_mean": float("nan")}
+        if self._buffer.size >= cfg.learning_starts:
+            mbs = self._buffer.sample(self._np_rng, cfg.num_grad_steps, cfg.minibatch_size)
+            metrics = self.learner_group.update(mbs)
+            self._weights = self.learner_group.get_weights()
+            self._weights = dict(self._weights)
+            self._weights["eps"] = np.asarray(self._epsilon(), np.float32)
+        return {"_env_steps_this_iter": env_steps, "info": {"learner": metrics}}
+
+
+DQNConfig.algo_class = DQN

@@ -1,0 +1,178 @@
+"""IMPALA (reference: `rllib/algorithms/impala/impala.py:65,126`).
+
+Decoupled actor-learner architecture: EnvRunner actors sample continuously
+with (slightly) stale weights; the driver consumes batches as they arrive
+(`ray_tpu.wait`), corrects off-policyness with **V-trace**, and re-arms each
+runner with fresh weights — the reference's aggregator/learner-thread split
+collapses into one jit-compiled V-trace program per arriving batch.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+
+from ..core.learner import Learner
+from .algorithm import Algorithm
+from .algorithm_config import AlgorithmConfig
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.vtrace_clip_rho_threshold: float = 1.0
+        self.vtrace_clip_c_threshold: float = 1.0
+        self.vf_loss_coeff: float = 0.5
+        self.entropy_coeff: float = 0.01
+        self.lr = 5e-4
+        self.train_batch_size = 512
+        self.num_env_runners = 2
+        self.broadcast_interval: int = 1  # updates between weight refreshes
+
+    def validate(self):
+        super().validate()
+
+
+def make_vtrace_update(module, opt, cfg: IMPALAConfig):
+    gamma = cfg.gamma
+    rho_bar = cfg.vtrace_clip_rho_threshold
+    c_bar = cfg.vtrace_clip_c_threshold
+    vf_coeff, ent_coeff = cfg.vf_loss_coeff, cfg.entropy_coeff
+
+    def loss_fn(params, batch):
+        T, B = batch["rewards"].shape
+        obs_flat = batch["obs"].reshape(T * B, -1)
+        dist, values = module.forward(params, obs_flat)
+        values = values.reshape(T, B)
+        if isinstance(dist, tuple):  # gaussian (mean, log_std)
+            dist = tuple(
+                d.reshape((T, B) + d.shape[1:]) if d.ndim > 1 else d for d in dist
+            )
+        else:
+            dist = dist.reshape((T, B) + dist.shape[1:])
+        logp = module.log_prob(dist, batch["actions"])
+
+        _, last_val = module.forward(params, batch["last_obs"])
+
+        rhos = jnp.exp(logp - batch["logp"])
+        clipped_rhos = jnp.minimum(rhos, rho_bar)
+        cs = jnp.minimum(rhos, c_bar)
+        not_done = 1.0 - batch["dones"]
+
+        v_next = jnp.concatenate([values[1:], last_val[None]], axis=0)
+        deltas = clipped_rhos * (
+            batch["rewards"] + gamma * not_done * v_next - values
+        )
+
+        def scan_fn(acc, x):
+            delta, c, nd = x
+            acc = delta + gamma * nd * c * acc
+            return acc, acc
+
+        _, vs_minus_v = lax.scan(
+            scan_fn,
+            jnp.zeros_like(last_val),
+            (deltas, cs, not_done),
+            reverse=True,
+        )
+        vs = jax.lax.stop_gradient(vs_minus_v + values)
+        vs_next = jnp.concatenate([vs[1:], last_val[None]], axis=0)
+        pg_adv = jax.lax.stop_gradient(
+            clipped_rhos * (batch["rewards"] + gamma * not_done * vs_next - values)
+        )
+
+        pg_loss = -(logp * pg_adv).mean()
+        vf_loss = 0.5 * ((values - vs) ** 2).mean()
+        entropy = module.entropy(dist).mean()
+        total = pg_loss + vf_coeff * vf_loss - ent_coeff * entropy
+        aux = {
+            "total_loss": total,
+            "policy_loss": pg_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+            "mean_rho": rhos.mean(),
+        }
+        return total, aux
+
+    def update(state, batch, rng):
+        del rng
+        params, opt_state = state
+        (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, batch)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return (params, opt_state), aux
+
+    return update
+
+
+class IMPALA(Algorithm):
+    config_class = IMPALAConfig
+
+    def setup(self):
+        super().setup()
+        self._inflight: dict = {}  # future -> runner
+        self._updates_since_broadcast = 0
+
+    def _make_learner(self) -> Learner:
+        cfg = self.config
+        chain = []
+        if cfg.grad_clip is not None:
+            chain.append(optax.clip_by_global_norm(cfg.grad_clip))
+        chain.append(optax.rmsprop(cfg.lr, decay=0.99, eps=0.1))
+        opt = optax.chain(*chain)
+        learner = Learner(
+            self.module, make_vtrace_update(self.module, opt, cfg), seed=cfg.seed
+        )
+        learner.opt_state = opt.init(learner.params)
+        return learner
+
+    def training_step(self) -> Dict:
+        if not self._remote_runners:
+            # Degenerate sync path (local runner): sample → vtrace update.
+            batches = self._sample_batches()
+            batch = self._concat_batches(batches)
+            T, B = batch["rewards"].shape
+            metrics = self.learner_group.update(batch)
+            self._weights = self.learner_group.get_weights()
+            return {"_env_steps_this_iter": T * B, "info": {"learner": metrics}}
+
+        ray = self._ray
+        # Arm every idle runner with the current weights.
+        w_ref = ray.put(self._weights)
+        for r in self._remote_runners:
+            if r not in self._inflight.values():
+                fut = r.sample.remote(w_ref)
+                self._inflight[fut] = r
+
+        ready, _ = ray.wait(list(self._inflight), num_returns=1, timeout=60.0)
+        env_steps = 0
+        metrics: Dict = {}
+        for fut in ready:
+            runner = self._inflight.pop(fut)
+            batch = ray.get(fut)
+            self._episode_returns.extend(batch.pop("episode_returns").tolist())
+            self._episode_lengths.extend(batch.pop("episode_lengths").tolist())
+            T, B = batch["rewards"].shape
+            env_steps += T * B
+            metrics = self.learner_group.update(batch)
+            self._updates_since_broadcast += 1
+            if self._updates_since_broadcast >= self.config.broadcast_interval:
+                self._weights = self.learner_group.get_weights()
+                w_ref = ray.put(self._weights)
+                self._updates_since_broadcast = 0
+            # Re-arm immediately (decoupled sampling).
+            new_fut = runner.sample.remote(w_ref)
+            self._inflight[new_fut] = runner
+        return {"_env_steps_this_iter": env_steps, "info": {"learner": metrics}}
+
+    def stop(self):
+        self._inflight.clear()
+        super().stop()
+
+
+IMPALAConfig.algo_class = IMPALA

@@ -1,0 +1,143 @@
+"""RLModule — the model abstraction (reference: `rllib/core/rl_module/rl_module.py:228`).
+
+The reference's RLModule is a torch/tf nn.Module with forward_exploration /
+forward_inference / forward_train methods. TPU-native shape: an RLModule is a
+*pure-function pair* `(init, forward)` over a params pytree — trivially
+jittable, shardable with `jax.sharding`, and usable identically inside the
+EnvRunner's sampling program and the Learner's update program.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _mlp_init(rng, sizes: Sequence[int], scale_last: float = 0.01):
+    """Orthogonal-init MLP params: list of (W, b)."""
+    params = []
+    keys = jax.random.split(rng, len(sizes) - 1)
+    for i, (d_in, d_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+        w = jax.nn.initializers.orthogonal(
+            scale_last if i == len(sizes) - 2 else float(np.sqrt(2))
+        )(keys[i], (d_in, d_out), jnp.float32)
+        params.append({"w": w, "b": jnp.zeros((d_out,), jnp.float32)})
+    return params
+
+
+def _mlp_apply(params, x, activation=jnp.tanh):
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            x = activation(x)
+    return x
+
+
+class RLModule:
+    """Base: subclasses define `init(rng) -> params` and
+    `forward(params, obs) -> outputs` as pure functions."""
+
+    def init(self, rng):
+        raise NotImplementedError
+
+    def forward(self, params, obs):
+        raise NotImplementedError
+
+
+class DiscretePolicyModule(RLModule):
+    """Separate policy/value MLP towers; categorical action distribution.
+
+    forward -> (logits [B, n_actions], value [B]).
+    """
+
+    def __init__(self, obs_dim: int, n_actions: int, hidden: Sequence[int] = (64, 64)):
+        self.obs_dim = obs_dim
+        self.n_actions = n_actions
+        self.hidden = tuple(hidden)
+
+    def init(self, rng):
+        k_pi, k_v = jax.random.split(rng)
+        return {
+            "pi": _mlp_init(k_pi, (self.obs_dim, *self.hidden, self.n_actions), scale_last=0.01),
+            "v": _mlp_init(k_v, (self.obs_dim, *self.hidden, 1), scale_last=1.0),
+        }
+
+    def forward(self, params, obs) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        logits = _mlp_apply(params["pi"], obs)
+        value = _mlp_apply(params["v"], obs)[..., 0]
+        return logits, value
+
+    # --- categorical distribution helpers (used by PPO/IMPALA losses) ---
+    @staticmethod
+    def log_prob(logits, actions):
+        logp = jax.nn.log_softmax(logits)
+        return jnp.take_along_axis(logp, actions[..., None], axis=-1)[..., 0]
+
+    @staticmethod
+    def entropy(logits):
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+    @staticmethod
+    def sample(rng, logits):
+        return jax.random.categorical(rng, logits, axis=-1)
+
+
+class GaussianPolicyModule(RLModule):
+    """Diagonal-Gaussian policy for continuous actions (tanh-free, clipped by
+    the env). forward -> ((mean [B, act_dim], log_std [act_dim]), value [B])."""
+
+    def __init__(self, obs_dim: int, act_dim: int, hidden: Sequence[int] = (64, 64)):
+        self.obs_dim = obs_dim
+        self.act_dim = act_dim
+        self.hidden = tuple(hidden)
+
+    def init(self, rng):
+        k_pi, k_v = jax.random.split(rng)
+        return {
+            "pi": _mlp_init(k_pi, (self.obs_dim, *self.hidden, self.act_dim), scale_last=0.01),
+            "v": _mlp_init(k_v, (self.obs_dim, *self.hidden, 1), scale_last=1.0),
+            "log_std": jnp.zeros((self.act_dim,), jnp.float32),
+        }
+
+    def forward(self, params, obs):
+        mean = _mlp_apply(params["pi"], obs)
+        value = _mlp_apply(params["v"], obs)[..., 0]
+        return (mean, params["log_std"]), value
+
+    @staticmethod
+    def log_prob(dist, actions):
+        mean, log_std = dist
+        var = jnp.exp(2 * log_std)
+        return jnp.sum(
+            -0.5 * ((actions - mean) ** 2 / var + 2 * log_std + jnp.log(2 * jnp.pi)),
+            axis=-1,
+        )
+
+    @staticmethod
+    def entropy(dist):
+        _, log_std = dist
+        return jnp.sum(log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e)) * jnp.ones(())
+
+    @staticmethod
+    def sample(rng, dist):
+        mean, log_std = dist
+        return mean + jnp.exp(log_std) * jax.random.normal(rng, mean.shape)
+
+
+class QModule(RLModule):
+    """Q-network for DQN: forward -> q_values [B, n_actions]."""
+
+    def __init__(self, obs_dim: int, n_actions: int, hidden: Sequence[int] = (64, 64)):
+        self.obs_dim = obs_dim
+        self.n_actions = n_actions
+        self.hidden = tuple(hidden)
+
+    def init(self, rng):
+        return {"q": _mlp_init(rng, (self.obs_dim, *self.hidden, self.n_actions), scale_last=1.0)}
+
+    def forward(self, params, obs):
+        return _mlp_apply(params["q"], obs, activation=jax.nn.relu)

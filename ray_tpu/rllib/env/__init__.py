@@ -1,0 +1,59 @@
+"""Environment API + registry (reference: `rllib/env/`).
+
+The reference delegates to gymnasium; this image has no gym, so classic
+control environments are implemented natively — and *vectorized in numpy*
+from the start, which is the shape the TPU stack wants anyway (EnvRunner
+actors step [N]-env batches, the policy forward is one XLA call per batch).
+
+API is gymnasium-flavored:
+    reset(seed) -> (obs, info);  step(a) -> (obs, rew, terminated, truncated, info)
+Vector envs auto-reset finished sub-envs and report completed episode
+returns/lengths in `info`.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from .spaces import Box, Discrete, Space
+from .vector import VectorEnv
+
+_ENV_REGISTRY: Dict[str, Callable[..., VectorEnv]] = {}
+
+
+def register_env(name: str, ctor: Callable[..., VectorEnv]) -> None:
+    """Register a vector-env constructor: ctor(num_envs, **kwargs) -> VectorEnv.
+
+    Reference analog: `ray.tune.registry.register_env` (used throughout
+    rllib/algorithms) — here envs are registered directly with the RL lib.
+    """
+    _ENV_REGISTRY[name] = ctor
+
+
+def make_env(name: str, num_envs: int = 1, **kwargs) -> VectorEnv:
+    if name not in _ENV_REGISTRY:
+        raise KeyError(
+            f"Unknown env {name!r}. Registered: {sorted(_ENV_REGISTRY)}. "
+            "Use register_env(name, ctor) for custom environments."
+        )
+    return _ENV_REGISTRY[name](num_envs, **kwargs)
+
+
+def _register_builtins():
+    from .cartpole import VectorCartPole
+    from .pendulum import VectorPendulum
+
+    register_env("CartPole-v1", VectorCartPole)
+    register_env("Pendulum-v1", VectorPendulum)
+
+
+_register_builtins()
+
+__all__ = [
+    "Box",
+    "Discrete",
+    "Space",
+    "VectorEnv",
+    "register_env",
+    "make_env",
+]

@@ -1,0 +1,94 @@
+"""CartPole-v1, natively vectorized (classic-control dynamics).
+
+Matches the standard CartPole-v1 contract the reference's BASELINE config
+targets (`rllib/tuned_examples/ppo/cartpole-ppo.yaml`): 4-dim observation,
+2 actions, reward 1 per step, termination at |x|>2.4 or |theta|>12°,
+truncation at 500 steps. Dynamics are Euler-integrated batched numpy.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+from .spaces import Box, Discrete
+from .vector import VectorEnv
+
+
+class VectorCartPole(VectorEnv):
+    GRAVITY = 9.8
+    MASSCART = 1.0
+    MASSPOLE = 0.1
+    TOTAL_MASS = MASSCART + MASSPOLE
+    LENGTH = 0.5  # half pole length
+    POLEMASS_LENGTH = MASSPOLE * LENGTH
+    FORCE_MAG = 10.0
+    TAU = 0.02
+    THETA_THRESHOLD = 12 * 2 * math.pi / 360
+    X_THRESHOLD = 2.4
+
+    max_episode_steps = 500
+
+    def __init__(self, num_envs: int = 1, max_episode_steps: int = 500):
+        self.num_envs = num_envs
+        self.max_episode_steps = max_episode_steps
+        self.observation_space = Box(-np.inf, np.inf, (4,))
+        self.action_space = Discrete(2)
+        self._rng = np.random.default_rng()
+        self._state = np.zeros((num_envs, 4), np.float64)
+        self._steps = np.zeros(num_envs, np.int64)
+
+    def _sample_state(self, n: int) -> np.ndarray:
+        return self._rng.uniform(-0.05, 0.05, size=(n, 4))
+
+    def reset(self, seed: Optional[int] = None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._state = self._sample_state(self.num_envs)
+        self._steps[:] = 0
+        return self._state.astype(np.float32), {}
+
+    def step(self, actions: np.ndarray):
+        s = self._state
+        x, x_dot, theta, theta_dot = s[:, 0], s[:, 1], s[:, 2], s[:, 3]
+        force = np.where(actions == 1, self.FORCE_MAG, -self.FORCE_MAG)
+        costheta = np.cos(theta)
+        sintheta = np.sin(theta)
+        temp = (force + self.POLEMASS_LENGTH * theta_dot**2 * sintheta) / self.TOTAL_MASS
+        thetaacc = (self.GRAVITY * sintheta - costheta * temp) / (
+            self.LENGTH * (4.0 / 3.0 - self.MASSPOLE * costheta**2 / self.TOTAL_MASS)
+        )
+        xacc = temp - self.POLEMASS_LENGTH * thetaacc * costheta / self.TOTAL_MASS
+
+        x = x + self.TAU * x_dot
+        x_dot = x_dot + self.TAU * xacc
+        theta = theta + self.TAU * theta_dot
+        theta_dot = theta_dot + self.TAU * thetaacc
+        self._state = np.stack([x, x_dot, theta, theta_dot], axis=1)
+        self._steps += 1
+
+        terminated = (np.abs(x) > self.X_THRESHOLD) | (np.abs(theta) > self.THETA_THRESHOLD)
+        truncated = (~terminated) & (self._steps >= self.max_episode_steps)
+        reward = np.ones(self.num_envs, np.float32)
+
+        done = terminated | truncated
+        info = {
+            "episode_returns": [],
+            "episode_lengths": [],
+        }
+        if done.any():
+            idx = np.nonzero(done)[0]
+            # reward-per-step=1 → episode return == episode length
+            info["episode_returns"] = [float(self._steps[i]) for i in idx]
+            info["episode_lengths"] = [int(self._steps[i]) for i in idx]
+            self._state[idx] = self._sample_state(len(idx))
+            self._steps[idx] = 0
+        return (
+            self._state.astype(np.float32),
+            reward,
+            terminated,
+            truncated,
+            info,
+        )

@@ -1,0 +1,130 @@
+"""EnvRunner — vectorized sampling actor (reference: `rllib/env/env_runner.py:15`,
+`SingleAgentEnvRunner`; old stack `rllib/evaluation/rollout_worker.py:159`).
+
+One EnvRunner steps an [N]-env numpy batch; the policy forward + action
+sample is a single jit-compiled XLA call per step (CPU backend on rollout
+hosts). Weights arrive as an argument to `sample()` — the driver broadcasts
+them through the object store exactly like the reference's
+`sync weights back to rollout workers` step (SURVEY.md §3.5).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from . import make_env
+from .spaces import Discrete
+
+
+class EnvRunner:
+    def __init__(
+        self,
+        *,
+        env_name: str,
+        num_envs: int = 8,
+        module: Any,
+        rollout_len: int = 128,
+        seed: Optional[int] = None,
+        env_kwargs: Optional[dict] = None,
+    ):
+        self.env = make_env(env_name, num_envs, **(env_kwargs or {}))
+        self.num_envs = num_envs
+        self.rollout_len = rollout_len
+        self.module = module
+        self._discrete = isinstance(self.env.action_space, Discrete)
+        self._rng = jax.random.PRNGKey(seed if seed is not None else np.random.randint(2**31))
+        self._obs, _ = self.env.reset(seed=seed)
+        self._ep_returns: list = []
+        self._ep_lengths: list = []
+
+        mod = self.module
+
+        def _act(params, obs, rng):
+            dist, value = mod.forward(params, obs)
+            action = mod.sample(rng, dist)
+            logp = mod.log_prob(dist, action)
+            return action, logp, value
+
+        def _act_greedy(params, obs):
+            dist, value = mod.forward(params, obs)
+            if self._discrete:
+                action = dist.argmax(axis=-1)
+            else:
+                action = dist[0]
+            return action, value
+
+        self._act = jax.jit(_act)
+        self._act_greedy = jax.jit(_act_greedy)
+
+    def get_spaces(self):
+        return self.env.observation_space, self.env.action_space
+
+    def ping(self) -> str:
+        return "ok"
+
+    def sample(self, params) -> Dict[str, np.ndarray]:
+        """Collect `rollout_len` vectorized steps. Returns time-major arrays
+        [T, N, ...] plus the bootstrap observation and episode stats."""
+        T, N = self.rollout_len, self.num_envs
+        obs_buf = np.empty((T, N) + tuple(self.env.observation_space.shape), np.float32)
+        act_dtype = np.int32 if self._discrete else np.float32
+        act_shape = (T, N) if self._discrete else (T, N) + tuple(self.env.action_space.shape)
+        act_buf = np.empty(act_shape, act_dtype)
+        logp_buf = np.empty((T, N), np.float32)
+        val_buf = np.empty((T, N), np.float32)
+        rew_buf = np.empty((T, N), np.float32)
+        done_buf = np.empty((T, N), np.float32)
+
+        ep_returns, ep_lengths = [], []
+        obs = self._obs
+        for t in range(T):
+            self._rng, key = jax.random.split(self._rng)
+            action, logp, value = self._act(params, obs, key)
+            action_np = np.asarray(action)
+            obs_buf[t] = obs
+            act_buf[t] = action_np
+            logp_buf[t] = np.asarray(logp)
+            val_buf[t] = np.asarray(value)
+            obs, rew, term, trunc, info = self.env.step(action_np)
+            rew_buf[t] = rew
+            done_buf[t] = (term | trunc).astype(np.float32)
+            ep_returns.extend(info.get("episode_returns", []))
+            ep_lengths.extend(info.get("episode_lengths", []))
+        self._obs = obs
+
+        return {
+            "obs": obs_buf,
+            "actions": act_buf,
+            "logp": logp_buf,
+            "values": val_buf,
+            "rewards": rew_buf,
+            "dones": done_buf,
+            "last_obs": obs.copy(),
+            "episode_returns": np.asarray(ep_returns, np.float64),
+            "episode_lengths": np.asarray(ep_lengths, np.int64),
+        }
+
+    def evaluate(self, params, num_episodes: int = 10) -> Dict[str, float]:
+        """Greedy rollouts to episode completion (fresh env instance so the
+        training stream's auto-reset state is untouched)."""
+        env = make_env_like(self.env)
+        obs, _ = env.reset()
+        returns: list = []
+        guard = 0
+        while len(returns) < num_episodes and guard < 100_000:
+            guard += 1
+            action, _ = self._act_greedy(params, obs)
+            obs, rew, term, trunc, info = env.step(np.asarray(action))
+            returns.extend(info.get("episode_returns", []))
+        return {
+            "episode_reward_mean": float(np.mean(returns[:num_episodes])) if returns else float("nan"),
+            "episodes": len(returns[:num_episodes]),
+        }
+
+
+def make_env_like(env):
+    """Fresh env of the same class/size (built-ins only need num_envs)."""
+    return type(env)(env.num_envs)

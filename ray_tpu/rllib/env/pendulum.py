@@ -1,0 +1,78 @@
+"""Pendulum-v1, natively vectorized — continuous-action counterpart for
+testing Gaussian policies (classic-control dynamics)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .spaces import Box
+from .vector import VectorEnv
+
+
+class VectorPendulum(VectorEnv):
+    MAX_SPEED = 8.0
+    MAX_TORQUE = 2.0
+    DT = 0.05
+    G = 10.0
+    M = 1.0
+    L = 1.0
+
+    max_episode_steps = 200
+
+    def __init__(self, num_envs: int = 1, max_episode_steps: int = 200):
+        self.num_envs = num_envs
+        self.max_episode_steps = max_episode_steps
+        self.observation_space = Box(-np.inf, np.inf, (3,))
+        self.action_space = Box(-self.MAX_TORQUE, self.MAX_TORQUE, (1,))
+        self._rng = np.random.default_rng()
+        self._theta = np.zeros(num_envs)
+        self._theta_dot = np.zeros(num_envs)
+        self._steps = np.zeros(num_envs, np.int64)
+        self._ep_ret = np.zeros(num_envs, np.float64)
+
+    def _obs(self) -> np.ndarray:
+        return np.stack(
+            [np.cos(self._theta), np.sin(self._theta), self._theta_dot], axis=1
+        ).astype(np.float32)
+
+    def _sample(self, n):
+        theta = self._rng.uniform(-np.pi, np.pi, n)
+        theta_dot = self._rng.uniform(-1.0, 1.0, n)
+        return theta, theta_dot
+
+    def reset(self, seed: Optional[int] = None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._theta, self._theta_dot = self._sample(self.num_envs)
+        self._steps[:] = 0
+        self._ep_ret[:] = 0.0
+        return self._obs(), {}
+
+    def step(self, actions: np.ndarray):
+        u = np.clip(np.asarray(actions, np.float64).reshape(self.num_envs), -self.MAX_TORQUE, self.MAX_TORQUE)
+        th, thdot = self._theta, self._theta_dot
+        norm_th = ((th + np.pi) % (2 * np.pi)) - np.pi
+        cost = norm_th**2 + 0.1 * thdot**2 + 0.001 * u**2
+
+        newthdot = thdot + (3 * self.G / (2 * self.L) * np.sin(th) + 3.0 / (self.M * self.L**2) * u) * self.DT
+        newthdot = np.clip(newthdot, -self.MAX_SPEED, self.MAX_SPEED)
+        self._theta = th + newthdot * self.DT
+        self._theta_dot = newthdot
+        self._steps += 1
+        self._ep_ret += -cost
+
+        truncated = self._steps >= self.max_episode_steps
+        terminated = np.zeros(self.num_envs, bool)
+        info = {"episode_returns": [], "episode_lengths": []}
+        if truncated.any():
+            idx = np.nonzero(truncated)[0]
+            info["episode_returns"] = [float(self._ep_ret[i]) for i in idx]
+            info["episode_lengths"] = [int(self._steps[i]) for i in idx]
+            th_new, thdot_new = self._sample(len(idx))
+            self._theta[idx] = th_new
+            self._theta_dot[idx] = thdot_new
+            self._steps[idx] = 0
+            self._ep_ret[idx] = 0.0
+        return self._obs(), (-cost).astype(np.float32), terminated, truncated, info

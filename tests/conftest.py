@@ -48,3 +48,9 @@ def cluster_runtime():
 def shutdown_only():
     yield
     ray_tpu.shutdown()
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "cluster: test boots the multiprocess cluster plane"
+    )

@@ -1,0 +1,136 @@
+"""RL library tests (reference analogs: `rllib/tests/`, per-algorithm `tests/`,
+learning smoke via `rllib/tuned_examples/ppo/cartpole-ppo.yaml` stop criteria)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib import DQNConfig, IMPALAConfig, PPOConfig, make_env
+
+
+class TestEnvs:
+    def test_cartpole_contract(self):
+        env = make_env("CartPole-v1", 4)
+        obs, _ = env.reset(seed=0)
+        assert obs.shape == (4, 4) and obs.dtype == np.float32
+        total_eps = 0
+        for _ in range(600):
+            obs, rew, term, trunc, info = env.step(np.random.randint(0, 2, 4))
+            assert rew.shape == (4,) and np.all(rew == 1.0)
+            total_eps += len(info["episode_returns"])
+        assert total_eps > 10  # random policy episodes are short
+        # episode return == episode length for CartPole
+        assert obs.shape == (4, 4)
+
+    def test_pendulum_contract(self):
+        env = make_env("Pendulum-v1", 3)
+        obs, _ = env.reset(seed=0)
+        assert obs.shape == (3, 3)
+        obs, rew, term, trunc, info = env.step(np.zeros((3, 1), np.float32))
+        assert np.all(rew <= 0)  # pendulum rewards are negative costs
+        assert not term.any()
+
+
+class TestPPO:
+    def test_cartpole_learning(self):
+        # BASELINE config #1: reward 150 within 100k env steps.
+        algo = (
+            PPOConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=0, num_envs_per_env_runner=16)
+            .training(train_batch_size=2048, minibatch_size=256, num_epochs=10,
+                      lr=3e-4, entropy_coeff=0.01)
+            .debugging(seed=0)
+            .build()
+        )
+        best = 0.0
+        for _ in range(25):  # ≤ 51.2k env steps
+            result = algo.train()
+            best = max(best, result["episode_reward_mean"])
+            if best >= 150:
+                break
+        assert best >= 150, f"PPO failed to learn CartPole: best={best}"
+        assert result["timesteps_total"] <= 100_000
+        algo.stop()
+
+    def test_save_restore(self, tmp_path):
+        config = (
+            PPOConfig()
+            .environment("CartPole-v1")
+            .training(train_batch_size=256, minibatch_size=64, num_epochs=2)
+        )
+        algo = config.build()
+        algo.train()
+        ckpt = algo.save(str(tmp_path / "ckpt"))
+        w_before = algo.learner_group.get_weights()
+
+        algo2 = config.copy().build()
+        algo2.restore(ckpt)
+        w_after = algo2.learner_group.get_weights()
+        np.testing.assert_allclose(
+            np.asarray(w_before["pi"][0]["w"]), np.asarray(w_after["pi"][0]["w"])
+        )
+        assert algo2.iteration == algo.iteration
+        algo.stop()
+        algo2.stop()
+
+    def test_continuous_actions_pendulum(self):
+        algo = (
+            PPOConfig()
+            .environment("Pendulum-v1")
+            .training(train_batch_size=512, minibatch_size=128, num_epochs=2)
+            .build()
+        )
+        result = algo.train()
+        assert np.isfinite(result["info"]["learner"]["total_loss"])
+        algo.stop()
+
+    @pytest.mark.cluster
+    def test_remote_env_runners(self):
+        algo = (
+            PPOConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=2, num_envs_per_env_runner=4)
+            .training(train_batch_size=512, minibatch_size=128, num_epochs=2)
+            .build()
+        )
+        result = algo.train()
+        assert result["num_env_steps_sampled_this_iter"] == 512
+        assert np.isfinite(result["info"]["learner"]["total_loss"])
+        algo.stop()
+
+
+class TestIMPALA:
+    def test_local_smoke(self):
+        algo = (
+            IMPALAConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=0, num_envs_per_env_runner=8)
+            .training(train_batch_size=512)
+            .build()
+        )
+        for _ in range(3):
+            result = algo.train()
+        assert np.isfinite(result["info"]["learner"]["total_loss"])
+        assert result["timesteps_total"] == 3 * 512
+        algo.stop()
+
+
+class TestDQN:
+    def test_smoke_and_epsilon_decay(self):
+        algo = (
+            DQNConfig()
+            .environment("CartPole-v1")
+            .training(
+                train_batch_size=256,
+                learning_starts=256,
+                num_grad_steps=8,
+                epsilon_decay_steps=1024,
+            )
+            .build()
+        )
+        eps0 = algo._epsilon()
+        for _ in range(4):
+            result = algo.train()
+        assert algo._epsilon() < eps0
+        assert np.isfinite(result["info"]["learner"]["td_loss"])
+        algo.stop()
