@@ -12,6 +12,7 @@ from .core.api import (
     cluster_resources,
     get,
     get_actor,
+    get_actor_or_none,
     init,
     is_initialized,
     kill,
@@ -60,6 +61,7 @@ __all__ = [
     "cancel",
     "method",
     "get_actor",
+    "get_actor_or_none",
     "nodes",
     "cluster_resources",
     "available_resources",

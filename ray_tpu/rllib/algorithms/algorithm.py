@@ -34,6 +34,7 @@ class Algorithm:
         self._timesteps_total = 0
         self._episode_returns = collections.deque(maxlen=100)
         self._episode_lengths = collections.deque(maxlen=100)
+        self._episodes_this_iter = 0
         self._remote_runners: List = []
         self._local_runner: Optional[EnvRunner] = None
         self._ray = None
@@ -94,6 +95,7 @@ class Algorithm:
     def train(self) -> Dict:
         t0 = time.perf_counter()
         self.iteration += 1
+        self._episodes_this_iter = 0
         result = self.training_step()
         dt = time.perf_counter() - t0
         steps_this_iter = result.pop("_env_steps_this_iter", 0)
@@ -108,7 +110,7 @@ class Algorithm:
             episode_len_mean=(
                 float(np.mean(self._episode_lengths)) if self._episode_lengths else float("nan")
             ),
-            episodes_this_iter=result.get("episodes_this_iter", 0),
+            episodes_this_iter=self._episodes_this_iter,
             time_this_iter_s=dt,
             env_steps_per_sec=steps_this_iter / dt if dt > 0 else 0.0,
         )
@@ -126,7 +128,9 @@ class Algorithm:
         else:
             batches = [self._local_runner.sample(self._weights)]
         for b in batches:
-            self._episode_returns.extend(b.pop("episode_returns").tolist())
+            returns = b.pop("episode_returns").tolist()
+            self._episodes_this_iter += len(returns)
+            self._episode_returns.extend(returns)
             self._episode_lengths.extend(b.pop("episode_lengths").tolist())
         return batches
 
@@ -191,6 +195,7 @@ class Algorithm:
                 except Exception:  # noqa: BLE001
                     pass
             self._remote_runners = []
+        self.learner_group.shutdown()
 
     # Tune function-trainable adapter
     def __call__(self, _config: Optional[dict] = None):

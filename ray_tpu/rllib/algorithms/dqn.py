@@ -69,6 +69,11 @@ class QPolicyModule(RLModule):
         return jnp.where(explore, random, greedy).astype(jnp.int32)
 
     @staticmethod
+    def greedy(dist):
+        qvals, _ = dist
+        return qvals.argmax(axis=-1)
+
+    @staticmethod
     def log_prob(dist, actions):
         qvals, _ = dist
         return jnp.zeros(qvals.shape[:-1], jnp.float32)  # unused by DQN

@@ -155,7 +155,9 @@ class IMPALA(Algorithm):
         for fut in ready:
             runner = self._inflight.pop(fut)
             batch = ray.get(fut)
-            self._episode_returns.extend(batch.pop("episode_returns").tolist())
+            returns = batch.pop("episode_returns").tolist()
+            self._episodes_this_iter += len(returns)
+            self._episode_returns.extend(returns)
             self._episode_lengths.extend(batch.pop("episode_lengths").tolist())
             T, B = batch["rewards"].shape
             env_steps += T * B

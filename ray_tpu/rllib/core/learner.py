@@ -139,6 +139,13 @@ class LearnerGroup:
         else:
             self._learner.load_state(blob)
 
+    def shutdown(self):
+        if self._remote:
+            try:
+                self._ray.kill(self._actor)
+            except Exception:  # noqa: BLE001
+                pass
+
     @property
     def local_learner(self) -> Optional[Learner]:
         return None if self._remote else self._learner

@@ -38,7 +38,9 @@ def _mlp_apply(params, x, activation=jnp.tanh):
 
 class RLModule:
     """Base: subclasses define `init(rng) -> params` and
-    `forward(params, obs) -> outputs` as pure functions."""
+    `forward(params, obs) -> outputs` as pure functions, plus static
+    distribution helpers `sample/log_prob/entropy/greedy` over the forward
+    output's dist component."""
 
     def init(self, rng):
         raise NotImplementedError
@@ -85,6 +87,10 @@ class DiscretePolicyModule(RLModule):
     def sample(rng, logits):
         return jax.random.categorical(rng, logits, axis=-1)
 
+    @staticmethod
+    def greedy(logits):
+        return logits.argmax(axis=-1)
+
 
 class GaussianPolicyModule(RLModule):
     """Diagonal-Gaussian policy for continuous actions (tanh-free, clipped by
@@ -126,6 +132,10 @@ class GaussianPolicyModule(RLModule):
     def sample(rng, dist):
         mean, log_std = dist
         return mean + jnp.exp(log_std) * jax.random.normal(rng, mean.shape)
+
+    @staticmethod
+    def greedy(dist):
+        return dist[0]  # the mean
 
 
 class QModule(RLModule):

@@ -30,15 +30,15 @@ class EnvRunner:
         seed: Optional[int] = None,
         env_kwargs: Optional[dict] = None,
     ):
-        self.env = make_env(env_name, num_envs, **(env_kwargs or {}))
+        self._env_name = env_name
+        self._env_kwargs = dict(env_kwargs or {})
+        self.env = make_env(env_name, num_envs, **self._env_kwargs)
         self.num_envs = num_envs
         self.rollout_len = rollout_len
         self.module = module
         self._discrete = isinstance(self.env.action_space, Discrete)
         self._rng = jax.random.PRNGKey(seed if seed is not None else np.random.randint(2**31))
         self._obs, _ = self.env.reset(seed=seed)
-        self._ep_returns: list = []
-        self._ep_lengths: list = []
 
         mod = self.module
 
@@ -50,11 +50,7 @@ class EnvRunner:
 
         def _act_greedy(params, obs):
             dist, value = mod.forward(params, obs)
-            if self._discrete:
-                action = dist.argmax(axis=-1)
-            else:
-                action = dist[0]
-            return action, value
+            return mod.greedy(dist), value
 
         self._act = jax.jit(_act)
         self._act_greedy = jax.jit(_act_greedy)
@@ -110,7 +106,7 @@ class EnvRunner:
     def evaluate(self, params, num_episodes: int = 10) -> Dict[str, float]:
         """Greedy rollouts to episode completion (fresh env instance so the
         training stream's auto-reset state is untouched)."""
-        env = make_env_like(self.env)
+        env = make_env(self._env_name, self.num_envs, **self._env_kwargs)
         obs, _ = env.reset()
         returns: list = []
         guard = 0
@@ -123,8 +119,3 @@ class EnvRunner:
             "episode_reward_mean": float(np.mean(returns[:num_episodes])) if returns else float("nan"),
             "episodes": len(returns[:num_episodes]),
         }
-
-
-def make_env_like(env):
-    """Fresh env of the same class/size (built-ins only need num_envs)."""
-    return type(env)(env.num_envs)

@@ -1,0 +1,53 @@
+"""ray_tpu.serve — online model serving (reference: `python/ray/serve/`).
+
+Control plane: ServeController actor reconciling deployment → replica-actor
+state. Data plane: client-side Router (power-of-two-choices) → replica
+actors; batch formation in the router so TPU replicas run one XLA program
+per formed batch. See SURVEY.md §2.5 / §3.4.
+"""
+
+from .api import (
+    delete,
+    get_app_handle,
+    get_deployment_handle,
+    http_port,
+    run,
+    shutdown,
+    start,
+    status,
+)
+from .batching import batch, multiplexed
+from .context import get_multiplexed_model_id, get_replica_context
+from .deployment import Application, AutoscalingConfig, Deployment, deployment
+from .handle import DeploymentHandle, DeploymentResponse
+from .http_proxy import Request
+
+__all__ = [
+    "deployment",
+    "Deployment",
+    "Application",
+    "AutoscalingConfig",
+    "run",
+    "start",
+    "delete",
+    "status",
+    "shutdown",
+    "http_port",
+    "batch",
+    "multiplexed",
+    "get_multiplexed_model_id",
+    "get_replica_context",
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "Request",
+]
+
+
+def ingress(*_a, **_k):
+    """FastAPI-style ingress decorator is a no-op shim (no fastapi in the
+    image); plain `__call__(request)` deployments cover HTTP ingress."""
+
+    def wrap(cls):
+        return cls
+
+    return wrap

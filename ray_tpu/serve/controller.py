@@ -1,0 +1,256 @@
+"""ServeController actor (reference: `serve/_private/controller.py:89`,
+deployment reconciler `serve/_private/deployment_state.py:1210,2307`,
+autoscaling `serve/_private/autoscaling_policy.py`).
+
+Owns the desired state (applications → deployments → target replica counts),
+reconciles it against live replica actors, and serves routing info to
+routers/proxies. The reference's LongPollHost broadcast becomes versioned
+snapshots that routers re-fetch when stale (short-poll at the router's
+refresh interval — no blocking calls into the single-threaded controller).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+SERVE_NAMESPACE = "serve"
+
+
+class _DeploymentState:
+    def __init__(self, spec: Dict[str, Any]):
+        self.spec = spec
+        self.target_replicas: int = spec["opts"]["num_replicas"]
+        self.replicas: List = []  # ActorHandles
+        self.replica_tags: List[str] = []
+        self.next_replica_id = 0
+        # autoscaling bookkeeping
+        self.ongoing_ema: float = 0.0
+        self.last_scale_action_t: float = 0.0
+        self.status: str = "UPDATING"
+
+
+class ServeController:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._apps: Dict[str, Dict[str, Any]] = {}  # app -> {deployments, route_prefix, ingress}
+        self._version = 0
+        self._shutdown = False
+        self._reconciler = threading.Thread(target=self._reconcile_loop, daemon=True)
+        self._reconciler.start()
+
+    # ------------------------------------------------------------ deploy API
+    def deploy_application(
+        self,
+        app_name: str,
+        dep_specs: List[Dict[str, Any]],
+        route_prefix: str,
+        ingress_name: str,
+    ) -> None:
+        with self._lock:
+            old = self._apps.get(app_name, {"deployments": {}})
+            deployments = {}
+            for spec in dep_specs:
+                name = spec["name"]
+                prev = old["deployments"].get(name)
+                state = _DeploymentState(spec)
+                if prev is not None and prev.spec["cls"] == spec["cls"]:
+                    # In-place update: keep live replicas, adopt new targets.
+                    state.replicas = prev.replicas
+                    state.replica_tags = prev.replica_tags
+                    state.next_replica_id = prev.next_replica_id
+                deployments[name] = state
+            # Kill replicas of deployments that disappeared.
+            for name, prev in old["deployments"].items():
+                if name not in deployments:
+                    self._drain(prev, len(prev.replicas))
+            self._apps[app_name] = {
+                "deployments": deployments,
+                "route_prefix": route_prefix,
+                "ingress": ingress_name,
+            }
+            self._version += 1
+            self._reconcile()
+
+    def delete_application(self, app_name: str) -> None:
+        with self._lock:
+            app = self._apps.pop(app_name, None)
+            if app:
+                for state in app["deployments"].values():
+                    self._drain(state, len(state.replicas))
+                self._version += 1
+
+    def shutdown(self) -> None:
+        with self._lock:
+            for app_name in list(self._apps):
+                self.delete_application(app_name)
+            self._shutdown = True
+
+    # ------------------------------------------------------------- routing
+    def get_deployment_info(self, app_name: str, deployment_name: str) -> Optional[Dict]:
+        with self._lock:
+            app = self._apps.get(app_name)
+            if app is None:
+                return None
+            state = app["deployments"].get(deployment_name)
+            if state is None:
+                return None
+            return {
+                "version": self._version,
+                "replicas": list(state.replicas),
+                "replica_tags": list(state.replica_tags),
+                "batch_methods": state.spec.get("batch_methods", {}),
+                "max_ongoing_requests": state.spec["opts"]["max_ongoing_requests"],
+                "status": state.status,
+            }
+
+    def routing_snapshot(self) -> Dict[str, Dict[str, str]]:
+        """route_prefix -> {app, ingress} for HTTP proxies."""
+        with self._lock:
+            return {
+                app["route_prefix"]: {"app": name, "ingress": app["ingress"]}
+                for name, app in self._apps.items()
+                if app["route_prefix"]
+            }
+
+    def version(self) -> int:
+        return self._version
+
+    def status(self) -> Dict[str, Any]:
+        """Reference: `serve.status()` → application/deployment statuses."""
+        with self._lock:
+            out = {}
+            for name, app in self._apps.items():
+                deps = {}
+                all_running = True
+                for dname, state in app["deployments"].items():
+                    running = len(state.replicas)
+                    deps[dname] = {
+                        "status": state.status,
+                        "replica_states": {"RUNNING": running},
+                        "target_replicas": state.target_replicas,
+                    }
+                    if state.status != "HEALTHY":
+                        all_running = False
+                out[name] = {
+                    "status": "RUNNING" if all_running else "DEPLOYING",
+                    "deployments": deps,
+                    "route_prefix": app["route_prefix"],
+                }
+            return out
+
+    # ---------------------------------------------------------- autoscaling
+    def record_request_metrics(self, app_name: str, deployment_name: str, ongoing: float):
+        """Routers report their outstanding-request counts (reference:
+        `autoscaling_metrics.py` pushes replica queue lengths)."""
+        with self._lock:
+            app = self._apps.get(app_name)
+            if not app:
+                return
+            state = app["deployments"].get(deployment_name)
+            if not state:
+                return
+            state.ongoing_ema = 0.8 * state.ongoing_ema + 0.2 * ongoing
+            self._maybe_autoscale(state)
+
+    def _maybe_autoscale(self, state: _DeploymentState):
+        cfg = state.spec["opts"].get("autoscaling_config")
+        if not cfg:
+            return
+        now = time.monotonic()
+        per_replica = state.ongoing_ema / max(len(state.replicas), 1)
+        if (
+            per_replica > cfg["target_ongoing_requests"]
+            and state.target_replicas < cfg["max_replicas"]
+            and now - state.last_scale_action_t > cfg["upscale_delay_s"]
+        ):
+            state.target_replicas += 1
+            state.last_scale_action_t = now
+            self._version += 1
+        elif (
+            per_replica < 0.5 * cfg["target_ongoing_requests"]
+            and state.target_replicas > cfg["min_replicas"]
+            and now - state.last_scale_action_t > cfg["downscale_delay_s"]
+        ):
+            state.target_replicas -= 1
+            state.last_scale_action_t = now
+            self._version += 1
+
+    # ------------------------------------------------------------ reconcile
+    def _reconcile_loop(self):
+        while not self._shutdown:
+            time.sleep(1.0)
+            try:
+                with self._lock:
+                    self._reconcile()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _reconcile(self):
+        import ray_tpu
+
+        for app_name, app in self._apps.items():
+            for dname, state in app["deployments"].items():
+                # Replace dead replicas (health check by ping).
+                alive, alive_tags = [], []
+                for handle, tag in zip(state.replicas, state.replica_tags):
+                    try:
+                        ray_tpu.get(handle.ping.remote(), timeout=10.0)
+                        alive.append(handle)
+                        alive_tags.append(tag)
+                    except Exception:  # noqa: BLE001
+                        pass
+                changed = len(alive) != len(state.replicas)
+                state.replicas, state.replica_tags = alive, alive_tags
+
+                while len(state.replicas) < state.target_replicas:
+                    self._start_replica(app_name, dname, state)
+                    changed = True
+                if len(state.replicas) > state.target_replicas:
+                    self._drain(state, len(state.replicas) - state.target_replicas)
+                    changed = True
+                state.status = (
+                    "HEALTHY" if len(state.replicas) == state.target_replicas else "UPDATING"
+                )
+                if changed:
+                    self._version += 1
+
+    def _start_replica(self, app_name: str, dname: str, state: _DeploymentState):
+        import ray_tpu
+        from .replica import Replica
+
+        spec = state.spec
+        tag = f"{app_name}#{dname}#{state.next_replica_id}"
+        state.next_replica_id += 1
+        actor_opts = dict(spec["opts"].get("ray_actor_options") or {})
+        RemoteReplica = ray_tpu.remote(Replica)
+        if actor_opts:
+            RemoteReplica = RemoteReplica.options(**actor_opts)
+        handle = RemoteReplica.remote(
+            app_name,
+            dname,
+            tag,
+            spec["cls"],
+            spec["init_args"],
+            spec["opts"].get("user_config"),
+        )
+        state.replicas.append(handle)
+        state.replica_tags.append(tag)
+
+    def _drain(self, state: _DeploymentState, n: int):
+        import ray_tpu
+
+        for _ in range(n):
+            if not state.replicas:
+                break
+            handle = state.replicas.pop()
+            state.replica_tags.pop()
+            try:
+                ray_tpu.kill(handle)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def ping(self) -> str:
+        return "ok"

@@ -1,0 +1,290 @@
+"""DeploymentHandle + Router (reference: `serve/handle.py:827,894`,
+`serve/_private/router.py:924` Router, `:295` PowerOfTwoChoicesReplicaScheduler).
+
+The router lives client-side (in whichever process holds the handle):
+power-of-two-choices over per-replica outstanding counts, periodic snapshot
+refresh from the controller, and router-side batch formation for
+`@serve.batch` methods (one replica call per formed batch — one XLA program
+per batch on TPU replicas).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+_ROUTER_REFRESH_S = 1.0
+
+
+class DeploymentResponse:
+    """Future-like result of `handle.method.remote()` (reference
+    `serve/handle.py` DeploymentResponse)."""
+
+    def __init__(self, ref=None, future=None, on_done=None):
+        self._ref = ref
+        self._future = future
+        self._on_done = on_done
+
+    def result(self, timeout_s: Optional[float] = None):
+        import ray_tpu
+
+        try:
+            if self._future is not None:
+                ref = self._future.result(timeout_s)
+                if isinstance(ref, Exception):
+                    raise ref
+                return ref
+            return ray_tpu.get(self._ref, timeout=timeout_s)
+        finally:
+            if self._on_done is not None:
+                self._on_done()
+                self._on_done = None
+
+    def _to_object_ref(self):
+        if self._ref is None:
+            raise RuntimeError("Batched responses have no single ObjectRef")
+        return self._ref
+
+
+class _Batcher:
+    """Router-side batch former for one (deployment, method)."""
+
+    def __init__(self, router: "Router", method: str, max_batch_size: int, wait_s: float):
+        self.router = router
+        self.method = method
+        self.max_batch_size = max_batch_size
+        self.wait_s = wait_s
+        self._lock = threading.Lock()
+        self._pending: List[Tuple[Any, Any, str]] = []  # (arg, Future, model_id)
+        self._timer: Optional[threading.Timer] = None
+
+    def submit(self, arg: Any, model_id: str):
+        from concurrent.futures import Future
+
+        fut = Future()
+        flush_now = False
+        with self._lock:
+            self._pending.append((arg, fut, model_id))
+            if len(self._pending) >= self.max_batch_size:
+                flush_now = True
+            elif self._timer is None:
+                self._timer = threading.Timer(self.wait_s, self._flush)
+                self._timer.daemon = True
+                self._timer.start()
+        if flush_now:
+            self._flush()
+        return DeploymentResponse(future=fut)
+
+    def _flush(self):
+        with self._lock:
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+            pending, self._pending = self._pending, []
+        if not pending:
+            return
+        # Split by model_id (multiplexed batches must be homogeneous).
+        by_model: Dict[str, List[Tuple[Any, Any]]] = {}
+        for arg, fut, mid in pending:
+            by_model.setdefault(mid, []).append((arg, fut))
+        for mid, items in by_model.items():
+            args = [a for a, _ in items]
+            futs = [f for _, f in items]
+            try:
+                results = self.router.call_batch(self.method, args, mid)
+                for f, r in zip(futs, results):
+                    f.set_result(r)
+            except Exception as e:  # noqa: BLE001
+                for f in futs:
+                    f.set_result(e)
+
+
+class Router:
+    """One per (process, app, deployment)."""
+
+    _routers: Dict[Tuple[str, str], "Router"] = {}
+    _routers_lock = threading.Lock()
+
+    @classmethod
+    def get_or_create(cls, app_name: str, deployment_name: str) -> "Router":
+        key = (app_name, deployment_name)
+        with cls._routers_lock:
+            r = cls._routers.get(key)
+            if r is None:
+                r = cls._routers[key] = Router(app_name, deployment_name)
+            return r
+
+    def __init__(self, app_name: str, deployment_name: str):
+        self.app_name = app_name
+        self.deployment_name = deployment_name
+        self._lock = threading.Lock()
+        self._info: Optional[Dict] = None
+        self._last_refresh = 0.0
+        self._outstanding: Dict[int, int] = {}  # replica idx -> in-flight
+        self._batchers: Dict[str, _Batcher] = {}
+        self._reported_t = 0.0
+
+    # ------------------------------------------------------------ snapshot
+    def _controller(self):
+        import ray_tpu
+        from .controller import CONTROLLER_NAME, SERVE_NAMESPACE
+
+        return ray_tpu.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
+
+    def _refresh(self, force: bool = False):
+        import ray_tpu
+
+        now = time.monotonic()
+        with self._lock:
+            stale = force or self._info is None or now - self._last_refresh > _ROUTER_REFRESH_S
+        if not stale:
+            return
+        info = ray_tpu.get(
+            self._controller().get_deployment_info.remote(self.app_name, self.deployment_name)
+        )
+        if info is None:
+            raise RuntimeError(
+                f"Deployment {self.deployment_name} in app {self.app_name} not found"
+            )
+        with self._lock:
+            self._info = info
+            self._last_refresh = now
+            self._outstanding = {i: self._outstanding.get(i, 0) for i in range(len(info["replicas"]))}
+
+    def _pick_replica(self, model_id: str = "") -> Tuple[int, Any]:
+        self._refresh()
+        with self._lock:
+            replicas = self._info["replicas"]
+            if not replicas:
+                raise RuntimeError(f"No replicas for {self.deployment_name}")
+            if model_id:
+                # Rendezvous hash → cache-affine replica for multiplexed models.
+                tags = self._info["replica_tags"]
+                idx = max(
+                    range(len(replicas)),
+                    key=lambda i: hashlib.md5(
+                        f"{model_id}:{tags[i]}".encode()
+                    ).hexdigest(),
+                )
+            elif len(replicas) == 1:
+                idx = 0
+            else:
+                # Power of two choices on local outstanding counts.
+                a, b = random.sample(range(len(replicas)), 2)
+                idx = a if self._outstanding.get(a, 0) <= self._outstanding.get(b, 0) else b
+            self._outstanding[idx] = self._outstanding.get(idx, 0) + 1
+            return idx, replicas[idx]
+
+    def _done(self, idx: int):
+        with self._lock:
+            self._outstanding[idx] = max(self._outstanding.get(idx, 1) - 1, 0)
+
+    def _maybe_report_metrics(self):
+        now = time.monotonic()
+        if now - self._reported_t < 1.0:
+            return
+        self._reported_t = now
+        try:
+            total = sum(self._outstanding.values())
+            self._controller().record_request_metrics.remote(
+                self.app_name, self.deployment_name, float(total)
+            )
+        except Exception:  # noqa: BLE001
+            pass
+
+    # ---------------------------------------------------------------- calls
+    def call(self, method: str, args, kwargs, model_id: str = "") -> DeploymentResponse:
+        self._refresh()
+        batch_cfg = self._info["batch_methods"].get(method)
+        if batch_cfg is not None:
+            if kwargs or len(args) != 1:
+                raise ValueError(
+                    f"@serve.batch method {method} takes exactly one positional arg"
+                )
+            batcher = self._batchers.get(method)
+            if batcher is None:
+                batcher = self._batchers[method] = _Batcher(
+                    self, method, batch_cfg["max_batch_size"], batch_cfg["batch_wait_timeout_s"]
+                )
+            self._maybe_report_metrics()
+            return batcher.submit(args[0], model_id)
+
+        idx, replica = self._pick_replica(model_id)
+        try:
+            ref = replica.handle_request.remote(method, args, kwargs, model_id)
+        except Exception:
+            self._done(idx)
+            raise
+        self._maybe_report_metrics()
+        # Outstanding count drops when the caller consumes the result.
+        return DeploymentResponse(ref=ref, on_done=lambda: self._done(idx))
+
+    def call_batch(self, method: str, batched_args: List, model_id: str) -> List:
+        import ray_tpu
+
+        idx, replica = self._pick_replica(model_id)
+        try:
+            return ray_tpu.get(
+                replica.handle_batch.remote(method, batched_args, model_id)
+            )
+        except Exception:
+            self._refresh(force=True)
+            raise
+        finally:
+            self._done(idx)
+
+
+class _MethodCaller:
+    def __init__(self, handle: "DeploymentHandle", method: str):
+        self._handle = handle
+        self._method = method
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._handle._call(self._method, args, kwargs)
+
+
+class DeploymentHandle:
+    """Serializable reference to a deployment; composable across replicas
+    (reference `serve/handle.py:827`)."""
+
+    def __init__(self, app_name: str, deployment_name: str, multiplexed_model_id: str = ""):
+        self._app_name = app_name
+        self._deployment_name = deployment_name
+        self._model_id = multiplexed_model_id
+
+    def options(self, *, multiplexed_model_id: Optional[str] = None) -> "DeploymentHandle":
+        return DeploymentHandle(
+            self._app_name,
+            self._deployment_name,
+            multiplexed_model_id if multiplexed_model_id is not None else self._model_id,
+        )
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._call("__call__", args, kwargs)
+
+    def __getattr__(self, name: str) -> _MethodCaller:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _MethodCaller(self, name)
+
+    def _call(self, method: str, args, kwargs) -> DeploymentResponse:
+        # Resolve nested responses/refs before shipping (reference chains
+        # DeploymentResponses through the object store).
+        args = tuple(
+            a.result() if isinstance(a, DeploymentResponse) else a for a in args
+        )
+        kwargs = {
+            k: (v.result() if isinstance(v, DeploymentResponse) else v)
+            for k, v in kwargs.items()
+        }
+        router = Router.get_or_create(self._app_name, self._deployment_name)
+        return router.call(method, args, kwargs, self._model_id)
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self._app_name, self._deployment_name, self._model_id))
+
+    def __repr__(self):
+        return f"DeploymentHandle({self._app_name}/{self._deployment_name})"

@@ -1,0 +1,91 @@
+"""Replica actor (reference: `serve/_private/replica.py`).
+
+A generic actor wrapping the user's deployment callable. Requests arrive as
+`handle_request(method, args, kwargs)` actor tasks — ordered execution per
+replica is exactly the reference's single-asyncio-loop replica semantics.
+Batched methods receive the router-formed list in one call.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+from .context import (
+    ReplicaContext,
+    _set_multiplexed_model_id,
+    _set_replica_context,
+)
+
+
+class Replica:
+    """NOTE: instantiated as a ray_tpu actor by the controller."""
+
+    def __init__(
+        self,
+        app_name: str,
+        deployment_name: str,
+        replica_tag: str,
+        serialized_cls: bytes,
+        serialized_init_args: bytes,
+        user_config: Optional[dict] = None,
+    ):
+        cls = cloudpickle.loads(serialized_cls)
+        args, kwargs = cloudpickle.loads(serialized_init_args)
+        self._ctx = ReplicaContext(app_name, deployment_name, replica_tag)
+        _set_replica_context(self._ctx)
+        if isinstance(cls, type):
+            self._callable = cls(*args, **kwargs)
+            self._is_function = False
+        else:
+            self._callable = cls
+            self._is_function = True
+        self._num_processed = 0
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    def reconfigure(self, user_config: dict):
+        fn = getattr(self._callable, "reconfigure", None)
+        if fn is not None:
+            fn(user_config)
+
+    def handle_request(
+        self,
+        method: str,
+        args: Tuple,
+        kwargs: Dict,
+        multiplexed_model_id: str = "",
+    ) -> Any:
+        _set_replica_context(self._ctx)
+        _set_multiplexed_model_id(multiplexed_model_id)
+        self._num_processed += 1
+        if self._is_function:
+            return self._callable(*args, **kwargs)
+        return getattr(self._callable, method)(*args, **kwargs)
+
+    def handle_batch(
+        self,
+        method: str,
+        batched_args: List[Any],
+        multiplexed_model_id: str = "",
+    ) -> List[Any]:
+        """Execute a router-formed batch: the user's @serve.batch method gets
+        the list of single args and returns a list of results."""
+        _set_replica_context(self._ctx)
+        _set_multiplexed_model_id(multiplexed_model_id)
+        self._num_processed += len(batched_args)
+        fn = getattr(self._callable, method)
+        results = fn(batched_args)
+        if len(results) != len(batched_args):
+            raise ValueError(
+                f"@serve.batch method {method} returned {len(results)} results "
+                f"for {len(batched_args)} inputs"
+            )
+        return results
+
+    def ping(self) -> str:
+        return "ok"
+
+    def stats(self) -> Dict[str, Any]:
+        return {"num_processed": self._num_processed}

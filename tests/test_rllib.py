@@ -133,4 +133,7 @@ class TestDQN:
             result = algo.train()
         assert algo._epsilon() < eps0
         assert np.isfinite(result["info"]["learner"]["td_loss"])
+        assert result["episodes_this_iter"] > 0
+        ev = algo.evaluate()
+        assert np.isfinite(ev["episode_reward_mean"])
         algo.stop()

@@ -1,0 +1,198 @@
+"""Serve tests (reference analog: `python/ray/serve/tests/`)."""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_instance():
+    ray_tpu.init(local_mode=True, ignore_reinit_error=True)
+    serve.start()
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+class TestBasics:
+    def test_class_deployment_and_handle(self, serve_instance):
+        @serve.deployment(num_replicas=2)
+        class Doubler:
+            def __call__(self, x):
+                return 2 * x
+
+            def triple(self, x):
+                return 3 * x
+
+        handle = serve.run(Doubler.bind(), name="app1", route_prefix="/double")
+        assert handle.remote(21).result() == 42
+        assert handle.triple.remote(5).result() == 15
+
+        st = serve.status()["applications"]["app1"]
+        assert st["status"] == "RUNNING"
+        assert st["deployments"]["Doubler"]["replica_states"]["RUNNING"] == 2
+        serve.delete("app1")
+
+    def test_function_deployment(self, serve_instance):
+        @serve.deployment
+        def reverse(s):
+            return s[::-1]
+
+        handle = serve.run(reverse.bind(), name="fn", route_prefix="/fn")
+        assert handle.remote("abc").result() == "cba"
+        serve.delete("fn")
+
+    def test_init_args_and_user_config(self, serve_instance):
+        @serve.deployment(user_config={"suffix": "!"})
+        class Greeter:
+            def __init__(self, greeting):
+                self.greeting = greeting
+                self.suffix = ""
+
+            def reconfigure(self, config):
+                self.suffix = config["suffix"]
+
+            def __call__(self, name):
+                return f"{self.greeting}, {name}{self.suffix}"
+
+        handle = serve.run(Greeter.bind("Hello"), name="greet", route_prefix="/greet")
+        assert handle.remote("TPU").result() == "Hello, TPU!"
+        serve.delete("greet")
+
+    def test_composition(self, serve_instance):
+        @serve.deployment
+        class Adder:
+            def __init__(self, amount):
+                self.amount = amount
+
+            def __call__(self, x):
+                return x + self.amount
+
+        @serve.deployment
+        class Pipeline:
+            def __init__(self, adder):
+                self.adder = adder
+
+            def __call__(self, x):
+                partial = self.adder.remote(x).result()
+                return partial * 10
+
+        app = Pipeline.bind(Adder.bind(5))
+        handle = serve.run(app, name="pipe", route_prefix="/pipe")
+        assert handle.remote(1).result() == 60
+        serve.delete("pipe")
+
+
+class TestBatching:
+    def test_router_side_batching(self, serve_instance):
+        @serve.deployment
+        class BatchModel:
+            def __init__(self):
+                self.batch_sizes = []
+
+            @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.2)
+            def predict(self, xs):
+                self.batch_sizes.append(len(xs))
+                return [x * x for x in xs]
+
+            def seen_batches(self):
+                return self.batch_sizes
+
+        handle = serve.run(BatchModel.bind(), name="batch", route_prefix="/batch")
+        responses = [handle.predict.remote(i) for i in range(8)]
+        results = [r.result(timeout_s=10) for r in responses]
+        assert results == [i * i for i in range(8)]
+        sizes = handle.seen_batches.remote().result()
+        assert max(sizes) > 1, f"no batching observed: {sizes}"
+        assert sum(sizes) == 8
+        serve.delete("batch")
+
+
+class TestMultiplex:
+    def test_multiplexed_model_loading(self, serve_instance):
+        @serve.deployment
+        class MultiModel:
+            def __init__(self):
+                self.loads = []
+
+            @serve.multiplexed(max_num_models_per_replica=2)
+            def get_model(self, model_id):
+                self.loads.append(model_id)
+                return {"id": model_id}
+
+            def __call__(self, x):
+                model_id = serve.get_multiplexed_model_id()
+                model = self.get_model(model_id)
+                return f"{model['id']}:{x}"
+
+            def get_loads(self):
+                return self.loads
+
+        handle = serve.run(MultiModel.bind(), name="mux", route_prefix="/mux")
+        h1 = handle.options(multiplexed_model_id="m1")
+        h2 = handle.options(multiplexed_model_id="m2")
+        assert h1.remote("a").result() == "m1:a"
+        assert h2.remote("b").result() == "m2:b"
+        assert h1.remote("c").result() == "m1:c"
+        # m1 loaded once (cached on second call)
+        loads = handle.get_loads.remote().result()
+        assert loads.count("m1") == 1
+        serve.delete("mux")
+
+
+class TestHTTP:
+    def test_http_ingress(self, serve_instance):
+        import requests
+
+        serve.start(http_options={"host": "127.0.0.1", "port": 0})
+
+        @serve.deployment
+        class Echo:
+            def __call__(self, request: serve.Request):
+                if request.method == "POST":
+                    data = request.json()
+                    return {"sum": data["a"] + data["b"]}
+                return {"path": request.path, "q": request.query_params}
+
+        serve.run(Echo.bind(), name="http", route_prefix="/")
+        port = serve.http_port()
+        base = f"http://127.0.0.1:{port}"
+
+        r = requests.post(f"{base}/", json={"a": 2, "b": 3}, timeout=10)
+        assert r.status_code == 200 and r.json() == {"sum": 5}
+        r = requests.get(f"{base}/sub/path?x=1", timeout=10)
+        assert r.json()["path"] == "/sub/path"
+        assert r.json()["q"] == {"x": "1"}
+        serve.delete("http")
+
+
+class TestLifecycle:
+    def test_redeploy_and_delete(self, serve_instance):
+        @serve.deployment
+        class V:
+            def __call__(self, _):
+                return "v1"
+
+        serve.run(V.bind(), name="life", route_prefix="/life")
+        h = serve.get_app_handle("life")
+        assert h.remote(None).result() == "v1"
+
+        @serve.deployment(name="V")
+        class V2:
+            def __call__(self, _):
+                return "v2"
+
+        serve.run(V2.bind(), name="life", route_prefix="/life")
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if serve.get_app_handle("life").remote(None).result() == "v2":
+                break
+            time.sleep(0.2)
+        assert serve.get_app_handle("life").remote(None).result() == "v2"
+
+        serve.delete("life")
+        assert "life" not in serve.status()["applications"]
