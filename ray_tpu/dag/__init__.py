@@ -37,6 +37,13 @@ class DAGNode:
         """Submit the whole DAG; returns the ObjectRef of this node's result."""
         return self.execute_with_cache({}, input_value)
 
+    def experimental_compile(self, _buffer_size_bytes: int = 1 << 20):
+        """Compile onto long-lived actors + reusable shm channels
+        (reference: `compiled_dag_node.py`); see `ray_tpu.dag.compiled`."""
+        from .compiled import CompiledDAG
+
+        return CompiledDAG(self, _buffer_size_bytes)
+
     def _execute_impl(self, node_results, input_value):
         raise NotImplementedError
 
@@ -127,4 +134,14 @@ __all__ = [
     "ClassNode",
     "ActorMethodNode",
     "MultiOutputNode",
+    "CompiledDAG",
+    "CompiledDAGRef",
 ]
+
+
+def __getattr__(name):
+    if name in ("CompiledDAG", "CompiledDAGRef"):
+        from . import compiled
+
+        return getattr(compiled, name)
+    raise AttributeError(name)

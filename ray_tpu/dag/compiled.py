@@ -1,0 +1,266 @@
+"""Compiled DAGs (reference: `python/ray/dag/compiled_dag_node.py`, 495 LoC).
+
+Compiles a static task graph onto long-lived actors connected by reusable
+shared-memory channels: after compile, `execute()` does ZERO task
+submissions — the driver writes the input channel, every stage actor sits in
+a read→compute→write loop, and the result appears in the output channel.
+This is the substrate for cross-host pipeline stages (the in-jit GPipe path
+for a single mesh lives in `ray_tpu.parallel.pipeline`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+from ..experimental.channel import Channel, ChannelClosed
+from . import ActorMethodNode, ClassNode, DAGNode, InputNode, MultiOutputNode
+
+
+class _StageHost:
+    """Generic actor hosting one compiled stage's user object + exec loop.
+
+    NOTE: the exec loop runs as one long actor task (`run_loop`), exactly the
+    reference's design — teardown writes a stop sentinel through the input
+    channels, which unblocks and ends the loop.
+    """
+
+    def __init__(self, serialized_cls: bytes, serialized_init: bytes):
+        cls = cloudpickle.loads(serialized_cls)
+        args, kwargs = cloudpickle.loads(serialized_init)
+        self._obj = cls(*args, **kwargs)
+
+    def ping(self) -> str:
+        return "ok"
+
+    def run_loop(self, stages: List[Tuple[str, List[Tuple[str, Any]], Channel]]) -> int:
+        """One loop task per actor, executing ALL of this actor's stages in
+        topological order each round (ordered actor queues mean a second
+        blocking task would never start). Stage: (method_name, arg_plan,
+        out_channel); arg_plan entries: ("chan", Channel) | ("const", value)
+        | ("dup", earlier_arg_index) — a channel bound to two params of one
+        stage is read ONCE per round and its value reused.
+        """
+        rounds = 0
+        closed = False
+        try:
+            while not closed:
+                for method_name, arg_plan, out_channel in stages:
+                    args, reads = [], []
+                    try:
+                        for kind, v in arg_plan:
+                            if kind == "chan":
+                                args.append(v.begin_read())
+                                reads.append(v)
+                            elif kind == "dup":
+                                args.append(args[v])
+                            else:
+                                args.append(v)
+                    except ChannelClosed:
+                        closed = True
+                        break
+                    try:
+                        result = getattr(self._obj, method_name)(*args)
+                    finally:
+                        for c in reads:
+                            c.end_read()
+                    out_channel.write(result)
+                else:
+                    rounds += 1
+        finally:
+            for _, _, out_channel in stages:
+                out_channel.close_writer()
+        return rounds
+
+
+class CompiledDAG:
+    def __init__(self, root: DAGNode, buffer_size_bytes: int = 1 << 20):
+        self._buffer_size = buffer_size_bytes
+        self._outputs: List[DAGNode] = (
+            list(root._bound_args) if isinstance(root, MultiOutputNode) else [root]
+        )
+        self._teardown_done = False
+        self._execute_count = 0
+        self._compile()
+
+    # ------------------------------------------------------------- compile
+    def _compile(self):
+        import ray_tpu
+
+        # Topological order over ActorMethodNodes.
+        order: List[ActorMethodNode] = []
+        seen = set()
+
+        def visit(node):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            if isinstance(node, ActorMethodNode):
+                for a in list(node._bound_args) + list(node._bound_kwargs.values()):
+                    visit(a)
+                order.append(node)
+            elif isinstance(node, MultiOutputNode):
+                for a in node._bound_args:
+                    visit(a)
+
+        for out in self._outputs:
+            visit(out)
+        if not order:
+            raise ValueError("Compiled DAGs need at least one bound actor method")
+        for node in order:
+            if node._bound_kwargs:
+                raise ValueError("Compiled DAGs support positional args only")
+            if not isinstance(node._target, ClassNode):
+                raise ValueError(
+                    "Compiled DAG stages must be methods of ClassNode actors "
+                    "(cls.bind(...).method.bind(...))"
+                )
+
+        # Count DISTINCT consuming stages per producer (a stage binding the
+        # same upstream twice reads its channel once per round) + the driver
+        # for output nodes. Each consumer gets its own ack slot.
+        consumer_stages: Dict[int, set] = {}
+        input_consumer_stages: set = set()
+        for node in order:
+            for a in node._bound_args:
+                if isinstance(a, InputNode):
+                    input_consumer_stages.add(id(node))
+                elif isinstance(a, ActorMethodNode):
+                    consumer_stages.setdefault(id(a), set()).add(id(node))
+        driver_reads = {id(out) for out in self._outputs}
+        num_readers = {
+            pid: len(stages) + (1 if pid in driver_reads else 0)
+            for pid, stages in consumer_stages.items()
+        }
+        for pid in driver_reads:
+            num_readers.setdefault(pid, 1)
+
+        # One channel per producing node; one for the DAG input.
+        self._input_channel: Optional[Channel] = (
+            Channel(self._buffer_size, num_readers=len(input_consumer_stages))
+            if input_consumer_stages
+            else None
+        )
+        self._channels: Dict[int, Channel] = {
+            id(node): Channel(self._buffer_size, num_readers=num_readers[id(node)])
+            for node in order
+            if id(node) in num_readers
+        }
+        self._all_channels = list(self._channels.values()) + (
+            [self._input_channel] if self._input_channel else []
+        )
+        self._next_slot: Dict[str, int] = {}  # channel name -> next reader slot
+
+        # Create one _StageHost per distinct ClassNode.
+        self._ray = ray_tpu
+        StageActor = ray_tpu.remote(_StageHost)
+        self._actors: Dict[int, Any] = {}
+        for node in order:
+            cn: ClassNode = node._target
+            if id(cn) not in self._actors:
+                if any(isinstance(a, DAGNode) for a in cn._bound_args) or any(
+                    isinstance(v, DAGNode) for v in cn._bound_kwargs.values()
+                ):
+                    raise ValueError(
+                        "Compiled DAG actor constructors take constants only"
+                    )
+                self._actors[id(cn)] = StageActor.remote(
+                    cloudpickle.dumps(cn._actor_cls.cls),
+                    cloudpickle.dumps((cn._bound_args, cn._bound_kwargs)),
+                )
+        ray_tpu.get([a.ping.remote() for a in self._actors.values()])
+
+        # One exec-loop task per actor, covering all its stages in topo order.
+        def take_slot(ch: Channel) -> Channel:
+            slot = self._next_slot.get(ch.name, 0)
+            self._next_slot[ch.name] = slot + 1
+            return ch.with_reader_slot(slot)
+
+        per_actor: Dict[int, List] = {}
+        for node in order:
+            arg_plan: List[Tuple[str, Any]] = []
+            chan_arg_idx: Dict[str, int] = {}  # channel name -> arg index (dedup)
+            for i, a in enumerate(node._bound_args):
+                if isinstance(a, InputNode):
+                    ch = self._input_channel
+                elif isinstance(a, ActorMethodNode):
+                    ch = self._channels[id(a)]
+                elif isinstance(a, DAGNode):
+                    raise ValueError(f"Unsupported arg node {type(a).__name__}")
+                else:
+                    arg_plan.append(("const", a))
+                    continue
+                if ch.name in chan_arg_idx:
+                    arg_plan.append(("dup", chan_arg_idx[ch.name]))
+                else:
+                    chan_arg_idx[ch.name] = i
+                    arg_plan.append(("chan", take_slot(ch)))
+            per_actor.setdefault(id(node._target), []).append(
+                (node._method_name, arg_plan, self._channels[id(node)])
+            )
+        self._loop_refs = [
+            self._actors[aid].run_loop.remote(stages)
+            for aid, stages in per_actor.items()
+        ]
+        # Driver takes the last reader slot of every output channel.
+        self._output_channels = [
+            take_slot(self._channels[id(o)]) for o in self._outputs
+        ]
+
+    # ------------------------------------------------------------- execute
+    def execute(self, *args) -> "CompiledDAGRef":
+        if self._teardown_done:
+            raise RuntimeError("Compiled DAG has been torn down")
+        if self._input_channel is not None:
+            if len(args) != 1:
+                raise ValueError("Compiled DAG execute() takes exactly one input")
+            self._input_channel.write(args[0])
+        self._execute_count += 1
+        return CompiledDAGRef(self)
+
+    def teardown(self):
+        if self._teardown_done:
+            return
+        self._teardown_done = True
+        if self._input_channel is not None:
+            self._input_channel.close_writer()
+        for a in self._actors.values():
+            try:
+                self._ray.kill(a)
+            except Exception:  # noqa: BLE001
+                pass
+        for c in self._all_channels:
+            c.destroy()
+
+    def __del__(self):
+        try:
+            self.teardown()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+class CompiledDAGRef:
+    """Result handle for one execute() round (reference returns a Channel-
+    backed ref the caller begin_read/end_reads)."""
+
+    def __init__(self, dag: CompiledDAG):
+        self._dag = dag
+        self._consumed = False
+
+    def get(self, timeout: Optional[float] = 60.0):
+        if self._consumed:
+            raise RuntimeError("CompiledDAGRef already consumed")
+        self._consumed = True
+        results = []
+        for ch in self._dag._output_channels:
+            results.append(ch.read(timeout))
+        single = len(results) == 1 and not isinstance(
+            self._dag._outputs[0], MultiOutputNode
+        )
+        return results[0] if single else results
+
+
+def compile_dag(node: DAGNode, *, _buffer_size_bytes: int = 1 << 20) -> CompiledDAG:
+    """`dag.experimental_compile()` entry point."""
+    return CompiledDAG(node, _buffer_size_bytes)

@@ -1,0 +1,182 @@
+"""Reusable shared-memory channels (reference: `python/ray/experimental/channel.py:49,99,135`
+`Channel.write/begin_read`).
+
+The reference reuses one mmap'd plasma buffer per edge of a compiled DAG so
+steady-state execution does zero allocations and zero task submissions. Same
+design here: a named POSIX shm segment with a seqlock header, single writer,
+N readers; the writer blocks until every reader has acked the previous
+message (backpressure = buffer reuse safety).
+
+Header layout (little-endian u64s):
+    [0]            seq     — message sequence number, bumped after payload is in place
+    [8]            length  — payload byte length
+    [16]           flag    — 0 normal, 1 stop sentinel
+    [24 + 8*k]     ack_k   — last seq acked by reader slot k (k < num_readers)
+
+Each reader owns a distinct ack slot and writes its *absolute* last-read seq
+(idempotent store, no read-modify-write) — concurrent acks from readers in
+different processes cannot race.
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+import time
+from multiprocessing import shared_memory
+from typing import Any, Optional
+
+_FLAG_STOP = 1
+
+
+class ChannelClosed(Exception):
+    pass
+
+
+class Channel:
+    def __init__(
+        self,
+        buffer_size: int = 1 << 20,
+        *,
+        name: Optional[str] = None,
+        create: bool = True,
+        num_readers: int = 1,
+        reader_slot: int = 0,
+    ):
+        self.num_readers = num_readers
+        self.reader_slot = reader_slot
+        self._header = 24 + 8 * num_readers
+        if create:
+            # Creator stays tracker-registered: unlink() (ours in destroy(),
+            # or the tracker's at process exit for leaked channels) balances
+            # the registration.
+            self._shm = shared_memory.SharedMemory(
+                create=True, size=self._header + buffer_size, name=name
+            )
+            self._shm.buf[: self._header] = b"\0" * self._header
+        else:
+            # Attach WITHOUT tracker registration: forked workers share the
+            # parent's resource tracker, and duplicate unregisters for the
+            # same segment name crash the tracker daemon at exit.
+            self._shm = _attach_untracked(name)
+        self._owner = create
+        self._last_read_seq = 0
+
+    @property
+    def name(self) -> str:
+        return self._shm.name
+
+    def with_reader_slot(self, slot: int) -> "Channel":
+        """A view of this channel for reader slot `slot` (what you ship to
+        the consumer process)."""
+        if not 0 <= slot < self.num_readers:
+            raise ValueError(f"reader slot {slot} out of range [0, {self.num_readers})")
+        ch = Channel.__new__(Channel)
+        ch.num_readers = self.num_readers
+        ch.reader_slot = slot
+        ch._header = self._header
+        ch._shm = self._shm
+        ch._owner = False
+        ch._last_read_seq = self._last_read_seq
+        return ch
+
+    # ------------------------------------------------------------- header
+    def _get(self, off: int) -> int:
+        return struct.unpack_from("<Q", self._shm.buf, off)[0]
+
+    def _set(self, off: int, val: int):
+        struct.pack_into("<Q", self._shm.buf, off, val)
+
+    def _min_ack(self) -> int:
+        return min(self._get(24 + 8 * k) for k in range(self.num_readers))
+
+    # -------------------------------------------------------------- write
+    def write(self, value: Any, timeout: Optional[float] = 60.0):
+        self._write_payload(pickle.dumps(value), 0, timeout)
+
+    def _write_payload(self, payload: bytes, flag: int, timeout: Optional[float]):
+        if len(payload) > len(self._shm.buf) - self._header:
+            raise ValueError(
+                f"Serialized value ({len(payload)}B) exceeds channel buffer "
+                f"({len(self._shm.buf) - self._header}B); recreate the DAG "
+                "with a larger _buffer_size_bytes"
+            )
+        seq = self._get(0)
+        # Backpressure: previous message must be acked by every reader slot.
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while seq > 0 and self._min_ack() < seq:
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError("channel write blocked: readers lagging")
+            time.sleep(0.0005)
+        self._shm.buf[self._header : self._header + len(payload)] = payload
+        self._set(8, len(payload))
+        self._set(16, flag)
+        self._set(0, seq + 1)  # publish
+
+    # --------------------------------------------------------------- read
+    def begin_read(self, timeout: Optional[float] = None) -> Any:
+        """Block until the next message; returns the deserialized value.
+        Caller must `end_read()` when done with it."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self._get(0) <= self._last_read_seq:
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError("channel read timed out")
+            time.sleep(0.0005)
+        self._last_read_seq += 1
+        if self._get(16) == _FLAG_STOP:
+            self._ack()
+            raise ChannelClosed
+        length = self._get(8)
+        return pickle.loads(self._shm.buf[self._header : self._header + length])
+
+    def end_read(self):
+        self._ack()
+
+    def _ack(self):
+        # Idempotent absolute store into this reader's own slot — safe under
+        # concurrent acks from other readers.
+        self._set(24 + 8 * self.reader_slot, self._last_read_seq)
+
+    def read(self, timeout: Optional[float] = None) -> Any:
+        """begin_read + end_read (for values that are fully copied out)."""
+        value = self.begin_read(timeout)
+        self.end_read()
+        return value
+
+    # ---------------------------------------------------------- lifecycle
+    def close_writer(self):
+        """Send the stop sentinel; readers raise ChannelClosed."""
+        try:
+            self._write_payload(b"", _FLAG_STOP, timeout=5.0)
+        except (TimeoutError, ValueError):
+            pass
+
+    def destroy(self):
+        try:
+            self._shm.close()
+            if self._owner:
+                self._shm.unlink()
+        except Exception:  # noqa: BLE001
+            pass
+
+    def __reduce__(self):
+        # Re-attach on the other side. Readers inherit seq 0, so ship
+        # channels BEFORE the first write (compiled DAGs do).
+        return (_attach_channel, (self.name, self.num_readers, self.reader_slot))
+
+
+def _attach_channel(name: str, num_readers: int, reader_slot: int) -> "Channel":
+    return Channel(
+        name=name, create=False, num_readers=num_readers, reader_slot=reader_slot
+    )
+
+
+def _attach_untracked(name: str) -> shared_memory.SharedMemory:
+    from multiprocessing import resource_tracker
+
+    orig = resource_tracker.register
+    resource_tracker.register = lambda *a, **k: None
+    try:
+        return shared_memory.SharedMemory(name=name)
+    finally:
+        resource_tracker.register = orig

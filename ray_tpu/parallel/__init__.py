@@ -1,4 +1,11 @@
 from .mesh import AXIS_ORDER, MeshSpec, ShardingRules, constrain, make_mesh
+from .pipeline import (
+    make_gpipe_fn,
+    make_pipelined_loss_fn,
+    merge_microbatches,
+    split_microbatches,
+    stack_stage_params,
+)
 from .spmd import parallelize, shard_fn
 
 __all__ = [
@@ -9,4 +16,9 @@ __all__ = [
     "constrain",
     "parallelize",
     "shard_fn",
+    "make_gpipe_fn",
+    "make_pipelined_loss_fn",
+    "split_microbatches",
+    "merge_microbatches",
+    "stack_stage_params",
 ]

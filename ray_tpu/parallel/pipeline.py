@@ -1,0 +1,116 @@
+"""Pipeline parallelism as a compiled XLA program (GPipe schedule).
+
+Reference analog: `python/ray/dag/compiled_dag_node.py` + channels is Ray's
+*substrate* for pipelines (SURVEY.md §2.6 — no actual schedule exists there).
+TPU-native design: the whole pipeline lives INSIDE one jit program over the
+`pp` mesh axis — each device holds one stage's weights, microbatches flow
+stage-to-stage via `ppermute` over ICI, and the 1F1B/GPipe *backward*
+schedule emerges automatically from jax AD transposing the forward scan
+(ppermute's transpose is the reverse ppermute). No host-side scheduling, no
+channel round-trips, no NCCL.
+
+Cross-host pipelines over DCN use `ray_tpu.dag.CompiledDAG` channels instead.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .spmd import shard_fn
+
+
+def split_microbatches(x, num_microbatches: int):
+    """[B, ...] -> [M, B/M, ...]."""
+    B = x.shape[0]
+    if B % num_microbatches != 0:
+        raise ValueError(f"batch {B} not divisible by num_microbatches {num_microbatches}")
+    return x.reshape(num_microbatches, B // num_microbatches, *x.shape[1:])
+
+
+def merge_microbatches(x):
+    return x.reshape(x.shape[0] * x.shape[1], *x.shape[2:])
+
+
+def stack_stage_params(per_stage_params: list):
+    """List of per-stage pytrees -> one pytree with leading stage axis
+    (shard it P('pp') so each device holds exactly its stage)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+def make_gpipe_fn(
+    stage_fn: Callable,
+    mesh,
+    *,
+    num_microbatches: int,
+    axis: str = "pp",
+    params_spec=None,
+    x_spec=P(),
+):
+    """Build `f(stacked_params, x_microbatched) -> y_microbatched`.
+
+    stage_fn(stage_params, activation) -> activation, applied S times (S =
+    mesh.shape[axis]); stacked_params has a leading [S] stage axis; x is
+    [M, mb, ...] microbatched input. The returned function is shard_map'ed
+    over `axis` and differentiable end-to-end.
+    """
+    S = mesh.shape[axis]
+    M = num_microbatches
+
+    def per_device(stacked_params, x):
+        params = jax.tree.map(lambda p: p[0], stacked_params)  # local stage
+        s = lax.axis_index(axis)
+        is_first = s == 0
+        is_last = s == S - 1
+        fwd_perm = [(i, i + 1) for i in range(S - 1)]
+
+        mb_shape = x.shape[1:]
+        outs0 = jnp.zeros((M,) + mb_shape, x.dtype)
+        act0 = jnp.zeros(mb_shape, x.dtype)
+
+        def tick(carry, t):
+            act_in, outs = carry
+            # Stage 0 injects microbatch t (clamped once the tail drains).
+            x_t = lax.dynamic_index_in_dim(x, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+            inp = jnp.where(is_first, x_t, act_in)
+            y = stage_fn(params, inp)
+            # Microbatch t leaves stage S-1 at tick t + S - 1.
+            write_idx = jnp.clip(t - (S - 1), 0, M - 1)
+            updated = lax.dynamic_update_index_in_dim(outs, y, write_idx, 0)
+            outs = jnp.where(jnp.logical_and(is_last, t >= S - 1), updated, outs)
+            act_next = lax.ppermute(y, axis, fwd_perm)
+            return (act_next, outs), None
+
+        (_, outs), _ = lax.scan(tick, (act0, outs0), jnp.arange(M + S - 1))
+        # Only stage S-1 holds real outputs (others kept zeros) — psum
+        # replicates the result to every stage.
+        return lax.psum(jnp.where(is_last, outs, jnp.zeros_like(outs)), axis)
+
+    if params_spec is None:
+        params_spec = P(axis)
+    return shard_fn(
+        per_device, mesh, in_specs=(params_spec, x_spec), out_specs=x_spec
+    )
+
+
+def make_pipelined_loss_fn(
+    stage_fn: Callable,
+    loss_fn: Callable,
+    mesh,
+    *,
+    num_microbatches: int,
+    axis: str = "pp",
+):
+    """`f(stacked_params, batch_x, batch_target) -> scalar loss` with the
+    pipeline inside; differentiable (GPipe backward via AD)."""
+    gpipe = make_gpipe_fn(stage_fn, mesh, num_microbatches=num_microbatches, axis=axis)
+
+    def fn(stacked_params, x, target):
+        y = merge_microbatches(gpipe(stacked_params, split_microbatches(x, num_microbatches)))
+        return loss_fn(y, target)
+
+    return fn

@@ -34,7 +34,8 @@ class _DeploymentState:
 
 class ServeController:
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = threading.RLock()          # guards state reads/writes (brief)
+        self._reconcile_lock = threading.Lock()  # serializes reconcile passes
         self._apps: Dict[str, Dict[str, Any]] = {}  # app -> {deployments, route_prefix, ingress}
         self._version = 0
         self._shutdown = False
@@ -49,18 +50,30 @@ class ServeController:
         route_prefix: str,
         ingress_name: str,
     ) -> None:
+        import ray_tpu
+
         with self._lock:
             old = self._apps.get(app_name, {"deployments": {}})
             deployments = {}
+            reconfigure_refs = []
             for spec in dep_specs:
                 name = spec["name"]
                 prev = old["deployments"].get(name)
                 state = _DeploymentState(spec)
                 if prev is not None and prev.spec["cls"] == spec["cls"]:
-                    # In-place update: keep live replicas, adopt new targets.
+                    # In-place update: keep live replicas, adopt new targets,
+                    # and push the (possibly changed) user_config to them.
                     state.replicas = prev.replicas
                     state.replica_tags = prev.replica_tags
                     state.next_replica_id = prev.next_replica_id
+                    new_cfg = spec["opts"].get("user_config")
+                    if new_cfg is not None and new_cfg != prev.spec["opts"].get("user_config"):
+                        reconfigure_refs += [
+                            r.reconfigure.remote(new_cfg) for r in state.replicas
+                        ]
+                elif prev is not None:
+                    # Code changed: old replicas are stale — drain them all.
+                    self._drain(prev, len(prev.replicas))
                 deployments[name] = state
             # Kill replicas of deployments that disappeared.
             for name, prev in old["deployments"].items():
@@ -72,7 +85,12 @@ class ServeController:
                 "ingress": ingress_name,
             }
             self._version += 1
-            self._reconcile()
+        for ref in reconfigure_refs:
+            try:
+                ray_tpu.get(ref, timeout=30.0)
+            except Exception:  # noqa: BLE001
+                pass
+        self._reconcile()
 
     def delete_application(self, app_name: str) -> None:
         with self._lock:
@@ -183,39 +201,60 @@ class ServeController:
         while not self._shutdown:
             time.sleep(1.0)
             try:
-                with self._lock:
-                    self._reconcile()
+                self._reconcile()
             except Exception:  # noqa: BLE001
                 pass
 
     def _reconcile(self):
+        """Health-check and converge replica counts. The state lock is held
+        only for snapshot/apply; pings run in parallel outside it so a dead
+        replica can't stall routing or deploy calls."""
         import ray_tpu
 
-        for app_name, app in self._apps.items():
-            for dname, state in app["deployments"].items():
-                # Replace dead replicas (health check by ping).
-                alive, alive_tags = [], []
-                for handle, tag in zip(state.replicas, state.replica_tags):
-                    try:
-                        ray_tpu.get(handle.ping.remote(), timeout=10.0)
-                        alive.append(handle)
-                        alive_tags.append(tag)
-                    except Exception:  # noqa: BLE001
-                        pass
-                changed = len(alive) != len(state.replicas)
-                state.replicas, state.replica_tags = alive, alive_tags
+        with self._reconcile_lock:
+            with self._lock:
+                work = [
+                    (app_name, dname, state, list(state.replicas), list(state.replica_tags))
+                    for app_name, app in self._apps.items()
+                    for dname, state in app["deployments"].items()
+                ]
+            for app_name, dname, state, replicas, tags in work:
+                refs = [h.ping.remote() for h in replicas]
+                ready = set()
+                if refs:
+                    done, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=5.0)
+                    for ref in done:
+                        try:
+                            ray_tpu.get(ref)
+                            ready.add(ref)
+                        except Exception:  # noqa: BLE001
+                            pass
+                alive = [(h, t) for h, t, r in zip(replicas, tags, refs) if r in ready]
 
-                while len(state.replicas) < state.target_replicas:
+                with self._lock:
+                    app = self._apps.get(app_name)
+                    if app is None or app["deployments"].get(dname) is not state:
+                        continue  # redeployed/removed while we were pinging
+                    changed = len(alive) != len(state.replicas)
+                    state.replicas = [h for h, _ in alive]
+                    state.replica_tags = [t for _, t in alive]
+                    need = state.target_replicas - len(state.replicas)
+                    excess = -need
+                for _ in range(max(need, 0)):
                     self._start_replica(app_name, dname, state)
                     changed = True
-                if len(state.replicas) > state.target_replicas:
-                    self._drain(state, len(state.replicas) - state.target_replicas)
+                if excess > 0:
+                    with self._lock:
+                        self._drain(state, excess)
                     changed = True
-                state.status = (
-                    "HEALTHY" if len(state.replicas) == state.target_replicas else "UPDATING"
-                )
-                if changed:
-                    self._version += 1
+                with self._lock:
+                    state.status = (
+                        "HEALTHY"
+                        if len(state.replicas) == state.target_replicas
+                        else "UPDATING"
+                    )
+                    if changed:
+                        self._version += 1
 
     def _start_replica(self, app_name: str, dname: str, state: _DeploymentState):
         import ray_tpu
@@ -236,8 +275,9 @@ class ServeController:
             spec["init_args"],
             spec["opts"].get("user_config"),
         )
-        state.replicas.append(handle)
-        state.replica_tags.append(tag)
+        with self._lock:
+            state.replicas.append(handle)
+            state.replica_tags.append(tag)
 
     def _drain(self, state: _DeploymentState, n: int):
         import ray_tpu

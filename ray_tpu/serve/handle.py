@@ -43,6 +43,15 @@ class DeploymentResponse:
                 self._on_done()
                 self._on_done = None
 
+    def __del__(self):
+        # Fire-and-forget callers never invoke result(); release the
+        # router's outstanding-count slot when the response is dropped.
+        if self._on_done is not None:
+            try:
+                self._on_done()
+            except Exception:  # noqa: BLE001
+                pass
+
     def _to_object_ref(self):
         if self._ref is None:
             raise RuntimeError("Batched responses have no single ObjectRef")

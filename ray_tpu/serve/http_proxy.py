@@ -88,6 +88,11 @@ class HTTPProxy:
     def _handle(self, h: BaseHTTPRequestHandler):
         from .handle import DeploymentHandle
 
+        # Drain the body FIRST — an early return with unread body bytes
+        # corrupts the next request on a keep-alive connection.
+        length = int(h.headers.get("Content-Length") or 0)
+        body = h.rfile.read(length) if length else b""
+
         self._refresh_routes()
         parsed = urlparse(h.path)
         path = parsed.path
@@ -99,9 +104,6 @@ class HTTPProxy:
         if match is None:
             return 404, json.dumps({"error": f"no route for {path}"}).encode()
         route = self._routes[match]
-
-        length = int(h.headers.get("Content-Length") or 0)
-        body = h.rfile.read(length) if length else b""
         req = Request(
             method=h.command,
             path=path[len(match.rstrip("/")):] or "/",

@@ -1,0 +1,146 @@
+"""DAG + compiled DAG tests (reference analogs: `python/ray/dag/tests`,
+`python/ray/tests/test_channel.py`)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import InputNode, MultiOutputNode
+from ray_tpu.experimental.channel import Channel, ChannelClosed
+
+
+@pytest.fixture
+def local_ray():
+    ray_tpu.init(local_mode=True, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+class TestChannel:
+    def test_write_read_roundtrip(self):
+        ch = Channel(1 << 16)
+        try:
+            ch.write({"x": np.arange(5)})
+            out = ch.read(timeout=5)
+            np.testing.assert_array_equal(out["x"], np.arange(5))
+            # Reusable: second message through the same buffer.
+            ch.write("second")
+            assert ch.read(timeout=5) == "second"
+        finally:
+            ch.destroy()
+
+    def test_backpressure_blocks_writer(self):
+        ch = Channel(1 << 12, num_readers=1)
+        try:
+            ch.write(1)
+            with pytest.raises(TimeoutError):
+                ch.write(2, timeout=0.2)  # reader never acked message 1
+            assert ch.read(timeout=1) == 1
+            ch.write(2, timeout=1)
+            assert ch.read(timeout=1) == 2
+        finally:
+            ch.destroy()
+
+    def test_oversize_value_rejected(self):
+        ch = Channel(128)
+        try:
+            with pytest.raises(ValueError, match="exceeds channel buffer"):
+                ch.write(np.zeros(1000))
+        finally:
+            ch.destroy()
+
+    def test_close_writer_raises_channel_closed(self):
+        ch = Channel(1 << 12)
+        try:
+            ch.close_writer()
+            with pytest.raises(ChannelClosed):
+                ch.begin_read(timeout=2)
+        finally:
+            ch.destroy()
+
+
+class TestLazyDag:
+    def test_function_chain(self, local_ray):
+        @ray_tpu.remote
+        def add(a, b):
+            return a + b
+
+        @ray_tpu.remote
+        def mul(a, b):
+            return a * b
+
+        with InputNode() as inp:
+            dag = mul.bind(add.bind(inp, 2), 10)
+        assert ray_tpu.get(dag.execute(3)) == 50
+
+    def test_actor_method_dag(self, local_ray):
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.total = 0
+
+            def add(self, x):
+                self.total += x
+                return self.total
+
+        c = Counter.remote()
+        node = c.add.bind(5)
+        assert ray_tpu.get(node.execute()) == 5
+
+
+class TestCompiledDag:
+    def test_two_stage_pipeline(self, local_ray):
+        @ray_tpu.remote
+        class Stage:
+            def __init__(self, scale):
+                self.scale = scale
+
+            def fwd(self, x):
+                return x * self.scale
+
+        with InputNode() as inp:
+            dag = Stage.bind(3).fwd.bind(Stage.bind(2).fwd.bind(inp))
+        compiled = dag.experimental_compile()
+        try:
+            for i in range(5):  # reusable: many rounds, zero task submissions
+                assert compiled.execute(i).get(timeout=30) == i * 6
+        finally:
+            compiled.teardown()
+
+    def test_multi_output(self, local_ray):
+        @ray_tpu.remote
+        class Worker:
+            def double(self, x):
+                return 2 * x
+
+            def square(self, x):
+                return x * x
+
+        with InputNode() as inp:
+            w1, w2 = Worker.bind(), Worker.bind()
+            dag = MultiOutputNode([w1.double.bind(inp), w2.square.bind(inp)])
+        compiled = dag.experimental_compile()
+        try:
+            assert compiled.execute(4).get(timeout=30) == [8, 16]
+            assert compiled.execute(5).get(timeout=30) == [10, 25]
+        finally:
+            compiled.teardown()
+
+    def test_multiple_stages_one_actor(self, local_ray):
+        @ray_tpu.remote
+        class TwoOps:
+            def inc(self, x):
+                return x + 1
+
+            def neg(self, x):
+                return -x
+
+        with InputNode() as inp:
+            a = TwoOps.bind()
+            dag = a.neg.bind(a.inc.bind(inp))
+        compiled = dag.experimental_compile()
+        try:
+            assert compiled.execute(10).get(timeout=30) == -11
+            assert compiled.execute(1).get(timeout=30) == -2
+        finally:
+            compiled.teardown()
