@@ -45,6 +45,12 @@ class GPTConfig:
     rotary_dim: int = 64
     parallel_block: bool = False     # GPT-J: attn and mlp in parallel
     tie_embeddings: bool = True
+    # Mixture-of-Experts (expert parallelism over the ep mesh axis).
+    mlp_type: str = "dense"          # dense | moe
+    moe_experts: int = 8
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
     # Execution knobs.
     dtype: Any = jnp.bfloat16
     attn_impl: str = "flash"         # flash | ring | ulysses | ref
@@ -52,9 +58,29 @@ class GPTConfig:
     sp_axis: str = "sp"
 
     @property
+    def moe_config(self):
+        from ..ops.moe import MoEConfig
+
+        return MoEConfig(
+            num_experts=self.moe_experts,
+            top_k=self.moe_top_k,
+            capacity_factor=self.moe_capacity_factor,
+            d_model=self.d_model,
+            d_ff=self.d_mlp,
+            aux_loss_weight=self.moe_aux_weight,
+            activation=self.activation,
+            dtype=self.dtype,
+        )
+
+    @property
     def n_params(self) -> int:
         E, L, F, V, Hd = self.d_model, self.n_layers, self.d_mlp, self.vocab_size, self.n_heads * self.d_head
-        per_layer = E * 3 * Hd + Hd * E + (2 if self.activation == "swiglu" else 1) * E * F + F * E
+        if self.mlp_type == "moe":
+            n_mats = 3 if self.activation == "swiglu" else 2
+            mlp_params = self.moe_experts * n_mats * E * F + E * self.moe_experts
+        else:
+            mlp_params = (2 if self.activation == "swiglu" else 1) * E * F + F * E
+        per_layer = E * 3 * Hd + Hd * E + mlp_params
         per_layer += 2 * E  # norms
         total = L * per_layer + V * E + (0 if self.tie_embeddings else E * V)
         if self.pos == "learned":
@@ -142,15 +168,22 @@ def param_logical_dims(cfg: GPTConfig) -> Dict[str, Tuple[Optional[str], ...]]:
         "b_qkv": ("layers", None, "heads", "head_dim"),
         "w_o": ("layers", "heads", "head_dim", "embed"),
         "b_o": ("layers", "embed_act"),
-        "w_in": ("layers", "embed", "mlp"),
-        "b_in": ("layers", "mlp_act"),
-        "w_out": ("layers", "mlp", "embed"),
-        "b_out": ("layers", "embed_act"),
         "ln1_w": ("layers", "embed_act"),
         "ln1_b": ("layers", "embed_act"),
     }
-    if cfg.activation == "swiglu":
-        dims["w_gate"] = ("layers", "embed", "mlp")
+    if cfg.mlp_type == "moe":
+        dims["moe_router"] = ("layers", "embed", "experts")
+        dims["moe_w_in"] = ("layers", "experts", "embed", "mlp")
+        dims["moe_w_out"] = ("layers", "experts", "mlp", "embed")
+        if cfg.activation == "swiglu":
+            dims["moe_w_gate"] = ("layers", "experts", "embed", "mlp")
+    else:
+        dims["w_in"] = ("layers", "embed", "mlp")
+        dims["b_in"] = ("layers", "mlp_act")
+        dims["w_out"] = ("layers", "mlp", "embed")
+        dims["b_out"] = ("layers", "embed_act")
+        if cfg.activation == "swiglu":
+            dims["w_gate"] = ("layers", "embed", "mlp")
     if not cfg.parallel_block:
         dims["ln2_w"] = ("layers", "embed_act")
         dims["ln2_b"] = ("layers", "embed_act")
@@ -182,15 +215,23 @@ def init_params(rng, cfg: GPTConfig) -> Dict[str, jnp.ndarray]:
         "b_qkv": jnp.zeros((L, 3, H, Dh), dt),
         "w_o": n(k[2], (L, H, Dh, E), resid_std),
         "b_o": jnp.zeros((L, E), dt),
-        "w_in": n(k[3], (L, E, F)),
-        "b_in": jnp.zeros((L, F), dt),
-        "w_out": n(k[4], (L, F, E), resid_std),
-        "b_out": jnp.zeros((L, E), dt),
         "ln1_w": jnp.ones((L, E), dt),
         "ln1_b": jnp.zeros((L, E), dt),
     }
-    if cfg.activation == "swiglu":
-        params["w_gate"] = n(k[5], (L, E, F))
+    if cfg.mlp_type == "moe":
+        X = cfg.moe_experts
+        params["moe_router"] = n(k[3], (L, E, X))
+        params["moe_w_in"] = n(k[4], (L, X, E, F))
+        params["moe_w_out"] = n(k[5], (L, X, F, E), resid_std)
+        if cfg.activation == "swiglu":
+            params["moe_w_gate"] = n(k[8], (L, X, E, F))
+    else:
+        params["w_in"] = n(k[3], (L, E, F))
+        params["b_in"] = jnp.zeros((L, F), dt)
+        params["w_out"] = n(k[4], (L, F, E), resid_std)
+        params["b_out"] = jnp.zeros((L, E), dt)
+        if cfg.activation == "swiglu":
+            params["w_gate"] = n(k[5], (L, E, F))
     if not cfg.parallel_block:
         params["ln2_w"] = jnp.ones((L, E), dt)
         params["ln2_b"] = jnp.zeros((L, E), dt)
@@ -268,22 +309,36 @@ def _block(cfg: GPTConfig, rope_tables, mesh, x, layer_params, positions):
         x = x + attn_out
         mlp_in = _norm(x, p["ln2_w"], p["ln2_b"], cfg.norm)
 
-    u = jnp.einsum("bse,ef->bsf", mlp_in, p["w_in"]) + p["b_in"]
-    if cfg.activation == "swiglu":
-        g = jnp.einsum("bse,ef->bsf", mlp_in, p["w_gate"])
-        u = jax.nn.silu(g) * u
+    aux = jnp.zeros((), jnp.float32)
+    if cfg.mlp_type == "moe":
+        from ..ops.moe import moe_forward
+
+        moe_params = {
+            "w_router": layer_params["moe_router"],  # router math stays f32
+            "w_in": p["moe_w_in"],
+            "w_out": p["moe_w_out"],
+        }
+        if cfg.activation == "swiglu":
+            moe_params["w_gate"] = p["moe_w_gate"]
+        mlp_out, aux = moe_forward(moe_params, mlp_in, cfg.moe_config)
     else:
-        u = jax.nn.gelu(u)
-    mlp_out = jnp.einsum("bsf,fe->bse", u, p["w_out"]) + p["b_out"]
+        u = jnp.einsum("bse,ef->bsf", mlp_in, p["w_in"]) + p["b_in"]
+        if cfg.activation == "swiglu":
+            g = jnp.einsum("bse,ef->bsf", mlp_in, p["w_gate"])
+            u = jax.nn.silu(g) * u
+        else:
+            u = jax.nn.gelu(u)
+        mlp_out = jnp.einsum("bsf,fe->bse", u, p["w_out"]) + p["b_out"]
 
     if cfg.parallel_block:
-        return x + attn_out + mlp_out
-    return x + mlp_out
+        return x + attn_out + mlp_out, aux
+    return x + mlp_out, aux
 
 
 _LAYER_KEYS = (
     "w_qkv", "b_qkv", "w_o", "b_o", "w_in", "b_in", "w_out", "b_out",
     "ln1_w", "ln1_b", "ln2_w", "ln2_b", "w_gate",
+    "moe_router", "moe_w_in", "moe_w_out", "moe_w_gate",
 )
 
 
@@ -298,8 +353,9 @@ def global_positions(cfg: GPTConfig, local_seq: int):
     return jnp.arange(local_seq)
 
 
-def forward(params, tokens, cfg: GPTConfig, positions=None, mesh=None):
-    """tokens [B, S] → logits [B, S, V].
+def forward(params, tokens, cfg: GPTConfig, positions=None, mesh=None, return_aux=False):
+    """tokens [B, S] → logits [B, S, V] (or (logits, moe_aux_loss) with
+    return_aux=True).
 
     mesh=None → plain jit or caller-managed shard_map (manual SPMD).
     mesh given → automatic pjit partitioning with a nested shard_map around
@@ -325,13 +381,16 @@ def forward(params, tokens, cfg: GPTConfig, positions=None, mesh=None):
         block = jax.checkpoint(block, policy=jax.checkpoint_policies.nothing_saveable)
 
     def scan_body(x, layer_params):
-        return block(x, layer_params, positions), None
+        x, aux = block(x, layer_params, positions)
+        return x, aux
 
-    x, _ = jax.lax.scan(scan_body, x, layer_stack)
+    x, aux_stack = jax.lax.scan(scan_body, x, layer_stack)
 
     x = _norm(x, params["ln_f_w"], params["ln_f_b"], cfg.norm)
     head = params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = jnp.einsum("bse,ev->bsv", x, head.astype(cfg.dtype))
+    if return_aux:
+        return logits, aux_stack.sum()
     return logits
 
 
@@ -347,13 +406,14 @@ def loss_fn(params, batch, cfg: GPTConfig, mesh=None):
         mask = batch.get("mask")
         if mask is not None:
             mask = mask[:, 1:]
-    logits = forward(params, inputs, cfg, mesh=mesh).astype(jnp.float32)
+    logits, aux = forward(params, inputs, cfg, mesh=mesh, return_aux=True)
+    logits = logits.astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     if mask is not None:
         m = mask.astype(jnp.float32)
-        return -(ll * m).sum() / jnp.maximum(m.sum(), 1.0)
-    return -ll.mean()
+        return -(ll * m).sum() / jnp.maximum(m.sum(), 1.0) + aux
+    return -ll.mean() + aux
 
 
 def make_train_step(cfg: GPTConfig, optimizer, mesh=None) -> Callable:

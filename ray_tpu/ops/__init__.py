@@ -4,6 +4,7 @@ from .attention import (
     ring_attention,
     ulysses_attention,
 )
+from .moe import MoEConfig, moe_forward, moe_init, moe_router
 from .norms import layernorm, rmsnorm
 from .rope import apply_rope, rope_frequencies
 
@@ -16,4 +17,8 @@ __all__ = [
     "layernorm",
     "apply_rope",
     "rope_frequencies",
+    "MoEConfig",
+    "moe_init",
+    "moe_forward",
+    "moe_router",
 ]

@@ -1,0 +1,152 @@
+"""Mixture-of-Experts layer with expert parallelism (absent from the
+reference — SURVEY.md §2.6 "Expert parallel (EP/MoE): Absent"; first-class
+here per the build plan).
+
+TPU-idiomatic GShard/Switch design: token→expert routing is expressed as
+dense one-hot dispatch/combine tensors and einsums — static shapes, no
+sorts/gathers, everything lands on the MXU, and under pjit the expert axis
+of the weights shards over the `ep` mesh axis (XLA inserts the all-to-alls).
+
+Top-1 (Switch) and top-2 (GShard) gating with capacity dropping and the
+standard load-balancing auxiliary loss.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    num_experts: int = 8
+    top_k: int = 2                  # 1 = Switch, 2 = GShard
+    capacity_factor: float = 1.25
+    d_model: int = 768
+    d_ff: int = 3072
+    aux_loss_weight: float = 0.01
+    activation: str = "gelu"        # gelu | swiglu (adds w_gate per expert)
+    dtype: object = jnp.bfloat16
+
+    def capacity(self, num_tokens: int) -> int:
+        c = int(self.capacity_factor * num_tokens * self.top_k / self.num_experts)
+        return max(c, 4)
+
+
+def moe_init(rng, cfg: MoEConfig) -> Dict[str, jnp.ndarray]:
+    """Params with logical dims:
+    w_router (embed, experts); w_in (experts, embed, mlp); w_out (experts, mlp, embed).
+    """
+    k1, k2, k3, k4 = jax.random.split(rng, 4)
+    E, D, F = cfg.num_experts, cfg.d_model, cfg.d_ff
+    s = 0.02
+    params = {
+        "w_router": jax.random.normal(k1, (D, E), jnp.float32) * s,
+        "w_in": jax.random.normal(k2, (E, D, F), jnp.float32) * s,
+        "w_out": jax.random.normal(k3, (E, F, D), jnp.float32) * s,
+    }
+    if cfg.activation == "swiglu":
+        params["w_gate"] = jax.random.normal(k4, (E, D, F), jnp.float32) * s
+    return params
+
+
+def _one_hot_dispatch(gate_idx, probs, mask, capacity, num_experts):
+    """Build dispatch/combine slices for one routing choice.
+
+    gate_idx [N] expert per token; mask [N] tokens still in play;
+    returns (dispatch [N, E, C] one-hot, gate_probs [N] prob of this choice,
+    kept [N] capacity mask).
+    """
+    expert_mask = jax.nn.one_hot(gate_idx, num_experts, dtype=jnp.float32) * mask[:, None]
+    # Position of each token within its expert's buffer (cumulative count).
+    position = jnp.cumsum(expert_mask, axis=0) * expert_mask  # [N, E]
+    position = position.sum(axis=-1) - 1.0                    # [N], -1 if masked
+    kept = (position >= 0) & (position < capacity)
+    pos_oh = jax.nn.one_hot(position.astype(jnp.int32), capacity, dtype=jnp.float32)
+    dispatch = expert_mask[:, :, None] * pos_oh[:, None, :] * kept[:, None, None]
+    gate_probs = (probs * expert_mask).sum(axis=-1)
+    return dispatch, gate_probs, kept
+
+
+def moe_router(x_flat, w_router, cfg: MoEConfig) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """x_flat [N, D] → (combine [N, E, C], aux_loss scalar).
+
+    combine holds the gating weight of each (token, expert, slot); dispatch
+    is its boolean support.
+    """
+    N = x_flat.shape[0]
+    E = cfg.num_experts
+    C = cfg.capacity(N)
+    logits = x_flat.astype(jnp.float32) @ w_router  # router math in f32
+    probs = jax.nn.softmax(logits, axis=-1)        # [N, E]
+
+    gate1 = jnp.argmax(probs, axis=-1)
+    disp1, p1, kept1 = _one_hot_dispatch(
+        gate1, probs, jnp.ones(N, jnp.float32), C, E
+    )
+
+    # Load-balancing aux loss (Switch eq. 4): E * Σ_e f_e · P_e
+    me = jax.nn.one_hot(gate1, E, dtype=jnp.float32).mean(axis=0)  # token fraction
+    pe = probs.mean(axis=0)                                        # mean router prob
+    aux = E * jnp.sum(me * pe)
+
+    if cfg.top_k == 1:
+        combine = disp1 * p1[:, None, None]
+        return combine, aux
+
+    # Top-2: mask out the first choice, route the remainder.
+    probs2 = probs * (1.0 - jax.nn.one_hot(gate1, E, dtype=jnp.float32))
+    gate2 = jnp.argmax(probs2, axis=-1)
+    # Second-choice buffer positions start after all first-choice tokens.
+    first_counts = jax.nn.one_hot(gate1, E, dtype=jnp.float32).sum(axis=0)  # [E]
+    expert_mask2 = jax.nn.one_hot(gate2, E, dtype=jnp.float32)
+    position2 = jnp.cumsum(expert_mask2, axis=0) * expert_mask2
+    position2 = (position2 + first_counts[None, :] * expert_mask2).sum(axis=-1) - 1.0
+    kept2 = (position2 >= 0) & (position2 < C)
+    pos2_oh = jax.nn.one_hot(position2.astype(jnp.int32), C, dtype=jnp.float32)
+    disp2 = expert_mask2[:, :, None] * pos2_oh[:, None, :] * kept2[:, None, None]
+    p2 = (probs * expert_mask2).sum(axis=-1)
+
+    # Renormalize the two gate probs over the kept choices.
+    denom = p1 * kept1 + p2 * kept2
+    denom = jnp.maximum(denom, 1e-9)
+    combine = disp1 * (p1 * kept1 / denom)[:, None, None] + disp2 * (
+        p2 * kept2 / denom
+    )[:, None, None]
+    return combine, aux
+
+
+def moe_forward(params, x, cfg: MoEConfig) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """x [..., D] → (y [..., D], aux_loss). Shard w_in/w_out on `ep` via
+    logical dim "experts"; the dispatch einsum's [E, C, D] intermediate then
+    shards on ep and XLA places the token all-to-alls on ICI."""
+    orig_shape = x.shape
+    D = orig_shape[-1]
+    x_flat = x.reshape(-1, D)
+
+    combine, aux = moe_router(x_flat, params["w_router"], cfg)
+    combine = combine.astype(cfg.dtype)
+    dispatch = (combine > 0).astype(cfg.dtype)
+
+    xc = x_flat.astype(cfg.dtype)
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch, xc)         # [E, C, D]
+    h = jnp.einsum("ecd,edf->ecf", expert_in, params["w_in"].astype(cfg.dtype))
+    if cfg.activation == "swiglu":
+        g = jnp.einsum("ecd,edf->ecf", expert_in, params["w_gate"].astype(cfg.dtype))
+        h = jax.nn.silu(g) * h
+    else:
+        h = jax.nn.gelu(h)
+    expert_out = jnp.einsum("ecf,efd->ecd", h, params["w_out"].astype(cfg.dtype))
+    y = jnp.einsum("nec,ecd->nd", combine, expert_out)          # [N, D]
+    return y.reshape(orig_shape), cfg.aux_loss_weight * aux
+
+
+MOE_LOGICAL_DIMS = {
+    "w_router": ("embed", "experts"),
+    "w_in": ("experts", "embed", "mlp"),
+    "w_out": ("experts", "mlp", "embed"),
+    "w_gate": ("experts", "embed", "mlp"),
+}

@@ -1,0 +1,124 @@
+"""MoE / expert-parallelism tests (no reference analog — SURVEY.md §2.6
+records EP as absent upstream; first-class here)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.ops import MoEConfig, moe_forward, moe_init, moe_router
+
+
+class TestRouter:
+    def test_top1_dispatch_shapes_and_mass(self):
+        cfg = MoEConfig(num_experts=4, top_k=1, d_model=16, d_ff=32)
+        x = jax.random.normal(jax.random.PRNGKey(0), (32, 16))
+        params = moe_init(jax.random.PRNGKey(1), cfg)
+        combine, aux = moe_router(x, params["w_router"], cfg)
+        N, E, C = combine.shape
+        assert (N, E) == (32, 4) and C == cfg.capacity(32)
+        # each kept token contributes exactly its top-1 router prob
+        probs = np.asarray(jax.nn.softmax(x @ params["w_router"], axis=-1))
+        gate1 = probs.argmax(-1)
+        per_token = np.asarray(combine.sum(axis=(1, 2)))
+        kept = per_token > 0
+        assert kept.any()
+        np.testing.assert_allclose(
+            per_token[kept], probs[np.arange(32), gate1][kept], rtol=1e-5
+        )
+        assert np.isfinite(float(aux)) and float(aux) > 0.5  # ≈1 near balance
+
+    def test_top2_combine_normalized(self):
+        cfg = MoEConfig(num_experts=4, top_k=2, d_model=16, d_ff=32, capacity_factor=4.0)
+        x = jax.random.normal(jax.random.PRNGKey(2), (64, 16))
+        params = moe_init(jax.random.PRNGKey(3), cfg)
+        combine, _ = moe_router(x, params["w_router"], cfg)
+        # With generous capacity every token keeps both choices → weights sum to 1.
+        sums = np.asarray(combine.sum(axis=(1, 2)))
+        np.testing.assert_allclose(sums, 1.0, atol=1e-5)
+
+    def test_capacity_drops_tokens(self):
+        cfg = MoEConfig(num_experts=2, top_k=1, d_model=8, d_ff=16, capacity_factor=0.25)
+        x = jnp.ones((64, 8))  # all tokens route identically → overflow
+        params = moe_init(jax.random.PRNGKey(4), cfg)
+        combine, _ = moe_router(x, params["w_router"], cfg)
+        kept = float((combine.sum(axis=(1, 2)) > 0).sum())
+        assert kept <= cfg.capacity(64) + 1e-6
+
+
+class TestMoELayer:
+    def test_forward_and_grads(self):
+        cfg = MoEConfig(num_experts=4, top_k=2, d_model=16, d_ff=32)
+        params = moe_init(jax.random.PRNGKey(5), cfg)
+        x = jax.random.normal(jax.random.PRNGKey(6), (2, 8, 16))
+
+        def loss(p):
+            y, aux = moe_forward(p, x, cfg)
+            return (y.astype(jnp.float32) ** 2).mean() + aux
+
+        val, grads = jax.jit(jax.value_and_grad(loss))(params)
+        assert np.isfinite(float(val))
+        for leaf in jax.tree.leaves(grads):
+            assert np.isfinite(np.asarray(leaf)).all()
+        # router must receive gradient (learned routing)
+        assert float(jnp.abs(grads["w_router"]).sum()) > 0
+
+    def test_expert_parallel_sharding(self):
+        """MoE einsums under pjit with experts sharded over ep."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ray_tpu.parallel import MeshSpec
+
+        mesh = MeshSpec(ep=4, dp=2).build(jax.devices()[:8])
+        cfg = MoEConfig(num_experts=8, top_k=2, d_model=16, d_ff=32)
+        params = moe_init(jax.random.PRNGKey(7), cfg)
+        params = {
+            "w_router": jax.device_put(params["w_router"], NamedSharding(mesh, P())),
+            "w_in": jax.device_put(params["w_in"], NamedSharding(mesh, P("ep"))),
+            "w_out": jax.device_put(params["w_out"], NamedSharding(mesh, P("ep"))),
+        }
+        x = jax.device_put(
+            jax.random.normal(jax.random.PRNGKey(8), (8, 16, 16)),
+            NamedSharding(mesh, P("dp")),
+        )
+        y, aux = jax.jit(lambda p, x: moe_forward(p, x, cfg))(params, x)
+        assert y.shape == x.shape
+        assert np.isfinite(np.asarray(y, dtype=np.float32)).all()
+
+
+class TestMoEGPT:
+    def test_moe_gpt_trains(self):
+        import optax
+
+        from ray_tpu.models import GPTConfig, init_params, make_train_step
+
+        cfg = GPTConfig(
+            vocab_size=128, n_layers=2, d_model=32, n_heads=2, d_head=16,
+            d_mlp=64, max_seq=32, attn_impl="ref", remat=False,
+            mlp_type="moe", moe_experts=4, moe_top_k=2,
+        )
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        assert "moe_w_in" in params and "w_in" not in params
+        opt = optax.adam(1e-3)
+        step = jax.jit(make_train_step(cfg, opt))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0, 128)
+        state = (params, opt.init(params))
+        losses = []
+        for _ in range(5):
+            state, m = step(state, {"tokens": tokens})
+            losses.append(float(m["loss"]))
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0]  # memorizes a fixed batch
+
+    def test_moe_param_shardings(self):
+        from ray_tpu.models import GPTConfig, param_shardings
+        from ray_tpu.parallel import MeshSpec
+
+        mesh = MeshSpec(ep=2, dp=4).build(jax.devices()[:8])
+        cfg = GPTConfig(
+            vocab_size=128, n_layers=2, d_model=32, n_heads=2, d_head=16,
+            d_mlp=64, mlp_type="moe", moe_experts=4,
+        )
+        sh = param_shardings(cfg, mesh)
+        spec = sh["moe_w_in"].spec
+        assert spec[1] == "ep"  # experts dim sharded over ep
