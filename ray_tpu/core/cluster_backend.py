@@ -130,6 +130,9 @@ class ClusterBackend(RuntimeBackend):
             raise RayTpuError(f"Failed to register with controller: {result}")
         if result.get("session_tag"):
             store.set_session_tag(result["session_tag"])
+        # With the tag known, upgrade to the native arena store if this
+        # session's controller created one (falls back silently otherwise).
+        self.local_store = store.make_store()
 
     def _request(self, msg: dict, timeout: Optional[float] = None) -> Any:
         # Leave generous slack over the server-side timeout.

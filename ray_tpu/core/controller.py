@@ -149,6 +149,11 @@ class Controller:
     async def start(self):
         store.set_session_tag(str(os.getpid()))
         store.cleanup_stale_segments()
+        # Native arena (plasma-equivalent): the controller owns the segment;
+        # drivers/workers attach after the session-tag handshake.
+        self.local_store = store.make_store(
+            create_arena=True, arena_capacity=self.object_store_memory
+        )
         self._server = await asyncio.start_server(
             self._on_connection, host="127.0.0.1", port=self.port
         )
@@ -175,6 +180,9 @@ class Controller:
             if obj.shm_name:
                 self.local_store.release(obj.shm_name, unlink=True)
         self.local_store.close_all(unlink=False)
+        arena = getattr(self.local_store, "arena", None)
+        if arena is not None:
+            arena.unlink()  # whole-session segment; workers are exiting
         if self._server:
             self._server.close()
 

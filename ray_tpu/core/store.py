@@ -201,3 +201,160 @@ def cleanup_stale_segments():
             os.unlink(os.path.join(shm_dir, fn))
         except OSError:
             pass
+
+
+# =============================================================== native arena
+# C++ arena-backed store (plasma-equivalent allocator in ray_tpu/native).
+# Objects live in ONE session shm segment managed by the native allocator;
+# names are "arena:<object_hex>". Falls back to per-object segments when the
+# arena is full or the native lib is unavailable.
+
+ARENA_PREFIX = "arena:"
+
+
+def arena_segment_name() -> str:
+    # Matches the `rtpu-<pid>-…` convention so cleanup_stale_segments()
+    # reclaims arenas of dead sessions too.
+    return f"/{_SHM_PREFIX}{SESSION_TAG}-arena"
+
+
+class ArenaStore:
+    """LocalStore-compatible store over the native shm arena."""
+
+    def __init__(self, arena, fallback: Optional[LocalStore] = None):
+        self.arena = arena
+        self.fallback = fallback or LocalStore()
+        self._pinned: Dict[str, Any] = {}  # hex -> root memoryview (1 pin each)
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- creation
+    def create_packed(self, object_hex: str, payload: bytes, buffers) -> Tuple[str, int]:
+        size = serialization.packed_size(payload, buffers)
+        try:
+            view = self.arena.create(object_hex, size)
+        except MemoryError:
+            # Arena full → classic per-object segment keeps progress.
+            return self.fallback.create_packed(object_hex, payload, buffers)
+        try:
+            serialization.pack_into(payload, buffers, view)
+        except BaseException:
+            view.release()
+            self.arena.delete(object_hex)
+            raise
+        view.release()
+        self.arena.seal(object_hex)
+        return ARENA_PREFIX + object_hex, size
+
+    def put(self, object_hex: str, value: Any) -> Tuple[Optional[str], Optional[bytes], int]:
+        payload, buffers = serialization.serialize(value)
+        size = serialization.packed_size(payload, buffers)
+        if size <= INLINE_THRESHOLD:
+            frame = bytearray(size)
+            serialization.pack_into(payload, buffers, memoryview(frame))
+            return None, bytes(frame), size
+        name, size = self.create_packed(object_hex, payload, buffers)
+        return name, None, size
+
+    # -------------------------------------------------------------- reading
+    def read(self, name: str) -> Any:
+        if not name.startswith(ARENA_PREFIX):
+            return self.fallback.read(name)
+        hex_id = name[len(ARENA_PREFIX):]
+        with self._lock:
+            view = self._pinned.get(hex_id)
+            if view is None:
+                view = self.arena.get(hex_id)
+                if view is None:
+                    raise FileNotFoundError(f"object {hex_id} not in arena")
+                self._pinned[hex_id] = view  # hold the pin for zero-copy views
+        return serialization.unpack(view)
+
+    def read_from_file(self, path: str) -> Any:
+        return self.fallback.read_from_file(path)
+
+    # ------------------------------------------------------------- lifetime
+    def spill(self, name: str, spill_dir: str) -> str:
+        if not name.startswith(ARENA_PREFIX):
+            return self.fallback.spill(name, spill_dir)
+        hex_id = name[len(ARENA_PREFIX):]
+        os.makedirs(spill_dir, exist_ok=True)
+        path = os.path.join(spill_dir, f"arena-{hex_id}")
+        with self._lock:
+            view = self._pinned.pop(hex_id, None)
+        if view is None:
+            view = self.arena.get(hex_id)
+            if view is None:
+                raise FileNotFoundError(hex_id)
+        with open(path, "wb") as f:
+            f.write(bytes(view))
+        try:
+            view.release()
+        except BufferError:
+            pass  # exported numpy views keep the pin; delete below may defer
+        self.arena.release(hex_id)
+        self.arena.delete(hex_id)
+        return path
+
+    def release(self, name: str, unlink: bool = False):
+        if not name.startswith(ARENA_PREFIX):
+            return self.fallback.release(name, unlink)
+        hex_id = name[len(ARENA_PREFIX):]
+        with self._lock:
+            view = self._pinned.pop(hex_id, None)
+        if view is not None:
+            try:
+                view.release()
+                self.arena.release(hex_id)
+            except BufferError:
+                # Live zero-copy views — keep the pin; the object stays until
+                # the views die and the process exits/closes.
+                with self._lock:
+                    self._pinned[hex_id] = view
+                return
+        if unlink:
+            self.arena.delete(hex_id)  # no-op if other processes still pin it
+
+    def close_all(self, unlink: bool = False):
+        with self._lock:
+            pinned = dict(self._pinned)
+            self._pinned.clear()
+        for hex_id, view in pinned.items():
+            try:
+                view.release()
+                self.arena.release(hex_id)
+            except BufferError:
+                pass
+        self.fallback.close_all(unlink=unlink)
+
+
+def make_store(
+    create_arena: bool = False,
+    arena_capacity: Optional[int] = None,
+):
+    """Store factory: native arena when buildable (controller creates, others
+    attach), else the per-object-segment LocalStore.
+
+    Opt out with RAY_TPU_STORE=segments.
+    """
+    if os.environ.get("RAY_TPU_STORE", "") == "segments":
+        return LocalStore()
+    try:
+        from ..native import Arena
+    except Exception:  # noqa: BLE001
+        return LocalStore()
+    name = arena_segment_name()
+    try:
+        if create_arena:
+            capacity = arena_capacity or (1 << 30)
+            # Never claim more than half of what /dev/shm can still hold.
+            try:
+                st = os.statvfs("/dev/shm")
+                capacity = min(capacity, st.f_bavail * st.f_frsize // 2)
+            except OSError:
+                pass
+            arena = Arena(name, capacity=capacity, create=True)
+        else:
+            arena = Arena(name, create=False)
+    except Exception:  # noqa: BLE001  (native build failed / arena absent)
+        return LocalStore()
+    return ArenaStore(arena)

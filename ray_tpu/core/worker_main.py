@@ -33,7 +33,7 @@ class WorkerProcess:
         self.host, self.port = host, int(port)
         self.worker_id = worker_id
         self.session_dir = session_dir
-        self.local_store = store.LocalStore()
+        self.local_store = store.make_store()  # arena attach (tag already set)
         self.io = EventLoopThread(name=f"worker-{worker_id}-io")
         self.conn: Optional[Connection] = None
         self.task_queue: "queue.Queue[dict]" = queue.Queue()

@@ -1,0 +1,192 @@
+"""Native (C++) components, built on demand with g++ and bound via ctypes.
+
+The reference keeps its runtime core in C++ (`src/ray/…`); here the
+machine-local object plane's hot allocator lives in
+`src/arena.cpp` (plasma-equivalent arena — SURVEY.md §2.1). The .so is
+compiled once per source change into `_build/` (no pip, no pybind — plain
+g++ + ctypes per the environment contract).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "src", "arena.cpp")
+_BUILD_DIR = os.path.join(_DIR, "_build")
+_LIB = os.path.join(_BUILD_DIR, "libray_tpu_arena.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_error: Optional[str] = None
+
+
+def _ensure_built() -> Optional[str]:
+    """Compile the .so if missing/stale. Returns an error string or None."""
+    if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
+        return None
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    tmp = _LIB + f".tmp.{os.getpid()}"
+    cmd = [
+        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+        "-o", tmp, _SRC, "-lpthread", "-lrt",
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:  # g++ absent/hung
+        return f"arena build failed: {e!r}"
+    if proc.returncode != 0:
+        return f"arena build failed:\n{proc.stderr[-2000:]}"
+    os.replace(tmp, _LIB)  # atomic: concurrent builders race safely
+    return None
+
+
+def load_arena_lib() -> Optional[ctypes.CDLL]:
+    """The cached handle to the native library, or None if unbuildable."""
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_error is not None:
+            return None
+        err = _ensure_built()
+        if err is not None:
+            _build_error = err
+            return None
+        lib = ctypes.CDLL(_LIB)
+        lib.rt_arena_create.restype = ctypes.c_void_p
+        lib.rt_arena_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64]
+        lib.rt_arena_attach.restype = ctypes.c_void_p
+        lib.rt_arena_attach.argtypes = [ctypes.c_char_p]
+        lib.rt_arena_alloc.restype = ctypes.c_int64
+        lib.rt_arena_alloc.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+        lib.rt_arena_seal.restype = ctypes.c_int
+        lib.rt_arena_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rt_arena_get.restype = ctypes.c_int64
+        lib.rt_arena_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64)
+        ]
+        lib.rt_arena_release.restype = ctypes.c_int
+        lib.rt_arena_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rt_arena_delete.restype = ctypes.c_int
+        lib.rt_arena_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rt_arena_evict_lru.restype = ctypes.c_uint64
+        lib.rt_arena_evict_lru.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p,
+            ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.rt_arena_base.restype = ctypes.c_void_p
+        lib.rt_arena_base.argtypes = [ctypes.c_void_p]
+        for fn in ("rt_arena_capacity", "rt_arena_used", "rt_arena_num_objects"):
+            getattr(lib, fn).restype = ctypes.c_uint64
+            getattr(lib, fn).argtypes = [ctypes.c_void_p]
+        lib.rt_arena_detach.restype = ctypes.c_int
+        lib.rt_arena_detach.argtypes = [ctypes.c_void_p]
+        lib.rt_arena_unlink.restype = ctypes.c_int
+        lib.rt_arena_unlink.argtypes = [ctypes.c_char_p]
+        _lib = lib
+        return _lib
+
+
+def build_error() -> Optional[str]:
+    return _build_error
+
+
+class Arena:
+    """Pythonic handle over one shm arena (create or attach)."""
+
+    def __init__(self, name: str, capacity: Optional[int] = None, create: bool = False):
+        lib = load_arena_lib()
+        if lib is None:
+            raise RuntimeError(f"native arena unavailable: {build_error()}")
+        self._lib = lib
+        self.name = name
+        if create:
+            if capacity is None:
+                raise ValueError("capacity required to create an arena")
+            self._h = lib.rt_arena_create(name.encode(), capacity, 0)
+        else:
+            self._h = lib.rt_arena_attach(name.encode())
+        if not self._h:
+            raise RuntimeError(
+                f"arena {'create' if create else 'attach'} failed for {name!r}"
+            )
+        self._base = lib.rt_arena_base(self._h)
+        self._owner = create
+
+    # -------------------------------------------------------------- objects
+    def create(self, object_id: str, size: int) -> memoryview:
+        """Allocate an unsealed object; returns a writable view of it."""
+        off = self._lib.rt_arena_alloc(self._h, object_id.encode(), size)
+        if off < 0:
+            raise MemoryError(f"arena alloc failed for {object_id} ({size}B)")
+        return self._view(off, size)
+
+    def seal(self, object_id: str):
+        if self._lib.rt_arena_seal(self._h, object_id.encode()) != 0:
+            raise KeyError(object_id)
+
+    def get(self, object_id: str) -> Optional[memoryview]:
+        """Pin + return a read view of a sealed object; None if absent.
+        Balance every successful get with release()."""
+        size = ctypes.c_uint64()
+        off = self._lib.rt_arena_get(self._h, object_id.encode(), ctypes.byref(size))
+        if off == -1:
+            return None
+        if off == -2:
+            raise BlockingIOError(f"object {object_id} not sealed yet")
+        return self._view(off, size.value)
+
+    def release(self, object_id: str):
+        self._lib.rt_arena_release(self._h, object_id.encode())
+
+    def delete(self, object_id: str) -> bool:
+        return self._lib.rt_arena_delete(self._h, object_id.encode()) == 0
+
+    def evict_lru(self, want_bytes: int) -> list:
+        """Evict sealed, unpinned objects; returns their ids."""
+        cap = 4096
+        buf = ctypes.create_string_buffer(cap * 64)
+        count = ctypes.c_uint64()
+        self._lib.rt_arena_evict_lru(self._h, want_bytes, buf, cap, ctypes.byref(count))
+        out = []
+        for k in range(count.value):
+            raw = buf.raw[k * 64 : (k + 1) * 64]
+            out.append(raw.split(b"\0", 1)[0].decode())
+        return out
+
+    # --------------------------------------------------------------- stats
+    @property
+    def capacity(self) -> int:
+        return self._lib.rt_arena_capacity(self._h)
+
+    @property
+    def used(self) -> int:
+        return self._lib.rt_arena_used(self._h)
+
+    @property
+    def num_objects(self) -> int:
+        return self._lib.rt_arena_num_objects(self._h)
+
+    # ------------------------------------------------------------ internals
+    def _view(self, offset: int, size: int) -> memoryview:
+        buf = (ctypes.c_char * size).from_address(self._base + offset)
+        return memoryview(buf).cast("B")
+
+    def detach(self):
+        if self._h:
+            self._lib.rt_arena_detach(self._h)
+            self._h = None
+
+    def unlink(self):
+        self._lib.rt_arena_unlink(self.name.encode())
+
+    def __del__(self):
+        try:
+            self.detach()
+        except Exception:  # noqa: BLE001
+            pass

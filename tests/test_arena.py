@@ -1,0 +1,130 @@
+"""Native C++ arena tests (reference analog: plasma store tests under
+`src/ray/object_manager/plasma/` + `python/ray/tests/test_object_store*`)."""
+
+import multiprocessing
+import os
+
+import numpy as np
+import pytest
+
+from ray_tpu.core import serialization, store
+from ray_tpu.native import Arena, build_error, load_arena_lib
+
+pytestmark = pytest.mark.skipif(
+    load_arena_lib() is None, reason=f"native build unavailable: {build_error()}"
+)
+
+
+def _read_shared_from_child(name, q):
+    a = Arena(name, create=False)
+    r = a.get("shared")
+    q.put(bytes(r[:5]))
+    r.release()
+    a.release("shared")
+    a.detach()
+
+
+@pytest.fixture
+def arena():
+    name = f"/rtpu-test-{os.getpid()}"
+    a = Arena(name, capacity=1 << 22, create=True)
+    yield a
+    a.unlink()
+    a.detach()
+
+
+class TestArena:
+    def test_create_seal_get_release_delete(self, arena):
+        v = arena.create("obj-a", 64)
+        v[:3] = b"abc"
+        v.release()
+        with pytest.raises(BlockingIOError):
+            arena.get("obj-a")  # unsealed objects are not readable
+        arena.seal("obj-a")
+        r = arena.get("obj-a")
+        assert bytes(r[:3]) == b"abc"
+        assert not arena.delete("obj-a")  # pinned
+        r.release()
+        arena.release("obj-a")
+        assert arena.delete("obj-a")
+        assert arena.get("obj-a") is None
+
+    def test_duplicate_alloc_rejected(self, arena):
+        arena.create("dup", 16)
+        with pytest.raises(MemoryError):
+            arena.create("dup", 16)
+
+    def test_full_arena_raises(self, arena):
+        with pytest.raises(MemoryError):
+            arena.create("huge", 1 << 23)  # bigger than capacity
+
+    def test_free_list_reuse_and_coalescing(self, arena):
+        for i in range(20):
+            arena.create(f"x{i}", 100_000)
+            arena.seal(f"x{i}")
+        for i in range(20):
+            assert arena.delete(f"x{i}")
+        assert arena.used == 0
+        # After full coalescing one max-size block must fit again.
+        big = arena.create("big", (1 << 22) - 64)
+        assert big is not None
+
+    def test_lru_eviction_order(self, arena):
+        for i in range(5):
+            arena.create(f"e{i}", 1000)
+            arena.seal(f"e{i}")
+        r = arena.get("e0")  # touch e0 → most recent
+        r.release()
+        arena.release("e0")
+        evicted = arena.evict_lru(2500)
+        assert evicted == ["e1", "e2", "e3"]
+
+    def test_cross_process_visibility(self, arena):
+        v = arena.create("shared", 32)
+        v[:5] = b"cross"
+        v.release()
+        arena.seal("shared")
+
+        ctx = multiprocessing.get_context("spawn")
+        q = ctx.Queue()
+        p = ctx.Process(target=_read_shared_from_child, args=(arena.name, q))
+        p.start()
+        assert q.get(timeout=30) == b"cross"
+        p.join(timeout=30)
+
+
+class TestArenaStore:
+    def test_put_read_roundtrip(self, arena):
+        s = store.ArenaStore(arena)
+        big = np.arange(100_000, dtype=np.float64)  # > inline threshold
+        name, inline, size = s.put("a" * 56, big)
+        assert inline is None and name.startswith(store.ARENA_PREFIX)
+        out = s.read(name)
+        np.testing.assert_array_equal(out, big)
+        # zero-copy: the array views the arena mapping
+        s.release(name)
+
+    def test_small_objects_stay_inline(self, arena):
+        s = store.ArenaStore(arena)
+        name, inline, _ = s.put("b" * 56, {"k": 1})
+        assert name is None and inline is not None
+
+    def test_spill_and_restore(self, arena, tmp_path):
+        s = store.ArenaStore(arena)
+        value = np.arange(50_000, dtype=np.int64)
+        name, _, _ = s.put("c" * 56, value)
+        path = s.spill(name, str(tmp_path))
+        assert os.path.exists(path)
+        assert arena.get("c" * 56) is None  # gone from the arena
+        np.testing.assert_array_equal(s.read_from_file(path), value)
+
+    def test_fallback_when_full(self, arena):
+        s = store.ArenaStore(arena)
+        store.set_session_tag(str(os.getpid()))
+        huge = np.zeros(1 << 21, dtype=np.float64)  # 16MB > 4MB arena
+        name, inline, _ = s.put("d" * 56, huge)
+        assert name is not None and not name.startswith(store.ARENA_PREFIX)
+        out = s.read(name)
+        np.testing.assert_array_equal(out, huge)
+        del out  # drop the zero-copy view before unlinking the segment
+        s.release(name, unlink=True)
