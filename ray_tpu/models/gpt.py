@@ -55,6 +55,7 @@ class GPTConfig:
     dtype: Any = jnp.bfloat16
     attn_impl: str = "flash"         # flash | ring | ulysses | ref
     remat: bool = True
+    remat_policy: Optional[str] = None  # None (save nothing) | "dots"
     sp_axis: str = "sp"
 
     @property
@@ -378,7 +379,14 @@ def forward(params, tokens, cfg: GPTConfig, positions=None, mesh=None, return_au
 
     block = functools.partial(_block, cfg, rope_tables, mesh)
     if cfg.remat:
-        block = jax.checkpoint(block, policy=jax.checkpoint_policies.nothing_saveable)
+        if cfg.remat_policy not in (None, "dots"):
+            raise ValueError(f"unknown remat_policy: {cfg.remat_policy!r}")
+        policy = (
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            if cfg.remat_policy == "dots"
+            else jax.checkpoint_policies.nothing_saveable
+        )
+        block = jax.checkpoint(block, policy=policy)
 
     def scan_body(x, layer_params):
         x, aux = block(x, layer_params, positions)

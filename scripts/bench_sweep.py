@@ -1,0 +1,76 @@
+"""Bench config sweep: batch size × remat policy × attention blocks.
+
+Finds the (B, remat, blocks) that maximizes single-chip MFU for bench.py.
+Run on the real TPU: `python scripts/bench_sweep.py`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import optax
+
+from ray_tpu.models import gpt2_medium, init_params, make_train_step
+
+
+def peak_flops():
+    kind = jax.devices()[0].device_kind.lower()
+    if "v5 lite" in kind or "v5e" in kind:
+        return 197e12
+    if "v4" in kind:
+        return 275e12
+    return 197e12
+
+
+def run(B, S, remat, policy=None, steps=6):
+    cfg = gpt2_medium(max_seq=S, attn_impl="flash", remat=remat, remat_policy=policy)
+    params = jax.jit(lambda key: init_params(key, cfg))(jax.random.PRNGKey(0))
+    opt = optax.adamw(3e-4, weight_decay=0.1)
+    opt_state = opt.init(params)
+    step = jax.jit(make_train_step(cfg, opt), donate_argnums=(0,))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (B, S + 1), 0, cfg.vocab_size)
+    batch = {"tokens": tokens}
+    state = (params, opt_state)
+    for _ in range(2):
+        state, metrics = step(state, batch)
+    _ = float(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch)
+    _ = float(metrics["loss"])
+    dt = (time.perf_counter() - t0) / steps
+    tok_s = B * S / dt
+    mfu = cfg.flops_per_token(S) * tok_s / peak_flops()
+    return {"B": B, "S": S, "remat": remat, "policy": policy or "none",
+            "step_ms": round(dt * 1000, 1), "tok_s": round(tok_s), "mfu": round(mfu, 4)}
+
+
+def main():
+    results = []
+    for B, remat, policy in [
+        (8, True, None),
+        (16, True, None),
+        (32, True, None),
+        (8, False, None),
+        (16, False, None),
+        (16, True, "dots"),
+        (32, True, "dots"),
+    ]:
+        try:
+            r = run(B, 1024, remat, policy)
+        except Exception as e:  # noqa: BLE001
+            r = {"B": B, "remat": remat, "policy": policy, "error": repr(e)[:200]}
+        print(json.dumps(r), flush=True)
+        results.append(r)
+    best = max((r for r in results if "mfu" in r), key=lambda r: r["mfu"])
+    print("BEST:", json.dumps(best))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,71 @@
+"""Capture a profiler trace of the bench train step and print the op table.
+
+Run on the real TPU. Writes the raw trace under /tmp/ray_tpu_trace.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import optax
+
+from ray_tpu.models import gpt2_medium, init_params, make_train_step
+
+TRACE_DIR = "/tmp/ray_tpu_trace"
+
+
+def main():
+    B, S = 16, 1024
+    cfg = gpt2_medium(max_seq=S, attn_impl="flash", remat=True)
+    params = jax.jit(lambda key: init_params(key, cfg))(jax.random.PRNGKey(0))
+    opt = optax.adamw(3e-4, weight_decay=0.1)
+    opt_state = opt.init(params)
+    step = jax.jit(make_train_step(cfg, opt), donate_argnums=(0,))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (B, S + 1), 0, cfg.vocab_size)
+    batch = {"tokens": tokens}
+    state = (params, opt_state)
+    for _ in range(2):
+        state, metrics = step(state, batch)
+    _ = float(metrics["loss"])
+
+    jax.profiler.start_trace(TRACE_DIR)
+    for _ in range(3):
+        state, metrics = step(state, batch)
+    _ = float(metrics["loss"])
+    jax.profiler.stop_trace()
+
+    # Convert xplane -> op profile via the tensorboard profile plugin.
+    xplanes = glob.glob(f"{TRACE_DIR}/**/*.xplane.pb", recursive=True)
+    print("xplane files:", xplanes, file=sys.stderr)
+    if not xplanes:
+        return
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    xs = xplane_pb2.XSpace()
+    with open(sorted(xplanes)[-1], "rb") as f:
+        xs.ParseFromString(f.read())
+    for plane in xs.planes:
+        if "TPU" not in plane.name and "Device" not in plane.name:
+            continue
+        emeta = plane.event_metadata
+        by_name = {}
+        for line in plane.lines:
+            for ev in line.events:
+                name = emeta[ev.metadata_id].name if ev.metadata_id in emeta else "?"
+                dur = ev.duration_ps / 1e9  # ps -> ms
+                by_name[name] = by_name.get(name, 0.0) + dur
+        total = sum(by_name.values())
+        print(f"== plane: {plane.name} (total {total:.1f} ms over 3 steps)")
+        for name, dur in sorted(by_name.items(), key=lambda kv: -kv[1])[:45]:
+            print(f"{dur:10.2f} ms  {100*dur/max(total,1e-9):5.1f}%  {name[:120]}")
+
+
+if __name__ == "__main__":
+    main()
