@@ -1,0 +1,194 @@
+"""Multi-node-on-one-machine test cluster.
+
+Reference analog: `python/ray/cluster_utils.py:108` `Cluster`/`add_node` —
+the fixture behind all of the reference's multi-node CI (SURVEY.md §4): N
+node daemons as separate processes on one machine with fake resources.
+
+Usage:
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    cluster.add_node(num_cpus=2, resources={"worker1": 1})
+    ray_tpu.init(address=cluster.address)
+    ...
+    cluster.shutdown()
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import select
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import cloudpickle
+
+from .core.cluster_backend import ClusterBackend
+
+
+def read_sentinel(proc: subprocess.Popen, prefix: str, timeout: float) -> Optional[str]:
+    """Read stdout lines until one starts with `prefix`; honors the deadline
+    even when the child stays alive but silent (select before readline)."""
+    deadline = time.monotonic() + timeout
+    buf = b""
+    fd = proc.stdout.fileno()
+    while time.monotonic() < deadline:
+        if proc.poll() is not None and not buf:
+            return None
+        ready, _, _ = select.select([fd], [], [], min(0.5, max(0.01, deadline - time.monotonic())))
+        if not ready:
+            continue
+        chunk = os.read(fd, 4096)
+        if not chunk:
+            if proc.poll() is not None:
+                return None
+            continue
+        buf += chunk
+        while b"\n" in buf:
+            line, buf = buf.split(b"\n", 1)
+            text = line.decode(errors="replace")
+            if text.startswith(prefix):
+                return text[len(prefix):].strip()
+    return None
+
+
+@dataclass
+class NodeHandle:
+    node_id: str
+    process: subprocess.Popen
+    resources: Dict[str, float] = field(default_factory=dict)
+
+    @property
+    def alive(self) -> bool:
+        return self.process.poll() is None
+
+
+class Cluster:
+    def __init__(
+        self,
+        initialize_head: bool = True,
+        head_node_args: Optional[dict] = None,
+    ):
+        self.address: Optional[str] = None
+        self.session_dir: Optional[str] = None
+        self.head_proc: Optional[subprocess.Popen] = None
+        self.nodes: List[NodeHandle] = []
+        self._node_counter = 0
+        if initialize_head:
+            args = head_node_args or {}
+            self._start_head(
+                num_cpus=args.get("num_cpus", 2),
+                resources=args.get("resources", {}),
+                object_store_memory=args.get("object_store_memory"),
+            )
+
+    # -------------------------------------------------------------- head
+    def _start_head(self, num_cpus, resources, object_store_memory):
+        self.session_dir = os.path.join(
+            "/tmp/ray_tpu", f"cluster_{int(time.time() * 1000)}_{os.getpid()}"
+        )
+        os.makedirs(self.session_dir, exist_ok=True)
+        args = {
+            "num_cpus": float(num_cpus),
+            "resources": resources,
+            "session_dir": self.session_dir,
+            "object_store_memory": object_store_memory,
+            "port": 0,
+        }
+        env = dict(os.environ)
+        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        env["RAY_TPU_CONTROLLER_ARGS"] = cloudpickle.dumps(args).hex()
+        log_f = open(os.path.join(self.session_dir, "controller.log"), "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.controller_main"],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=log_f,
+            cwd=pkg_root,
+        )
+        val = read_sentinel(proc, "RAY_TPU_CONTROLLER_PORT=", 30)
+        if val is None:
+            proc.terminate()
+            raise RuntimeError(
+                f"cluster head failed to start; see {self.session_dir}/controller.log"
+            )
+        port = int(val)
+        self.head_proc = proc
+        self.address = f"127.0.0.1:{port}"
+
+    # ------------------------------------------------------------- nodes
+    def add_node(
+        self,
+        num_cpus: float = 1.0,
+        resources: Optional[Dict[str, float]] = None,
+        object_store_memory: Optional[int] = None,
+        node_id: Optional[str] = None,
+    ) -> NodeHandle:
+        assert self.address, "head not started"
+        self._node_counter += 1
+        node_id = node_id or f"node{self._node_counter}"
+        total = {"CPU": float(num_cpus), **(resources or {})}
+        args = {
+            "node_id": node_id,
+            "address": self.address,
+            "resources": total,
+            "session_dir": self.session_dir,
+            "object_store_memory": object_store_memory,
+        }
+        env = dict(os.environ)
+        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        env["RAY_TPU_NODE_ARGS"] = json.dumps(args)
+        log_f = open(os.path.join(self.session_dir, f"agent-{node_id}.log"), "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.node_agent"],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=log_f,
+            cwd=pkg_root,
+        )
+        if read_sentinel(proc, "RAY_TPU_NODE_READY=", 30) is None:
+            proc.terminate()
+            raise RuntimeError(
+                f"node {node_id} failed to start; see "
+                f"{self.session_dir}/agent-{node_id}.log"
+            )
+        handle = NodeHandle(node_id=node_id, process=proc, resources=total)
+        self.nodes.append(handle)
+        return handle
+
+    def remove_node(self, node: NodeHandle, allow_graceful: bool = False):
+        """Kill a node (agent + its workers die together via PDEATHSIG)."""
+        if node.process.poll() is None:
+            if allow_graceful:
+                node.process.terminate()
+            else:
+                node.process.kill()
+            try:
+                node.process.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                node.process.kill()
+        if node in self.nodes:
+            self.nodes.remove(node)
+
+    # ----------------------------------------------------------- teardown
+    def shutdown(self):
+        for node in list(self.nodes):
+            self.remove_node(node, allow_graceful=True)
+        if self.head_proc is not None and self.head_proc.poll() is None:
+            try:
+                backend = ClusterBackend(self.address)
+                backend._connect(register_as="register_client")
+                backend._request({"type": "shutdown"}, timeout=2)
+                backend.conn.close()
+                backend.io.stop()
+            except Exception:  # noqa: BLE001
+                pass
+            try:
+                self.head_proc.wait(timeout=8)
+            except subprocess.TimeoutExpired:
+                self.head_proc.terminate()
+        self.head_proc = None

@@ -30,6 +30,7 @@ class ClusterBackend(RuntimeBackend):
         self.address = address
         self.client_address = address
         self.role = role
+        self.node_id_hex = os.environ.get("RAY_TPU_NODE_ID", "node0")
         self.worker = worker  # WorkerProcess when role == "worker"
         self.local_store = store.LocalStore()
         self.io = EventLoopThread(name="client-io")
@@ -97,21 +98,15 @@ class ClusterBackend(RuntimeBackend):
             cwd=pkg_root,
         )
         # Handshake: controller prints its bound port on stdout.
-        deadline = time.monotonic() + 30
-        port = None
-        while time.monotonic() < deadline:
-            line = proc.stdout.readline().decode()
-            if line.startswith("RAY_TPU_CONTROLLER_PORT="):
-                port = int(line.strip().split("=", 1)[1])
-                break
-            if not line and proc.poll() is not None:
-                raise RayTpuError(
-                    f"Controller failed to start; see {session_dir}/controller.log"
-                )
-        if port is None:
+        from ..cluster_utils import read_sentinel
+
+        val = read_sentinel(proc, "RAY_TPU_CONTROLLER_PORT=", 30)
+        if val is None:
             proc.terminate()
-            raise RayTpuError("Controller startup timed out")
-        return f"127.0.0.1:{port}", proc
+            raise RayTpuError(
+                f"Controller failed to start (or timed out); see {session_dir}/controller.log"
+            )
+        return f"127.0.0.1:{int(val)}", proc
 
     def _connect(self, register_as: str):
         async def go():
@@ -120,7 +115,7 @@ class ClusterBackend(RuntimeBackend):
             conn = Connection(reader, writer)
             conn.start()
             self.conn = conn
-            payload = {"type": register_as}
+            payload = {"type": register_as, "node_id": os.environ.get("RAY_TPU_NODE_ID", "node0")}
             if register_as == "register_worker" and self.worker is not None:
                 payload["worker_id"] = self.worker.worker_id
             return await conn.request(payload, timeout=15)
@@ -128,7 +123,10 @@ class ClusterBackend(RuntimeBackend):
         result = self.io.call(go(), timeout=20)
         if not (result or {}).get("ok"):
             raise RayTpuError(f"Failed to register with controller: {result}")
-        if result.get("session_tag"):
+        # Adopt the head's session tag unless this process is env-pinned to a
+        # node arena: a worker on a remote node carries ITS node's tag
+        # (RAY_TPU_SESSION_TAG from the agent) and must keep attaching there.
+        if result.get("session_tag") and not os.environ.get("RAY_TPU_SESSION_TAG"):
             store.set_session_tag(result["session_tag"])
         # With the tag known, upgrade to the native arena store if this
         # session's controller created one (falls back silently otherwise).

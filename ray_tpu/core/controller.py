@@ -38,13 +38,21 @@ from .exceptions import (
     WorkerCrashedError,
 )
 from .rpc import Connection, read_msg
-from .task_spec import TaskSpec, TaskType
+from .task_spec import (
+    NodeAffinitySchedulingStrategy,
+    SpreadSchedulingStrategy,
+    TaskSpec,
+    TaskType,
+)
 
 IDLE = "idle"
 BUSY = "busy"
 STARTING = "starting"
 ACTOR = "actor"
 DEAD = "dead"
+
+
+HEAD_NODE = "node0"
 
 
 @dataclass
@@ -57,21 +65,54 @@ class WorkerState:
     actor_hex: Optional[str] = None
     assigned: Dict[str, float] = field(default_factory=dict)
     blocked: bool = False
-    node_id: str = "node0"
+    node_id: str = HEAD_NODE
     has_tpu: bool = False
+
+
+@dataclass
+class NodeState:
+    """Per-node view (reference analog: `NodeResources` in
+    `cluster_resource_data.h:289` + the GCS node directory). The head node
+    (`node0`) is the controller's own machine slice — `conn is None`; remote
+    nodes are `node_agent.py` daemons."""
+
+    node_id: str
+    conn: Optional[Connection] = None
+    fetch_addr: str = ""
+    total: Dict[str, float] = field(default_factory=dict)
+    available: Dict[str, float] = field(default_factory=dict)
+    session_tag: str = ""
+    alive: bool = True
+    spawning: int = 0
+    spawning_tpu: int = 0
+    object_store_memory: int = 0
+
+    def utilization(self) -> float:
+        fracs = [
+            1.0 - self.available.get(k, 0.0) / v
+            for k, v in self.total.items()
+            if v > 0
+        ]
+        return max(fracs) if fracs else 0.0
 
 
 @dataclass
 class ObjectState:
     status: str = "pending"  # pending | ready
     inline: Optional[bytes] = None
-    shm_name: Optional[str] = None
+    # node_id -> shm name on that node (primary + pulled copies).
+    locations: Dict[str, str] = field(default_factory=dict)
     spilled_path: Optional[str] = None
+    spilled_node: str = HEAD_NODE
     size: int = 0
     last_access: float = 0.0
     events: List[asyncio.Event] = field(default_factory=list)
     # Tasks blocked on this object (by task hex).
     dependents: Set[str] = field(default_factory=set)
+
+    @property
+    def shm_name(self) -> Optional[str]:  # head-node name (spill path compat)
+        return self.locations.get(HEAD_NODE)
 
 
 @dataclass
@@ -102,6 +143,10 @@ class PendingTask:
     spec: TaskSpec
     deps_remaining: Set[str] = field(default_factory=set)
     retries_left: int = 0
+    # Spread/affinity commitment: once a node is chosen, later scheduling
+    # passes honor it (otherwise the round-robin re-rolls every pass and the
+    # task bounces between half-spawned nodes).
+    pinned_node: Optional[str] = None
 
 
 class Controller:
@@ -117,13 +162,25 @@ class Controller:
         os.makedirs(session_dir, exist_ok=True)
         self.spill_dir = os.path.join(session_dir, "spill")
         self.port = port
-        self.total_resources = {"CPU": float(num_cpus), **resources}
-        self.available = dict(self.total_resources)
         self.object_store_memory = object_store_memory or int(
             min(0.3 * os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES"), 64 << 30)
         )
         self.store_bytes_used = 0
         self.local_store = store.LocalStore()
+
+        head_total = {"CPU": float(num_cpus), **resources}
+        self.head = NodeState(
+            node_id=HEAD_NODE,
+            total=dict(head_total),
+            available=dict(head_total),
+            object_store_memory=self.object_store_memory,
+        )
+        self.nodes: Dict[str, NodeState] = {HEAD_NODE: self.head}
+        # In-flight cross-node pulls, deduped: (node_id, object_hex) -> Future.
+        self._pulls: Dict[Tuple[str, str], asyncio.Future] = {}
+        # Controller -> agent fetch-server connections (for pulls INTO node0).
+        self._fetch_conns: Dict[str, Connection] = {}
+        self._spread_rr = 0
 
         self.objects: Dict[str, ObjectState] = {}
         self.workers: Dict[str, WorkerState] = {}
@@ -137,8 +194,6 @@ class Controller:
         self.timeline: List[dict] = []
         self.drivers: Set[Connection] = set()
         self._worker_counter = itertools.count()
-        self._spawning = 0
-        self._spawning_tpu = 0
         self._max_workers = max(int(num_cpus) * 4, 8)
         self._min_workers = 2
         self._server: Optional[asyncio.base_events.Server] = None
@@ -166,6 +221,12 @@ class Controller:
         await self._teardown()
 
     async def _teardown(self):
+        for node in self.nodes.values():
+            if node.conn is not None and node.alive:
+                try:
+                    await node.conn.send({"type": "exit"})
+                except Exception:  # noqa: BLE001
+                    pass
         for ws in self.workers.values():
             if ws.conn is not None:
                 try:
@@ -187,18 +248,28 @@ class Controller:
             self._server.close()
 
     # ------------------------------------------------------------- workers
-    def _spawn_worker(self, tpu: bool = False):
+    def _spawn_worker(self, tpu: bool = False, node: Optional[NodeState] = None):
+        """Spawn a worker on `node` (default head). Remote nodes spawn via
+        their agent (reference: raylet `WorkerPool::StartWorkerProcess`)."""
+        node = node or self.head
         if tpu:
-            if self._spawning_tpu > 0:
+            if node.spawning_tpu > 0:
                 return
-            self._spawning_tpu += 1
+            node.spawning_tpu += 1
         elif (
-            self._spawning + len([w for w in self.workers.values() if w.state != DEAD])
+            node.spawning
+            + len([w for w in self.workers.values()
+                   if w.state != DEAD and w.node_id == node.node_id])
             >= self._max_workers
         ):
             return
-        self._spawning += 1
+        node.spawning += 1
         worker_id = f"w{next(self._worker_counter)}"
+        if node.conn is not None:
+            asyncio.ensure_future(
+                node.conn.send({"type": "spawn_worker", "worker_id": worker_id, "tpu": tpu})
+            )
+            return
         env = dict(os.environ)
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
@@ -274,6 +345,8 @@ class Controller:
     async def _on_disconnect(self, conn: Connection, meta: dict):
         if meta["kind"] == "worker":
             await self._on_worker_death(meta["worker_id"])
+        elif meta["kind"] == "node":
+            await self._on_node_death(meta["node_id"])
         elif meta["kind"] == "driver":
             self.drivers.discard(conn)
             if not self.drivers:
@@ -291,12 +364,15 @@ class Controller:
         }
 
     async def h_register_client(self, conn, meta, msg):
-        # Secondary connection from a worker's nested-API backend.
+        # Secondary connection from a worker's nested-API backend (or an
+        # agent's fetch client). Carries its node so gets resolve locally.
         meta["kind"] = "client"
+        meta["node_id"] = msg.get("node_id", HEAD_NODE)
         return {"ok": True}
 
     async def h_register_worker(self, conn, meta, msg):
         worker_id = msg["worker_id"]
+        node_id = msg.get("node_id", HEAD_NODE)
         meta["kind"] = "worker"
         meta["worker_id"] = worker_id
         ws = WorkerState(
@@ -305,11 +381,36 @@ class Controller:
             pid=msg.get("pid", 0),
             state=IDLE,
             has_tpu=bool(msg.get("has_tpu")),
+            node_id=node_id,
         )
         self.workers[worker_id] = ws
-        self._spawning = max(0, self._spawning - 1)
-        if ws.has_tpu:
-            self._spawning_tpu = max(0, self._spawning_tpu - 1)
+        node = self.nodes.get(node_id)
+        if node is not None:
+            node.spawning = max(0, node.spawning - 1)
+            if ws.has_tpu:
+                node.spawning_tpu = max(0, node.spawning_tpu - 1)
+        self._schedule()
+        return {"ok": True}
+
+    async def h_register_node(self, conn, meta, msg):
+        """A node agent joined (reference: `GcsNodeManager::HandleRegisterNode`).
+        The docstring seam promised in round 1 (`register_node`) — now real."""
+        node_id = msg["node_id"]
+        if node_id in self.nodes and self.nodes[node_id].alive:
+            return {"ok": False, "error": f"node id {node_id} already registered"}
+        meta["kind"] = "node"
+        meta["node_id"] = node_id
+        total = {k: float(v) for k, v in (msg.get("resources") or {}).items()}
+        self.nodes[node_id] = NodeState(
+            node_id=node_id,
+            conn=conn,
+            fetch_addr=msg.get("fetch_addr", ""),
+            total=dict(total),
+            available=dict(total),
+            session_tag=msg.get("session_tag", ""),
+            object_store_memory=msg.get("object_store_memory", 0),
+        )
+        self._event("node_added", node=node_id, resources=total)
         self._schedule()
         return {"ok": True}
 
@@ -330,14 +431,16 @@ class Controller:
         inline: Optional[bytes] = None,
         shm_name: Optional[str] = None,
         size: int = 0,
+        node_id: str = HEAD_NODE,
     ):
         obj = self._obj(hex_id)
         obj.status = "ready"
         obj.inline = inline
-        obj.shm_name = shm_name
+        if shm_name:
+            obj.locations[node_id] = shm_name
         obj.size = size
         obj.last_access = time.monotonic()
-        if shm_name:
+        if shm_name and node_id == HEAD_NODE:
             self.store_bytes_used += size
         for ev in obj.events:
             ev.set()
@@ -358,27 +461,133 @@ class Controller:
         frame = serialization.pack(err)
         self._mark_ready(hex_id, inline=frame)
 
-    def _location_payload(self, obj: ObjectState) -> dict:
+    def _location_payload(self, obj: ObjectState, node_id: str = HEAD_NODE) -> dict:
         obj.last_access = time.monotonic()
         if obj.inline is not None:
             return {"status": "inline", "data": obj.inline}
-        if obj.shm_name is not None:
-            return {"status": "shm", "name": obj.shm_name, "size": obj.size}
-        if obj.spilled_path is not None:
+        name = obj.locations.get(node_id)
+        if name is not None:
+            return {"status": "shm", "name": name, "size": obj.size}
+        if obj.spilled_path is not None and obj.spilled_node == node_id:
             return {"status": "spilled", "path": obj.spilled_path}
+        if obj.locations or obj.spilled_path:
+            return {"status": "remote"}  # caller must _ensure_local first
         return {"status": "lost"}
+
+    # ------------------------------------------------- cross-node transfer
+    def _source_for(self, obj: ObjectState) -> Optional[dict]:
+        """Pick a fetch source: any live shm copy, else the spill file."""
+        for nid, name in obj.locations.items():
+            node = self.nodes.get(nid)
+            if node is None or not node.alive:
+                continue
+            addr = f"127.0.0.1:{self.port}" if nid == HEAD_NODE else node.fetch_addr
+            return {"addr": addr, "name": name, "node": nid}
+        if obj.spilled_path is not None:
+            nid = obj.spilled_node
+            node = self.nodes.get(nid)
+            if node is not None and (nid == HEAD_NODE or node.alive):
+                addr = f"127.0.0.1:{self.port}" if nid == HEAD_NODE else node.fetch_addr
+                return {"addr": addr, "path": obj.spilled_path, "node": nid}
+        return None
+
+    async def _ensure_local(self, node_id: str, hex_id: str):
+        """Materialize a ready object on `node_id` (controller-directed pull —
+        reference analog: `PullManager` asking the owner's `PushManager`)."""
+        obj = self._obj(hex_id)
+        if obj.inline is not None or node_id in obj.locations:
+            return
+        if obj.spilled_path is not None and obj.spilled_node == node_id:
+            return
+        key = (node_id, hex_id)
+        fut = self._pulls.get(key)
+        if fut is not None:
+            await fut
+            return
+        fut = asyncio.get_running_loop().create_future()
+        self._pulls[key] = fut
+        try:
+            src = self._source_for(obj)
+            if src is None:
+                raise RuntimeError(f"object {hex_id[:12]} has no live copy")
+            if node_id == HEAD_NODE:
+                data = await self._fetch_from(src)
+                name, size = self.local_store.create_raw(hex_id, data)
+                self.store_bytes_used += size
+                self._maybe_spill()  # pulls also count against the memory cap
+            else:
+                node = self.nodes[node_id]
+                req = {"type": "pull_object", "id": hex_id, "addr": src["addr"]}
+                if "name" in src:
+                    req["name"] = src["name"]
+                else:
+                    req["path"] = src["path"]
+                resp = await node.conn.request(req, timeout=120)
+                if not resp.get("ok"):
+                    raise RuntimeError(f"pull failed: {resp.get('error')}")
+                name = resp["name"]
+            obj.locations[node_id] = name
+            self._event("object_transferred", object=hex_id, to=node_id, src=src["node"])
+            fut.set_result(None)
+        except BaseException as e:  # noqa: BLE001
+            fut.set_exception(e)
+            # Consume the exception if nobody else awaits this future.
+            fut.exception()
+            raise
+        finally:
+            self._pulls.pop(key, None)
+
+    async def _fetch_from(self, src: dict) -> bytes:
+        """Fetch object bytes into the controller (head-node pulls)."""
+        if src["node"] == HEAD_NODE:
+            if "name" in src:
+                return self.local_store.read_raw(src["name"])
+            with open(src["path"], "rb") as f:
+                return f.read()
+        conn = self._fetch_conns.get(src["node"])
+        if conn is None or conn._closed:
+            host, port = src["addr"].rsplit(":", 1)
+            reader, writer = await asyncio.open_connection(host, int(port))
+            conn = Connection(reader, writer)
+            conn.start()
+            self._fetch_conns[src["node"]] = conn
+        fetch = {"type": "fetch_object"}
+        if "name" in src:
+            fetch["name"] = src["name"]
+        else:
+            fetch["path"] = src["path"]
+        resp = await conn.request(fetch, timeout=60)
+        if resp.get("error"):
+            raise RuntimeError(resp["error"])
+        return resp["data"]
+
+    async def h_fetch_object(self, conn, meta, msg):
+        """Serve head-node object bytes to a pulling agent."""
+        try:
+            if msg.get("name"):
+                data = self.local_store.read_raw(msg["name"])
+            else:
+                with open(msg["path"], "rb") as f:
+                    data = f.read()
+            return {"data": data}
+        except Exception as e:  # noqa: BLE001
+            return {"error": repr(e)}
 
     async def h_put_inline(self, conn, meta, msg):
         self._mark_ready(msg["id"], inline=msg["data"], size=len(msg["data"]))
         return {"ok": True}
 
     async def h_register_object(self, conn, meta, msg):
-        self._mark_ready(msg["id"], shm_name=msg["name"], size=msg["size"])
+        self._mark_ready(
+            msg["id"], shm_name=msg["name"], size=msg["size"],
+            node_id=meta.get("node_id") or HEAD_NODE,
+        )
         return {"ok": True}
 
     async def h_get_object(self, conn, meta, msg):
         hex_id = msg["id"]
         timeout = msg.get("timeout")
+        node_id = meta.get("node_id") or HEAD_NODE
         obj = self._obj(hex_id)
         if obj.status != "ready":
             ev = asyncio.Event()
@@ -395,7 +604,14 @@ class Controller:
                 # never-produced objects don't accumulate dead events.
                 if ev in obj.events:
                     obj.events.remove(ev)
-        return self._location_payload(obj)
+        payload = self._location_payload(obj, node_id)
+        if payload["status"] == "remote":
+            try:
+                await self._ensure_local(node_id, hex_id)
+            except Exception:  # noqa: BLE001
+                return {"status": "lost"}
+            payload = self._location_payload(obj, node_id)
+        return payload
 
     async def h_wait_objects(self, conn, meta, msg):
         ids: List[str] = msg["ids"]
@@ -441,37 +657,62 @@ class Controller:
     async def h_free_objects(self, conn, meta, msg):
         for hex_id in msg["ids"]:
             obj = self.objects.pop(hex_id, None)
-            if obj and obj.shm_name:
-                self.store_bytes_used -= obj.size
-                self.local_store.release(obj.shm_name, unlink=True)
+            if obj is None:
+                continue
+            for nid, name in obj.locations.items():
+                if nid == HEAD_NODE:
+                    self.store_bytes_used -= obj.size
+                    self.local_store.release(name, unlink=True)
+                else:
+                    node = self.nodes.get(nid)
+                    if node is not None and node.alive and node.conn is not None:
+                        asyncio.ensure_future(
+                            node.conn.send({"type": "free_object", "name": name})
+                        )
         return {"ok": True}
 
     # ------------------------------------------------------------ spilling
     def _maybe_spill(self):
+        """Head-node spill (remote arenas evict via their own LRU)."""
         if self.store_bytes_used <= self.object_store_memory:
             return
         candidates = sorted(
             (
                 (o.last_access, h, o)
                 for h, o in self.objects.items()
-                if o.status == "ready" and o.shm_name
+                if o.status == "ready" and HEAD_NODE in o.locations
             ),
         )
         for _, hex_id, obj in candidates:
             if self.store_bytes_used <= self.object_store_memory * 0.8:
                 break
             try:
-                path = self.local_store.spill(obj.shm_name, self.spill_dir)
+                path = self.local_store.spill(obj.locations[HEAD_NODE], self.spill_dir)
             except FileNotFoundError:
                 continue
             self.store_bytes_used -= obj.size
             obj.spilled_path = path
-            obj.shm_name = None
+            obj.spilled_node = HEAD_NODE
+            del obj.locations[HEAD_NODE]
             self._event("object_spilled", object=hex_id, size=obj.size)
 
     # --------------------------------------------------------------- tasks
     def _infeasible(self, demand: Dict[str, float]) -> Dict[str, float]:
-        return {k: v for k, v in demand.items() if self.total_resources.get(k, 0.0) < v}
+        """A demand is infeasible iff NO single alive node could ever fit it
+        (reference: `ClusterResourceScheduler::IsSchedulableOnNode`)."""
+        for n in self.nodes.values():
+            if n.alive and all(n.total.get(k, 0.0) >= v for k, v in demand.items()):
+                return {}
+        return dict(demand)
+
+    def _cluster_totals(self) -> Dict[str, float]:
+        total: Dict[str, float] = {}
+        for n in self.nodes.values():
+            if not n.alive:
+                continue
+            for k, v in n.total.items():
+                total[k] = total.get(k, 0.0) + v
+        return total
 
     async def h_submit_task(self, conn, meta, msg):
         spec: TaskSpec = cloudpickle.loads(msg["spec"])
@@ -479,8 +720,9 @@ class Controller:
         if bad:
             err = TaskError(
                 RuntimeError(
-                    f"Task {spec.name} demands {bad} but the cluster total is "
-                    f"{self.total_resources} — infeasible, will never schedule."
+                    f"Task {spec.name} demands {bad} but no node can fit it "
+                    f"(cluster total {self._cluster_totals()}) — infeasible, "
+                    f"will never schedule."
                 ),
                 "",
                 spec.name,
@@ -509,21 +751,23 @@ class Controller:
         else:
             self.ready_queue.append(pt)
 
-    def _resources_fit(self, demand: Dict[str, float]) -> bool:
-        return all(self.available.get(k, 0.0) + 1e-9 >= v for k, v in demand.items())
+    def _fits_node(self, node: NodeState, demand: Dict[str, float]) -> bool:
+        return node.alive and all(
+            node.available.get(k, 0.0) + 1e-9 >= v for k, v in demand.items()
+        )
 
-    def _acquire(self, demand: Dict[str, float]):
+    def _acquire(self, node: NodeState, demand: Dict[str, float]):
         for k, v in demand.items():
-            self.available[k] = self.available.get(k, 0.0) - v
+            node.available[k] = node.available.get(k, 0.0) - v
 
-    def _release(self, demand: Dict[str, float]):
+    def _release(self, node: NodeState, demand: Dict[str, float]):
         for k, v in demand.items():
-            self.available[k] = self.available.get(k, 0.0) + v
+            node.available[k] = node.available.get(k, 0.0) + v
 
-    def _idle_worker(self, need_tpu: bool = False) -> Optional[WorkerState]:
+    def _idle_worker(self, node_id: str, need_tpu: bool = False) -> Optional[WorkerState]:
         fallback = None
         for ws in self.workers.values():
-            if ws.state != IDLE:
+            if ws.state != IDLE or ws.node_id != node_id:
                 continue
             if need_tpu:
                 if ws.has_tpu:
@@ -535,29 +779,108 @@ class Controller:
                 fallback = ws
         return None if need_tpu else fallback
 
-    def _deps_payload(self, spec: TaskSpec) -> dict:
+    def _candidate_nodes(self, spec: TaskSpec) -> List[NodeState]:
+        """Order nodes per the task's scheduling strategy.
+
+        Reference analogs: `HybridSchedulingPolicy` (pack until threshold,
+        then least-utilized — `hybrid_scheduling_policy.h:50`),
+        `SpreadSchedulingPolicy`, `NodeAffinitySchedulingPolicy`.
+        """
+        alive = [n for n in self.nodes.values() if n.alive]
+        strat = spec.options.scheduling_strategy
+        if isinstance(strat, NodeAffinitySchedulingStrategy) and strat.node_id:
+            pinned = [n for n in alive if n.node_id == strat.node_id]
+            if not strat.soft:
+                return pinned
+            return pinned + [n for n in alive if n.node_id != strat.node_id]
+        if isinstance(strat, SpreadSchedulingStrategy):
+            # True round-robin: each spread decision starts one node further
+            # along, so consecutive tasks land on distinct nodes (reference:
+            # `SpreadSchedulingPolicy` round-robins over feasible nodes).
+            ordered = sorted(alive, key=lambda n: n.node_id)
+            self._spread_rr += 1
+            r = self._spread_rr % len(ordered) if ordered else 0
+            return ordered[r:] + ordered[:r]
+        # Hybrid default: pack in node-id order while below the utilization
+        # threshold, then least-utilized.
+        ordered = sorted(alive, key=lambda n: n.node_id)
+        packable = [n for n in ordered if n.utilization() < 0.8]
+        rest = sorted(
+            (n for n in ordered if n.utilization() >= 0.8),
+            key=lambda n: n.utilization(),
+        )
+        return packable + rest
+
+    def _deps_payload(self, spec: TaskSpec, node_id: str) -> dict:
         locs = {}
         for oid in spec.arg_refs:
             h = oid.hex()
-            locs[h] = self._location_payload(self.objects[h])
+            locs[h] = self._location_payload(self.objects[h], node_id)
         return locs
+
+    async def _dispatch(self, node: NodeState, ws: WorkerState, pt: PendingTask):
+        """Send a task to its granted worker, first materializing remote deps
+        on that worker's node (controller-directed pull)."""
+        spec = pt.spec
+        task_hex = spec.task_id.hex()
+        try:
+            await asyncio.gather(
+                *(self._ensure_local(node.node_id, oid.hex()) for oid in spec.arg_refs)
+            )
+        except Exception as e:  # noqa: BLE001
+            # A dep's every copy died mid-transfer: fail the task returns.
+            self.running.pop(task_hex, None)
+            was_actor = ws.state == ACTOR
+            ws.state = IDLE
+            ws.current_task = None
+            ws.actor_hex = None
+            self._release(node, ws.assigned)
+            ws.assigned = {}
+            err = TaskError(
+                RuntimeError(f"dependency transfer failed: {e}"), "", spec.name
+            )
+            if was_actor and spec.actor_id is not None:
+                astate = self.actors.get(spec.actor_id.hex())
+                if astate is not None:
+                    astate.init_error = err
+                    self._set_actor_state(astate, "dead")
+                    self._drain_actor_queue(astate, err)
+            for oid in spec.return_ids:
+                self._store_error_object(oid.hex(), err)
+            self._schedule()
+            return
+        msg_type = (
+            "create_actor"
+            if spec.task_type == TaskType.ACTOR_CREATION_TASK
+            else "execute_task"
+        )
+        await ws.conn.send(
+            {
+                "type": msg_type,
+                "spec": cloudpickle.dumps(spec),
+                "deps": self._deps_payload(spec, node.node_id),
+            }
+        )
+        self._event("task_dispatched", task=task_hex, worker=ws.worker_id,
+                     node=node.node_id)
 
     def _schedule(self):
         """Dispatch as many ready tasks as resources + workers allow.
 
-        Reference analog: `LocalTaskManager::ScheduleAndDispatchTasks`.
+        Reference analog: `ClusterTaskManager::ScheduleAndDispatchTasks` (node
+        pick) + `LocalTaskManager` (worker grant), collapsed into one pass.
         """
         made_progress = True
+        # node_id -> CPU workers wanted this pass; flushed bounded below so a
+        # task waiting out a worker boot doesn't fork one per scheduling event.
+        spawn_wanted: Dict[str, int] = {}
         while made_progress and self.ready_queue:
             made_progress = False
             # Bounded head scan: dispatch FIFO, skipping over at most a small
             # window of blocked tasks (so a TPU task at the head can't starve
             # CPU tasks behind it, but a long queue isn't rescanned per event).
             scan = min(len(self.ready_queue), 64)
-            no_idle_worker = False
             for _ in range(scan):
-                if no_idle_worker:
-                    break
                 pt = self.ready_queue.popleft()
                 spec = pt.spec
                 if spec.task_id.hex() in self.cancelled:
@@ -565,52 +888,76 @@ class Controller:
                     made_progress = True
                     continue
                 demand = spec.resources
-                if not self._resources_fit(demand):
-                    self.ready_queue.append(pt)
-                    continue
                 need_tpu = demand.get("TPU", 0) > 0
-                ws = self._idle_worker(need_tpu)
-                if ws is None:
+                chosen: Optional[Tuple[NodeState, WorkerState]] = None
+                spawn_on: Optional[NodeState] = None
+                # Spread/affinity COMMIT to the placement-correct node (spawn
+                # a worker there and wait); hybrid falls through to any node
+                # with an idle worker — packing tolerates the substitution.
+                commit_first_fit = isinstance(
+                    spec.options.scheduling_strategy,
+                    (SpreadSchedulingStrategy, NodeAffinitySchedulingStrategy),
+                )
+                if pt.pinned_node is not None:
+                    pin = self.nodes.get(pt.pinned_node)
+                    candidates = [pin] if pin is not None and pin.alive else None
+                    if candidates is None:
+                        pt.pinned_node = None  # pinned node died — re-pick
+                        candidates = self._candidate_nodes(spec)
+                else:
+                    candidates = self._candidate_nodes(spec)
+                for node in candidates:
+                    if not self._fits_node(node, demand):
+                        continue
+                    ws = self._idle_worker(node.node_id, need_tpu)
+                    if ws is None:
+                        spawn_on = spawn_on or node
+                        if commit_first_fit:
+                            pt.pinned_node = node.node_id
+                            break
+                        continue
+                    chosen = (node, ws)
+                    break
+                if chosen is None:
                     self.ready_queue.append(pt)
-                    if need_tpu:
-                        self._spawn_worker(tpu=True)
-                    else:
-                        # No idle CPU worker — scanning further is pointless.
-                        no_idle_worker = True
+                    if spawn_on is not None:
+                        if need_tpu:
+                            self._spawn_worker(tpu=True, node=spawn_on)
+                        else:
+                            spawn_wanted[spawn_on.node_id] = (
+                                spawn_wanted.get(spawn_on.node_id, 0) + 1
+                            )
                     continue
-                self._acquire(demand)
+                node, ws = chosen
+                self._acquire(node, demand)
                 ws.assigned = dict(demand)
                 task_hex = spec.task_id.hex()
                 self.running[task_hex] = (ws.worker_id, pt)
                 if spec.task_type == TaskType.ACTOR_CREATION_TASK:
                     ws.state = ACTOR
                     ws.actor_hex = spec.actor_id.hex()
-                    asyncio.ensure_future(
-                        ws.conn.send(
-                            {
-                                "type": "create_actor",
-                                "spec": cloudpickle.dumps(spec),
-                                "deps": self._deps_payload(spec),
-                            }
-                        )
-                    )
                 else:
                     ws.state = BUSY
                     ws.current_task = task_hex
-                    asyncio.ensure_future(
-                        ws.conn.send(
-                            {
-                                "type": "execute_task",
-                                "spec": cloudpickle.dumps(spec),
-                                "deps": self._deps_payload(spec),
-                            }
-                        )
-                    )
-                self._event("task_dispatched", task=task_hex, worker=ws.worker_id)
+                asyncio.ensure_future(self._dispatch(node, ws, pt))
                 made_progress = True
-        # Top the pool up to the queue depth (reference analog: worker_pool
-        # PrestartWorkers on backlog hints, `worker_pool.h:354`).
-        starting = self._spawning + sum(1 for w in self.workers.values() if w.state == STARTING)
+        # Flush per-node spawn demand, net of workers already booting there
+        # (reference analog: worker_pool PrestartWorkers on backlog hints,
+        # `worker_pool.h:354` — backlog-sized, not one-per-event).
+        for node_id, wanted in spawn_wanted.items():
+            node = self.nodes.get(node_id)
+            if node is None or not node.alive:
+                continue
+            booting = node.spawning + sum(
+                1 for w in self.workers.values()
+                if w.state == STARTING and w.node_id == node_id
+            )
+            for _ in range(max(0, min(wanted - booting, 4))):
+                self._spawn_worker(node=node)
+        # Top the head pool up to the queue depth.
+        starting = self.head.spawning + sum(
+            1 for w in self.workers.values() if w.state == STARTING
+        )
         cpu_backlog = sum(1 for pt in self.ready_queue if pt.spec.resources.get("TPU", 0) == 0)
         deficit = cpu_backlog - starting
         for _ in range(max(0, min(deficit, 6))):
@@ -625,10 +972,13 @@ class Controller:
         task_hex = msg["task"]
         self.running.pop(task_hex, None)
         ws = self.workers.get(meta["worker_id"]) if meta["worker_id"] else None
+        node_id = ws.node_id if ws is not None else HEAD_NODE
         if ws is not None and ws.state == BUSY:
             ws.state = IDLE
             ws.current_task = None
-            self._release(ws.assigned)
+            node = self.nodes.get(ws.node_id)
+            if node is not None:
+                self._release(node, ws.assigned)
             ws.assigned = {}
         if ws is not None and ws.actor_hex:
             astate = self.actors.get(ws.actor_hex)
@@ -638,7 +988,9 @@ class Controller:
             if item.get("inline") is not None:
                 self._mark_ready(item["id"], inline=item["inline"], size=len(item["inline"]))
             else:
-                self._mark_ready(item["id"], shm_name=item["name"], size=item["size"])
+                self._mark_ready(
+                    item["id"], shm_name=item["name"], size=item["size"], node_id=node_id
+                )
         self._event("task_done", task=task_hex)
         self._schedule()
         return None
@@ -683,8 +1035,8 @@ class Controller:
             astate = ActorState(actor_hex=actor_hex, spec=None, state="dead")
             astate.init_error = TaskError(
                 RuntimeError(
-                    f"Actor {spec.name} demands {bad} but the cluster total is "
-                    f"{self.total_resources} — infeasible."
+                    f"Actor {spec.name} demands {bad} but no node can fit it "
+                    f"(cluster total {self._cluster_totals()}) — infeasible."
                 ),
                 "",
                 spec.name,
@@ -718,20 +1070,35 @@ class Controller:
             for oid in spec.return_ids:
                 self._store_error_object(oid.hex(), err)
             return
+        try:
+            await asyncio.gather(
+                *(self._ensure_local(ws.node_id, oid.hex()) for oid in spec.arg_refs)
+            )
+        except Exception as e:  # noqa: BLE001
+            err = TaskError(
+                RuntimeError(f"dependency transfer failed: {e}"), "", spec.name
+            )
+            for oid in spec.return_ids:
+                self._store_error_object(oid.hex(), err)
+            return
         await ws.conn.send(
             {
                 "type": "execute_actor_task",
                 "spec": cloudpickle.dumps(spec),
-                "deps": self._deps_payload_safe(spec),
+                "deps": self._deps_payload_safe(spec, ws.node_id),
             }
         )
 
-    def _deps_payload_safe(self, spec: TaskSpec) -> dict:
+    def _deps_payload_safe(self, spec: TaskSpec, node_id: str) -> dict:
         locs = {}
         for oid in spec.arg_refs:
             h = oid.hex()
             obj = self.objects.get(h)
-            locs[h] = self._location_payload(obj) if obj and obj.status == "ready" else {"status": "pending"}
+            locs[h] = (
+                self._location_payload(obj, node_id)
+                if obj and obj.status == "ready"
+                else {"status": "pending"}
+            )
         return locs
 
     async def h_submit_actor_task(self, conn, meta, msg):
@@ -797,10 +1164,22 @@ class Controller:
                 del self.named_actors[key]
         ws = self.workers.get(astate.worker_id)
         if ws is not None:
-            proc = self._worker_procs.get(ws.worker_id)
-            if proc is not None and proc.poll() is None:
-                proc.terminate()
+            self._terminate_worker(ws)
         return {"ok": True}
+
+    def _terminate_worker(self, ws: WorkerState):
+        """SIGTERM a worker wherever it lives (head: direct child; remote:
+        via its node agent, since a busy worker won't read an exit message)."""
+        proc = self._worker_procs.get(ws.worker_id)
+        if proc is not None:
+            if proc.poll() is None:
+                proc.terminate()
+            return
+        node = self.nodes.get(ws.node_id)
+        if node is not None and node.conn is not None and node.alive:
+            asyncio.ensure_future(
+                node.conn.send({"type": "kill_worker", "worker_id": ws.worker_id})
+            )
 
     async def h_get_named_actor(self, conn, meta, msg):
         key = (msg.get("namespace", "default"), msg["name"])
@@ -818,8 +1197,9 @@ class Controller:
         prev_state = ws.state
         ws.state = DEAD
         if ws.assigned:
-            if not ws.blocked:
-                self._release(ws.assigned)
+            node = self.nodes.get(ws.node_id)
+            if not ws.blocked and node is not None:
+                self._release(node, ws.assigned)
             ws.assigned = {}
         self._worker_procs.pop(worker_id, None)
         if prev_state == BUSY and ws.current_task:
@@ -884,12 +1264,37 @@ class Controller:
                         self._store_error_object(oid.hex(), err)
             astate.inflight.clear()
 
+    # ---------------------------------------------------------- node death
+    async def _on_node_death(self, node_id: str):
+        """A node agent's connection dropped (reference analog: GCS node
+        death pubsub after `GcsHealthCheckManager` misses)."""
+        node = self.nodes.get(node_id)
+        if node is None or not node.alive:
+            return
+        node.alive = False
+        self._fetch_conns.pop(node_id, None)
+        self._event("node_died", node=node_id)
+        # Its workers are dying with it (PDEATHSIG); process them now so
+        # running tasks retry immediately rather than on socket timeout.
+        for ws in list(self.workers.values()):
+            if ws.node_id == node_id and ws.state != DEAD:
+                await self._on_worker_death(ws.worker_id)
+        # Objects whose only copy lived there are lost (until lineage
+        # reconstruction re-executes their creators).
+        for hex_id, obj in self.objects.items():
+            obj.locations.pop(node_id, None)
+            if obj.spilled_path is not None and obj.spilled_node == node_id:
+                obj.spilled_path = None
+        self._schedule()
+
     # ------------------------------------------------------------ blocking
     async def h_worker_blocked(self, conn, meta, msg):
         ws = self.workers.get(msg["worker_id"])
         if ws is not None and not ws.blocked:
             ws.blocked = True
-            self._release(ws.assigned)
+            node = self.nodes.get(ws.node_id)
+            if node is not None:
+                self._release(node, ws.assigned)
             self._schedule()
         return None
 
@@ -897,7 +1302,9 @@ class Controller:
         ws = self.workers.get(msg["worker_id"])
         if ws is not None and ws.blocked:
             ws.blocked = False
-            self._acquire(ws.assigned)
+            node = self.nodes.get(ws.node_id)
+            if node is not None:
+                self._acquire(node, ws.assigned)
         return None
 
     # ------------------------------------------------------------- cancel
@@ -907,9 +1314,9 @@ class Controller:
         entry = self.running.get(task_hex)
         if entry is not None and msg.get("force"):
             worker_id, _ = entry
-            proc = self._worker_procs.get(worker_id)
-            if proc is not None and proc.poll() is None:
-                proc.terminate()
+            ws = self.workers.get(worker_id)
+            if ws is not None:
+                self._terminate_worker(ws)
         # Pending-in-queue tasks are culled in _schedule.
         pt = self.waiting_tasks.pop(task_hex, None)
         if pt is not None:
@@ -919,53 +1326,124 @@ class Controller:
 
     # ---------------------------------------------------- placement groups
     async def h_create_pg(self, conn, meta, msg):
+        """Per-bundle placement onto nodes (reference analog:
+        `BundleSchedulingPolicy` PACK/SPREAD/STRICT_* in
+        `bundle_scheduling_policy.cc`). Reserves each bundle against a
+        concrete node; bundle->node mapping drives bundle_index scheduling."""
         bundles: List[Dict[str, float]] = msg["bundles"]
         strategy = msg["strategy"]
-        feasible = True
-        if strategy == "STRICT_SPREAD" and len(bundles) > 1:
-            feasible = False  # single-node cluster cannot strictly spread
-        total: Dict[str, float] = {}
-        for b in bundles:
-            for k, v in b.items():
-                total[k] = total.get(k, 0.0) + v
-        if not all(self.total_resources.get(k, 0.0) >= v for k, v in total.items()):
-            feasible = False
+        placement = self._place_bundles(bundles, strategy)
+        feasible = placement is not None
         if feasible:
-            self._acquire(total)
+            for b, nid in zip(bundles, placement):
+                self._acquire(self.nodes[nid], b)
         self.pgs[msg["id"]] = {
             "bundles": bundles,
             "strategy": strategy,
             "name": msg.get("name", ""),
             "ready": feasible,
-            "reserved": total if feasible else {},
+            "bundle_nodes": placement or [],
         }
         return {"ok": feasible}
+
+    def _place_bundles(
+        self, bundles: List[Dict[str, float]], strategy: str
+    ) -> Optional[List[str]]:
+        """Map bundles to nodes per the PG strategy; None if infeasible.
+        Works against a scratch copy of availability so partial placements
+        never leak reservations."""
+        alive = [n for n in self.nodes.values() if n.alive]
+        avail = {n.node_id: dict(n.available) for n in alive}
+
+        def fits(nid: str, b: Dict[str, float]) -> bool:
+            a = avail[nid]
+            return all(a.get(k, 0.0) + 1e-9 >= v for k, v in b.items())
+
+        def take(nid: str, b: Dict[str, float]):
+            a = avail[nid]
+            for k, v in b.items():
+                a[k] = a.get(k, 0.0) - v
+
+        placement: List[str] = []
+        if strategy in ("PACK", "STRICT_PACK"):
+            order = sorted(avail, key=lambda nid: (nid != HEAD_NODE, nid))
+            for b in bundles:
+                chosen = None
+                for nid in (placement[-1:] if strategy == "STRICT_PACK" and placement else []) + order:
+                    if fits(nid, b):
+                        chosen = nid
+                        break
+                if chosen is None:
+                    return None
+                if strategy == "STRICT_PACK" and placement and chosen != placement[0]:
+                    return None
+                take(chosen, b)
+                placement.append(chosen)
+            if strategy == "STRICT_PACK" and len(set(placement)) > 1:
+                return None
+            return placement
+        # SPREAD / STRICT_SPREAD: round-robin across distinct nodes.
+        used: Set[str] = set()
+        for b in bundles:
+            fresh = [nid for nid in sorted(avail) if nid not in used and fits(nid, b)]
+            any_fit = [nid for nid in sorted(avail) if fits(nid, b)]
+            if strategy == "STRICT_SPREAD":
+                if not fresh:
+                    return None  # needs a distinct node per bundle
+                chosen = fresh[0]
+            else:
+                chosen = fresh[0] if fresh else (any_fit[0] if any_fit else None)
+                if chosen is None:
+                    return None
+            take(chosen, b)
+            placement.append(chosen)
+            used.add(chosen)
+        return placement
 
     async def h_pg_ready(self, conn, meta, msg):
         pg = self.pgs.get(msg["id"])
         return {"ready": bool(pg and pg["ready"])}
 
+    async def h_pg_table(self, conn, meta, msg):
+        pg = self.pgs.get(msg["id"])
+        if pg is None:
+            return {"pg": None}
+        return {"pg": {k: pg[k] for k in ("bundles", "strategy", "name", "ready", "bundle_nodes")}}
+
     async def h_remove_pg(self, conn, meta, msg):
         pg = self.pgs.pop(msg["id"], None)
         if pg and pg["ready"]:
-            self._release(pg["reserved"])
+            for b, nid in zip(pg["bundles"], pg["bundle_nodes"]):
+                node = self.nodes.get(nid)
+                if node is not None and node.alive:
+                    self._release(node, b)
             self._schedule()
         return {"ok": True}
 
     # -------------------------------------------------------------- state
     async def h_cluster_resources(self, conn, meta, msg):
-        return {"total": dict(self.total_resources), "available": dict(self.available)}
+        total = self._cluster_totals()
+        avail: Dict[str, float] = {}
+        for n in self.nodes.values():
+            if not n.alive:
+                continue
+            for k, v in n.available.items():
+                avail[k] = avail.get(k, 0.0) + v
+        return {"total": total, "available": avail}
 
     async def h_nodes(self, conn, meta, msg):
         return {
             "nodes": [
                 {
-                    "NodeID": "node0",
-                    "Alive": True,
-                    "Resources": dict(self.total_resources),
+                    "NodeID": n.node_id,
+                    "Alive": n.alive,
+                    "Resources": dict(n.total),
+                    "Available": dict(n.available),
                     "NodeManagerAddress": "127.0.0.1",
-                    "object_store_memory": self.object_store_memory,
+                    "object_store_memory": n.object_store_memory
+                    or self.object_store_memory,
                 }
+                for n in self.nodes.values()
             ]
         }
 

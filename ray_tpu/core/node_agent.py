@@ -1,0 +1,248 @@
+"""Node agent — per-node daemon for multi-node clusters.
+
+Reference analog: the raylet (`src/ray/raylet/node_manager.cc`) + the node's
+plasma store + the object-manager push/pull plane
+(`src/ray/object_manager/{pull,push}_manager.h`). Redesign (TPU-first): the
+agent owns no scheduler state — the controller (head) schedules globally and
+directs transfers; the agent's jobs are mechanical:
+
+  * register with the controller (`register_node`) announcing resources —
+    the `NodeManager` handshake (`node_manager.cc:1765` lease protocol's
+    node side);
+  * spawn/reap worker processes on this node when the controller asks
+    (reference: `WorkerPool`, `worker_pool.h:156`);
+  * own this node's shm arena (plasma role) — workers on the node attach it;
+  * serve object fetches to peer nodes and pull objects from peers on
+    controller command (pull/push manager roles).
+
+Workers die with the agent (PR_SET_PDEATHSIG) so killing the agent is a
+faithful "node death" for chaos tests.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ctypes
+import json
+import os
+import signal
+import subprocess
+import sys
+import traceback
+from typing import Dict, Optional
+
+from . import store
+from .rpc import Connection
+
+
+def _set_pdeathsig():
+    """Linux: kill this process when the parent (agent) dies."""
+    try:
+        libc = ctypes.CDLL("libc.so.6", use_errno=True)
+        PR_SET_PDEATHSIG = 1
+        libc.prctl(PR_SET_PDEATHSIG, signal.SIGKILL)
+    except Exception:  # noqa: BLE001
+        pass
+
+
+class NodeAgent:
+    def __init__(
+        self,
+        node_id: str,
+        controller_address: str,
+        resources: Dict[str, float],
+        session_dir: str,
+        object_store_memory: Optional[int] = None,
+    ):
+        self.node_id = node_id
+        self.controller_address = controller_address
+        self.resources = resources
+        self.session_dir = session_dir
+        self.object_store_memory = object_store_memory or (1 << 30)
+        self.local_store: store.LocalStore = store.LocalStore()
+        self.conn: Optional[Connection] = None
+        self.fetch_port = 0
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._worker_procs: Dict[str, subprocess.Popen] = {}
+        self._peer_conns: Dict[str, Connection] = {}
+        self._shutdown = asyncio.Event()
+
+    # ------------------------------------------------------------ lifecycle
+    async def start(self):
+        store.set_session_tag(str(os.getpid()))
+        self.local_store = store.make_store(
+            create_arena=True, arena_capacity=self.object_store_memory
+        )
+        self._server = await asyncio.start_server(
+            self._on_peer_connection, host="127.0.0.1", port=0
+        )
+        self.fetch_port = self._server.sockets[0].getsockname()[1]
+
+        host, port = self.controller_address.rsplit(":", 1)
+        reader, writer = await asyncio.open_connection(host, int(port))
+        self.conn = Connection(
+            reader, writer, on_push=self._on_controller_push, on_close=self._on_controller_close
+        )
+        self.conn.start()
+        resp = await self.conn.request(
+            {
+                "type": "register_node",
+                "node_id": self.node_id,
+                "resources": self.resources,
+                "fetch_addr": f"127.0.0.1:{self.fetch_port}",
+                "session_tag": store.SESSION_TAG,
+                "object_store_memory": self.object_store_memory,
+                "pid": os.getpid(),
+            },
+            timeout=15,
+        )
+        if not (resp or {}).get("ok"):
+            raise RuntimeError(f"node registration rejected: {resp}")
+
+    async def serve_forever(self):
+        await self._shutdown.wait()
+        self._kill_workers()
+        if self._server:
+            self._server.close()
+        arena = getattr(self.local_store, "arena", None)
+        self.local_store.close_all(unlink=False)
+        if arena is not None:
+            arena.unlink()
+
+    def _kill_workers(self):
+        for proc in self._worker_procs.values():
+            if proc.poll() is None:
+                proc.terminate()
+
+    async def _on_controller_close(self):
+        # Controller gone → the session is over.
+        self._shutdown.set()
+
+    # ------------------------------------------------- controller messages
+    async def _on_controller_push(self, msg: dict):
+        try:
+            mtype = msg["type"]
+            if mtype == "spawn_worker":
+                self._spawn_worker(msg["worker_id"], tpu=bool(msg.get("tpu")))
+            elif mtype == "pull_object":
+                # Long transfer — detach so other commands keep flowing.
+                asyncio.ensure_future(self._handle_pull(msg))
+            elif mtype == "free_object":
+                self.local_store.release(msg["name"], unlink=True)
+            elif mtype == "kill_worker":
+                proc = self._worker_procs.get(msg["worker_id"])
+                if proc is not None and proc.poll() is None:
+                    proc.terminate()
+            elif mtype == "exit":
+                self._shutdown.set()
+        except Exception:  # noqa: BLE001
+            traceback.print_exc()
+
+    def _spawn_worker(self, worker_id: str, tpu: bool = False):
+        env = dict(os.environ)
+        pkg_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        env["RAY_TPU_WORKER_ID"] = worker_id
+        env["RAY_TPU_ADDRESS"] = self.controller_address
+        env["RAY_TPU_SESSION_DIR"] = self.session_dir
+        env["RAY_TPU_SESSION_TAG"] = store.SESSION_TAG  # this node's arena
+        env["RAY_TPU_NODE_ID"] = self.node_id
+        if tpu:
+            env["RAY_TPU_WORKER_TPU"] = "1"
+        else:
+            env["RAY_TPU_WORKER_TPU"] = "0"
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            if env.get("JAX_PLATFORMS", "").lower() in ("", "axon", "tpu"):
+                env["JAX_PLATFORMS"] = "cpu"
+        log_path = os.path.join(self.session_dir, f"worker-{worker_id}.log")
+        log_f = open(log_path, "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.worker_main"],
+            env=env,
+            stdout=log_f,
+            stderr=subprocess.STDOUT,
+            cwd=pkg_root,
+            preexec_fn=_set_pdeathsig,
+        )
+        self._worker_procs[worker_id] = proc
+
+    # ------------------------------------------------------------ transfer
+    async def _peer(self, addr: str) -> Connection:
+        conn = self._peer_conns.get(addr)
+        if conn is not None and not conn._closed:
+            return conn
+        host, port = addr.rsplit(":", 1)
+        reader, writer = await asyncio.open_connection(host, int(port))
+        conn = Connection(reader, writer)
+        conn.start()
+        self._peer_conns[addr] = conn
+        return conn
+
+    async def _handle_pull(self, msg: dict):
+        """Fetch object bytes from a peer node into the local arena.
+        Reference analog: `PullManager` bundle fetch (`pull_manager.h:52`)."""
+        req_id = msg.get("req_id")
+        hex_id = msg["id"]
+        try:
+            peer = await self._peer(msg["addr"])
+            fetch = {"type": "fetch_object"}
+            if msg.get("name"):
+                fetch["name"] = msg["name"]
+            else:
+                fetch["path"] = msg["path"]
+            resp = await peer.request(fetch, timeout=60)
+            if resp.get("error"):
+                raise RuntimeError(resp["error"])
+            name, size = self.local_store.create_raw(hex_id, resp["data"])
+            result = {"ok": True, "name": name, "size": size}
+        except Exception as e:  # noqa: BLE001
+            result = {"ok": False, "error": repr(e)}
+        if req_id is not None:
+            await self.conn.respond(req_id, result)
+
+    # ------------------------------------------------------- peer fetches
+    async def _on_peer_connection(self, reader, writer):
+        conn = Connection(reader, writer)
+
+        async def on_push(msg: dict):
+            if msg.get("type") != "fetch_object" or msg.get("req_id") is None:
+                return
+            try:
+                if msg.get("name"):
+                    data = self.local_store.read_raw(msg["name"])
+                else:
+                    with open(msg["path"], "rb") as f:
+                        data = f.read()
+                await conn.respond(msg["req_id"], {"data": data})
+            except Exception as e:  # noqa: BLE001
+                await conn.respond(msg["req_id"], {"error": repr(e)})
+
+        conn.on_push = on_push
+        conn.start()
+
+
+async def run_agent(args: dict):
+    agent = NodeAgent(
+        node_id=args["node_id"],
+        controller_address=args["address"],
+        resources=args.get("resources", {}),
+        session_dir=args["session_dir"],
+        object_store_memory=args.get("object_store_memory"),
+    )
+    await agent.start()
+    print(f"RAY_TPU_NODE_READY={agent.node_id}", flush=True)
+    await agent.serve_forever()
+
+
+def main():
+    args = json.loads(os.environ["RAY_TPU_NODE_ARGS"])
+    try:
+        asyncio.run(run_agent(args))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

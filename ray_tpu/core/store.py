@@ -105,6 +105,31 @@ class LocalStore:
         name, size = self.create_packed(object_hex, payload, buffers)
         return name, None, size
 
+    # ------------------------------------------------- raw bytes (transfer)
+    def create_raw(self, object_hex: str, data: bytes) -> Tuple[str, int]:
+        """Write an already-packed frame (received from a peer node)."""
+        name = shm_name_for(object_hex)
+        size = len(data)
+        try:
+            seg = shared_memory.SharedMemory(name=name, create=True, size=max(size, 1))
+        except FileExistsError:
+            return name, size  # a concurrent pull already materialized it
+        _untrack(seg)
+        seg.buf[:size] = data
+        with self._lock:
+            self._open[name] = seg
+        return name, size
+
+    def read_raw(self, shm_name: str) -> bytes:
+        """Packed frame bytes of a local object (for serving a peer's pull)."""
+        with self._lock:
+            seg = self._open.get(shm_name)
+            if seg is None:
+                seg = shared_memory.SharedMemory(name=shm_name)
+                _untrack(seg)
+                self._open[shm_name] = seg
+        return bytes(seg.buf)
+
     # -------------------------------------------------------------- reading
     def read(self, shm_name: str) -> Any:
         """Attach and deserialize. Numpy arrays are zero-copy views over the
@@ -271,6 +296,42 @@ class ArenaStore:
 
     def read_from_file(self, path: str) -> Any:
         return self.fallback.read_from_file(path)
+
+    # ------------------------------------------------- raw bytes (transfer)
+    def create_raw(self, object_hex: str, data: bytes) -> Tuple[str, int]:
+        size = len(data)
+        try:
+            existing = self.arena.get(object_hex)
+        except BlockingIOError:
+            existing = None  # another writer mid-pull; controller dedups
+        if existing is not None:
+            existing.release()
+            self.arena.release(object_hex)
+            return ARENA_PREFIX + object_hex, size
+        try:
+            view = self.arena.create(object_hex, size)
+        except MemoryError:
+            return self.fallback.create_raw(object_hex, data)
+        view[:size] = data
+        view.release()
+        self.arena.seal(object_hex)
+        return ARENA_PREFIX + object_hex, size
+
+    def read_raw(self, name: str) -> bytes:
+        if not name.startswith(ARENA_PREFIX):
+            return self.fallback.read_raw(name)
+        hex_id = name[len(ARENA_PREFIX):]
+        view = self.arena.get(hex_id)
+        if view is None:
+            raise FileNotFoundError(f"object {hex_id} not in arena")
+        try:
+            return bytes(view)
+        finally:
+            try:
+                view.release()
+                self.arena.release(hex_id)
+            except BufferError:
+                pass
 
     # ------------------------------------------------------------- lifetime
     def spill(self, name: str, spill_dir: str) -> str:

@@ -55,6 +55,7 @@ class WorkerProcess:
                 "worker_id": self.worker_id,
                 "pid": os.getpid(),
                 "has_tpu": os.environ.get("RAY_TPU_WORKER_TPU") == "1",
+                "node_id": os.environ.get("RAY_TPU_NODE_ID", "node0"),
             }
         )
 
