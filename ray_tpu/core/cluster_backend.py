@@ -128,6 +128,15 @@ class ClusterBackend(RuntimeBackend):
         # (RAY_TPU_SESSION_TAG from the agent) and must keep attaching there.
         if result.get("session_tag") and not os.environ.get("RAY_TPU_SESSION_TAG"):
             store.set_session_tag(result["session_tag"])
+        # Distributed ref counting: batch local ObjectRef 0↔1 transitions to
+        # the controller (reference: `reference_count.h` borrower protocol).
+        from .ref_tracker import TRACKER
+
+        def _flush_refs(add, release):
+            if self.conn is not None and not self.conn._closed:
+                self._send({"type": "update_refs", "add": add, "release": release})
+
+        TRACKER.set_flusher(_flush_refs)
         # With the tag known, upgrade to the native arena store if this
         # session's controller created one (falls back silently otherwise).
         self.local_store = store.make_store()
@@ -153,10 +162,21 @@ class ClusterBackend(RuntimeBackend):
         oid = ObjectID.of(TaskID.from_hex(owner_task_hex), 2**24 + idx)
         hex_id = oid.hex()
         shm_name, inline, size = self.local_store.put(hex_id, value)
+        contains = serialization.last_contained_refs()
         if inline is not None:
-            self._request({"type": "put_inline", "id": hex_id, "data": inline})
+            self._request(
+                {"type": "put_inline", "id": hex_id, "data": inline, "contains": contains}
+            )
         else:
-            self._request({"type": "register_object", "id": hex_id, "name": shm_name, "size": size})
+            self._request(
+                {
+                    "type": "register_object",
+                    "id": hex_id,
+                    "name": shm_name,
+                    "size": size,
+                    "contains": contains,
+                }
+            )
         return ObjectRef(oid, self.client_address)
 
     # ----------------------------------------------------------------- get
@@ -285,6 +305,9 @@ class ClusterBackend(RuntimeBackend):
 
     # ------------------------------------------------------------ shutdown
     def shutdown(self) -> None:
+        from .ref_tracker import TRACKER
+
+        TRACKER.set_flusher(None)
         if self.role == "driver":
             try:
                 self._request({"type": "shutdown"}, timeout=2)

@@ -109,10 +109,28 @@ class ObjectState:
     events: List[asyncio.Event] = field(default_factory=list)
     # Tasks blocked on this object (by task hex).
     dependents: Set[str] = field(default_factory=set)
+    # --- distributed refcount (reference: `reference_count.h:39-52`) ---
+    holders: Set[int] = field(default_factory=set)  # conn ids with live refs
+    ever_held: bool = False
+    pinned: int = 0          # queued/running tasks using this as an arg
+    recon_attempts: int = 0  # lineage re-executions tried for this object
+    expected: bool = False   # a submitted task will produce this (return id)
+    gc_at: float = 0.0       # earliest sweep time once GC-eligible
+    # ObjectRefs nested in this value's bytes: pinned for the container's
+    # lifetime (reference: `ReferenceCounter::AddNestedObjectIds`).
+    contains: List[str] = field(default_factory=list)
 
     @property
     def shm_name(self) -> Optional[str]:  # head-node name (spill path compat)
         return self.locations.get(HEAD_NODE)
+
+    def is_lost(self) -> bool:
+        return (
+            self.status == "ready"
+            and self.inline is None
+            and not self.locations
+            and self.spilled_path is None
+        )
 
 
 @dataclass
@@ -182,6 +200,15 @@ class Controller:
         self._fetch_conns: Dict[str, Connection] = {}
         self._spread_rr = 0
 
+        # Lineage: creating TaskSpec per task, enabling lost-object
+        # re-execution (reference: `ObjectRecoveryManager::RecoverObject`,
+        # `object_recovery_manager.cc:22`; ObjectID encodes TaskID so the
+        # lookup is free — `common/id.h:272` property kept by ids.py).
+        self.lineage: Dict[str, TaskSpec] = {}
+        self._lineage_cap = 20_000
+        self._conn_counter = itertools.count(1)
+        self._gc_candidates: Set[str] = set()
+
         self.objects: Dict[str, ObjectState] = {}
         self.workers: Dict[str, WorkerState] = {}
         self.actors: Dict[str, ActorState] = {}
@@ -215,6 +242,7 @@ class Controller:
         self.port = self._server.sockets[0].getsockname()[1]
         for _ in range(self._min_workers):
             self._spawn_worker()
+        asyncio.ensure_future(self._gc_loop())
 
     async def serve_forever(self):
         await self._shutdown_event.wait()
@@ -303,7 +331,7 @@ class Controller:
     # ---------------------------------------------------------- connection
     async def _on_connection(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         conn = Connection(reader, writer)
-        meta = {"kind": None, "worker_id": None}
+        meta = {"kind": None, "worker_id": None, "conn_id": next(self._conn_counter)}
 
         async def on_push(msg: dict):
             try:
@@ -343,6 +371,16 @@ class Controller:
             await run()
 
     async def _on_disconnect(self, conn: Connection, meta: dict):
+        # A dead process's refs die with it (reference: borrower death
+        # detection via pubsub channel close).
+        conn_id = meta.get("conn_id")
+        if conn_id is not None:
+            for hex_id in [
+                h for h, o in self.objects.items() if conn_id in o.holders
+            ]:
+                obj = self.objects[hex_id]
+                obj.holders.discard(conn_id)
+                self._maybe_gc(hex_id)
         if meta["kind"] == "worker":
             await self._on_worker_death(meta["worker_id"])
         elif meta["kind"] == "node":
@@ -432,10 +470,16 @@ class Controller:
         shm_name: Optional[str] = None,
         size: int = 0,
         node_id: str = HEAD_NODE,
+        contains: Optional[List[str]] = None,
     ):
         obj = self._obj(hex_id)
         obj.status = "ready"
         obj.inline = inline
+        if contains and not obj.contains:  # first registration only (a
+            # reconstruction re-run re-reports the same nested ids)
+            obj.contains = list(contains)
+            for h in obj.contains:
+                self._obj(h).pinned += 1
         if shm_name:
             obj.locations[node_id] = shm_name
         obj.size = size
@@ -455,6 +499,7 @@ class Controller:
                     self.ready_queue.append(pt)
         obj.dependents.clear()
         self._maybe_spill()
+        self._maybe_gc(hex_id)  # refs may have been dropped while pending
         self._schedule()
 
     def _store_error_object(self, hex_id: str, err: TaskError):
@@ -574,44 +619,62 @@ class Controller:
             return {"error": repr(e)}
 
     async def h_put_inline(self, conn, meta, msg):
-        self._mark_ready(msg["id"], inline=msg["data"], size=len(msg["data"]))
+        self._mark_ready(
+            msg["id"], inline=msg["data"], size=len(msg["data"]),
+            contains=msg.get("contains"),
+        )
         return {"ok": True}
 
     async def h_register_object(self, conn, meta, msg):
         self._mark_ready(
             msg["id"], shm_name=msg["name"], size=msg["size"],
             node_id=meta.get("node_id") or HEAD_NODE,
+            contains=msg.get("contains"),
         )
         return {"ok": True}
+
+    async def _wait_ready(self, obj: ObjectState, deadline: Optional[float]) -> bool:
+        """Wait for an object's next readiness event (shared deadline across
+        attempts). _mark_ready clears the event list; on timeout we remove
+        ourselves so never-produced objects don't accumulate dead events."""
+        ev = asyncio.Event()
+        obj.events.append(ev)
+        try:
+            if deadline is None:
+                await ev.wait()
+            else:
+                await asyncio.wait_for(ev.wait(), max(0.0, deadline - time.monotonic()))
+            return True
+        except asyncio.TimeoutError:
+            return False
+        finally:
+            if ev in obj.events:
+                obj.events.remove(ev)
 
     async def h_get_object(self, conn, meta, msg):
         hex_id = msg["id"]
         timeout = msg.get("timeout")
+        deadline = None if timeout is None else time.monotonic() + timeout
         node_id = meta.get("node_id") or HEAD_NODE
         obj = self._obj(hex_id)
-        if obj.status != "ready":
-            ev = asyncio.Event()
-            obj.events.append(ev)
-            try:
-                if timeout is None:
-                    await ev.wait()
-                else:
-                    await asyncio.wait_for(ev.wait(), timeout)
-            except asyncio.TimeoutError:
-                return {"status": "timeout"}
-            finally:
-                # _mark_ready clears the list; on timeout remove ourselves so
-                # never-produced objects don't accumulate dead events.
-                if ev in obj.events:
-                    obj.events.remove(ev)
-        payload = self._location_payload(obj, node_id)
-        if payload["status"] == "remote":
-            try:
-                await self._ensure_local(node_id, hex_id)
-            except Exception:  # noqa: BLE001
-                return {"status": "lost"}
+        if obj.status != "ready" and not await self._wait_ready(obj, deadline):
+            return {"status": "timeout"}
+        for _ in range(4):  # transfer, with lineage re-execution on loss
             payload = self._location_payload(obj, node_id)
-        return payload
+            if payload["status"] == "remote":
+                try:
+                    await self._ensure_local(node_id, hex_id)
+                except Exception:  # noqa: BLE001
+                    continue  # copies vanished mid-pull; re-evaluate
+                payload = self._location_payload(obj, node_id)
+            if payload["status"] != "lost":
+                return payload
+            if not self._reconstruct_object(hex_id):
+                return payload
+            # Creating task resubmitted — wait for the new copy.
+            if not await self._wait_ready(obj, deadline):
+                return {"status": "timeout"}
+        return {"status": "lost"}
 
     async def h_wait_objects(self, conn, meta, msg):
         ids: List[str] = msg["ids"]
@@ -656,20 +719,122 @@ class Controller:
 
     async def h_free_objects(self, conn, meta, msg):
         for hex_id in msg["ids"]:
-            obj = self.objects.pop(hex_id, None)
-            if obj is None:
-                continue
-            for nid, name in obj.locations.items():
-                if nid == HEAD_NODE:
-                    self.store_bytes_used -= obj.size
-                    self.local_store.release(name, unlink=True)
-                else:
-                    node = self.nodes.get(nid)
-                    if node is not None and node.alive and node.conn is not None:
-                        asyncio.ensure_future(
-                            node.conn.send({"type": "free_object", "name": name})
-                        )
+            self._free_object(hex_id)
         return {"ok": True}
+
+    def _drop_copies(self, hex_id: str):
+        """Release every physical copy (shm on all nodes + spill file) while
+        keeping the directory entry."""
+        obj = self.objects.get(hex_id)
+        if obj is None:
+            return
+        for nid, name in list(obj.locations.items()):
+            if nid == HEAD_NODE:
+                self.store_bytes_used -= obj.size
+                self.local_store.release(name, unlink=True)
+            else:
+                node = self.nodes.get(nid)
+                if node is not None and node.alive and node.conn is not None:
+                    asyncio.ensure_future(
+                        node.conn.send({"type": "free_object", "name": name})
+                    )
+        obj.locations.clear()
+        if obj.spilled_path:
+            try:
+                os.unlink(obj.spilled_path)
+            except OSError:
+                pass
+            obj.spilled_path = None
+        obj.inline = None
+
+    def _free_object(self, hex_id: str):
+        self._drop_copies(hex_id)
+        obj = self.objects.pop(hex_id, None)
+        self._gc_candidates.discard(hex_id)
+        if obj is not None:
+            for h in obj.contains:  # container gone → nested refs unpin
+                inner = self.objects.get(h)
+                if inner is not None:
+                    inner.pinned = max(0, inner.pinned - 1)
+                    self._maybe_gc(h)
+
+    # -------------------------------------------------- distributed refcount
+    async def h_update_refs(self, conn, meta, msg):
+        """Batched 0↔1 ref transitions from one process (reference analog:
+        `WaitForRefRemoved` batching via pubsub — `pubsub/README.md:7-27`).
+        Adds are processed before releases, so an add+release pair in one
+        batch (a short-lived ref) still marks the object ever_held."""
+        conn_id = meta.get("conn_id")
+        for hex_id in msg.get("add", ()):
+            obj = self._obj(hex_id)
+            obj.holders.add(conn_id)
+            obj.ever_held = True
+        for hex_id in msg.get("release", ()):
+            obj = self.objects.get(hex_id)
+            if obj is not None:
+                obj.holders.discard(conn_id)
+                self._maybe_gc(hex_id)
+        return None
+
+    _GC_GRACE = 1.0  # > 2× the client flush interval: lets in-flight adds land
+
+    def _maybe_gc(self, hex_id: str):
+        """Schedule a holderless, unpinned object for the GC sweep. The grace
+        window absorbs the cross-process handoff race (a receiver's batched
+        add-ref can trail the sender's release by up to the flush interval)."""
+        obj = self.objects.get(hex_id)
+        if (
+            obj is None
+            or not obj.ever_held
+            or obj.holders
+            or obj.pinned > 0
+            or obj.events
+            or obj.dependents
+        ):
+            return
+        if obj.status == "ready" or not obj.expected:
+            obj.gc_at = time.monotonic() + self._GC_GRACE
+            self._gc_candidates.add(hex_id)
+
+    async def _gc_loop(self):
+        while not self._shutdown_event.is_set():
+            await asyncio.sleep(0.4)
+            now = time.monotonic()
+            for hex_id in list(self._gc_candidates):
+                obj = self.objects.get(hex_id)
+                if obj is None:
+                    self._gc_candidates.discard(hex_id)
+                    continue
+                if (
+                    obj.holders
+                    or obj.pinned > 0
+                    or obj.events
+                    or obj.dependents
+                    or not obj.ever_held
+                ):
+                    self._gc_candidates.discard(hex_id)  # re-added on release
+                    continue
+                if now < obj.gc_at:
+                    continue
+                if obj.status == "ready":
+                    size = obj.size
+                    self._free_object(hex_id)
+                    self._event("object_gc", object=hex_id, size=size)
+                elif not obj.expected:
+                    # Zombie entry (late add after free) — drop the state.
+                    self.objects.pop(hex_id, None)
+                    self._gc_candidates.discard(hex_id)
+
+    def _pin_args(self, spec: TaskSpec):
+        for oid in spec.arg_refs:
+            self._obj(oid.hex()).pinned += 1
+
+    def _unpin_args(self, spec: TaskSpec):
+        for oid in spec.arg_refs:
+            obj = self.objects.get(oid.hex())
+            if obj is not None:
+                obj.pinned = max(0, obj.pinned - 1)
+                self._maybe_gc(oid.hex())
 
     # ------------------------------------------------------------ spilling
     def _maybe_spill(self):
@@ -730,11 +895,72 @@ class Controller:
             for oid in spec.return_ids:
                 self._store_error_object(oid.hex(), err)
             return {"ok": False}
+        self._pin_args(spec)
+        self._remember_lineage(spec)
+        self._expect_returns(spec)
         pt = PendingTask(spec=spec, retries_left=spec.options.max_retries)
         self._event("task_submitted", task=spec.task_id.hex(), name=spec.name)
         self._enqueue(pt)
         self._schedule()
         return {"ok": True}
+
+    def _expect_returns(self, spec: TaskSpec):
+        """Create directory entries for a task's returns up front, flagged
+        `expected` — distinguishes 'result is coming' from zombie state, and
+        guarantees early add-refs land on real entries."""
+        for oid in spec.return_ids:
+            self._obj(oid.hex()).expected = True
+
+    def _remember_lineage(self, spec: TaskSpec):
+        """Keep the creating spec so lost outputs can be re-executed; bounded
+        (reference: lineage pinning budget in `reference_count.h`)."""
+        self.lineage[spec.task_id.hex()] = spec
+        while len(self.lineage) > self._lineage_cap:
+            self.lineage.pop(next(iter(self.lineage)))
+
+    def _reconstruct_object(self, hex_id: str) -> bool:
+        """Resubmit the creating task of a lost object (reference analog:
+        `ObjectRecoveryManager::ReconstructObject`, `object_recovery_manager.cc:141`).
+        ObjectID's first 24 bytes ARE the creating TaskID."""
+        obj = self.objects.get(hex_id)
+        if obj is None or not obj.is_lost():
+            return obj is not None
+        spec = self.lineage.get(hex_id[:48])
+        if spec is None or obj.recon_attempts >= 3:
+            return False
+        if hex_id not in {oid.hex() for oid in spec.return_ids}:
+            # A put() object of that task — re-running would mint a fresh
+            # object id, not this one. Not reconstructable (reference has the
+            # same rule: only task returns are recoverable).
+            return False
+        # Deps must be producible first: a GC-freed dep (entry popped, or a
+        # zombie pending entry) is re-materialized through ITS lineage before
+        # this task is parked waiting on it — else the wait never resolves.
+        for oid in spec.arg_refs:
+            h = oid.hex()
+            dep = self.objects.get(h)
+            if dep is None or (dep.status == "pending" and not dep.expected):
+                d = self._obj(h)
+                d.status = "ready"  # lost-shaped: no copies → is_lost()
+                d.inline = None
+                d.locations.clear()
+                d.spilled_path = None
+                if not self._reconstruct_object(h):
+                    d.status = "pending"
+                    return False
+            elif dep.is_lost() and not self._reconstruct_object(h):
+                return False
+        obj.recon_attempts += 1
+        for oid in spec.return_ids:
+            o = self._obj(oid.hex())
+            self._drop_copies(oid.hex())  # free live siblings before reset
+            o.status = "pending"
+            o.expected = True
+        self._pin_args(spec)
+        self._event("object_reconstruction", object=hex_id, task=spec.task_id.hex())
+        self._enqueue(PendingTask(spec=spec, retries_left=spec.options.max_retries))
+        self._schedule()
+        return True
 
     def _enqueue(self, pt: PendingTask):
         spec = pt.spec
@@ -828,7 +1054,8 @@ class Controller:
                 *(self._ensure_local(node.node_id, oid.hex()) for oid in spec.arg_refs)
             )
         except Exception as e:  # noqa: BLE001
-            # A dep's every copy died mid-transfer: fail the task returns.
+            # A dep's every copy died mid-transfer. Return the grant, then
+            # try lineage reconstruction before declaring the task failed.
             self.running.pop(task_hex, None)
             was_actor = ws.state == ACTOR
             ws.state = IDLE
@@ -836,9 +1063,26 @@ class Controller:
             ws.actor_hex = None
             self._release(node, ws.assigned)
             ws.assigned = {}
+            lost = [
+                oid.hex()
+                for oid in spec.arg_refs
+                if (o := self.objects.get(oid.hex())) is not None and o.is_lost()
+            ]
+            if lost and all(self._reconstruct_object(h) for h in lost):
+                # Deps are re-executing; requeue — _enqueue re-registers the
+                # (now pending) deps so the task waits for the new copies.
+                if was_actor and spec.actor_id is not None:
+                    astate = self.actors.get(spec.actor_id.hex())
+                    if astate is not None:
+                        self._set_actor_state(astate, "pending")
+                self._event("task_requeued_for_reconstruction", task=task_hex)
+                self._enqueue(pt)
+                self._schedule()
+                return
             err = TaskError(
                 RuntimeError(f"dependency transfer failed: {e}"), "", spec.name
             )
+            self._unpin_args(spec)
             if was_actor and spec.actor_id is not None:
                 astate = self.actors.get(spec.actor_id.hex())
                 if astate is not None:
@@ -965,12 +1209,15 @@ class Controller:
 
     def _finish_cancelled(self, pt: PendingTask):
         err = TaskError(TaskCancelledError(), "", pt.spec.name)
+        self._unpin_args(pt.spec)
         for oid in pt.spec.return_ids:
             self._store_error_object(oid.hex(), err)
 
     async def h_task_done(self, conn, meta, msg):
         task_hex = msg["task"]
-        self.running.pop(task_hex, None)
+        entry = self.running.pop(task_hex, None)
+        if entry is not None:
+            self._unpin_args(entry[1].spec)
         ws = self.workers.get(meta["worker_id"]) if meta["worker_id"] else None
         node_id = ws.node_id if ws is not None else HEAD_NODE
         if ws is not None and ws.state == BUSY:
@@ -983,13 +1230,19 @@ class Controller:
         if ws is not None and ws.actor_hex:
             astate = self.actors.get(ws.actor_hex)
             if astate is not None:
-                astate.inflight.pop(task_hex, None)
+                ispec = astate.inflight.pop(task_hex, None)
+                if ispec is not None:
+                    self._unpin_args(ispec)
         for item in msg["results"]:
             if item.get("inline") is not None:
-                self._mark_ready(item["id"], inline=item["inline"], size=len(item["inline"]))
+                self._mark_ready(
+                    item["id"], inline=item["inline"], size=len(item["inline"]),
+                    contains=item.get("contains"),
+                )
             else:
                 self._mark_ready(
-                    item["id"], shm_name=item["name"], size=item["size"], node_id=node_id
+                    item["id"], shm_name=item["name"], size=item["size"],
+                    node_id=node_id, contains=item.get("contains"),
                 )
         self._event("task_done", task=task_hex)
         self._schedule()
@@ -1000,7 +1253,9 @@ class Controller:
         astate = self.actors.get(actor_hex)
         task_hex = msg.get("task")
         if task_hex:
-            self.running.pop(task_hex, None)
+            entry = self.running.pop(task_hex, None)
+            if entry is not None:
+                self._unpin_args(entry[1].spec)
         if astate is None:
             return None
         if msg.get("error") is not None:
@@ -1023,6 +1278,7 @@ class Controller:
     def _drain_actor_queue(self, astate: ActorState, err: TaskError):
         while astate.send_queue:
             spec = astate.send_queue.popleft()
+            self._unpin_args(spec)
             for oid in spec.return_ids:
                 self._store_error_object(oid.hex(), err)
 
@@ -1057,6 +1313,7 @@ class Controller:
             if key in self.named_actors:
                 return {"error": f"Actor name '{astate.name}' already taken"}
             self.named_actors[key] = actor_hex
+        self._pin_args(spec)
         pt = PendingTask(spec=spec, retries_left=0)
         self._event("actor_created", actor=actor_hex, name=astate.name)
         self._enqueue(pt)
@@ -1064,22 +1321,22 @@ class Controller:
         return {"ok": True}
 
     async def _send_actor_task(self, astate: ActorState, spec: TaskSpec):
-        ws = self.workers.get(astate.worker_id)
-        if ws is None or ws.conn is None or ws.state == DEAD:
-            err = TaskError(ActorDiedError(), "", spec.name)
+        def fail(err: TaskError):
+            astate.inflight.pop(spec.task_id.hex(), None)
+            self._unpin_args(spec)
             for oid in spec.return_ids:
                 self._store_error_object(oid.hex(), err)
+
+        ws = self.workers.get(astate.worker_id)
+        if ws is None or ws.conn is None or ws.state == DEAD:
+            fail(TaskError(ActorDiedError(), "", spec.name))
             return
         try:
             await asyncio.gather(
                 *(self._ensure_local(ws.node_id, oid.hex()) for oid in spec.arg_refs)
             )
         except Exception as e:  # noqa: BLE001
-            err = TaskError(
-                RuntimeError(f"dependency transfer failed: {e}"), "", spec.name
-            )
-            for oid in spec.return_ids:
-                self._store_error_object(oid.hex(), err)
+            fail(TaskError(RuntimeError(f"dependency transfer failed: {e}"), "", spec.name))
             return
         await ws.conn.send(
             {
@@ -1111,6 +1368,8 @@ class Controller:
             for oid in spec.return_ids:
                 self._store_error_object(oid.hex(), err)
             return {"ok": False}
+        self._pin_args(spec)
+        self._expect_returns(spec)
         astate.send_queue.append(spec)
         if not astate.pump_active:
             asyncio.ensure_future(self._pump_actor(astate))
@@ -1139,6 +1398,7 @@ class Controller:
                 astate.send_queue.popleft()
                 if astate.state == "dead":
                     err = astate.init_error or TaskError(ActorDiedError(), "", spec.name)
+                    self._unpin_args(spec)
                     for oid in spec.return_ids:
                         self._store_error_object(oid.hex(), err)
                     continue
@@ -1211,6 +1471,7 @@ class Controller:
                 elif pt.retries_left > 0:
                     pt.retries_left -= 1
                     pt.spec.attempt_number += 1
+                    pt.pinned_node = None  # re-pick; the node may be gone
                     self._event("task_retry", task=ws.current_task)
                     self._enqueue(pt)
                 else:
@@ -1219,6 +1480,7 @@ class Controller:
                         "",
                         pt.spec.name,
                     )
+                    self._unpin_args(pt.spec)
                     for oid in pt.spec.return_ids:
                         self._store_error_object(oid.hex(), err)
         if prev_state == ACTOR and ws.actor_hex:
@@ -1247,10 +1509,12 @@ class Controller:
                 ActorUnavailableError(f"actor {actor_hex[:12]} restarting"), "", "actor task"
             )
             for ispec in astate.inflight.values():
+                self._unpin_args(ispec)
                 for oid in ispec.return_ids:
                     if self._obj(oid.hex()).status != "ready":
                         self._store_error_object(oid.hex(), err)
             astate.inflight.clear()
+            self._pin_args(spec)  # restart creation re-reads its args
             pt = PendingTask(spec=spec, retries_left=0)
             self._enqueue(pt)
             self._schedule()
@@ -1259,6 +1523,7 @@ class Controller:
             err = TaskError(ActorDiedError(), "", f"actor {actor_hex[:12]}")
             self._drain_actor_queue(astate, err)
             for ispec in astate.inflight.values():
+                self._unpin_args(ispec)
                 for oid in ispec.return_ids:
                     if self._obj(oid.hex()).status != "ready":
                         self._store_error_object(oid.hex(), err)

@@ -15,10 +15,28 @@ from .ids import ObjectID
 class ObjectRef:
     __slots__ = ("id", "owner_address", "_weak")
 
-    def __init__(self, object_id: ObjectID, owner_address: Optional[str] = None):
+    def __init__(
+        self,
+        object_id: ObjectID,
+        owner_address: Optional[str] = None,
+        _weak: bool = False,
+    ):
         self.id = object_id
         self.owner_address = owner_address
-        self._weak = False
+        self._weak = _weak
+        if not _weak:
+            from .ref_tracker import TRACKER
+
+            TRACKER.incref(object_id.hex())
+
+    def __del__(self):
+        try:
+            if not self._weak:
+                from .ref_tracker import TRACKER
+
+                TRACKER.decref(self.id.hex())
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
 
     def hex(self) -> str:
         return self.id.hex()
@@ -54,6 +72,10 @@ class ObjectRef:
         return f"ObjectRef({self.id.hex()})"
 
     def __reduce__(self):
+        from .serialization import CONTAINED
+
+        if CONTAINED.active is not None:
+            CONTAINED.active.append(self.id.hex())
         return (ObjectRef, (self.id, self.owner_address))
 
 

@@ -16,7 +16,8 @@ from __future__ import annotations
 import io
 import pickle
 import struct
-from typing import Any, List, Tuple
+import threading
+from typing import Any, List, Optional, Tuple
 
 import cloudpickle
 
@@ -24,11 +25,44 @@ import cloudpickle
 _PROTO = 5
 
 
+class _ContainedRefs(threading.local):
+    """Collector for ObjectRefs nested inside a value being serialized —
+    `ObjectRef.__reduce__` reports into it. The controller pins contained
+    objects for the container's lifetime (reference analog: nested-ref
+    tracking in `ReferenceCounter::AddNestedObjectIds`)."""
+
+    def __init__(self):
+        self.active: Optional[List[str]] = None
+
+
+CONTAINED = _ContainedRefs()
+
+
 def serialize(value: Any) -> Tuple[bytes, List[pickle.PickleBuffer]]:
-    """Serialize to (payload, out_of_band_buffers)."""
+    """Serialize to (payload, out_of_band_buffers). Also records nested
+    ObjectRef ids into `last_contained_refs`."""
     buffers: List[pickle.PickleBuffer] = []
-    payload = cloudpickle.dumps(value, protocol=_PROTO, buffer_callback=buffers.append)
+    CONTAINED.active = contained = []
+    try:
+        payload = cloudpickle.dumps(value, protocol=_PROTO, buffer_callback=buffers.append)
+    finally:
+        CONTAINED.active = None
+    _LAST_CONTAINED.value = contained
     return payload, buffers
+
+
+class _LastContained(threading.local):
+    def __init__(self):
+        self.value: List[str] = []
+
+
+_LAST_CONTAINED = _LastContained()
+
+
+def last_contained_refs() -> List[str]:
+    """Nested ObjectRef hex ids recorded by the most recent serialize() on
+    this thread."""
+    return list(_LAST_CONTAINED.value)
 
 
 def deserialize(payload: bytes, buffers: List[Any]) -> Any:

@@ -81,16 +81,17 @@ class WorkerProcess:
 
     def store_result(self, object_hex: str, value: Any) -> dict:
         payload, buffers = serialization.serialize(value)
+        contains = serialization.last_contained_refs()
         size = serialization.packed_size(payload, buffers)
         if size <= store.INLINE_THRESHOLD:
             frame = bytearray(size)
             serialization.pack_into(payload, buffers, memoryview(frame))
-            return {"id": object_hex, "inline": bytes(frame)}
+            return {"id": object_hex, "inline": bytes(frame), "contains": contains}
         try:
             name, size = self.local_store.create_packed(object_hex, payload, buffers)
         except FileExistsError:
             name = store.shm_name_for(object_hex)
-        return {"id": object_hex, "name": name, "size": size}
+        return {"id": object_hex, "name": name, "size": size, "contains": contains}
 
     # -------------------------------------------------------------- tasks
     def _resolve(self, spec: TaskSpec, deps: Dict[str, dict]) -> List[Any]:

@@ -1,0 +1,153 @@
+"""Distributed ref counting + lineage reconstruction.
+
+Reference analogs: `python/ray/tests/test_reference_counting.py` (refcount
+GC) and `test_reconstruction.py` (lineage re-execution of lost objects).
+"""
+
+import gc
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+pytestmark = pytest.mark.cluster
+
+
+def _wait_for(pred, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+@pytest.fixture
+def cluster_rt():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_del_refs_reclaims_store(cluster_rt):
+    from ray_tpu.core import api
+
+    backend = api._global_runtime().backend
+    base = backend.state_summary()["store_bytes"]
+
+    refs = [ray_tpu.put(np.zeros(200_000)) for _ in range(4)]  # 1.6MB each
+    time.sleep(0.6)  # let the add-ref batch flush (so GC has holders to drop)
+    assert backend.state_summary()["store_bytes"] >= base + 4 * 1_500_000
+    del refs
+    gc.collect()
+
+    def reclaimed():
+        s = backend.state_summary()
+        return s["store_bytes"] <= base + 100_000
+
+    _wait_for(reclaimed, msg="store bytes reclaimed after del")
+
+
+def test_pending_task_pins_args(cluster_rt):
+    @ray_tpu.remote
+    def use(arr, delay):
+        import time
+
+        time.sleep(delay)
+        return float(arr.sum())
+
+    big = ray_tpu.put(np.ones(150_000))
+    ref = use.remote(big, 1.0)
+    del big  # only the queued task keeps it alive now
+    gc.collect()
+    assert ray_tpu.get(ref) == 150_000.0
+
+
+def test_result_gc_after_release(cluster_rt):
+    from ray_tpu.core import api
+
+    backend = api._global_runtime().backend
+
+    @ray_tpu.remote
+    def make():
+        return np.ones(200_000)
+
+    ref = make.remote()
+    _ = ray_tpu.get(ref)
+    time.sleep(0.6)  # let the add-ref flush land
+    before = backend.state_summary()["store_bytes"]
+    assert before > 0
+    del ref, _
+    gc.collect()
+    _wait_for(
+        lambda: backend.state_summary()["store_bytes"] < before,
+        msg="task result reclaimed",
+    )
+
+
+def test_nested_ref_pinned_by_container(cluster_rt):
+    inner = ray_tpu.put(np.ones(120_000))
+    outer = ray_tpu.put([inner, "meta"])
+    time.sleep(0.6)  # flush add-refs
+    del inner
+    gc.collect()
+    time.sleep(2.0)  # past the GC grace window
+    got = ray_tpu.get(outer)
+    val = ray_tpu.get(got[0])  # inner must still be alive via container pin
+    assert float(val.sum()) == 120_000.0
+
+
+def test_lineage_reconstruction_after_node_death():
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    cluster.add_node(num_cpus=2, resources={"producer": 2.0})
+    ray_tpu.init(address=cluster.address)
+    try:
+        @ray_tpu.remote(resources={"producer": 1.0}, max_retries=2)
+        def produce():
+            return np.full(120_000, 3.0)  # big -> lives in node1's arena only
+
+        ref = produce.remote()
+        # Wait for completion WITHOUT fetching (no head copy).
+        ready, _ = ray_tpu.wait([ref], timeout=30)
+        assert ready
+        node1 = cluster.nodes[0]
+        cluster.remove_node(node1)  # kill -9: the only copy dies
+        # Resources "producer" died with the node — reconstruction must run
+        # the task elsewhere? No: demand requires node1. Re-add a node with
+        # the resource, then get() triggers lineage re-execution there.
+        cluster.add_node(num_cpus=2, resources={"producer": 2.0})
+        val = ray_tpu.get(ref, timeout=60)
+        assert float(val.sum()) == 3.0 * 120_000
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_chained_reconstruction():
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    cluster.add_node(num_cpus=2, resources={"vol": 4.0})
+    ray_tpu.init(address=cluster.address)
+    try:
+        @ray_tpu.remote(resources={"vol": 1.0}, max_retries=2)
+        def stage1():
+            return np.arange(100_000, dtype=np.float64)
+
+        @ray_tpu.remote(resources={"vol": 1.0}, max_retries=2)
+        def stage2(a):
+            return a * 2.0
+
+        r2 = stage2.remote(stage1.remote())
+        ready, _ = ray_tpu.wait([r2], timeout=30)
+        assert ready
+        node1 = cluster.nodes[0]
+        cluster.remove_node(node1)  # both stages' outputs lost
+        cluster.add_node(num_cpus=2, resources={"vol": 4.0})
+        val = ray_tpu.get(r2, timeout=90)
+        assert float(val[1]) == 2.0
+        assert val.shape == (100_000,)
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
