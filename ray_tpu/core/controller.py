@@ -40,6 +40,7 @@ from .exceptions import (
 from .rpc import Connection, read_msg
 from .task_spec import (
     NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
     SpreadSchedulingStrategy,
     TaskSpec,
     TaskType,
@@ -64,6 +65,8 @@ class WorkerState:
     current_task: Optional[str] = None  # task hex
     actor_hex: Optional[str] = None
     assigned: Dict[str, float] = field(default_factory=dict)
+    # When set, `assigned` was carved from this PG bundle, not node capacity.
+    assigned_pg: Optional[Tuple[str, int]] = None
     blocked: bool = False
     node_id: str = HEAD_NODE
     has_tpu: bool = False
@@ -208,6 +211,8 @@ class Controller:
         self._lineage_cap = 20_000
         self._conn_counter = itertools.count(1)
         self._gc_candidates: Set[str] = set()
+        # Reverse index: conn_id -> hex ids it holds (O(refs) disconnects).
+        self._conn_refs: Dict[int, Set[str]] = {}
 
         self.objects: Dict[str, ObjectState] = {}
         self.workers: Dict[str, WorkerState] = {}
@@ -375,12 +380,11 @@ class Controller:
         # detection via pubsub channel close).
         conn_id = meta.get("conn_id")
         if conn_id is not None:
-            for hex_id in [
-                h for h, o in self.objects.items() if conn_id in o.holders
-            ]:
-                obj = self.objects[hex_id]
-                obj.holders.discard(conn_id)
-                self._maybe_gc(hex_id)
+            for hex_id in self._conn_refs.pop(conn_id, ()):
+                obj = self.objects.get(hex_id)
+                if obj is not None:
+                    obj.holders.discard(conn_id)
+                    self._maybe_gc(hex_id)
         if meta["kind"] == "worker":
             await self._on_worker_death(meta["worker_id"])
         elif meta["kind"] == "node":
@@ -765,11 +769,14 @@ class Controller:
         Adds are processed before releases, so an add+release pair in one
         batch (a short-lived ref) still marks the object ever_held."""
         conn_id = meta.get("conn_id")
+        held = self._conn_refs.setdefault(conn_id, set())
         for hex_id in msg.get("add", ()):
             obj = self._obj(hex_id)
             obj.holders.add(conn_id)
             obj.ever_held = True
+            held.add(hex_id)
         for hex_id in msg.get("release", ()):
+            held.discard(hex_id)
             obj = self.objects.get(hex_id)
             if obj is not None:
                 obj.holders.discard(conn_id)
@@ -990,6 +997,65 @@ class Controller:
         for k, v in demand.items():
             node.available[k] = node.available.get(k, 0.0) + v
 
+    # --- grants may come from node capacity OR a PG bundle reservation ---
+    def _grant_apply(self, ws: WorkerState, sign: float):
+        """Move ws.assigned into (+1) or out of (-1) its capacity source."""
+        if ws.assigned_pg is not None:
+            pg_hex, bidx = ws.assigned_pg
+            pg = self.pgs.get(pg_hex)
+            if pg is not None and bidx < len(pg.get("bundle_avail", [])):
+                b = pg["bundle_avail"][bidx]
+                for k, v in ws.assigned.items():
+                    b[k] = b.get(k, 0.0) + sign * v
+        else:
+            node = self.nodes.get(ws.node_id)
+            if node is not None:
+                if sign > 0:
+                    self._release(node, ws.assigned)
+                else:
+                    self._acquire(node, ws.assigned)
+
+    def _grant_release(self, ws: WorkerState):
+        self._grant_apply(ws, +1.0)
+        ws.assigned = {}
+        ws.assigned_pg = None
+
+    def _grant_release_keep(self, ws: WorkerState):
+        """Blocked-worker release: free capacity but KEEP ws.assigned/PG so
+        worker_unblocked can restore the grant."""
+        self._grant_apply(ws, +1.0)
+
+    def _grant_reacquire(self, ws: WorkerState):
+        """Inverse of the blocked-release (worker_unblocked)."""
+        self._grant_apply(ws, -1.0)
+
+    def _pg_fit(
+        self, spec: TaskSpec, strat: PlacementGroupSchedulingStrategy
+    ) -> Optional[Tuple[str, int, NodeState]]:
+        """Find (pg_hex, bundle_index, node) serving this PG task's demand.
+        Reference analog: bundle resources in
+        `PlacementGroupResourceManager` (raylet)."""
+        pg_obj = strat.placement_group
+        pg_hex = pg_obj.id.hex() if hasattr(pg_obj, "id") else str(pg_obj)
+        pg = self.pgs.get(pg_hex)
+        if pg is None or not pg["ready"]:
+            return None
+        demand = spec.resources
+        idxs = (
+            [strat.placement_group_bundle_index]
+            if strat.placement_group_bundle_index >= 0
+            else range(len(pg["bundles"]))
+        )
+        for i in idxs:
+            if i >= len(pg["bundle_avail"]):
+                continue
+            avail = pg["bundle_avail"][i]
+            if all(avail.get(k, 0.0) + 1e-9 >= v for k, v in demand.items()):
+                node = self.nodes.get(pg["bundle_nodes"][i])
+                if node is not None and node.alive:
+                    return pg_hex, i, node
+        return None
+
     def _idle_worker(self, node_id: str, need_tpu: bool = False) -> Optional[WorkerState]:
         fallback = None
         for ws in self.workers.values():
@@ -1061,8 +1127,7 @@ class Controller:
             ws.state = IDLE
             ws.current_task = None
             ws.actor_hex = None
-            self._release(node, ws.assigned)
-            ws.assigned = {}
+            self._grant_release(ws)
             lost = [
                 oid.hex()
                 for oid in spec.arg_refs
@@ -1135,46 +1200,111 @@ class Controller:
                 need_tpu = demand.get("TPU", 0) > 0
                 chosen: Optional[Tuple[NodeState, WorkerState]] = None
                 spawn_on: Optional[NodeState] = None
-                # Spread/affinity COMMIT to the placement-correct node (spawn
-                # a worker there and wait); hybrid falls through to any node
-                # with an idle worker — packing tolerates the substitution.
-                commit_first_fit = isinstance(
-                    spec.options.scheduling_strategy,
-                    (SpreadSchedulingStrategy, NodeAffinitySchedulingStrategy),
-                )
-                if pt.pinned_node is not None:
-                    pin = self.nodes.get(pt.pinned_node)
-                    candidates = [pin] if pin is not None and pin.alive else None
-                    if candidates is None:
-                        pt.pinned_node = None  # pinned node died — re-pick
-                        candidates = self._candidate_nodes(spec)
-                else:
-                    candidates = self._candidate_nodes(spec)
-                for node in candidates:
-                    if not self._fits_node(node, demand):
+                pg_grant: Optional[Tuple[str, int]] = None
+                strat = spec.options.scheduling_strategy
+                if (
+                    isinstance(strat, PlacementGroupSchedulingStrategy)
+                    and strat.placement_group is not None
+                ):
+                    pg_obj = strat.placement_group
+                    pg_state = self.pgs.get(
+                        pg_obj.id.hex() if hasattr(pg_obj, "id") else str(pg_obj)
+                    )
+                    hard_fail = None
+                    if pg_state is None:
+                        hard_fail = "placement group was removed"
+                    else:
+                        bidx0 = strat.placement_group_bundle_index
+                        idxs = (
+                            [bidx0] if bidx0 >= 0 else range(len(pg_state["bundles"]))
+                        )
+                        if not any(
+                            i < len(pg_state["bundles"])
+                            and all(
+                                pg_state["bundles"][i].get(k, 0.0) >= v
+                                for k, v in demand.items()
+                            )
+                            for i in idxs
+                        ):
+                            hard_fail = (
+                                f"demand {demand} exceeds the bundle capacity"
+                            )
+                    if hard_fail is not None:
+                        self._fail_task(
+                            pt,
+                            TaskError(
+                                RuntimeError(
+                                    f"Task {spec.name} cannot schedule: {hard_fail}."
+                                ),
+                                "",
+                                spec.name,
+                            ),
+                        )
+                        made_progress = True
                         continue
+                    fit = self._pg_fit(spec, strat)
+                    if fit is None:
+                        self.ready_queue.append(pt)  # bundle busy / placing
+                        continue
+                    pg_hex, bidx, node = fit
                     ws = self._idle_worker(node.node_id, need_tpu)
                     if ws is None:
-                        spawn_on = spawn_on or node
-                        if commit_first_fit:
-                            pt.pinned_node = node.node_id
-                            break
-                        continue
-                    chosen = (node, ws)
-                    break
-                if chosen is None:
-                    self.ready_queue.append(pt)
-                    if spawn_on is not None:
+                        self.ready_queue.append(pt)
                         if need_tpu:
-                            self._spawn_worker(tpu=True, node=spawn_on)
+                            self._spawn_worker(tpu=True, node=node)
                         else:
-                            spawn_wanted[spawn_on.node_id] = (
-                                spawn_wanted.get(spawn_on.node_id, 0) + 1
+                            spawn_wanted[node.node_id] = (
+                                spawn_wanted.get(node.node_id, 0) + 1
                             )
-                    continue
+                        continue
+                    avail = self.pgs[pg_hex]["bundle_avail"][bidx]
+                    for k, v in demand.items():
+                        avail[k] = avail.get(k, 0.0) - v
+                    pg_grant = (pg_hex, bidx)
+                    chosen = (node, ws)
+                else:
+                    # Spread/affinity COMMIT to the placement-correct node
+                    # (spawn a worker there and wait); hybrid falls through to
+                    # any node with an idle worker — packing tolerates it.
+                    commit_first_fit = isinstance(
+                        strat,
+                        (SpreadSchedulingStrategy, NodeAffinitySchedulingStrategy),
+                    )
+                    if pt.pinned_node is not None:
+                        pin = self.nodes.get(pt.pinned_node)
+                        candidates = [pin] if pin is not None and pin.alive else None
+                        if candidates is None:
+                            pt.pinned_node = None  # pinned node died — re-pick
+                            candidates = self._candidate_nodes(spec)
+                    else:
+                        candidates = self._candidate_nodes(spec)
+                    for node in candidates:
+                        if not self._fits_node(node, demand):
+                            continue
+                        ws = self._idle_worker(node.node_id, need_tpu)
+                        if ws is None:
+                            spawn_on = spawn_on or node
+                            if commit_first_fit:
+                                pt.pinned_node = node.node_id
+                                break
+                            continue
+                        chosen = (node, ws)
+                        break
+                    if chosen is None:
+                        self.ready_queue.append(pt)
+                        if spawn_on is not None:
+                            if need_tpu:
+                                self._spawn_worker(tpu=True, node=spawn_on)
+                            else:
+                                spawn_wanted[spawn_on.node_id] = (
+                                    spawn_wanted.get(spawn_on.node_id, 0) + 1
+                                )
+                        continue
+                    node, ws = chosen
+                    self._acquire(node, demand)
                 node, ws = chosen
-                self._acquire(node, demand)
                 ws.assigned = dict(demand)
+                ws.assigned_pg = pg_grant
                 task_hex = spec.task_id.hex()
                 self.running[task_hex] = (ws.worker_id, pt)
                 if spec.task_type == TaskType.ACTOR_CREATION_TASK:
@@ -1208,9 +1338,20 @@ class Controller:
             self._spawn_worker()
 
     def _finish_cancelled(self, pt: PendingTask):
-        err = TaskError(TaskCancelledError(), "", pt.spec.name)
-        self._unpin_args(pt.spec)
-        for oid in pt.spec.return_ids:
+        self._fail_task(pt, TaskError(TaskCancelledError(), "", pt.spec.name))
+
+    def _fail_task(self, pt: PendingTask, err: TaskError):
+        """Terminal failure for a not-yet-dispatched task: unpin args, error
+        the returns, and mark a would-be actor dead."""
+        spec = pt.spec
+        self._unpin_args(spec)
+        if spec.task_type == TaskType.ACTOR_CREATION_TASK and spec.actor_id:
+            astate = self.actors.get(spec.actor_id.hex())
+            if astate is not None:
+                astate.init_error = err
+                self._set_actor_state(astate, "dead")
+                self._drain_actor_queue(astate, err)
+        for oid in spec.return_ids:
             self._store_error_object(oid.hex(), err)
 
     async def h_task_done(self, conn, meta, msg):
@@ -1223,10 +1364,7 @@ class Controller:
         if ws is not None and ws.state == BUSY:
             ws.state = IDLE
             ws.current_task = None
-            node = self.nodes.get(ws.node_id)
-            if node is not None:
-                self._release(node, ws.assigned)
-            ws.assigned = {}
+            self._grant_release(ws)
         if ws is not None and ws.actor_hex:
             astate = self.actors.get(ws.actor_hex)
             if astate is not None:
@@ -1457,10 +1595,11 @@ class Controller:
         prev_state = ws.state
         ws.state = DEAD
         if ws.assigned:
-            node = self.nodes.get(ws.node_id)
-            if not ws.blocked and node is not None:
-                self._release(node, ws.assigned)
-            ws.assigned = {}
+            if not ws.blocked:
+                self._grant_release(ws)
+            else:  # capacity already released at block time
+                ws.assigned = {}
+                ws.assigned_pg = None
         self._worker_procs.pop(worker_id, None)
         if prev_state == BUSY and ws.current_task:
             entry = self.running.pop(ws.current_task, None)
@@ -1550,6 +1689,35 @@ class Controller:
             obj.locations.pop(node_id, None)
             if obj.spilled_path is not None and obj.spilled_node == node_id:
                 obj.spilled_path = None
+        # Re-place ONLY the bundles that sat on the dead node (reference
+        # analog: `GcsPlacementGroupManager` rescheduling on node removal).
+        # Bundles on surviving nodes keep their reservations untouched —
+        # releasing them would double-book capacity still used by running
+        # gang members.
+        for pg_hex, pg in self.pgs.items():
+            dead_idx = [
+                i for i, nid in enumerate(pg["bundle_nodes"]) if nid == node_id
+            ]
+            if not dead_idx:
+                continue
+            dead_bundles = [pg["bundles"][i] for i in dead_idx]
+            surviving = {
+                nid for nid in pg["bundle_nodes"] if nid and nid != node_id
+            }
+            placement = self._place_bundles(
+                dead_bundles, pg["strategy"], occupied=surviving
+            )
+            if placement is None:
+                pg["ready"] = False  # blocks new PG dispatch; grants continue
+                for i in dead_idx:
+                    pg["bundle_nodes"][i] = None
+                self._event("pg_infeasible_after_node_death", pg=pg_hex)
+            else:
+                for i, nid in zip(dead_idx, placement):
+                    self._acquire(self.nodes[nid], pg["bundles"][i])
+                    pg["bundle_nodes"][i] = nid
+                    pg["bundle_avail"][i] = dict(pg["bundles"][i])
+                self._event("pg_rescheduled", pg=pg_hex, bundles=dead_idx)
         self._schedule()
 
     # ------------------------------------------------------------ blocking
@@ -1557,9 +1725,7 @@ class Controller:
         ws = self.workers.get(msg["worker_id"])
         if ws is not None and not ws.blocked:
             ws.blocked = True
-            node = self.nodes.get(ws.node_id)
-            if node is not None:
-                self._release(node, ws.assigned)
+            self._grant_release_keep(ws)
             self._schedule()
         return None
 
@@ -1567,9 +1733,7 @@ class Controller:
         ws = self.workers.get(msg["worker_id"])
         if ws is not None and ws.blocked:
             ws.blocked = False
-            node = self.nodes.get(ws.node_id)
-            if node is not None:
-                self._acquire(node, ws.assigned)
+            self._grant_reacquire(ws)
         return None
 
     # ------------------------------------------------------------- cancel
@@ -1608,15 +1772,22 @@ class Controller:
             "name": msg.get("name", ""),
             "ready": feasible,
             "bundle_nodes": placement or [],
+            # Unconsumed capacity per bundle: PG tasks draw from here, not
+            # from general node availability (it is already reserved).
+            "bundle_avail": [dict(b) for b in bundles],
         }
         return {"ok": feasible}
 
     def _place_bundles(
-        self, bundles: List[Dict[str, float]], strategy: str
+        self,
+        bundles: List[Dict[str, float]],
+        strategy: str,
+        occupied: Optional[Set[str]] = None,
     ) -> Optional[List[str]]:
         """Map bundles to nodes per the PG strategy; None if infeasible.
         Works against a scratch copy of availability so partial placements
-        never leak reservations."""
+        never leak reservations. `occupied` seeds STRICT_SPREAD's used-node
+        set (partial re-placement after a node death)."""
         alive = [n for n in self.nodes.values() if n.alive]
         avail = {n.node_id: dict(n.available) for n in alive}
 
@@ -1648,7 +1819,7 @@ class Controller:
                 return None
             return placement
         # SPREAD / STRICT_SPREAD: round-robin across distinct nodes.
-        used: Set[str] = set()
+        used: Set[str] = set(occupied or ())
         for b in bundles:
             fresh = [nid for nid in sorted(avail) if nid not in used and fits(nid, b)]
             any_fit = [nid for nid in sorted(avail) if fits(nid, b)]
@@ -1677,9 +1848,12 @@ class Controller:
 
     async def h_remove_pg(self, conn, meta, msg):
         pg = self.pgs.pop(msg["id"], None)
-        if pg and pg["ready"]:
+        if pg and pg["bundle_nodes"]:
+            # Release every still-placed bundle — including those of a PG
+            # demoted to not-ready after a node death (its surviving bundles
+            # keep reservations until removal).
             for b, nid in zip(pg["bundles"], pg["bundle_nodes"]):
-                node = self.nodes.get(nid)
+                node = self.nodes.get(nid) if nid else None
                 if node is not None and node.alive:
                     self._release(node, b)
             self._schedule()

@@ -13,10 +13,10 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Callable, Dict, Optional, Set
 
 _FLUSH_INTERVAL = 0.25
-_FLUSH_BATCH = 256
 
 
 class RefTracker:
@@ -25,6 +25,11 @@ class RefTracker:
         self._counts: Dict[str, int] = {}
         self._pending_add: Set[str] = set()
         self._pending_release: Set[str] = set()
+        # __del__-path decrefs land here WITHOUT taking the lock: cyclic GC
+        # can fire ObjectRef.__del__ on a thread that already holds _lock
+        # (any allocation inside a locked section can trigger it) — a plain
+        # lock acquire there would self-deadlock. deque.append is atomic.
+        self._dec_queue: deque = deque()
         self._flusher: Optional[Callable[[list, list], None]] = None
         self._gen = 0  # flush-thread generation: bumping it retires old threads
 
@@ -50,6 +55,7 @@ class RefTracker:
     # ------------------------------------------------------------ counting
     def incref(self, hex_id: str):
         with self._lock:
+            self._apply_decrefs_locked()  # keep per-thread del→create ordering
             c = self._counts.get(hex_id, 0)
             self._counts[hex_id] = c + 1
             if c == 0 and self._flusher is not None:
@@ -57,10 +63,17 @@ class RefTracker:
                 self._pending_add.add(hex_id)
 
     def decref(self, hex_id: str):
-        # Never flush inline: __del__ may run on ANY thread (including the
-        # backend's IO loop, where a blocking send would deadlock). The timer
-        # thread drains the batch within _FLUSH_INTERVAL.
-        with self._lock:
+        # Lock-free and non-blocking: __del__ may run on ANY thread (the
+        # backend's IO loop, or a thread that already holds _lock via cyclic
+        # GC). The flush thread applies queued decrefs under the lock.
+        self._dec_queue.append(hex_id)
+
+    def _apply_decrefs_locked(self):
+        while True:
+            try:
+                hex_id = self._dec_queue.popleft()
+            except IndexError:
+                return
             c = self._counts.get(hex_id, 0) - 1
             if c <= 0:
                 self._counts.pop(hex_id, None)
@@ -76,6 +89,7 @@ class RefTracker:
     # ------------------------------------------------------------- flushing
     def flush(self):
         with self._lock:
+            self._apply_decrefs_locked()
             flusher = self._flusher
             if flusher is None or (not self._pending_add and not self._pending_release):
                 return
@@ -98,6 +112,7 @@ class RefTracker:
 
     def local_count(self, hex_id: str) -> int:
         with self._lock:
+            self._apply_decrefs_locked()
             return self._counts.get(hex_id, 0)
 
 

@@ -17,7 +17,6 @@ Inside `train_loop_per_worker`:
 from __future__ import annotations
 
 import os
-import socket
 from typing import Callable, Dict, Optional
 
 from .backend_executor import Backend
@@ -40,8 +39,11 @@ class JaxBackend(CollectiveBackend):
         n = len(worker_group)
         if n <= 1:
             return
-        port = _free_port()
-        coord = f"127.0.0.1:{port}"  # multi-node providers substitute host IPs
+        # Worker 0 hosts the coordinator: resolve ITS address (gang workers
+        # may sit on different nodes via the placement group), then pick a
+        # port on that host.
+        coord_ip, port = worker_group.execute_single(0, _coordinator_binding)
+        coord = f"{coord_ip}:{port}"
         envs = [
             {
                 "RAY_TPU_JAX_COORDINATOR": coord,
@@ -53,12 +55,24 @@ class JaxBackend(CollectiveBackend):
         worker_group.set_env_all(envs)
 
 
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+def _coordinator_binding():
+    """Runs ON worker 0: its routable IP + a free port on that host."""
+    import socket
+
+    ip = "127.0.0.1"
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("8.8.8.8", 80))  # no packets sent; just picks a route
+        ip = s.getsockname()[0]
+    except OSError:
+        pass
+    finally:
+        s.close()
+    ps = socket.socket()
+    ps.bind((ip if ip != "127.0.0.1" else "127.0.0.1", 0))
+    port = ps.getsockname()[1]
+    ps.close()
+    return ip, port
 
 
 class JaxTrainer(DataParallelTrainer):

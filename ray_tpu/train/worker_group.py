@@ -103,15 +103,47 @@ class WorkerGroup:
 
         self._cloudpickle = cloudpickle
         remote_cls = api.remote(TrainWorker)
-        opts: Dict[str, Any] = {}
         cpus = resources_per_worker.get("CPU", 1.0)
         tpus = resources_per_worker.get("TPU", 0.0)
         extra = {
             k: v for k, v in resources_per_worker.items() if k not in ("CPU", "TPU")
         }
+        # Gang placement: one bundle per worker, worker i pinned to bundle i
+        # (reference: `BackendExecutor` creating the Train placement group;
+        # TPU slice gangs are STRICT_PACK per `accelerators/tpu.py:199-313`).
+        self._pg = None
+        strategy_kwargs: List[Dict[str, Any]] = [{} for _ in range(num_workers)]
+        bundle = {k: v for k, v in {"CPU": cpus, "TPU": tpus, **extra}.items() if v}
+        if bundle:
+            from ..core.task_spec import PlacementGroupSchedulingStrategy
+            from ..util.placement_group import placement_group
+
+            try:
+                pg = placement_group(
+                    [dict(bundle) for _ in range(num_workers)],
+                    strategy=placement_strategy,
+                )
+                if pg.wait(timeout_seconds=30):
+                    self._pg = pg
+                    strategy_kwargs = [
+                        {
+                            "scheduling_strategy": PlacementGroupSchedulingStrategy(
+                                placement_group=pg,
+                                placement_group_bundle_index=i,
+                            )
+                        }
+                        for i in range(num_workers)
+                    ]
+                else:  # infeasible as a gang — fall back to free placement
+                    from ..util.placement_group import remove_placement_group
+
+                    remove_placement_group(pg)
+            except Exception:  # noqa: BLE001 — backend without PG support
+                self._pg = None
         self.workers = [
             remote_cls.options(
-                num_cpus=cpus, num_tpus=tpus or None, resources=extra or {}
+                num_cpus=cpus, num_tpus=tpus or None, resources=extra or {},
+                **strategy_kwargs[i],
             ).remote(contexts[i])
             for i in range(num_workers)
         ]
@@ -129,6 +161,10 @@ class WorkerGroup:
     def execute_all(self, fn: Callable):
         payload = self._cloudpickle.dumps(fn)
         return api.get([w.execute.remote(payload) for w in self.workers])
+
+    def execute_single(self, index: int, fn: Callable):
+        payload = self._cloudpickle.dumps(fn)
+        return api.get(self.workers[index].execute.remote(payload))
 
     def set_env_all(self, envs: List[Dict[str, str]]):
         return api.get(
@@ -151,3 +187,11 @@ class WorkerGroup:
                 api.kill(w)
             except Exception:  # noqa: BLE001
                 pass
+        if self._pg is not None:
+            from ..util.placement_group import remove_placement_group
+
+            try:
+                remove_placement_group(self._pg)
+            except Exception:  # noqa: BLE001
+                pass
+            self._pg = None

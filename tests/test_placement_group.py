@@ -1,0 +1,191 @@
+"""Placement groups: per-bundle node mapping + gang scheduling.
+
+Reference analogs: `python/ray/tests/test_placement_group*.py` —
+STRICT_SPREAD/STRICT_PACK semantics, bundle_index scheduling, and driving a
+trainer gang through a PG over the fake multi-node cluster (VERDICT item 4).
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.core.task_spec import PlacementGroupSchedulingStrategy
+from ray_tpu.util.placement_group import placement_group, remove_placement_group
+
+pytestmark = pytest.mark.cluster
+
+
+@pytest.fixture
+def three_node_cluster():
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    ray_tpu.init(address=cluster.address)
+    yield cluster
+    ray_tpu.shutdown()
+    cluster.shutdown()
+
+
+def test_strict_spread_bundles_on_distinct_nodes(three_node_cluster):
+    pg = placement_group([{"CPU": 1}] * 3, strategy="STRICT_SPREAD")
+    assert pg.wait(timeout_seconds=20)
+
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    nodes = ray_tpu.get(
+        [
+            where.options(
+                scheduling_strategy=PlacementGroupSchedulingStrategy(
+                    placement_group=pg, placement_group_bundle_index=i
+                )
+            ).remote()
+            for i in range(3)
+        ]
+    )
+    assert len(set(nodes)) == 3, nodes
+    remove_placement_group(pg)
+
+
+def test_strict_pack_bundles_on_one_node(three_node_cluster):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_PACK")
+    assert pg.wait(timeout_seconds=20)
+
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    nodes = ray_tpu.get(
+        [
+            where.options(
+                scheduling_strategy=PlacementGroupSchedulingStrategy(
+                    placement_group=pg, placement_group_bundle_index=i
+                )
+            ).remote()
+            for i in range(2)
+        ]
+    )
+    assert len(set(nodes)) == 1, nodes
+    remove_placement_group(pg)
+
+
+def test_strict_spread_infeasible_when_too_few_nodes(three_node_cluster):
+    pg = placement_group([{"CPU": 1}] * 5, strategy="STRICT_SPREAD")
+    assert not pg.wait(timeout_seconds=2)
+    remove_placement_group(pg)
+
+
+def test_pg_reserves_capacity(three_node_cluster):
+    # Reserve ALL cluster CPUs; a non-PG CPU task must not find capacity,
+    # then must run as soon as the PG is removed.
+    pg = placement_group([{"CPU": 2}] * 3, strategy="SPREAD")
+    assert pg.wait(timeout_seconds=20)
+
+    @ray_tpu.remote(num_cpus=1)
+    def ping():
+        return "ran"
+
+    ref = ping.remote()
+    ready, not_ready = ray_tpu.wait([ref], timeout=2)
+    assert not ready, "task ran despite full PG reservation"
+    remove_placement_group(pg)
+    assert ray_tpu.get(ref, timeout=30) == "ran"
+
+
+def test_task_on_removed_pg_fails_fast(three_node_cluster):
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.wait(timeout_seconds=20)
+    remove_placement_group(pg)
+
+    @ray_tpu.remote(num_cpus=1)
+    def f():
+        return 1
+
+    ref = f.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(placement_group=pg)
+    ).remote()
+    with pytest.raises(RuntimeError, match="removed"):
+        ray_tpu.get(ref, timeout=20)
+
+
+def test_task_exceeding_bundle_fails_fast(three_node_cluster):
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.wait(timeout_seconds=20)
+
+    @ray_tpu.remote(num_cpus=2)  # bundle only has 1 CPU
+    def f():
+        return 1
+
+    ref = f.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(placement_group=pg)
+    ).remote()
+    with pytest.raises(RuntimeError, match="bundle capacity"):
+        ray_tpu.get(ref, timeout=20)
+    remove_placement_group(pg)
+
+
+def test_actor_gang_via_pg(three_node_cluster):
+    pg = placement_group([{"CPU": 1}] * 3, strategy="STRICT_SPREAD")
+    assert pg.wait(timeout_seconds=20)
+
+    @ray_tpu.remote(num_cpus=1)
+    class Member:
+        def node(self):
+            return ray_tpu.get_runtime_context().get_node_id()
+
+    members = [
+        Member.options(
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                placement_group=pg, placement_group_bundle_index=i
+            )
+        ).remote()
+        for i in range(3)
+    ]
+    nodes = ray_tpu.get([m.node.remote() for m in members])
+    assert len(set(nodes)) == 3, nodes
+    for m in members:
+        ray_tpu.kill(m)
+    remove_placement_group(pg)
+
+
+def test_jax_trainer_gang_spread_across_nodes(three_node_cluster):
+    """JaxTrainer drives its WorkerGroup through a PG gang (VERDICT item 4
+    done-criterion: multi-daemon JaxTrainer over the fake cluster)."""
+    from ray_tpu.train import JaxTrainer, ScalingConfig
+
+    @ray_tpu.remote(num_cpus=0)
+    class Collector:
+        def __init__(self):
+            self.nodes = []
+
+        def add(self, n):
+            self.nodes.append(n)
+            return len(self.nodes)
+
+        def get(self):
+            return self.nodes
+
+    collector = Collector.options(name="gang-collector").remote()
+    ray_tpu.get(collector.get.remote())  # force creation before the gang
+
+    def loop(config=None):
+        import ray_tpu as rt
+        from ray_tpu import train
+
+        c = rt.get_actor("gang-collector")
+        rt.get(c.add.remote(rt.get_runtime_context().get_node_id()))
+        train.report({"ok": 1})
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(
+            num_workers=3,
+            resources_per_worker={"CPU": 1},
+            placement_strategy="STRICT_SPREAD",
+        ),
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    nodes = ray_tpu.get(collector.get.remote())
+    assert len(nodes) == 3 and len(set(nodes)) == 3, nodes
