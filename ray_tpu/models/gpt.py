@@ -379,14 +379,7 @@ def forward(params, tokens, cfg: GPTConfig, positions=None, mesh=None, return_au
 
     block = functools.partial(_block, cfg, rope_tables, mesh)
     if cfg.remat:
-        if cfg.remat_policy not in (None, "dots"):
-            raise ValueError(f"unknown remat_policy: {cfg.remat_policy!r}")
-        policy = (
-            jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-            if cfg.remat_policy == "dots"
-            else jax.checkpoint_policies.nothing_saveable
-        )
-        block = jax.checkpoint(block, policy=policy)
+        block = jax.checkpoint(block, policy=_remat_policy(cfg))
 
     def scan_body(x, layer_params):
         x, aux = block(x, layer_params, positions)
@@ -402,41 +395,61 @@ def forward(params, tokens, cfg: GPTConfig, positions=None, mesh=None, return_au
     return logits
 
 
-def loss_fn(params, batch, cfg: GPTConfig, mesh=None):
-    """batch: {"tokens": [B, S+1]} or {"inputs","targets"} → mean next-token
-    cross-entropy (f32)."""
+def _remat_policy(cfg: GPTConfig):
+    if cfg.remat_policy not in (None, "dots"):
+        raise ValueError(f"unknown remat_policy: {cfg.remat_policy!r}")
+    return (
+        jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        if cfg.remat_policy == "dots"
+        else jax.checkpoint_policies.nothing_saveable
+    )
+
+
+def _parse_batch(batch):
+    """{"tokens": [B,S+1]} or {"inputs","targets"} → (inputs, targets, mask)."""
     if "inputs" in batch:
-        inputs, targets = batch["inputs"], batch["targets"]
-        mask = batch.get("mask")  # already target-aligned in this layout
-    else:
-        tokens = batch["tokens"]
-        inputs, targets = tokens[:, :-1], tokens[:, 1:]
-        mask = batch.get("mask")
-        if mask is not None:
-            mask = mask[:, 1:]
-    logits, aux = forward(params, inputs, cfg, mesh=mesh, return_aux=True)
-    logits = logits.astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits, axis=-1)
+        return batch["inputs"], batch["targets"], batch.get("mask")
+    tokens = batch["tokens"]
+    mask = batch.get("mask")
+    return tokens[:, :-1], tokens[:, 1:], (mask[:, 1:] if mask is not None else None)
+
+
+def _ce_loss(logits, targets, mask):
+    """Mean next-token cross-entropy (f32), optionally padding-masked."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     if mask is not None:
         m = mask.astype(jnp.float32)
-        return -(ll * m).sum() / jnp.maximum(m.sum(), 1.0) + aux
-    return -ll.mean() + aux
+        return -(ll * m).sum() / jnp.maximum(m.sum(), 1.0)
+    return -ll.mean()
 
 
-def make_train_step(cfg: GPTConfig, optimizer, mesh=None) -> Callable:
+def loss_fn(params, batch, cfg: GPTConfig, mesh=None):
+    """batch: {"tokens": [B, S+1]} or {"inputs","targets"} → mean next-token
+    cross-entropy (f32) + MoE aux."""
+    inputs, targets, mask = _parse_batch(batch)
+    logits, aux = forward(params, inputs, cfg, mesh=mesh, return_aux=True)
+    return _ce_loss(logits, targets, mask) + aux
+
+
+def make_train_step(cfg: GPTConfig, optimizer, mesh=None, loss=None) -> Callable:
     """Returns `step(state, batch) -> (state, metrics)`; jit at the call site
-    with shardings (see ray_tpu.train.JaxTrainer / bench.py)."""
+    with shardings (see ray_tpu.train.JaxTrainer / bench.py). `loss`
+    overrides the loss callable (params, batch) -> scalar — the pipeline
+    train step rides this hook."""
+    if loss is None:
+        def loss(params, batch):
+            return loss_fn(params, batch, cfg, mesh)
 
     def step(state, batch):
         params, opt_state = state
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg, mesh)
+        loss_val, grads = jax.value_and_grad(loss)(params, batch)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = jax.tree_util.tree_map(
             lambda p, u: (p + u.astype(p.dtype)), params, updates
         )
         gnorm = optax_global_norm(grads)
-        return (params, opt_state), {"loss": loss, "grad_norm": gnorm}
+        return (params, opt_state), {"loss": loss_val, "grad_norm": gnorm}
 
     return step
 
@@ -444,3 +457,179 @@ def make_train_step(cfg: GPTConfig, optimizer, mesh=None) -> Callable:
 def optax_global_norm(tree):
     leaves = jax.tree_util.tree_leaves(tree)
     return jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves))
+
+
+# ------------------------------------------------------- pipeline parallelism
+def split_stage_params(params, cfg: GPTConfig, num_stages: int):
+    """Reshape the [L, ...] layer stack to [S, L/S, ...] (the `stage` logical
+    dim — shard it P('pp') so each pp device holds exactly its stage's
+    layers). Non-layer params (embeddings, final norm, head) stay as-is;
+    they live outside the pipelined region."""
+    if cfg.n_layers % num_stages != 0:
+        raise ValueError(f"{cfg.n_layers} layers not divisible by {num_stages} stages")
+    per = cfg.n_layers // num_stages
+    out = {}
+    for k, v in params.items():
+        if k in _LAYER_KEYS:
+            out[k] = v.reshape(num_stages, per, *v.shape[1:])
+        else:
+            out[k] = v
+    return out
+
+
+def merge_stage_params(params, cfg: GPTConfig):
+    """Inverse of split_stage_params ([S, L/S, ...] -> [L, ...])."""
+    out = {}
+    for k, v in params.items():
+        if k in _LAYER_KEYS:
+            out[k] = v.reshape(v.shape[0] * v.shape[1], *v.shape[2:])
+        else:
+            out[k] = v
+    return out
+
+
+def pipeline_stage_shardings(cfg: GPTConfig, mesh, rules: Optional[ShardingRules] = None):
+    """Param shardings for the stage-split layout: layer arrays gain a
+    leading `stage` dim (→ pp); the rest match param_shardings."""
+    rules = rules or ShardingRules.default()
+    dims = param_logical_dims(cfg)
+    out = {}
+    for name, d in dims.items():
+        if name in _LAYER_KEYS:
+            assert d[0] == "layers"
+            out[name] = rules.sharding(mesh, "stage", *d)
+        else:
+            out[name] = rules.sharding(mesh, *d)
+    return out
+
+
+def pipeline_loss_fn(
+    params,
+    batch,
+    cfg: GPTConfig,
+    mesh,
+    num_microbatches: int,
+):
+    """GPipe loss: the transformer stack runs inside a shard_map manual over
+    ONLY the `pp` axis — microbatches flow stage→stage via ppermute while the
+    compiler keeps auto-partitioning each stage's math over dp/fsdp/tp/sp
+    (the `axis_names` subset-manual mode). Embedding/head/loss stay outside
+    the pipelined region in ordinary pjit land.
+
+    Reference gap being closed: Ray has NO pipeline schedule (SURVEY §2.6 —
+    compiled-DAG channels are substrate only); here GPipe's backward emerges
+    from jax AD transposing the forward scan. `params` is the stage-split
+    layout from split_stage_params.
+
+    Limitations: manual sp attention (ring/ulysses) cannot nest inside the
+    pp-manual region — those impls are rejected; with "ref"/"flash" the
+    compiler still auto-partitions attention over sp (all-gather based). The
+    MoE aux loss is averaged per microbatch (≈ the full-batch value).
+    """
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.spmd import shard_fn
+
+    if cfg.attn_impl in ("ring", "ulysses"):
+        raise NotImplementedError(
+            f"attn_impl={cfg.attn_impl!r} needs its own manual sp axis and "
+            "cannot nest inside the pp-manual pipeline region; use 'flash' "
+            "or 'ref' (XLA auto-partitions those over sp)."
+        )
+    S_pp = mesh.shape["pp"]
+    M = num_microbatches
+    inputs, targets, mask = _parse_batch(batch)
+    B, S_len = inputs.shape
+    if B % M != 0:
+        raise ValueError(f"batch {B} not divisible by num_microbatches {M}")
+    positions = jnp.arange(S_len)
+
+    x = params["tok_embed"][inputs].astype(cfg.dtype)
+    if cfg.pos == "learned":
+        x = x + params["pos_embed"][positions].astype(cfg.dtype)
+    # The pipeline input crosses the shard_map boundary in f32: AD transposes
+    # its stage-0 broadcast into a psum, and bf16 psums crash the partitioner
+    # in subset-manual mode (see the matching forward-path comment below).
+    xm = x.reshape(M, B // M, S_len, x.shape[-1]).astype(jnp.float32)
+
+    rope_tables = None
+    if cfg.pos == "rotary":
+        rd = min(cfg.rotary_dim, cfg.d_head)
+        rope_tables = rope_frequencies(rd, cfg.max_seq, dtype=jnp.float32)
+
+    stage_stack = {k: params[k] for k in _LAYER_KEYS if k in params}
+    block = functools.partial(_block, cfg, rope_tables, None)
+    if cfg.remat:
+        block = jax.checkpoint(block, policy=_remat_policy(cfg))
+
+    def stage_fn(stage_params, act):
+        def body(h, layer_params):
+            h, aux = block(h, layer_params, positions)
+            return h, aux
+
+        act, aux_stack = lax.scan(body, act, stage_params)
+        return act, aux_stack.sum()
+
+    def per_stage(stacked, xm):
+        local = jax.tree_util.tree_map(lambda p: p[0], stacked)  # my stage
+        s = lax.axis_index("pp")
+        is_first = s == 0
+        is_last = s == S_pp - 1
+        fwd_perm = [(i, i + 1) for i in range(S_pp - 1)]
+        mb_shape = xm.shape[1:]
+        outs0 = jnp.zeros((M,) + mb_shape, cfg.dtype)
+        act0 = jnp.zeros(mb_shape, cfg.dtype)
+
+        def tick(carry, t):
+            act_in, outs, aux_acc = carry
+            x_t = lax.dynamic_index_in_dim(xm, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+            inp = jnp.where(is_first, x_t.astype(cfg.dtype), act_in)
+            y, aux = stage_fn(local, inp)
+            # Stage s holds real data only for ticks s <= t < s + M; bubble
+            # ticks chew zeros and must not pollute the MoE aux loss.
+            valid = jnp.logical_and(t >= s, t < s + M).astype(jnp.float32)
+            aux_acc = aux_acc + aux * valid
+            write_idx = jnp.clip(t - (S_pp - 1), 0, M - 1)
+            updated = lax.dynamic_update_index_in_dim(outs, y, write_idx, 0)
+            outs = jnp.where(jnp.logical_and(is_last, t >= S_pp - 1), updated, outs)
+            act_next = lax.ppermute(y, "pp", fwd_perm)
+            return (act_next, outs, aux_acc), None
+
+        (_, outs, aux_acc), _ = lax.scan(
+            tick, (act0, outs0, jnp.zeros((), jnp.float32)), jnp.arange(M + S_pp - 1)
+        )
+        # psum in f32: a bf16 psum under subset-manual shard_map crashes the
+        # SPMD partitioner ("Invalid binary instruction opcode copy").
+        masked = jnp.where(is_last, outs, jnp.zeros_like(outs)).astype(jnp.float32)
+        return lax.psum(masked, "pp"), lax.psum(aux_acc, "pp") / M
+
+    gpipe = shard_fn(
+        per_stage,
+        mesh,
+        in_specs=(P("pp"), P()),
+        out_specs=(P(), P()),
+        manual_axes=frozenset({"pp"}),
+    )
+    y, aux = gpipe(stage_stack, xm)
+    y = y.astype(cfg.dtype).reshape(B, S_len, -1)
+
+    h = _norm(y, params["ln_f_w"].astype(cfg.dtype), params["ln_f_b"].astype(cfg.dtype), cfg.norm)
+    head = params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("bse,ev->bsv", h, head.astype(cfg.dtype))
+    return _ce_loss(logits, targets, mask) + aux
+
+
+def make_pipeline_train_step(
+    cfg: GPTConfig, optimizer, mesh, num_microbatches: int
+) -> Callable:
+    """`step(state, batch) -> (state, metrics)` with the GPipe pipeline
+    inside one jit program (pp × dp/fsdp/tp composition)."""
+    return make_train_step(
+        cfg,
+        optimizer,
+        mesh,
+        loss=lambda params, batch: pipeline_loss_fn(
+            params, batch, cfg, mesh, num_microbatches
+        ),
+    )

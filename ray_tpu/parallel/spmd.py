@@ -73,19 +73,29 @@ def shard_fn(
     in_specs,
     out_specs,
     check_vma: bool = False,
+    manual_axes: Optional[frozenset] = None,
 ) -> Callable:
     """`shard_map` wrapper: per-device function with explicit collectives.
 
     This is where ring attention, Ulysses all-to-all, and hand-written
     pipeline schedules live — code inside `fn` sees its local shard and the
     mesh axis names are bound for `jax.lax.p*`.
+
+    `manual_axes` restricts manual collectives to a subset of mesh axes; the
+    rest stay AUTO — the compiler keeps partitioning the body over them
+    (e.g. a pipeline manual over `pp` whose stages still auto-shard over
+    dp/fsdp/tp).
     """
     if hasattr(jax, "shard_map"):
-        return jax.shard_map(
-            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma
-        )
+        kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_vma=check_vma)
+        if manual_axes is not None:
+            kwargs["axis_names"] = frozenset(manual_axes)
+        return jax.shard_map(fn, **kwargs)
     from jax.experimental.shard_map import shard_map  # older jax fallback
 
-    return shard_map(
-        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check_vma
-    )
+    kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=check_vma)
+    if manual_axes is not None:
+        kwargs["auto"] = frozenset(mesh.axis_names) - frozenset(manual_axes)
+    return shard_map(fn, **kwargs)

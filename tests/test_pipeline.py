@@ -84,3 +84,110 @@ class TestGPipe:
         np.testing.assert_array_equal(merge_microbatches(mb), x)
         with pytest.raises(ValueError, match="not divisible"):
             split_microbatches(x, 5)
+
+
+class TestGPTPipeline:
+    """GPT stack through the in-jit GPipe schedule (VERDICT item 5: pp wired
+    into the model family, not just tanh toys)."""
+
+    def _setup(self, pp, extra_axes=None):
+        import jax
+        import ray_tpu.models.gpt as G
+        from ray_tpu.parallel import MeshSpec
+
+        axes = {"pp": pp, **(extra_axes or {})}
+        n = 1
+        for v in axes.values():
+            n *= v
+        mesh = MeshSpec(**axes).build(jax.devices()[:n])
+        cfg = G.GPTConfig(
+            vocab_size=128, n_layers=4, d_model=32, n_heads=2, d_head=16,
+            d_mlp=64, max_seq=16, attn_impl="ref", remat=False,
+        )
+        params = G.init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 17), 0, cfg.vocab_size)
+        return G, mesh, cfg, params, {"tokens": tokens}
+
+    def test_gpt_pipeline_loss_matches_serial(self):
+        import jax
+        import numpy as np
+
+        G, mesh, cfg, params, batch = self._setup(pp=4)
+        serial = G.loss_fn(params, batch, cfg)
+        staged = G.split_stage_params(params, cfg, 4)
+        piped = jax.jit(
+            lambda p, b: G.pipeline_loss_fn(p, b, cfg, mesh, num_microbatches=2)
+        )(staged, batch)
+        np.testing.assert_allclose(float(piped), float(serial), rtol=2e-3)
+
+    def test_gpt_pipeline_grads_match_serial(self):
+        import jax
+        import numpy as np
+
+        G, mesh, cfg, params, batch = self._setup(pp=2)
+        sg = jax.grad(lambda p: G.loss_fn(p, batch, cfg))(params)
+        staged = G.split_stage_params(params, cfg, 2)
+        pg = jax.jit(
+            jax.grad(lambda p: G.pipeline_loss_fn(p, batch, cfg, mesh, num_microbatches=2))
+        )(staged)
+        pg = G.merge_stage_params(pg, cfg)
+        for k in sg:
+            np.testing.assert_allclose(
+                np.asarray(pg[k], np.float32),
+                np.asarray(sg[k], np.float32),
+                atol=2e-2, rtol=2e-2,
+                err_msg=k,
+            )
+
+    def test_gpt_pipeline_composes_with_fsdp_tp(self):
+        import jax
+        import jax.numpy as jnp
+
+        G, mesh, cfg, params, batch = self._setup(pp=2, extra_axes={"fsdp": 2, "tp": 2})
+        from ray_tpu.models.gpt import pipeline_stage_shardings
+
+        staged = G.split_stage_params(params, cfg, 2)
+        shardings = pipeline_stage_shardings(cfg, mesh)
+        staged = {k: jax.device_put(v, shardings[k]) for k, v in staged.items()}
+        loss = jax.jit(
+            lambda p, b: G.pipeline_loss_fn(p, b, cfg, mesh, num_microbatches=2)
+        )(staged, batch)
+        assert bool(jnp.isfinite(loss))
+
+    def test_gpt_pipeline_moe_aux_and_router_grads(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        import ray_tpu.models.gpt as G
+        from ray_tpu.parallel import MeshSpec
+
+        mesh = MeshSpec(pp=2).build(jax.devices()[:2])
+        cfg = G.GPTConfig(
+            vocab_size=64, n_layers=2, d_model=32, n_heads=2, d_head=16,
+            d_mlp=64, max_seq=16, attn_impl="ref", remat=False,
+            mlp_type="moe", moe_experts=2, moe_top_k=1,
+        )
+        params = G.init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 17), 0, cfg.vocab_size)
+        staged = G.split_stage_params(params, cfg, 2)
+        grads = jax.jit(
+            jax.grad(lambda p: G.pipeline_loss_fn(p, {"tokens": tokens}, cfg, mesh, 2))
+        )(staged)
+        router_g = np.abs(np.asarray(grads["moe_router"], np.float32)).sum()
+        assert router_g > 0, "router got no gradient — aux loss not flowing"
+
+    def test_gpt_pipeline_rejects_ring_attention(self):
+        import jax
+        import pytest as _pytest
+        import ray_tpu.models.gpt as G
+        from ray_tpu.parallel import MeshSpec
+
+        mesh = MeshSpec(pp=2).build(jax.devices()[:2])
+        cfg = G.GPTConfig(
+            vocab_size=64, n_layers=2, d_model=32, n_heads=2, d_head=16,
+            d_mlp=64, max_seq=16, attn_impl="ring", remat=False,
+        )
+        params = G.split_stage_params(G.init_params(jax.random.PRNGKey(0), cfg), cfg, 2)
+        tokens = jax.numpy.zeros((2, 17), jax.numpy.int32)
+        with _pytest.raises(NotImplementedError, match="pp-manual"):
+            G.pipeline_loss_fn(params, {"tokens": tokens}, cfg, mesh, 2)
