@@ -106,6 +106,8 @@ def init(
             )
             runtime = Runtime(backend, job_id, address=backend.client_address)
             backend.set_runtime(runtime)
+            if log_to_driver and os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0":
+                backend.start_log_tailer()
 
         _runtime = runtime
         atexit.register(_atexit_shutdown)

@@ -303,11 +303,55 @@ class ClusterBackend(RuntimeBackend):
     def free_objects(self, refs: Sequence[ObjectRef]) -> None:
         self._request({"type": "free_objects", "ids": [r.id.hex() for r in refs]})
 
+    # ------------------------------------------------------------- metrics
+    def record_metric(self, name: str, kind: str, value: float, tags: dict) -> None:
+        self._send(
+            {"type": "record_metric", "name": name, "kind": kind,
+             "value": value, "tags": tags}
+        )
+
+    # --------------------------------------------------------- log tailing
+    def start_log_tailer(self):
+        """Stream worker logs to this driver's stdout (reference analog:
+        `log_monitor.py` → driver). Poll-based over the control plane."""
+        import threading
+
+        if getattr(self, "_log_tailer", None) is not None:
+            return
+        self._log_tailer_stop = threading.Event()
+
+        def tail():
+            # Seed cursors at each file's current end: a driver joining a
+            # long-lived cluster streams from 'now', not hours of history.
+            cursors: Dict[str, int] = {}
+            try:
+                resp = self._request({"type": "tail_logs", "cursors": {}, "init": True})
+                cursors = {
+                    w: c["offset"] for w, c in (resp or {}).get("logs", {}).items()
+                }
+            except Exception:  # noqa: BLE001
+                return
+            while not self._log_tailer_stop.wait(1.0):
+                try:
+                    resp = self._request({"type": "tail_logs", "cursors": cursors})
+                except Exception:  # noqa: BLE001
+                    return
+                for wid, chunk in sorted((resp or {}).get("logs", {}).items()):
+                    cursors[wid] = chunk["offset"]
+                    for line in chunk["data"].splitlines():
+                        print(f"({wid}) {line}")
+
+        self._log_tailer = threading.Thread(target=tail, name="log-tailer", daemon=True)
+        self._log_tailer.start()
+
     # ------------------------------------------------------------ shutdown
     def shutdown(self) -> None:
         from .ref_tracker import TRACKER
 
         TRACKER.set_flusher(None)
+        if getattr(self, "_log_tailer", None) is not None:
+            self._log_tailer_stop.set()
+            self._log_tailer = None
         if self.role == "driver":
             try:
                 self._request({"type": "shutdown"}, timeout=2)

@@ -213,6 +213,10 @@ class Controller:
         self._gc_candidates: Set[str] = set()
         # Reverse index: conn_id -> hex ids it holds (O(refs) disconnects).
         self._conn_refs: Dict[int, Set[str]] = {}
+        # (name, tags) -> (value, kind) — user metrics for /metrics.
+        self.user_metrics: Dict[Tuple[str, tuple], Tuple[float, str]] = {}
+        self.metrics_port = 0
+        self._metrics_server: Optional[asyncio.base_events.Server] = None
 
         self.objects: Dict[str, ObjectState] = {}
         self.workers: Dict[str, WorkerState] = {}
@@ -245,9 +249,37 @@ class Controller:
             self._on_connection, host="127.0.0.1", port=self.port
         )
         self.port = self._server.sockets[0].getsockname()[1]
+        # Prometheus exposition (reference: `metrics_agent.py:83-95`).
+        self._metrics_server = await asyncio.start_server(
+            self._on_metrics_connection, host="127.0.0.1", port=0
+        )
+        self.metrics_port = self._metrics_server.sockets[0].getsockname()[1]
+        self._write_session_info()
         for _ in range(self._min_workers):
             self._spawn_worker()
         asyncio.ensure_future(self._gc_loop())
+
+    def _write_session_info(self):
+        """address.json + /tmp/ray_tpu/session_latest symlink — CLI discovery
+        (reference analog: ray's session_latest convention)."""
+        import json
+
+        info = {
+            "address": f"127.0.0.1:{self.port}",
+            "metrics_url": f"http://127.0.0.1:{self.metrics_port}/metrics",
+            "session_dir": self.session_dir,
+            "pid": os.getpid(),
+        }
+        with open(os.path.join(self.session_dir, "address.json"), "w") as f:
+            json.dump(info, f)
+        link = "/tmp/ray_tpu/session_latest"
+        try:
+            os.makedirs("/tmp/ray_tpu", exist_ok=True)
+            tmp = f"{link}.{os.getpid()}"
+            os.symlink(self.session_dir, tmp)
+            os.replace(tmp, link)
+        except OSError:
+            pass
 
     async def serve_forever(self):
         await self._shutdown_event.wait()
@@ -310,6 +342,7 @@ class Controller:
         env["RAY_TPU_ADDRESS"] = f"127.0.0.1:{self.port}"
         env["RAY_TPU_SESSION_DIR"] = self.session_dir
         env["RAY_TPU_SESSION_TAG"] = store.SESSION_TAG
+        env["PYTHONUNBUFFERED"] = "1"  # log tailing needs unbuffered stdout
         if tpu:
             env["RAY_TPU_WORKER_TPU"] = "1"
         else:
@@ -355,7 +388,7 @@ class Controller:
     # they run as detached tasks — otherwise a long-poll would block the
     # connection's read loop and deadlock clients that get() on one thread
     # while another thread produces the object.
-    _LONG_POLL = frozenset({"get_object", "wait_objects"})
+    _LONG_POLL = frozenset({"get_object", "wait_objects", "tail_logs"})
 
     async def _dispatch_msg(self, conn: Connection, meta: dict, msg: dict):
         mtype = msg["type"]
@@ -1898,6 +1931,203 @@ class Controller:
             "pending_tasks": len(self.ready_queue) + len(self.waiting_tasks),
             "running_tasks": len(self.running),
         }
+
+    # ------------------------------------------------- state API (listing)
+    # Reference analogs: `python/ray/util/state/api.py` list_* +
+    # `dashboard/state_aggregator.py`. Served straight from controller state.
+    async def h_list_tasks(self, conn, meta, msg):
+        out = []
+        for pt in list(self.ready_queue):
+            out.append({"task_id": pt.spec.task_id.hex(), "name": pt.spec.name,
+                        "state": "PENDING_SCHEDULING",
+                        "required_resources": pt.spec.resources})
+        for task_hex, pt in self.waiting_tasks.items():
+            out.append({"task_id": task_hex, "name": pt.spec.name,
+                        "state": "PENDING_ARGS",
+                        "deps_remaining": len(pt.deps_remaining)})
+        for task_hex, (worker_id, pt) in self.running.items():
+            ws = self.workers.get(worker_id)
+            out.append({"task_id": task_hex, "name": pt.spec.name,
+                        "state": "RUNNING", "worker_id": worker_id,
+                        "node_id": ws.node_id if ws else "?"})
+        return {"tasks": out}
+
+    async def h_list_actors(self, conn, meta, msg):
+        out = []
+        for h, a in self.actors.items():
+            ws = self.workers.get(a.worker_id) if a.worker_id else None
+            out.append({
+                "actor_id": h, "state": a.state.upper(), "name": a.name,
+                "namespace": a.namespace, "worker_id": a.worker_id,
+                "node_id": ws.node_id if ws else None,
+                "restarts": a.restarts_used,
+                "pending_calls": len(a.send_queue) + len(a.inflight),
+            })
+        return {"actors": out}
+
+    async def h_list_objects(self, conn, meta, msg):
+        limit = msg.get("limit", 1000)
+        out = []
+        for h, o in itertools.islice(self.objects.items(), limit):
+            out.append({
+                "object_id": h, "status": o.status, "size": o.size,
+                "locations": list(o.locations), "spilled": bool(o.spilled_path),
+                "holders": len(o.holders), "pinned": o.pinned,
+            })
+        return {"objects": out, "total": len(self.objects)}
+
+    async def h_list_workers(self, conn, meta, msg):
+        return {
+            "workers": [
+                {"worker_id": w.worker_id, "state": w.state, "pid": w.pid,
+                 "node_id": w.node_id, "has_tpu": w.has_tpu,
+                 "current_task": w.current_task, "actor": w.actor_hex}
+                for w in self.workers.values()
+            ]
+        }
+
+    # -------------------------------------------------------- log tailing
+    _LOG_CHUNK = 256 * 1024
+
+    @staticmethod
+    def read_log_chunk(path: str, offset: int, cap: int) -> Optional[Tuple[bytes, int]]:
+        """Read a log increment, holding back a trailing partial line so the
+        consumer never prints fragments or splits multi-byte characters
+        (unless a single line exceeds the cap)."""
+        try:
+            with open(path, "rb") as f:
+                f.seek(offset)
+                data = f.read(cap)
+        except OSError:
+            return None
+        if not data:
+            return None
+        if not data.endswith(b"\n"):
+            cut = data.rfind(b"\n")
+            if cut >= 0:
+                data = data[: cut + 1]
+            elif len(data) < cap:
+                return None  # mid-line write in progress; wait for the newline
+        return data, offset + len(data)
+
+    async def h_tail_logs(self, conn, meta, msg):
+        """Incremental worker-log chunks (reference analog: `log_monitor.py`
+        tailing worker files → driver). cursors: {worker_id: offset}. With
+        init=True, returns current end-offsets and no data (a late-joining
+        driver streams from 'now' instead of replaying history). Remote-node
+        workers' files live on their agent — fetched over the agent conn."""
+        cursors: Dict[str, int] = msg.get("cursors", {})
+        only = msg.get("worker_id")
+        init = bool(msg.get("init"))
+        out = {}
+
+        async def one(ws: WorkerState):
+            path = os.path.join(self.session_dir, f"worker-{ws.worker_id}.log")
+            if ws.node_id == HEAD_NODE:
+                if init:
+                    try:
+                        out[ws.worker_id] = {"data": "", "offset": os.path.getsize(path)}
+                    except OSError:
+                        pass
+                    return
+                got = self.read_log_chunk(path, cursors.get(ws.worker_id, 0), self._LOG_CHUNK)
+                if got is not None:
+                    data, offset = got
+                    out[ws.worker_id] = {
+                        "data": data.decode(errors="replace"), "offset": offset
+                    }
+                return
+            node = self.nodes.get(ws.node_id)
+            if node is None or not node.alive or node.conn is None:
+                return
+            try:
+                resp = await node.conn.request(
+                    {"type": "tail_log", "worker_id": ws.worker_id,
+                     "offset": cursors.get(ws.worker_id, 0), "init": init},
+                    timeout=10,
+                )
+            except Exception:  # noqa: BLE001
+                return
+            if resp and resp.get("offset") is not None:
+                out[ws.worker_id] = {"data": resp.get("data", ""), "offset": resp["offset"]}
+
+        await asyncio.gather(*(one(ws) for ws in list(self.workers.values())
+                               if not only or ws.worker_id == only))
+        return {"logs": out}
+
+    # -------------------------------------------------- prometheus metrics
+    async def h_record_metric(self, conn, meta, msg):
+        """User metrics (reference: `ray.util.metrics` Counter/Gauge/Histogram
+        → `metrics_agent.py` Prometheus re-export)."""
+        name, kind, value = msg["name"], msg["kind"], float(msg["value"])
+        tags = tuple(sorted((msg.get("tags") or {}).items()))
+        key = (name, tags)
+        if kind == "counter":
+            cur, _ = self.user_metrics.get(key, (0.0, None))
+            self.user_metrics[key] = (cur + value, kind)
+        else:  # gauge (histograms export observed value gauges + counts)
+            self.user_metrics[key] = (value, kind)
+        return None
+
+    def _prometheus_text(self) -> str:
+        lines = [
+            "# TYPE ray_tpu_tasks_pending gauge",
+            f"ray_tpu_tasks_pending {len(self.ready_queue) + len(self.waiting_tasks)}",
+            "# TYPE ray_tpu_tasks_running gauge",
+            f"ray_tpu_tasks_running {len(self.running)}",
+            "# TYPE ray_tpu_objects gauge",
+            f"ray_tpu_objects {len(self.objects)}",
+            "# TYPE ray_tpu_object_store_bytes gauge",
+            f"ray_tpu_object_store_bytes {self.store_bytes_used}",
+            "# TYPE ray_tpu_workers_alive gauge",
+            f"ray_tpu_workers_alive {sum(1 for w in self.workers.values() if w.state != DEAD)}",
+            "# TYPE ray_tpu_nodes_alive gauge",
+            f"ray_tpu_nodes_alive {sum(1 for n in self.nodes.values() if n.alive)}",
+            "# TYPE ray_tpu_actors gauge",
+            f"ray_tpu_actors {sum(1 for a in self.actors.values() if a.state == 'alive')}",
+        ]
+        def esc(v) -> str:  # prometheus exposition label escaping
+            return str(v).replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+
+        import re
+
+        for n in self.nodes.values():
+            if not n.alive:
+                continue
+            for k, v in n.available.items():
+                lines.append(
+                    f'ray_tpu_node_resource_available{{node="{esc(n.node_id)}",'
+                    f'resource="{esc(k)}"}} {v}'
+                )
+        for (name, tags), (value, kind) in self.user_metrics.items():
+            name = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+            tag_s = ",".join(f'{re.sub(r"[^a-zA-Z0-9_]", "_", k)}="{esc(v)}"' for k, v in tags)
+            lines.append(f"{name}{{{tag_s}}} {value}" if tag_s else f"{name} {value}")
+        return "\n".join(lines) + "\n"
+
+    async def _on_metrics_connection(self, reader, writer):
+        """Minimal HTTP/1.0 responder for GET /metrics (Prometheus text)."""
+        try:
+            line = await asyncio.wait_for(reader.readline(), 5)
+            while True:  # drain headers
+                h = await asyncio.wait_for(reader.readline(), 5)
+                if h in (b"\r\n", b"\n", b""):
+                    break
+            body = self._prometheus_text().encode()
+            path = line.split(b" ")[1] if len(line.split(b" ")) > 1 else b"/"
+            if not path.startswith(b"/metrics"):
+                writer.write(b"HTTP/1.0 404 Not Found\r\nContent-Length: 0\r\n\r\n")
+            else:
+                writer.write(
+                    b"HTTP/1.0 200 OK\r\nContent-Type: text/plain; version=0.0.4\r\n"
+                    + f"Content-Length: {len(body)}\r\n\r\n".encode()
+                    + body
+                )
+            await writer.drain()
+        except Exception:  # noqa: BLE001
+            pass
+        finally:
+            writer.close()
 
     def _event(self, kind: str, **fields):
         self.timeline.append({"ts": time.time(), "event": kind, **fields})

@@ -133,6 +133,8 @@ class NodeAgent:
                 proc = self._worker_procs.get(msg["worker_id"])
                 if proc is not None and proc.poll() is None:
                     proc.terminate()
+            elif mtype == "tail_log" and msg.get("req_id") is not None:
+                await self.conn.respond(msg["req_id"], self._tail_log(msg))
             elif mtype == "exit":
                 self._shutdown.set()
         except Exception:  # noqa: BLE001
@@ -149,6 +151,7 @@ class NodeAgent:
         env["RAY_TPU_SESSION_DIR"] = self.session_dir
         env["RAY_TPU_SESSION_TAG"] = store.SESSION_TAG  # this node's arena
         env["RAY_TPU_NODE_ID"] = self.node_id
+        env["PYTHONUNBUFFERED"] = "1"  # log tailing needs unbuffered stdout
         if tpu:
             env["RAY_TPU_WORKER_TPU"] = "1"
         else:
@@ -167,6 +170,22 @@ class NodeAgent:
             preexec_fn=_set_pdeathsig,
         )
         self._worker_procs[worker_id] = proc
+
+    def _tail_log(self, msg: dict) -> dict:
+        """Serve this node's worker-log increments to the controller."""
+        from .controller import Controller
+
+        path = os.path.join(self.session_dir, f"worker-{msg['worker_id']}.log")
+        if msg.get("init"):
+            try:
+                return {"data": "", "offset": os.path.getsize(path)}
+            except OSError:
+                return {}
+        got = Controller.read_log_chunk(path, msg.get("offset", 0), 256 * 1024)
+        if got is None:
+            return {}
+        data, offset = got
+        return {"data": data.decode(errors="replace"), "offset": offset}
 
     # ------------------------------------------------------------ transfer
     async def _peer(self, addr: str) -> Connection:

@@ -1,0 +1,168 @@
+"""Ops CLI — `python -m ray_tpu.scripts.cli <command>`.
+
+Reference analogs: `python/ray/scripts/scripts.py` (`ray status/timeline`) and
+`python/ray/util/state/state_cli.py` (`ray list tasks/actors/objects/...`).
+
+Address resolution order: --address flag, RAY_TPU_ADDRESS env, then the
+/tmp/ray_tpu/session_latest symlink's address.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _resolve_address(flag: str | None) -> dict:
+    if flag:
+        return {"address": flag}
+    env = os.environ.get("RAY_TPU_ADDRESS")
+    if env:
+        return {"address": env}
+    path = "/tmp/ray_tpu/session_latest/address.json"
+    try:
+        with open(path) as f:
+            info = json.load(f)
+        if not os.path.exists(f"/proc/{info.get('pid', 0)}"):
+            raise SystemExit(
+                "session_latest points at a dead controller; pass --address"
+            )
+        return info
+    except FileNotFoundError:
+        raise SystemExit(
+            "No running session found (no --address, no RAY_TPU_ADDRESS, no "
+            "/tmp/ray_tpu/session_latest)."
+        )
+
+
+def _backend(info: dict):
+    from ray_tpu.core.cluster_backend import ClusterBackend
+
+    backend = ClusterBackend(info["address"])
+    backend._connect(register_as="register_client")
+    return backend
+
+
+def _table(rows, columns):
+    if not rows:
+        print("(empty)")
+        return
+    widths = [max(len(str(r.get(c, ""))) for r in rows + [{c: c}]) for c in columns]
+    print("  ".join(c.ljust(w) for c, w in zip(columns, widths)))
+    for r in rows:
+        print("  ".join(str(r.get(c, "")).ljust(w) for c, w in zip(columns, widths)))
+
+
+def cmd_status(backend, info, args):
+    res = backend._request({"type": "cluster_resources"})
+    nodes = backend._request({"type": "nodes"})["nodes"]
+    summary = backend._request({"type": "state_summary"})
+    print(f"Cluster: {info['address']}")
+    if info.get("metrics_url"):
+        print(f"Metrics: {info['metrics_url']}")
+    print(f"Nodes: {sum(1 for n in nodes if n['Alive'])} alive / {len(nodes)} total")
+    total, avail = res["total"], res["available"]
+    for k in sorted(total):
+        print(f"  {k}: {total[k] - avail.get(k, 0.0):g}/{total[k]:g} used")
+    print(
+        f"Tasks: {summary['running_tasks']} running, {summary['pending_tasks']} pending"
+    )
+    print(f"Workers: {summary['num_workers']}  Objects: {summary['objects']} "
+          f"({summary['store_bytes'] / 1e6:.1f} MB in store)")
+
+
+def cmd_list(backend, info, args):
+    kind = args.kind
+    if kind == "tasks":
+        rows = backend._request({"type": "list_tasks"})["tasks"]
+        for r in rows:
+            r["task_id"] = r["task_id"][:16]
+        _table(rows, ["task_id", "name", "state", "worker_id", "node_id"])
+    elif kind == "actors":
+        rows = backend._request({"type": "list_actors"})["actors"]
+        for r in rows:
+            r["actor_id"] = r["actor_id"][:16]
+        _table(rows, ["actor_id", "name", "state", "node_id", "restarts", "pending_calls"])
+    elif kind == "objects":
+        resp = backend._request({"type": "list_objects", "limit": args.limit})
+        rows = resp["objects"]
+        for r in rows:
+            r["object_id"] = r["object_id"][:16]
+            r["locations"] = ",".join(r["locations"]) or "-"
+        _table(rows, ["object_id", "status", "size", "locations", "holders", "pinned"])
+        if resp["total"] > len(rows):
+            print(f"... {resp['total'] - len(rows)} more (raise --limit)")
+    elif kind == "nodes":
+        rows = backend._request({"type": "nodes"})["nodes"]
+        for r in rows:
+            r["Resources"] = json.dumps(r["Resources"])
+        _table(rows, ["NodeID", "Alive", "Resources"])
+    elif kind == "workers":
+        rows = backend._request({"type": "list_workers"})["workers"]
+        _table(rows, ["worker_id", "state", "node_id", "pid", "has_tpu", "current_task"])
+
+
+def cmd_logs(backend, info, args):
+    # Loop with returned cursors: logs can exceed the server's per-poll cap.
+    cursors = {}
+    shown = set()
+    while True:
+        resp = backend._request(
+            {"type": "tail_logs", "worker_id": args.worker, "cursors": cursors}
+        )
+        logs = resp["logs"]
+        if not logs:
+            break
+        for wid, chunk in sorted(logs.items()):
+            if not args.worker and wid not in shown:
+                print(f"==== {wid} ====")
+                shown.add(wid)
+            cursors[wid] = chunk["offset"]
+            sys.stdout.write(chunk["data"])
+
+
+def cmd_timeline(backend, info, args):
+    events = backend._request({"type": "state_summary"})["timeline"]
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(events, f)
+        print(f"wrote {len(events)} events to {args.output}")
+    else:
+        for ev in events[-args.tail:]:
+            fields = {k: v for k, v in ev.items() if k not in ("ts", "event")}
+            print(f"{ev['ts']:.3f} {ev['event']:28s} {fields}")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="ray-tpu", description=__doc__)
+    parser.add_argument("--address", default=None, help="controller host:port")
+    sub = parser.add_subparsers(dest="command", required=True)
+    sub.add_parser("status", help="cluster summary")
+    p_list = sub.add_parser("list", help="list tasks/actors/objects/nodes/workers")
+    p_list.add_argument("kind", choices=["tasks", "actors", "objects", "nodes", "workers"])
+    p_list.add_argument("--limit", type=int, default=100)
+    p_logs = sub.add_parser("logs", help="dump worker logs")
+    p_logs.add_argument("worker", nargs="?", default=None, help="worker id (all if omitted)")
+    p_tl = sub.add_parser("timeline", help="chrome-trace events")
+    p_tl.add_argument("-o", "--output", default=None)
+    p_tl.add_argument("--tail", type=int, default=50)
+    args = parser.parse_args(argv)
+
+    info = _resolve_address(args.address)
+    backend = _backend(info)
+    try:
+        {
+            "status": cmd_status,
+            "list": cmd_list,
+            "logs": cmd_logs,
+            "timeline": cmd_timeline,
+        }[args.command](backend, info, args)
+    finally:
+        backend.conn.close()
+        backend.io.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,3 +1,4 @@
+from . import metrics
 from .placement_group import (
     PlacementGroup,
     get_current_placement_group,

@@ -1,0 +1,63 @@
+"""User metrics API (reference: `python/ray/util/metrics.py` Counter/Gauge/
+Histogram → OpenCensus → `metrics_agent.py` Prometheus). Redesign: metrics
+push straight to the controller over the control plane and are served from
+its `/metrics` HTTP endpoint (see address.json's metrics_url)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+
+class _Metric:
+    kind = "gauge"
+
+    def __init__(self, name: str, description: str = "", tag_keys: Tuple[str, ...] = ()):
+        self._name = name
+        self._description = description
+        self._tag_keys = tuple(tag_keys)
+        self._default_tags: Dict[str, str] = {}
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self._default_tags = dict(tags)
+        return self
+
+    def _record(self, value: float, tags: Optional[Dict[str, str]]):
+        from ..core import api
+
+        merged = {**self._default_tags, **(tags or {})}
+        backend = api._global_runtime().backend
+        send = getattr(backend, "record_metric", None)
+        if send is not None:
+            send(self._name, self.kind, value, merged)
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
+        if value <= 0:
+            raise ValueError("Counter increments must be positive")
+        self._record(value, tags)
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        self._record(value, tags)
+
+
+class Histogram(_Metric):
+    """Exported as a last-observation gauge plus a _count counter (full
+    bucketed export is a TODO; the reference's boundaries arg is accepted)."""
+
+    kind = "gauge"
+
+    def __init__(self, name, description="", boundaries=None, tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = boundaries or []
+        self._count = Counter(f"{name}_count", description, tag_keys)
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        self._record(value, tags)
+        self._count.inc(1.0, tags)

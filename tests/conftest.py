@@ -13,6 +13,7 @@ import os
 # XLA_FLAGS set here still takes effect at first device query.
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # keep it out of worker subprocesses
+os.environ["RAY_TPU_LOG_TO_DRIVER"] = "0"  # keep worker logs out of test output
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,151 @@
+"""Observability plane: state API, CLI, Prometheus /metrics, log tailing.
+
+Reference analogs: `python/ray/util/state/state_cli.py` (`ray list ...`),
+`python/ray/scripts/scripts.py` (`ray status/timeline`),
+`_private/metrics_agent.py` (Prometheus), `_private/log_monitor.py`.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core import api
+
+pytestmark = pytest.mark.cluster
+
+
+@pytest.fixture
+def cluster_rt():
+    ray_tpu.init(num_cpus=4)
+    yield api._global_runtime().backend
+    ray_tpu.shutdown()
+
+
+def _session_info():
+    with open("/tmp/ray_tpu/session_latest/address.json") as f:
+        return json.load(f)
+
+
+def test_state_api_lists(cluster_rt):
+    backend = cluster_rt
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.options(name="obs-actor").remote()
+    assert ray_tpu.get(a.ping.remote()) == "pong"
+
+    actors = backend._request({"type": "list_actors"})["actors"]
+    assert any(x["name"] == "obs-actor" and x["state"] == "ALIVE" for x in actors)
+    workers = backend._request({"type": "list_workers"})["workers"]
+    assert len(workers) >= 1 and all("node_id" in w for w in workers)
+    ref = ray_tpu.put(list(range(50_000)))
+    objs = backend._request({"type": "list_objects"})
+    assert objs["total"] >= 1
+    _ = ref
+
+
+def test_prometheus_metrics_endpoint(cluster_rt):
+    info = _session_info()
+    text = urllib.request.urlopen(info["metrics_url"], timeout=5).read().decode()
+    assert "ray_tpu_workers_alive" in text
+    assert "ray_tpu_object_store_bytes" in text
+    assert "ray_tpu_nodes_alive 1" in text
+
+
+def test_user_metrics_exported(cluster_rt):
+    from ray_tpu.util.metrics import Counter, Gauge
+
+    Counter("my_app_events").inc(3)
+    Counter("my_app_events").inc(2)
+    Gauge("my_app_qps").set(7.5, tags={"route": "a"})
+    time.sleep(0.3)
+    info = _session_info()
+    text = urllib.request.urlopen(info["metrics_url"], timeout=5).read().decode()
+    assert "my_app_events 5" in text
+    assert 'my_app_qps{route="a"} 7.5' in text
+
+
+def test_tail_logs_returns_worker_output(cluster_rt):
+    backend = cluster_rt
+
+    @ray_tpu.remote
+    def chatty():
+        print("HELLO-FROM-WORKER-xyz")
+        return 1
+
+    assert ray_tpu.get(chatty.remote()) == 1
+    deadline = time.monotonic() + 10
+    seen = ""
+    while time.monotonic() < deadline:
+        resp = backend._request({"type": "tail_logs", "cursors": {}})
+        seen = "".join(c["data"] for c in resp["logs"].values())
+        if "HELLO-FROM-WORKER-xyz" in seen:
+            break
+        time.sleep(0.3)
+    assert "HELLO-FROM-WORKER-xyz" in seen
+
+
+def _run_cli(*args):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", *args],
+        capture_output=True, text=True, timeout=60, env=env, cwd="/root/repo",
+    )
+
+
+def test_cli_status_and_lists(cluster_rt):
+    @ray_tpu.remote
+    def noop():
+        return 1
+
+    ray_tpu.get(noop.remote())
+    r = _run_cli("status")
+    assert r.returncode == 0, r.stderr
+    assert "Cluster:" in r.stdout and "Nodes:" in r.stdout and "CPU" in r.stdout
+    r = _run_cli("list", "workers")
+    assert r.returncode == 0, r.stderr
+    assert "worker_id" in r.stdout
+    r = _run_cli("list", "nodes")
+    assert "node0" in r.stdout
+    r = _run_cli("timeline", "--tail", "5")
+    assert r.returncode == 0, r.stderr
+    r = _run_cli("logs")
+    assert r.returncode == 0, r.stderr
+
+
+def test_tail_logs_from_remote_node():
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    cluster.add_node(num_cpus=2, resources={"r1": 1.0})
+    ray_tpu.init(address=cluster.address)
+    try:
+        @ray_tpu.remote(resources={"r1": 1.0})
+        def chatty():
+            print("REMOTE-NODE-LOG-LINE")
+            return 1
+
+        assert ray_tpu.get(chatty.remote()) == 1
+        backend = api._global_runtime().backend
+        deadline = time.monotonic() + 10
+        seen = ""
+        while time.monotonic() < deadline:
+            resp = backend._request({"type": "tail_logs", "cursors": {}})
+            seen = "".join(c["data"] for c in resp["logs"].values())
+            if "REMOTE-NODE-LOG-LINE" in seen:
+                break
+            time.sleep(0.3)
+        assert "REMOTE-NODE-LOG-LINE" in seen
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
