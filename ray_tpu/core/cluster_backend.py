@@ -324,18 +324,27 @@ class ClusterBackend(RuntimeBackend):
             # Seed cursors at each file's current end: a driver joining a
             # long-lived cluster streams from 'now', not hours of history.
             cursors: Dict[str, int] = {}
+            failures = 0
             try:
                 resp = self._request({"type": "tail_logs", "cursors": {}, "init": True})
                 cursors = {
                     w: c["offset"] for w, c in (resp or {}).get("logs", {}).items()
                 }
             except Exception:  # noqa: BLE001
-                return
+                pass  # keep polling; workers may simply not exist yet
             while not self._log_tailer_stop.wait(1.0):
+                if self.conn is None or self.conn._closed:
+                    return
                 try:
                     resp = self._request({"type": "tail_logs", "cursors": cursors})
+                    failures = 0
                 except Exception:  # noqa: BLE001
-                    return
+                    # Transient hiccups must not silently kill log streaming
+                    # for the rest of the job — retry until persistent.
+                    failures += 1
+                    if failures >= 5:
+                        return
+                    continue
                 for wid, chunk in sorted((resp or {}).get("logs", {}).items()):
                     cursors[wid] = chunk["offset"]
                     for line in chunk["data"].splitlines():

@@ -1920,6 +1920,14 @@ class Controller:
         }
 
     async def h_state_summary(self, conn, meta, msg):
+        if msg.get("counts_only"):  # cheap status — no timeline payload
+            return {
+                "num_workers": len([w for w in self.workers.values() if w.state != DEAD]),
+                "objects": len(self.objects),
+                "store_bytes": self.store_bytes_used,
+                "pending_tasks": len(self.ready_queue) + len(self.waiting_tasks),
+                "running_tasks": len(self.running),
+            }
         return {
             "timeline": list(self.timeline[-10000:]),
             "num_workers": len([w for w in self.workers.values() if w.state != DEAD]),
@@ -1987,29 +1995,6 @@ class Controller:
         }
 
     # -------------------------------------------------------- log tailing
-    _LOG_CHUNK = 256 * 1024
-
-    @staticmethod
-    def read_log_chunk(path: str, offset: int, cap: int) -> Optional[Tuple[bytes, int]]:
-        """Read a log increment, holding back a trailing partial line so the
-        consumer never prints fragments or splits multi-byte characters
-        (unless a single line exceeds the cap)."""
-        try:
-            with open(path, "rb") as f:
-                f.seek(offset)
-                data = f.read(cap)
-        except OSError:
-            return None
-        if not data:
-            return None
-        if not data.endswith(b"\n"):
-            cut = data.rfind(b"\n")
-            if cut >= 0:
-                data = data[: cut + 1]
-            elif len(data) < cap:
-                return None  # mid-line write in progress; wait for the newline
-        return data, offset + len(data)
-
     async def h_tail_logs(self, conn, meta, msg):
         """Incremental worker-log chunks (reference analog: `log_monitor.py`
         tailing worker files → driver). cursors: {worker_id: offset}. With
@@ -2030,7 +2015,9 @@ class Controller:
                     except OSError:
                         pass
                     return
-                got = self.read_log_chunk(path, cursors.get(ws.worker_id, 0), self._LOG_CHUNK)
+                from .log_utils import read_log_chunk
+
+                got = read_log_chunk(path, cursors.get(ws.worker_id, 0))
                 if got is not None:
                     data, offset = got
                     out[ws.worker_id] = {

@@ -173,7 +173,7 @@ class NodeAgent:
 
     def _tail_log(self, msg: dict) -> dict:
         """Serve this node's worker-log increments to the controller."""
-        from .controller import Controller
+        from .log_utils import read_log_chunk
 
         path = os.path.join(self.session_dir, f"worker-{msg['worker_id']}.log")
         if msg.get("init"):
@@ -181,7 +181,7 @@ class NodeAgent:
                 return {"data": "", "offset": os.path.getsize(path)}
             except OSError:
                 return {}
-        got = Controller.read_log_chunk(path, msg.get("offset", 0), 256 * 1024)
+        got = read_log_chunk(path, msg.get("offset", 0))
         if got is None:
             return {}
         data, offset = got

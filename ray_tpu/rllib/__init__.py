@@ -19,8 +19,12 @@ from .algorithms.algorithm_config import AlgorithmConfig
 from .algorithms.ppo import PPO, PPOConfig
 from .algorithms.impala import IMPALA, IMPALAConfig
 from .algorithms.dqn import DQN, DQNConfig
+from .algorithms.sac import SAC, SACConfig
+from .algorithms.appo import APPO, APPOConfig
 from .env import register_env, make_env
 from .env.env_runner import EnvRunner
+from .env.multi_agent import MultiAgentEnv, SharedPolicyVectorEnv, make_multi_agent
+from .utils import replay_buffers
 
 __all__ = [
     "Algorithm",
@@ -31,7 +35,15 @@ __all__ = [
     "IMPALAConfig",
     "DQN",
     "DQNConfig",
+    "SAC",
+    "SACConfig",
+    "APPO",
+    "APPOConfig",
     "register_env",
     "make_env",
     "EnvRunner",
+    "MultiAgentEnv",
+    "SharedPolicyVectorEnv",
+    "make_multi_agent",
+    "replay_buffers",
 ]

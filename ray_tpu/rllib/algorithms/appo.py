@@ -1,0 +1,115 @@
+"""APPO — Asynchronous PPO (reference: `rllib/algorithms/appo/`).
+
+IMPALA's decoupled actor-learner architecture (stale-weight async rollouts,
+consume-as-they-arrive) with PPO's clipped-surrogate objective computed on
+V-trace-corrected advantages — the reference's exact hybrid. Reuses the
+IMPALA driver loop; only the jit-compiled update program differs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+from ..core.learner import Learner
+from .impala import IMPALA, IMPALAConfig
+
+
+class APPOConfig(IMPALAConfig):
+    def __init__(self):
+        super().__init__()
+        self.clip_param: float = 0.2
+        self.lr = 3e-4
+        self.entropy_coeff = 0.01
+
+
+def make_appo_update(module, opt, cfg: APPOConfig):
+    gamma = cfg.gamma
+    rho_bar = cfg.vtrace_clip_rho_threshold
+    c_bar = cfg.vtrace_clip_c_threshold
+    clip = cfg.clip_param
+    vf_coeff, ent_coeff = cfg.vf_loss_coeff, cfg.entropy_coeff
+
+    def loss_fn(params, batch):
+        T, B = batch["rewards"].shape
+        obs_flat = batch["obs"].reshape(T * B, -1)
+        dist, values = module.forward(params, obs_flat)
+        values = values.reshape(T, B)
+        if isinstance(dist, tuple):
+            dist = tuple(
+                d.reshape((T, B) + d.shape[1:]) if d.ndim > 1 else d for d in dist
+            )
+        else:
+            dist = dist.reshape((T, B) + dist.shape[1:])
+        logp = module.log_prob(dist, batch["actions"])
+        _, last_val = module.forward(params, batch["last_obs"])
+
+        ratio = jnp.exp(logp - batch["logp"])
+        clipped_rhos = jnp.minimum(lax.stop_gradient(ratio), rho_bar)
+        cs = jnp.minimum(lax.stop_gradient(ratio), c_bar)
+        not_done = 1.0 - batch["dones"]
+
+        v_next = jnp.concatenate([values[1:], last_val[None]], axis=0)
+        deltas = clipped_rhos * (batch["rewards"] + gamma * not_done * v_next - values)
+
+        def scan_fn(acc, x):
+            delta, c, nd = x
+            acc = delta + gamma * nd * c * acc
+            return acc, acc
+
+        _, vs_minus_v = lax.scan(
+            scan_fn, jnp.zeros_like(last_val), (deltas, cs, not_done), reverse=True
+        )
+        vs = lax.stop_gradient(vs_minus_v + values)
+        vs_next = jnp.concatenate([vs[1:], last_val[None]], axis=0)
+        adv = lax.stop_gradient(
+            clipped_rhos * (batch["rewards"] + gamma * not_done * vs_next - values)
+        )
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+
+        # PPO clipped surrogate on the v-trace advantages — APPO's objective.
+        pg_loss = jnp.maximum(
+            -adv * ratio, -adv * jnp.clip(ratio, 1 - clip, 1 + clip)
+        ).mean()
+        vf_loss = 0.5 * ((values - vs) ** 2).mean()
+        entropy = module.entropy(dist).mean()
+        total = pg_loss + vf_coeff * vf_loss - ent_coeff * entropy
+        return total, {
+            "total_loss": total,
+            "policy_loss": pg_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+            "clip_frac": (jnp.abs(ratio - 1.0) > clip).mean(),
+        }
+
+    def update(state, batch, rng):
+        del rng
+        params, opt_state = state
+        (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, batch)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return (params, opt_state), aux
+
+    return update
+
+
+class APPO(IMPALA):
+    config_class = APPOConfig
+
+    def _make_learner(self) -> Learner:
+        cfg = self.config
+        chain = []
+        if cfg.grad_clip is not None:
+            chain.append(optax.clip_by_global_norm(cfg.grad_clip))
+        chain.append(optax.adam(cfg.lr))
+        opt = optax.chain(*chain)
+        learner = Learner(
+            self.module, make_appo_update(self.module, opt, cfg), seed=cfg.seed
+        )
+        learner.opt_state = opt.init(learner.params)
+        return learner
+
+
+APPOConfig.algo_class = APPO

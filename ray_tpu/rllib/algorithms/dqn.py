@@ -84,51 +84,7 @@ class QPolicyModule(RLModule):
         return jnp.zeros(qvals.shape[:-1], jnp.float32)
 
 
-class ReplayBuffer:
-    """Flat circular numpy buffer (reference: `rllib/utils/replay_buffers/`)."""
-
-    def __init__(self, capacity: int, obs_dim: int):
-        self.capacity = capacity
-        self.obs = np.empty((capacity, obs_dim), np.float32)
-        self.next_obs = np.empty((capacity, obs_dim), np.float32)
-        self.actions = np.empty(capacity, np.int32)
-        self.rewards = np.empty(capacity, np.float32)
-        self.dones = np.empty(capacity, np.float32)
-        self.size = 0
-        self.pos = 0
-
-    def add_fragment(self, batch: Dict[str, np.ndarray]):
-        """Flatten a time-major [T, B] rollout fragment into transitions."""
-        obs, dones = batch["obs"], batch["dones"]
-        T, B = dones.shape
-        next_obs = np.concatenate([obs[1:], batch["last_obs"][None]], axis=0)
-        flat = {
-            "obs": obs.reshape(T * B, -1),
-            "next_obs": next_obs.reshape(T * B, -1),
-            "actions": batch["actions"].reshape(T * B),
-            "rewards": batch["rewards"].reshape(T * B),
-            "dones": dones.reshape(T * B),
-        }
-        n = T * B
-        idx = (self.pos + np.arange(n)) % self.capacity
-        self.obs[idx] = flat["obs"]
-        self.next_obs[idx] = flat["next_obs"]
-        self.actions[idx] = flat["actions"]
-        self.rewards[idx] = flat["rewards"]
-        self.dones[idx] = flat["dones"]
-        self.pos = (self.pos + n) % self.capacity
-        self.size = min(self.size + n, self.capacity)
-
-    def sample(self, rng: np.random.Generator, k: int, mb: int) -> Dict[str, np.ndarray]:
-        """k minibatches of size mb, stacked [k, mb, ...]."""
-        idx = rng.integers(0, self.size, size=(k, mb))
-        return {
-            "obs": self.obs[idx],
-            "next_obs": self.next_obs[idx],
-            "actions": self.actions[idx],
-            "rewards": self.rewards[idx],
-            "dones": self.dones[idx],
-        }
+from ..utils.replay_buffers import ReplayBuffer  # noqa: E402 — shared framework
 
 
 def make_dqn_update(module: QPolicyModule, opt, cfg: DQNConfig):

@@ -33,9 +33,15 @@ class IMPALAConfig(AlgorithmConfig):
         self.train_batch_size = 512
         self.num_env_runners = 2
         self.broadcast_interval: int = 1  # updates between weight refreshes
+        # "adam" | "rmsprop". The reference defaults to rmsprop(eps=0.1),
+        # tuned for Atari-scale gradients — that eps flattens the small
+        # gradients of classic-control tasks to a standstill; adam default.
+        self.opt: str = "adam"
 
     def validate(self):
         super().validate()
+        if self.opt not in ("adam", "rmsprop"):
+            raise ValueError(f"opt must be adam|rmsprop, got {self.opt!r}")
 
 
 def make_vtrace_update(module, opt, cfg: IMPALAConfig):
@@ -123,7 +129,11 @@ class IMPALA(Algorithm):
         chain = []
         if cfg.grad_clip is not None:
             chain.append(optax.clip_by_global_norm(cfg.grad_clip))
-        chain.append(optax.rmsprop(cfg.lr, decay=0.99, eps=0.1))
+        chain.append(
+            optax.adam(cfg.lr)
+            if cfg.opt == "adam"
+            else optax.rmsprop(cfg.lr, decay=0.99, eps=0.1)
+        )
         opt = optax.chain(*chain)
         learner = Learner(
             self.module, make_vtrace_update(self.module, opt, cfg), seed=cfg.seed

@@ -1,0 +1,280 @@
+"""SAC — Soft Actor-Critic (reference: `rllib/algorithms/sac/`).
+
+Squashed-Gaussian actor, twin Q critics with Polyak targets, and learned
+entropy temperature alpha (target entropy = -act_dim). TPU-native: the k
+gradient steps of one iteration run as a single jit-compiled `lax.scan`
+over stacked minibatches — actor, critics, and alpha all update inside one
+XLA program; the host only feeds replay samples.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+
+from ..core.learner import Learner
+from ..core.rl_module import RLModule, _mlp_apply, _mlp_init
+from ..env.spaces import Box
+from ..utils.replay_buffers import ReplayBuffer
+from .algorithm import Algorithm
+from .algorithm_config import AlgorithmConfig
+
+_LOG_STD_MIN, _LOG_STD_MAX = -5.0, 2.0
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 3e-4
+        self.train_batch_size = 400        # env steps sampled per iteration
+        self.replay_buffer_capacity: int = 100_000
+        self.learning_starts: int = 1_000
+        self.minibatch_size: int = 256
+        self.num_grad_steps: int = 32      # grad steps per iteration
+        self.tau: float = 0.005            # Polyak for target critics
+        self.initial_alpha: float = 0.2
+        self.target_entropy: str | float = "auto"  # -act_dim when auto
+        self.grad_clip = None
+
+
+class SACModule(RLModule):
+    """Actor head outputs (mean, log_std); actions are tanh-squashed and
+    scaled to the env bound. Critics live alongside in the same pytree:
+    params = {actor, q1, q2, q1_t, q2_t, log_alpha}."""
+
+    def __init__(self, obs_dim: int, act_dim: int, action_scale: float,
+                 hidden=(256, 256), initial_alpha: float = 0.2):
+        self.obs_dim = obs_dim
+        self.act_dim = act_dim
+        self.action_scale = float(action_scale)
+        self.hidden = tuple(hidden)
+        self.initial_alpha = float(initial_alpha)
+
+    def init(self, rng):
+        ka, k1, k2 = jax.random.split(rng, 3)
+        q_sizes = (self.obs_dim + self.act_dim, *self.hidden, 1)
+        q1 = _mlp_init(k1, q_sizes, scale_last=1.0)
+        q2 = _mlp_init(k2, q_sizes, scale_last=1.0)
+        return {
+            "actor": _mlp_init(ka, (self.obs_dim, *self.hidden, 2 * self.act_dim),
+                               scale_last=0.01),
+            "q1": q1,
+            "q2": q2,
+            "q1_t": jax.tree.map(jnp.copy, q1),
+            "q2_t": jax.tree.map(jnp.copy, q2),
+            "log_alpha": jnp.asarray(np.log(self.initial_alpha), jnp.float32),
+        }
+
+    # ---- actor ----
+    def actor_dist(self, actor_params, obs):
+        out = _mlp_apply(actor_params, obs, activation=jax.nn.relu)
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        return mean, jnp.clip(log_std, _LOG_STD_MIN, _LOG_STD_MAX)
+
+    def sample_action(self, rng, actor_params, obs):
+        """Reparameterized squashed sample → (action, log_prob)."""
+        mean, log_std = self.actor_dist(actor_params, obs)
+        std = jnp.exp(log_std)
+        eps = jax.random.normal(rng, mean.shape)
+        pre = mean + std * eps
+        a = jnp.tanh(pre)
+        # Change-of-variables: tanh Jacobian AND the ×scale Jacobian
+        # (-log scale per dim; without it the entropy equilibrium is biased).
+        logp = jnp.sum(
+            -0.5 * (eps**2 + 2 * log_std + jnp.log(2 * jnp.pi))
+            - jnp.log(1.0 - a**2 + 1e-6)
+            - jnp.log(self.action_scale),
+            axis=-1,
+        )
+        return a * self.action_scale, logp
+
+    def q_value(self, q_params, obs, actions):
+        x = jnp.concatenate([obs, actions / self.action_scale], axis=-1)
+        return _mlp_apply(q_params, x, activation=jax.nn.relu)[..., 0]
+
+    # ---- EnvRunner interface (dist = (mean, log_std)) ----
+    def forward(self, params, obs):
+        dist = self.actor_dist(params["actor"], obs)
+        return dist, jnp.zeros(obs.shape[:-1], jnp.float32)
+
+    def sample(self, rng, dist):
+        mean, log_std = dist
+        pre = mean + jnp.exp(log_std) * jax.random.normal(rng, mean.shape)
+        return jnp.tanh(pre) * self.action_scale
+
+    def greedy(self, dist):
+        return jnp.tanh(dist[0]) * self.action_scale
+
+    def log_prob(self, dist, actions):
+        mean, log_std = dist
+        a = jnp.clip(actions / self.action_scale, -1 + 1e-6, 1 - 1e-6)
+        pre = jnp.arctanh(a)
+        var = jnp.exp(2 * log_std)
+        base = jnp.sum(
+            -0.5 * ((pre - mean) ** 2 / var + 2 * log_std + jnp.log(2 * jnp.pi)), axis=-1
+        )
+        return base - jnp.sum(
+            jnp.log(1.0 - a**2 + 1e-6) + jnp.log(self.action_scale), axis=-1
+        )
+
+    def entropy(self, dist):
+        _, log_std = dist
+        return jnp.sum(log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e), axis=-1)
+
+
+def make_sac_update(module: SACModule, actor_opt, critic_opt, alpha_opt, cfg: SACConfig,
+                    target_entropy: float):
+    gamma, tau = cfg.gamma, cfg.tau
+
+    def critic_loss(qs, params, mb, next_a, next_logp, alpha):
+        y = mb["rewards"] + gamma * (1.0 - mb["dones"]) * (
+            jnp.minimum(
+                module.q_value(params["q1_t"], mb["next_obs"], next_a),
+                module.q_value(params["q2_t"], mb["next_obs"], next_a),
+            )
+            - alpha * next_logp
+        )
+        y = lax.stop_gradient(y)
+        q1 = module.q_value(qs["q1"], mb["obs"], mb["actions"])
+        q2 = module.q_value(qs["q2"], mb["obs"], mb["actions"])
+        return ((q1 - y) ** 2 + (q2 - y) ** 2).mean(), (q1.mean(), jnp.abs(q1 - y))
+
+    def actor_loss(actor, params, mb, rng, alpha):
+        a, logp = module.sample_action(rng, actor, mb["obs"])
+        q = jnp.minimum(
+            module.q_value(params["q1"], mb["obs"], a),
+            module.q_value(params["q2"], mb["obs"], a),
+        )
+        return (alpha * logp - q).mean(), logp
+
+    def update(state, batches, rng):
+        params, opt_states = state
+
+        def grad_step(carry, inp):
+            params, (a_opt, c_opt, al_opt) = carry
+            mb, key = inp
+            k_next, k_actor = jax.random.split(key)
+            alpha = jnp.exp(params["log_alpha"])
+
+            next_a, next_logp = module.sample_action(k_next, params["actor"], mb["next_obs"])
+            (c_loss, (q_mean, _td)), c_grads = jax.value_and_grad(critic_loss, has_aux=True)(
+                {"q1": params["q1"], "q2": params["q2"]}, params, mb, next_a,
+                next_logp, alpha,
+            )
+            c_updates, c_opt = critic_opt.update(
+                c_grads, c_opt, {"q1": params["q1"], "q2": params["q2"]}
+            )
+            new_qs = optax.apply_updates({"q1": params["q1"], "q2": params["q2"]}, c_updates)
+            params = {**params, **new_qs}
+
+            (a_loss, logp), a_grads = jax.value_and_grad(actor_loss, has_aux=True)(
+                params["actor"], params, mb, k_actor, alpha
+            )
+            a_updates, a_opt = actor_opt.update(a_grads, a_opt, params["actor"])
+            params = {**params, "actor": optax.apply_updates(params["actor"], a_updates)}
+
+            al_grad = jax.grad(
+                lambda la: (-jnp.exp(la) * lax.stop_gradient(logp + target_entropy)).mean()
+            )(params["log_alpha"])
+            al_update, al_opt = alpha_opt.update(al_grad, al_opt, params["log_alpha"])
+            params = {
+                **params,
+                "log_alpha": optax.apply_updates(params["log_alpha"], al_update),
+            }
+
+            params = {
+                **params,
+                "q1_t": jax.tree.map(lambda t, o: (1 - tau) * t + tau * o,
+                                     params["q1_t"], params["q1"]),
+                "q2_t": jax.tree.map(lambda t, o: (1 - tau) * t + tau * o,
+                                     params["q2_t"], params["q2"]),
+            }
+            aux = {
+                "critic_loss": c_loss,
+                "actor_loss": a_loss,
+                "alpha": jnp.exp(params["log_alpha"]),
+                "q_mean": q_mean,
+                "entropy": -logp.mean(),
+            }
+            return (params, (a_opt, c_opt, al_opt)), aux
+
+        k = jax.tree_util.tree_leaves(batches)[0].shape[0]
+        keys = jax.random.split(rng, k)
+        (params, opt_states), auxs = lax.scan(grad_step, (params, opt_states), (batches, keys))
+        return (params, opt_states), jax.tree.map(lambda x: x.mean(), auxs)
+
+    return update
+
+
+class SAC(Algorithm):
+    config_class = SACConfig
+
+    def setup(self):
+        super().setup()
+        cfg = self.config
+        obs_dim = int(np.prod(self.observation_space.shape))
+        act_dim = int(np.prod(self.action_space.shape))
+        self._buffer = ReplayBuffer(
+            cfg.replay_buffer_capacity, obs_dim, act_shape=(act_dim,), act_dtype=np.float32
+        )
+        self._np_rng = np.random.default_rng(cfg.seed)
+
+    def _make_module(self):
+        if not isinstance(self.action_space, Box):
+            raise TypeError("SAC requires a continuous (Box) action space")
+        hidden = tuple(self.config.model.get("hidden", (256, 256)))
+        obs_dim = int(np.prod(self.observation_space.shape))
+        act_dim = int(np.prod(self.action_space.shape))
+        scale = float(np.max(np.abs(self.action_space.high)))
+        return SACModule(obs_dim, act_dim, scale, hidden,
+                         initial_alpha=self.config.initial_alpha)
+
+    def _make_learner(self) -> Learner:
+        cfg = self.config
+        act_dim = self.module.act_dim
+        target_entropy = (
+            -float(act_dim) if cfg.target_entropy == "auto" else float(cfg.target_entropy)
+        )
+        def make_opt():
+            chain = []
+            if cfg.grad_clip is not None:
+                chain.append(optax.clip_by_global_norm(cfg.grad_clip))
+            chain.append(optax.adam(cfg.lr))
+            return optax.chain(*chain)
+
+        actor_opt, critic_opt, alpha_opt = make_opt(), make_opt(), make_opt()
+        learner = Learner(
+            self.module,
+            make_sac_update(self.module, actor_opt, critic_opt, alpha_opt, cfg, target_entropy),
+            seed=cfg.seed,
+        )
+        learner.opt_state = (
+            actor_opt.init(learner.params["actor"]),
+            critic_opt.init({"q1": learner.params["q1"], "q2": learner.params["q2"]}),
+            alpha_opt.init(learner.params["log_alpha"]),
+        )
+        return learner
+
+    def training_step(self) -> Dict:
+        cfg = self.config
+        batches = self._sample_batches()
+        env_steps = 0
+        for b in batches:
+            T, B = b["rewards"].shape
+            env_steps += T * B
+            self._buffer.add_fragment(b)
+
+        metrics: Dict = {}
+        if len(self._buffer) >= cfg.learning_starts:
+            mbs = self._buffer.sample(self._np_rng, cfg.num_grad_steps, cfg.minibatch_size)
+            metrics = self.learner_group.update(mbs)
+            self._weights = self.learner_group.get_weights()
+        return {"_env_steps_this_iter": env_steps, "info": {"learner": metrics}}
+
+
+SACConfig.algo_class = SAC

@@ -46,6 +46,22 @@ def _register_builtins():
     register_env("CartPole-v1", VectorCartPole)
     register_env("Pendulum-v1", VectorPendulum)
 
+    def _multi_cartpole(num_envs, num_agents: int = 2, **kwargs):
+        # Shared-policy multi-agent CartPole: num_envs policy slots total.
+        from .multi_agent import SharedPolicyVectorEnv, make_multi_agent
+
+        # Slots come in whole instances (instances × agents). num_envs below
+        # one instance (e.g. the space-probe's num_envs=1) rounds up to one.
+        if num_envs > num_agents and num_envs % num_agents != 0:
+            raise ValueError(
+                f"MultiCartPole needs num_envs ({num_envs}) divisible by "
+                f"num_agents ({num_agents}) — slots are instances × agents"
+            )
+        ma_cls = make_multi_agent(VectorCartPole, num_agents=num_agents)
+        return SharedPolicyVectorEnv(lambda: ma_cls(**kwargs), max(num_envs // num_agents, 1))
+
+    register_env("MultiCartPole", _multi_cartpole)
+
 
 _register_builtins()
 

@@ -33,7 +33,9 @@ class EnvRunner:
         self._env_name = env_name
         self._env_kwargs = dict(env_kwargs or {})
         self.env = make_env(env_name, num_envs, **self._env_kwargs)
-        self.num_envs = num_envs
+        # The env may round the slot count (e.g. multi-agent instances ×
+        # agents) — its own num_envs is authoritative for buffer shapes.
+        self.num_envs = self.env.num_envs
         self.rollout_len = rollout_len
         self.module = module
         self._discrete = isinstance(self.env.action_space, Discrete)
@@ -64,6 +66,9 @@ class EnvRunner:
     def sample(self, params) -> Dict[str, np.ndarray]:
         """Collect `rollout_len` vectorized steps. Returns time-major arrays
         [T, N, ...] plus the bootstrap observation and episode stats."""
+        # Commit weights to device ONCE per fragment: numpy leaves re-commit
+        # on every jit call otherwise (~5ms × n_leaves per env step).
+        params = jax.device_put(params)
         T, N = self.rollout_len, self.num_envs
         obs_buf = np.empty((T, N) + tuple(self.env.observation_space.shape), np.float32)
         act_dtype = np.int32 if self._discrete else np.float32
@@ -107,6 +112,7 @@ class EnvRunner:
         """Greedy rollouts to episode completion (fresh env instance so the
         training stream's auto-reset state is untouched)."""
         env = make_env(self._env_name, self.num_envs, **self._env_kwargs)
+        params = jax.device_put(params)
         obs, _ = env.reset()
         returns: list = []
         guard = 0

@@ -1,0 +1,3 @@
+from . import replay_buffers
+
+__all__ = ["replay_buffers"]

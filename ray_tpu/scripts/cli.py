@@ -58,7 +58,7 @@ def _table(rows, columns):
 def cmd_status(backend, info, args):
     res = backend._request({"type": "cluster_resources"})
     nodes = backend._request({"type": "nodes"})["nodes"]
-    summary = backend._request({"type": "state_summary"})
+    summary = backend._request({"type": "state_summary", "counts_only": True})
     print(f"Cluster: {info['address']}")
     if info.get("metrics_url"):
         print(f"Metrics: {info['metrics_url']}")

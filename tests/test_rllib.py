@@ -86,6 +86,8 @@ class TestPPO:
 
     @pytest.mark.cluster
     def test_remote_env_runners(self):
+        import ray_tpu
+
         algo = (
             PPOConfig()
             .environment("CartPole-v1")
@@ -93,10 +95,13 @@ class TestPPO:
             .training(train_batch_size=512, minibatch_size=128, num_epochs=2)
             .build()
         )
-        result = algo.train()
-        assert result["num_env_steps_sampled_this_iter"] == 512
-        assert np.isfinite(result["info"]["learner"]["total_loss"])
-        algo.stop()
+        try:
+            result = algo.train()
+            assert result["num_env_steps_sampled_this_iter"] == 512
+            assert np.isfinite(result["info"]["learner"]["total_loss"])
+        finally:
+            algo.stop()
+            ray_tpu.shutdown()  # Algorithm.setup initialized the runtime
 
 
 class TestIMPALA:

@@ -1,0 +1,169 @@
+"""Learning-bar tests for every algorithm + replay buffers + multi-agent.
+
+Reference analog: `rllib/tuned_examples/` stop criteria (e.g.
+`cartpole-ppo.yaml` stops at reward 150) — every algorithm must clear a
+reward threshold, not just produce finite losses (VERDICT r1 "What's weak"
+#6: IMPALA/DQN were smoke-only).
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib import (
+    APPOConfig,
+    DQNConfig,
+    IMPALAConfig,
+    PPOConfig,
+    SACConfig,
+    make_env,
+)
+from ray_tpu.rllib.utils.replay_buffers import PrioritizedReplayBuffer, ReplayBuffer
+
+
+def _train_until(algo, bar, max_iters):
+    best = -np.inf
+    for _ in range(max_iters):
+        result = algo.train()
+        m = result["episode_reward_mean"]
+        if np.isfinite(m):
+            best = max(best, m)
+        if best >= bar:
+            break
+    algo.stop()
+    return best
+
+
+class TestLearningBars:
+    def test_dqn_cartpole_learning(self):
+        algo = (
+            DQNConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=0, num_envs_per_env_runner=8)
+            .training(train_batch_size=512, learning_starts=1000, num_grad_steps=64,
+                      epsilon_decay_steps=10_000, lr=5e-4)
+            .debugging(seed=0)
+            .build()
+        )
+        best = _train_until(algo, 130, 80)
+        assert best >= 130, f"DQN failed to learn CartPole: best={best}"
+
+    def test_impala_cartpole_learning(self):
+        algo = (
+            IMPALAConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=0, num_envs_per_env_runner=16,
+                         rollout_fragment_length=16)
+            .training(train_batch_size=256, lr=1e-3, entropy_coeff=0.01)
+            .debugging(seed=0)
+            .build()
+        )
+        best = _train_until(algo, 130, 250)
+        assert best >= 130, f"IMPALA failed to learn CartPole: best={best}"
+
+    def test_appo_cartpole_learning(self):
+        algo = (
+            APPOConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=0, num_envs_per_env_runner=16,
+                         rollout_fragment_length=16)
+            .training(train_batch_size=256, lr=1e-3, entropy_coeff=0.005)
+            .debugging(seed=0)
+            .build()
+        )
+        best = _train_until(algo, 130, 250)
+        assert best >= 130, f"APPO failed to learn CartPole: best={best}"
+
+    def test_sac_pendulum_learning(self):
+        algo = (
+            SACConfig()
+            .environment("Pendulum-v1")
+            .env_runners(num_env_runners=0, num_envs_per_env_runner=8)
+            .training(train_batch_size=256, learning_starts=512, num_grad_steps=256,
+                      minibatch_size=128, model={"hidden": (64, 64)}, lr=3e-4)
+            .debugging(seed=0)
+            .build()
+        )
+        best = _train_until(algo, -350, 200)
+        assert best >= -350, f"SAC failed to learn Pendulum: best={best}"
+
+
+class TestReplayBuffers:
+    def _fragment(self, T=4, B=2, obs_dim=3):
+        rng = np.random.default_rng(0)
+        return {
+            "obs": rng.normal(size=(T, B, obs_dim)).astype(np.float32),
+            "last_obs": rng.normal(size=(B, obs_dim)).astype(np.float32),
+            "actions": rng.integers(0, 2, size=(T, B)).astype(np.int32),
+            "rewards": np.ones((T, B), np.float32),
+            "dones": np.zeros((T, B), np.float32),
+        }
+
+    def test_uniform_wraparound(self):
+        buf = ReplayBuffer(capacity=10, obs_dim=3)
+        for _ in range(3):
+            buf.add_fragment(self._fragment())  # 8 transitions each
+        assert len(buf) == 10  # capped
+        mb = buf.sample(np.random.default_rng(0), k=2, mb=4)
+        assert mb["obs"].shape == (2, 4, 3)
+        assert mb["actions"].dtype == np.int32
+
+    def test_continuous_actions(self):
+        buf = ReplayBuffer(capacity=32, obs_dim=3, act_shape=(2,), act_dtype=np.float32)
+        frag = self._fragment()
+        frag["actions"] = np.random.default_rng(1).normal(size=(4, 2, 2)).astype(np.float32)
+        buf.add_fragment(frag)
+        mb = buf.sample(np.random.default_rng(0), k=1, mb=4)
+        assert mb["actions"].shape == (1, 4, 2)
+
+    def test_prioritized_sampling_and_updates(self):
+        buf = PrioritizedReplayBuffer(capacity=64, obs_dim=3, alpha=1.0)
+        buf.add_fragment(self._fragment(T=8, B=4))  # 32 transitions
+        rng = np.random.default_rng(0)
+        mb = buf.sample(rng, k=1, mb=16, beta=0.4)
+        assert mb["weights"].shape == (1, 16) and mb["weights"].max() <= 1.0
+        # Spike one transition's priority; it should dominate sampling.
+        buf.update_priorities(np.array([5]), np.array([1000.0]))
+        counts = 0
+        for _ in range(20):
+            mb = buf.sample(rng, k=1, mb=8)
+            counts += int((mb["indices"] == 5).sum())
+        assert counts > 40, f"prioritized sampling ignored the spike ({counts})"
+
+
+class TestMultiAgent:
+    def test_multi_agent_env_contract(self):
+        from ray_tpu.rllib.env.cartpole import VectorCartPole
+        from ray_tpu.rllib.env.multi_agent import make_multi_agent
+
+        env = make_multi_agent(VectorCartPole, num_agents=3)()
+        obs, _ = env.reset(seed=0)
+        assert set(obs) == {"agent_0", "agent_1", "agent_2"}
+        acts = {a: 0 for a in env.agents}
+        obs, rew, term, trunc, _ = env.step(acts)
+        assert set(rew) == set(env.agents)
+        assert "__all__" in term and isinstance(term["__all__"], bool)
+
+    def test_shared_policy_vector_env_episodes(self):
+        env = make_env("MultiCartPole", 8, num_agents=2)  # 4 instances × 2 agents
+        obs, _ = env.reset(seed=0)
+        assert obs.shape[0] == 8
+        eps = 0
+        for _ in range(400):
+            obs, rew, term, trunc, info = env.step(
+                np.random.randint(0, 2, env.num_envs)
+            )
+            eps += len(info["episode_returns"])
+        assert eps > 3  # team episodes complete under random play
+
+    def test_shared_policy_ppo_learns_multicartpole(self):
+        algo = (
+            PPOConfig()
+            .environment("MultiCartPole", env_config={"num_agents": 2})
+            .env_runners(num_env_runners=0, num_envs_per_env_runner=16)
+            .training(train_batch_size=2048, minibatch_size=256, num_epochs=10,
+                      lr=3e-4, entropy_coeff=0.01)
+            .debugging(seed=0)
+            .build()
+        )
+        best = _train_until(algo, 150, 25)  # team reward (2 agents)
+        assert best >= 150, f"shared-policy PPO failed on MultiCartPole: best={best}"
