@@ -8,10 +8,13 @@ redesign — THREE planes, matching SURVEY.md §5:
    `jax.lax.p*` inside a jitted program over a Mesh — the "communicator" is
    the XLA compiler. This is where tensor traffic belongs on TPU.
 2. **Host-level group collectives (DCN analog)**: the `ray.util.collective`
-   actor-group API (`init_collective_group` / `allreduce(tensor, group)`)
-   implemented over the object store through a rendezvous actor — for
-   control-plane-sized arrays (weight broadcast, metric reduction) between
-   gang actors, exactly the role Gloo plays in the reference.
+   actor-group API over the OBJECT STORE. The rendezvous actor exchanges
+   only ObjectRefs and blocks members on round completion (no payload ever
+   transits the actor, no busy-polling) — the reference's rendezvous-only
+   pattern (`nccl_collective_group.py:132-155`, where the Info actor stores
+   NCCL ids and data rides NCCL). Tensors move peer-to-peer through the
+   store; large-world allreduce uses bandwidth-optimal reduce-scatter +
+   allgather (per-member traffic ~3×size instead of world×size).
 3. **Multi-host jax runtime bootstrap**: `init_jax_distributed` arranges
    `jax.distributed.initialize` across a WorkerGroup so a multi-host mesh
    can be built (the moral equivalent of `dist.init_process_group` in
@@ -46,94 +49,126 @@ class Backend:
     NCCL = "xla"
 
 
-class GroupInfo:
-    """Rendezvous + reduction state for one collective group (detached actor).
+class GroupRendezvous:
+    """Control-plane-only rendezvous for one collective group (detached
+    actor, max_concurrency sized to the world so members can BLOCK in
+    `contribute_and_await` — long-poll semantics, no client-side spinning).
 
-    Reference analog: the named "Info" actor storing NCCL unique IDs
-    (`collective.py:40 GroupManager`). Here it is also the data plane for
-    host collectives: members push chunks, the actor reduces and serves.
-    """
+    Carries ObjectRefs (and rank bookkeeping) exclusively; tensor bytes
+    stay in the object store."""
 
     def __init__(self, world_size: int):
         self.world_size = world_size
-        self.members: Dict[int, bool] = {}
+        self._lock = threading.Lock()
+        self._members: Dict[int, bool] = {}
+        self._ready = threading.Event()
         self._rounds: Dict[str, dict] = {}
+        self._rank_map: Dict[str, int] = {}  # actor hex -> assigned rank
 
+    # ------------------------------------------------------------ membership
     def join(self, rank: int) -> int:
-        self.members[rank] = True
-        return len(self.members)
+        with self._lock:
+            self._members[rank] = True
+            n = len(self._members)
+            if n >= self.world_size:
+                self._ready.set()
+        return n
 
-    def ready(self) -> bool:
-        return len(self.members) >= self.world_size
+    def await_ready(self, timeout: float = 60.0) -> bool:
+        return self._ready.wait(timeout)
 
+    def assign_ranks(self, mapping: Dict[str, int]) -> bool:
+        with self._lock:
+            self._rank_map.update(mapping)
+        return True
+
+    def assigned_rank(self, actor_hex: str) -> int:
+        with self._lock:
+            return self._rank_map.get(actor_hex, -1)
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    # ---------------------------------------------------------------- rounds
     def _round(self, key: str) -> dict:
         r = self._rounds.get(key)
         if r is None:
-            r = self._rounds[key] = {"parts": {}, "result": None, "fetched": 0}
+            r = self._rounds[key] = {
+                "refs": {},
+                "event": threading.Event(),
+                "served": 0,
+            }
         return r
 
-    def contribute(self, key: str, rank: int, value, op: str, root: int = 0):
-        """Accumulate a member's tensor for round `key`; returns #arrived."""
-        r = self._round(key)
-        r["parts"][rank] = value
-        if op == "p2p":
-            return len(r["parts"])
-        if len(r["parts"]) == self.world_size:
-            vals = [r["parts"][k] for k in sorted(r["parts"])]
-            if op == "sum":
-                out = vals[0]
-                for v in vals[1:]:
-                    out = out + v
-            elif op == "max":
-                out = np.maximum.reduce(vals)
-            elif op == "min":
-                out = np.minimum.reduce(vals)
-            elif op == "prod":
-                out = np.multiply.reduce(vals)
-            elif op == "gather":
-                out = vals
-            elif op == "broadcast":
-                out = r["parts"][root]
-            else:
-                raise ValueError(f"unknown op {op}")
-            r["result"] = out
-        return len(r["parts"])
+    def contribute_and_await(self, key: str, rank: int, ref, timeout: float = 300.0):
+        """Deposit this member's ref for round `key`, then BLOCK until every
+        member has contributed. Returns {rank: ref} or None on timeout.
 
-    def fetch(self, key: str):
-        r = self._round(key)
-        if r["result"] is None:
+        A timeout ABORTS the round for everyone (symmetric failure): the
+        waiters that timed out and any straggler arriving later all get
+        None, and the round's refs are dropped — no member computes a
+        result others missed, and nothing leaks in the actor."""
+        with self._lock:
+            r = self._round(key)
+            if r.get("aborted"):
+                return None
+            r["refs"][rank] = ref
+            if len(r["refs"]) >= self.world_size:
+                r["event"].set()
+        if not r["event"].wait(timeout):
+            with self._lock:
+                r["aborted"] = True
+                r["event"].set()  # release other waiters into the abort path
+                self._rounds.pop(key, None)
             return None
-        result = r["result"]
-        r["fetched"] += 1
-        if r["fetched"] >= self.world_size:
-            self._rounds.pop(key, None)  # all members served — free the round
-        return result
+        with self._lock:
+            if r.get("aborted"):
+                return None
+            refs = dict(r["refs"])
+            r["served"] += 1
+            if r["served"] >= self.world_size:
+                self._rounds.pop(key, None)  # all members served — free refs
+        return refs
 
-    def discard(self, key: str):
-        self._rounds.pop(key, None)
+    # ------------------------------------------------------------------ p2p
+    def put_p2p(self, key: str, ref) -> bool:
+        with self._lock:
+            r = self._round(key)
+            r["refs"][0] = ref
+            r["event"].set()
+        return True
 
-    def fetch_p2p(self, key: str):
-        """One-shot point-to-point mailbox read (consumes the value)."""
-        r = self._rounds.get(key)
-        if r is None or not r["parts"]:
+    def await_p2p(self, key: str, timeout: float = 300.0):
+        with self._lock:
+            r = self._round(key)
+        if not r["event"].wait(timeout):
             return None
-        self._rounds.pop(key, None)
-        return next(iter(r["parts"].values()))
+        with self._lock:
+            self._rounds.pop(key, None)
+            return r["refs"][0]
 
+
+# Back-compat alias (round-1 name).
+GroupInfo = GroupRendezvous
 
 _LOCAL = threading.local()
 
 
 def _info_actor(group_name: str, world_size: Optional[int] = None, create: bool = False):
-    from .. import core
     from ..core import api
 
     name = f"__collective_{group_name}"
     handle = api.get_actor_or_none(name)
     if handle is None and create:
-        remote_cls = api.remote(GroupInfo)
+        remote_cls = api.remote(GroupRendezvous)
         try:
-            handle = remote_cls.options(name=name, lifetime="detached").remote(world_size)
+            handle = remote_cls.options(
+                name=name,
+                lifetime="detached",
+                # Members BLOCK inside contribute_and_await; every member
+                # needs a thread, with headroom for bookkeeping calls.
+                max_concurrency=(world_size or 16) * 2 + 4,
+            ).remote(world_size)
         except ValueError:
             handle = api.get_actor(name)
     if handle is None:
@@ -173,11 +208,8 @@ def init_collective_group(
         )
     info = _info_actor(group_name, world_size, create=True)
     api.get(info.join.remote(rank))
-    deadline = time.time() + 60
-    while not api.get(info.ready.remote()):
-        if time.time() > deadline:
-            raise TimeoutError(f"Group {group_name} rendezvous timed out")
-        time.sleep(0.02)
+    if not api.get(info.await_ready.remote(60.0)):
+        raise TimeoutError(f"Group {group_name} rendezvous timed out")
     _ctx()[group_name] = {"info": info, "rank": rank, "world_size": world_size, "seq": 0}
 
 
@@ -188,10 +220,16 @@ def create_collective_group(
     backend: str = Backend.HOST,
     group_name: str = "default",
 ):
-    """Declarative variant (reference `collective.py:151`): the driver
-    assigns ranks; actors must expose `init_collective_group` calls in their
-    methods (or use `ray_tpu.collective.init_collective_group` inside)."""
-    _info_actor(group_name, world_size, create=True)
+    """Declarative variant (reference `collective.py:151`): the DRIVER
+    assigns ranks to actor handles up front; member processes auto-join on
+    their first collective call (rank resolved from their actor id)."""
+    from ..core import api
+
+    if len(actors) != len(ranks):
+        raise ValueError("actors and ranks must have equal length")
+    info = _info_actor(group_name, world_size, create=True)
+    mapping = {a._id.hex(): r for a, r in zip(actors, ranks)}
+    api.get(info.assign_ranks.remote(mapping))
     return True
 
 
@@ -206,6 +244,32 @@ def destroy_collective_group(group_name: str = "default"):
         _ctx().pop(group_name, None)
 
 
+def _group(group_name: str) -> dict:
+    """Resolve this process's membership — explicit init or driver-assigned
+    rank (create_collective_group) discovered from the runtime actor id."""
+    g = _ctx().get(group_name)
+    if g is not None:
+        return g
+    from ..core import api
+    from ..core.runtime_context import get_runtime_context
+
+    actor_hex = get_runtime_context().get_actor_id()
+    if actor_hex:
+        info = _info_actor(group_name)
+        rank = api.get(info.assigned_rank.remote(actor_hex))
+        if rank >= 0:
+            api.get(info.join.remote(rank))
+            world = api.get(info.get_world_size.remote())
+            g = {"info": info, "rank": rank, "world_size": world, "seq": 0}
+            _ctx()[group_name] = g
+            return g
+    raise RuntimeError(
+        f"init_collective_group('{group_name}') must be called in this process "
+        "first (or the driver must assign this actor a rank via "
+        "create_collective_group)"
+    )
+
+
 def get_rank(group_name: str = "default") -> int:
     g = _ctx().get(group_name)
     return g["rank"] if g else -1
@@ -216,60 +280,133 @@ def get_collective_group_size(group_name: str = "default") -> int:
     return g["world_size"] if g else -1
 
 
-def _sync(group_name: str, op: str, value, root: int = 0):
+def _exchange(g: dict, tag: str, value) -> Dict[int, "object"]:
+    """One rendezvous round: put `value` in the store, swap refs via the
+    group actor (blocking — no polling), return {rank: ref}."""
     from ..core import api
 
-    g = _ctx().get(group_name)
-    if g is None:
-        raise RuntimeError(
-            f"init_collective_group('{group_name}') must be called in this process first"
-        )
     g["seq"] += 1
-    key = f"{op}:{g['seq']}"
-    info = g["info"]
-    api.get(info.contribute.remote(key, g["rank"], value, op, root))
-    deadline = time.time() + 300
-    while True:
-        result = api.get(info.fetch.remote(key))
-        if result is not None:
-            return result
-        if time.time() > deadline:
-            raise TimeoutError(f"collective {op} timed out in group {group_name}")
-        time.sleep(0.005)
+    key = f"{tag}:{g['seq']}"
+    ref = api.put(value)
+    # Wrapped in a list: TOP-LEVEL ObjectRef args are resolved to values
+    # before actor execution (reference semantics); nested refs travel as
+    # refs — which is the whole point of the rendezvous-only design.
+    wrapped = api.get(g["info"].contribute_and_await.remote(key, g["rank"], [ref]))
+    if wrapped is None:
+        raise TimeoutError(
+            f"collective round {key} timed out/aborted — the group is "
+            f"desynchronized; destroy_collective_group() and re-init"
+        )
+    return {r: w[0] for r, w in wrapped.items()}
+
+
+def _reduce(vals: List[np.ndarray], op: str) -> np.ndarray:
+    if op == "sum":
+        out = np.array(vals[0], copy=True)
+        for v in vals[1:]:
+            out = out + v
+        return out
+    if op == "max":
+        return np.maximum.reduce(vals)
+    if op == "min":
+        return np.minimum.reduce(vals)
+    if op == "prod":
+        return np.multiply.reduce(vals)
+    raise ValueError(f"unknown op {op}")
+
+
+_RS_AG_MIN_WORLD = 5
+_RS_AG_MIN_SIZE = 4096  # elements; below this the chunking overhead dominates
 
 
 def allreduce(tensor, group_name: str = "default", op: str = "sum"):
-    """Host-plane allreduce (reference `collective.py:258`). For tensors that
-    live on-device inside jit, use `ops.psum`/`allreduce_jit` instead.
+    """Host-plane allreduce (reference `collective.py:258`). Tensors ride
+    the object store peer-to-peer; for world ≥ 5 and non-trivial sizes the
+    bandwidth-optimal reduce-scatter + allgather runs (per-member traffic
+    ~3×size; the naive gather is world×size). For on-device tensors inside
+    jit use `ops.psum`/`allreduce_jit`."""
+    from ..core import api
 
-    Results are defensive copies: in local mode the object table stores by
-    reference, and members must never alias each other's arrays.
-    """
-    return np.array(_sync(group_name, op, np.asarray(tensor)), copy=True)
+    g = _group(group_name)
+    x = np.asarray(tensor)
+    if g["world_size"] >= _RS_AG_MIN_WORLD and x.size >= _RS_AG_MIN_SIZE:
+        return _allreduce_rs_ag(g, x, op)
+    refs = _exchange(g, f"ar-{op}", x)
+    vals = [np.asarray(api.get(refs[r])) for r in sorted(refs)]
+    return _reduce(vals, op)
+
+
+def _allreduce_rs_ag(g: dict, x: np.ndarray, op: str) -> np.ndarray:
+    """Reduce-scatter + allgather over flat chunks (ring-equivalent traffic)."""
+    from ..core import api
+
+    world, rank = g["world_size"], g["rank"]
+    flat = x.reshape(-1)
+    pad = (-len(flat)) % world
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, flat.dtype)])
+    chunks = flat.reshape(world, -1)
+    # Round 1: publish per-chunk objects; fetch every member's chunk `rank`.
+    my_chunk_refs = [api.put(np.array(chunks[c], copy=True)) for c in range(world)]
+    lists = _exchange(g, f"rs-{op}", my_chunk_refs)
+    # Each exchanged value is itself a (tiny) list-of-refs object; fetch the
+    # manifest, then only chunk `rank` of every member's payload.
+    manifests = {m: api.get(lists[m]) for m in lists}
+    mine = [np.asarray(api.get(manifests[m][rank])) for m in sorted(manifests)]
+    reduced = _reduce(mine, op)
+    # Round 2: publish the reduced chunk; gather all reduced chunks.
+    out_refs = _exchange(g, f"ag-{op}", reduced)
+    parts = [np.asarray(api.get(out_refs[m])) for m in sorted(out_refs)]
+    full = np.concatenate(parts)
+    if pad:
+        full = full[: len(full) - pad]
+    return full.reshape(x.shape)
 
 
 def allgather(tensor, group_name: str = "default"):
-    return [
-        np.array(v, copy=True)
-        for v in _sync(group_name, "gather", np.asarray(tensor))
-    ]
+    from ..core import api
+
+    g = _group(group_name)
+    refs = _exchange(g, "gather", np.asarray(tensor))
+    return [np.array(api.get(refs[r]), copy=True) for r in sorted(refs)]
 
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
-    return np.array(
-        _sync(group_name, "broadcast", np.asarray(tensor), root=src_rank), copy=True
-    )
+    """Root publishes ONE object; every member reads it from the store
+    (zero-copy locally, one transfer per remote node) — the rendezvous actor
+    sees only the ref."""
+    from ..core import api
+
+    g = _group(group_name)
+    x = np.asarray(tensor) if g["rank"] == src_rank else None
+    refs = _exchange(g, "bcast", x)
+    return np.array(api.get(refs[src_rank]), copy=True)
 
 
 def reducescatter(tensor, group_name: str = "default", op: str = "sum"):
-    g = _ctx()[group_name]
-    total = np.array(_sync(group_name, op, np.asarray(tensor)), copy=True)
-    chunks = np.array_split(total, g["world_size"], axis=0)
-    return chunks[g["rank"]]
+    """Each member gets chunk `rank` of the axis-0-split reduction. Large
+    tensors use the chunked manifest (members fetch ONLY their chunk from
+    each peer — per-member traffic ~2×size instead of world×size)."""
+    from ..core import api
+
+    g = _group(group_name)
+    world, rank = g["world_size"], g["rank"]
+    x = np.asarray(tensor)
+    if world >= _RS_AG_MIN_WORLD and x.size >= _RS_AG_MIN_SIZE:
+        chunks = np.array_split(x, world, axis=0)
+        my_chunk_refs = [api.put(np.array(c, copy=True)) for c in chunks]
+        lists = _exchange(g, f"rsc-{op}", my_chunk_refs)
+        manifests = {m: api.get(lists[m]) for m in lists}
+        mine = [np.asarray(api.get(manifests[m][rank])) for m in sorted(manifests)]
+        return _reduce(mine, op)
+    refs = _exchange(g, f"rsc-{op}", x)
+    vals = [np.asarray(api.get(refs[r])) for r in sorted(refs)]
+    total = _reduce(vals, op)
+    return np.array_split(total, world, axis=0)[rank]
 
 
 def barrier(group_name: str = "default"):
-    _sync(group_name, "sum", np.zeros((), np.int32))
+    _exchange(_group(group_name), "barrier", None)
 
 
 def _p2p_key(g: dict, src: int, dst: int) -> str:
@@ -281,28 +418,25 @@ def _p2p_key(g: dict, src: int, dst: int) -> str:
 
 
 def send(tensor, dst_rank: int, group_name: str = "default"):
-    """Point-to-point via the group actor (host plane)."""
+    """Point-to-point: the ref rides the rendezvous actor, the payload rides
+    the store."""
     from ..core import api
 
-    g = _ctx()[group_name]
+    g = _group(group_name)
     key = _p2p_key(g, g["rank"], dst_rank)
-    api.get(g["info"].contribute.remote(key, 0, np.asarray(tensor), "p2p"))
+    ref = api.put(np.asarray(tensor))
+    api.get(g["info"].put_p2p.remote(key, [ref]))  # nested: stays a ref
 
 
 def recv(src_rank: int, group_name: str = "default"):
     from ..core import api
 
-    g = _ctx()[group_name]
+    g = _group(group_name)
     key = _p2p_key(g, src_rank, g["rank"])
-    info = g["info"]
-    deadline = time.time() + 300
-    while True:
-        result = api.get(info.fetch_p2p.remote(key))
-        if result is not None:
-            return np.array(result, copy=True)
-        if time.time() > deadline:
-            raise TimeoutError("recv timed out")
-        time.sleep(0.005)
+    wrapped = api.get(g["info"].await_p2p.remote(key, 300.0))
+    if wrapped is None:
+        raise TimeoutError("recv timed out")
+    return np.array(api.get(wrapped[0]), copy=True)
 
 
 __all__ = [

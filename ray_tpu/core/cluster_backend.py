@@ -324,18 +324,25 @@ class ClusterBackend(RuntimeBackend):
             # Seed cursors at each file's current end: a driver joining a
             # long-lived cluster streams from 'now', not hours of history.
             cursors: Dict[str, int] = {}
+            seeded = False
             failures = 0
-            try:
-                resp = self._request({"type": "tail_logs", "cursors": {}, "init": True})
-                cursors = {
-                    w: c["offset"] for w, c in (resp or {}).get("logs", {}).items()
-                }
-            except Exception:  # noqa: BLE001
-                pass  # keep polling; workers may simply not exist yet
             while not self._log_tailer_stop.wait(1.0):
                 if self.conn is None or self.conn._closed:
                     return
                 try:
+                    if not seeded:
+                        # Never poll with empty cursors un-seeded: that would
+                        # replay full history on the next success.
+                        resp = self._request(
+                            {"type": "tail_logs", "cursors": {}, "init": True}
+                        )
+                        cursors = {
+                            w: c["offset"]
+                            for w, c in (resp or {}).get("logs", {}).items()
+                        }
+                        seeded = True
+                        failures = 0
+                        continue
                     resp = self._request({"type": "tail_logs", "cursors": cursors})
                     failures = 0
                 except Exception:  # noqa: BLE001

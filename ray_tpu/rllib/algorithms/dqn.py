@@ -145,12 +145,10 @@ class DQN(Algorithm):
         return QPolicyModule(obs_dim, self.action_space.n, hidden)
 
     def _make_learner(self) -> Learner:
+        from ..utils.optim import make_optimizer
+
         cfg = self.config
-        chain = []
-        if cfg.grad_clip is not None:
-            chain.append(optax.clip_by_global_norm(cfg.grad_clip))
-        chain.append(optax.adam(cfg.lr))
-        opt = optax.chain(*chain)
+        opt = make_optimizer(cfg)
         learner = Learner(
             self.module, make_dqn_update(self.module, opt, cfg), seed=cfg.seed
         )

@@ -125,16 +125,10 @@ class IMPALA(Algorithm):
         self._updates_since_broadcast = 0
 
     def _make_learner(self) -> Learner:
+        from ..utils.optim import make_optimizer
+
         cfg = self.config
-        chain = []
-        if cfg.grad_clip is not None:
-            chain.append(optax.clip_by_global_norm(cfg.grad_clip))
-        chain.append(
-            optax.adam(cfg.lr)
-            if cfg.opt == "adam"
-            else optax.rmsprop(cfg.lr, decay=0.99, eps=0.1)
-        )
-        opt = optax.chain(*chain)
+        opt = make_optimizer(cfg, cfg.opt)
         learner = Learner(
             self.module, make_vtrace_update(self.module, opt, cfg), seed=cfg.seed
         )

@@ -240,14 +240,11 @@ class SAC(Algorithm):
         target_entropy = (
             -float(act_dim) if cfg.target_entropy == "auto" else float(cfg.target_entropy)
         )
-        def make_opt():
-            chain = []
-            if cfg.grad_clip is not None:
-                chain.append(optax.clip_by_global_norm(cfg.grad_clip))
-            chain.append(optax.adam(cfg.lr))
-            return optax.chain(*chain)
+        from ..utils.optim import make_optimizer
 
-        actor_opt, critic_opt, alpha_opt = make_opt(), make_opt(), make_opt()
+        actor_opt = make_optimizer(cfg)
+        critic_opt = make_optimizer(cfg)
+        alpha_opt = make_optimizer(cfg)
         learner = Learner(
             self.module,
             make_sac_update(self.module, actor_opt, critic_opt, alpha_opt, cfg, target_entropy),

@@ -75,7 +75,10 @@ def make_multi_agent(env_ctor: Callable, num_agents: int = 2):
                 a: bool(term[i]) and bool(live_before[i])
                 for i, a in enumerate(self.agents)
             }
-            trunc_d = {a: bool(trunc[i]) for i, a in enumerate(self.agents)}
+            trunc_d = {
+                a: bool(trunc[i]) and bool(live_before[i])
+                for i, a in enumerate(self.agents)
+            }
             term_d["__all__"] = bool((~self._live).all())
             trunc_d["__all__"] = False
             return obs_d, rew_d, term_d, trunc_d, info

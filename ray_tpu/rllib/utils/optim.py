@@ -1,0 +1,20 @@
+"""Shared optimizer construction for algorithm learners."""
+
+from __future__ import annotations
+
+import optax
+
+
+def make_optimizer(cfg, kind: str = "adam"):
+    """grad-clip (when configured) chained onto the base optimizer — the
+    block every `_make_learner` needs."""
+    chain = []
+    if cfg.grad_clip is not None:
+        chain.append(optax.clip_by_global_norm(cfg.grad_clip))
+    if kind == "adam":
+        chain.append(optax.adam(cfg.lr))
+    elif kind == "rmsprop":
+        chain.append(optax.rmsprop(cfg.lr, decay=0.99, eps=0.1))
+    else:
+        raise ValueError(f"unknown optimizer kind {kind!r}")
+    return optax.chain(*chain)

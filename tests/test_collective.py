@@ -51,6 +51,14 @@ class GangMember:
     def rank_info(self, group):
         return (collective.get_rank(group), collective.get_collective_group_size(group))
 
+    def do_big_allreduce(self, group, n):
+        x = np.arange(n, dtype=np.float64) * (self.rank + 1)
+        return collective.allreduce(x, group_name=group)
+
+    def do_big_broadcast(self, group, n):
+        x = np.arange(n, dtype=np.float64) if self.rank == 0 else np.zeros(1)
+        return collective.broadcast(x, src_rank=0, group_name=group)
+
 
 def _gang(world, group):
     members = [GangMember.remote(r, world) for r in range(world)]
@@ -97,3 +105,62 @@ def test_send_recv():
     members = _gang(2, "g_sr")
     outs = ray_tpu.get([m.do_sendrecv.remote("g_sr") for m in members])
     np.testing.assert_allclose(outs[1], [7.0])
+
+
+def test_allreduce_rs_ag_path():
+    """world>=5 + big tensor takes the reduce-scatter/allgather route."""
+    world, group = 5, "rsag"
+    members = [GangMember.remote(r, world) for r in range(world)]
+    ray_tpu.get([m.setup.remote(group) for m in members])
+
+    refs = [m.do_big_allreduce.remote(group, 5000) for m in members]
+    outs = ray_tpu.get(refs)
+    expected = np.arange(5000, dtype=np.float64) * sum(r + 1 for r in range(world))
+    for o in outs:
+        np.testing.assert_allclose(o, expected)
+
+
+def test_declarative_create_collective_group():
+    """Driver assigns ranks; members auto-join on first collective call
+    (reference `collective.py:151`)."""
+    world, group = 3, "declarative"
+
+    @ray_tpu.remote
+    class Passive:
+        def reduce_something(self, group):
+            x = np.full((8,), 2.0)
+            return collective.allreduce(x, group_name=group)
+
+        def my_rank(self, group):
+            return collective.get_rank(group)
+
+    members = [Passive.remote() for _ in range(world)]
+    collective.create_collective_group(
+        members, world, list(range(world)), group_name=group
+    )
+    outs = ray_tpu.get([m.reduce_something.remote(group) for m in members])
+    for o in outs:
+        np.testing.assert_allclose(o, np.full((8,), 6.0))
+    ranks = sorted(ray_tpu.get([m.my_rank.remote(group) for m in members]))
+    assert ranks == [0, 1, 2]
+
+
+@pytest.mark.cluster
+def test_weight_broadcast_world16_cluster():
+    """VERDICT r1 item 8 done-criterion: broadcast scaling at world=16 over
+    real worker processes — payload rides the store, not the rendezvous."""
+    import ray_tpu as rt
+
+    rt.shutdown()
+    rt.init(num_cpus=8)  # worker-pool cap is 4×cpus; 16 members + rendezvous
+    try:
+        world, group = 16, "bcast16"
+        members = [GangMember.remote(r, world) for r in range(world)]
+        rt.get([m.setup.remote(group) for m in members], timeout=120)
+        refs = [m.do_big_broadcast.remote(group, 250_000) for m in members]
+        outs = rt.get(refs, timeout=180)
+        expected = np.arange(250_000, dtype=np.float64)  # 2MB weights
+        for o in outs:
+            np.testing.assert_allclose(o, expected)
+    finally:
+        rt.shutdown()
