@@ -85,17 +85,21 @@ class Cluster:
             )
 
     # -------------------------------------------------------------- head
-    def _start_head(self, num_cpus, resources, object_store_memory):
-        self.session_dir = os.path.join(
-            "/tmp/ray_tpu", f"cluster_{int(time.time() * 1000)}_{os.getpid()}"
-        )
+    def _start_head(self, num_cpus, resources, object_store_memory, restore=False):
+        if self.session_dir is None:
+            self.session_dir = os.path.join(
+                "/tmp/ray_tpu", f"cluster_{int(time.time() * 1000)}_{os.getpid()}"
+            )
         os.makedirs(self.session_dir, exist_ok=True)
+        self._head_args = (num_cpus, resources, object_store_memory)
         args = {
             "num_cpus": float(num_cpus),
             "resources": resources,
             "session_dir": self.session_dir,
             "object_store_memory": object_store_memory,
             "port": 0,
+            "restore": restore,
+            "standalone": True,  # the cluster owns the lifetime, not drivers
         }
         env = dict(os.environ)
         pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -118,6 +122,20 @@ class Cluster:
         port = int(val)
         self.head_proc = proc
         self.address = f"127.0.0.1:{port}"
+
+    def kill_head(self):
+        """kill -9 the controller (GCS-FT chaos; workers survive — they are
+        orphaned, not PDEATHSIG-bound like node-agent workers)."""
+        if self.head_proc is not None and self.head_proc.poll() is None:
+            self.head_proc.kill()
+            self.head_proc.wait(timeout=10)
+
+    def restart_head(self):
+        """Restart the controller against the same session dir: it replays
+        the periodic snapshot, re-binds its port, and re-adopts surviving
+        actor workers as they reconnect."""
+        num_cpus, resources, object_store_memory = self._head_args
+        self._start_head(num_cpus, resources, object_store_memory, restore=True)
 
     # ------------------------------------------------------------- nodes
     def add_node(

@@ -119,7 +119,9 @@ class GroupRendezvous:
             with self._lock:
                 r["aborted"] = True
                 r["event"].set()  # release other waiters into the abort path
-                self._rounds.pop(key, None)
+                r["refs"].clear()  # drop payload refs; KEEP the tombstone so
+                # a straggler arriving later fails fast instead of opening a
+                # fresh round and stalling its own full timeout.
             return None
         with self._lock:
             if r.get("aborted"):

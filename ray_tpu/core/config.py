@@ -1,0 +1,92 @@
+"""Central flag registry (reference: `src/ray/common/ray_config_def.h` — 220
+`RAY_CONFIG(type, name, default)` entries behind a singleton, overridable by
+env vars on every process).
+
+Every tunable lives HERE with its default; any process overrides any flag
+with `RAY_TPU_<NAME>` in its environment. `get()` is cheap (cached after
+first read) — safe in hot paths.
+
+    from ray_tpu.core import config
+    config.get("gc_grace_s")          # -> 1.0, or RAY_TPU_GC_GRACE_S env
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict
+
+
+@dataclass(frozen=True)
+class Flag:
+    name: str
+    default: Any
+    type: Callable
+    doc: str
+
+
+_REGISTRY: Dict[str, Flag] = {}
+_CACHE: Dict[str, Any] = {}
+_LOCK = threading.Lock()
+
+
+def _parse_bool(v) -> bool:
+    if isinstance(v, bool):
+        return v
+    return str(v).lower() in ("1", "true", "yes", "on")
+
+
+def define(name: str, default: Any, type_: Callable = None, doc: str = ""):
+    if type_ is None:
+        type_ = _parse_bool if isinstance(default, bool) else type(default)
+    _REGISTRY[name] = Flag(name, default, type_, doc)
+
+
+def get(name: str) -> Any:
+    try:
+        return _CACHE[name]
+    except KeyError:
+        pass
+    flag = _REGISTRY.get(name)
+    if flag is None:
+        raise KeyError(f"Unknown config flag {name!r}; known: {sorted(_REGISTRY)}")
+    raw = os.environ.get(f"RAY_TPU_{name.upper()}")
+    value = flag.default if raw is None else flag.type(raw)
+    with _LOCK:
+        _CACHE[name] = value
+    return value
+
+
+def all_flags() -> Dict[str, Any]:
+    """Resolved view of every flag (for `ray-tpu status`/debugging)."""
+    return {name: get(name) for name in sorted(_REGISTRY)}
+
+
+def _reset_cache_for_tests():
+    with _LOCK:
+        _CACHE.clear()
+
+
+# ----------------------------------------------------------------- defaults
+# Object plane.
+define("inline_threshold_bytes", 64 * 1024,
+       doc="Objects at or below this ride the control plane inline")
+define("object_store_fraction", 0.3,
+       doc="Fraction of system memory for the default object store size")
+define("log_chunk_bytes", 256 * 1024, doc="Max bytes per log-tail poll")
+# Ref counting / GC.
+define("gc_grace_s", 1.0,
+       doc="Delay before freeing a holderless object (absorbs in-flight adds)")
+define("gc_sweep_interval_s", 0.4, doc="GC candidate sweep period")
+define("ref_flush_interval_s", 0.25, doc="Client ref-transition batch period")
+define("lineage_cap", 20_000, doc="Max task specs retained for reconstruction")
+# Scheduler / workers.
+define("scheduler_scan_window", 64,
+       doc="Ready-queue head scan bound per scheduling pass")
+define("max_workers_per_cpu", 4, doc="Worker pool cap = cpus × this")
+define("worker_prestart_cap", 6, doc="Max head workers prestarted per pass")
+define("spawn_burst_cap", 4, doc="Max workers spawned per node per pass")
+# Persistence.
+define("snapshot_interval_s", 1.0, doc="Controller state snapshot period")
+define("pull_timeout_s", 120.0, doc="Cross-node object pull timeout")

@@ -37,6 +37,7 @@ from .exceptions import (
     TaskError,
     WorkerCrashedError,
 )
+from . import config as rt_config
 from .rpc import Connection, read_msg
 from .task_spec import (
     NodeAffinitySchedulingStrategy,
@@ -178,13 +179,21 @@ class Controller:
         session_dir: str,
         object_store_memory: Optional[int] = None,
         port: int = 0,
+        standalone: bool = False,
     ):
+        # standalone: a Cluster-managed controller outlives its drivers
+        # (sessions auto-started by ray_tpu.init still die with the driver).
+        self.standalone = standalone
         self.session_dir = session_dir
         os.makedirs(session_dir, exist_ok=True)
         self.spill_dir = os.path.join(session_dir, "spill")
         self.port = port
         self.object_store_memory = object_store_memory or int(
-            min(0.3 * os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES"), 64 << 30)
+            min(
+                rt_config.get("object_store_fraction")
+                * os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES"),
+                64 << 30,
+            )
         )
         self.store_bytes_used = 0
         self.local_store = store.LocalStore()
@@ -208,7 +217,7 @@ class Controller:
         # `object_recovery_manager.cc:22`; ObjectID encodes TaskID so the
         # lookup is free — `common/id.h:272` property kept by ids.py).
         self.lineage: Dict[str, TaskSpec] = {}
-        self._lineage_cap = 20_000
+        self._lineage_cap = rt_config.get("lineage_cap")
         self._conn_counter = itertools.count(1)
         self._gc_candidates: Set[str] = set()
         # Reverse index: conn_id -> hex ids it holds (O(refs) disconnects).
@@ -230,21 +239,29 @@ class Controller:
         self.timeline: List[dict] = []
         self.drivers: Set[Connection] = set()
         self._worker_counter = itertools.count()
-        self._max_workers = max(int(num_cpus) * 4, 8)
+        self._max_workers = max(int(num_cpus) * rt_config.get("max_workers_per_cpu"), 8)
         self._min_workers = 2
         self._server: Optional[asyncio.base_events.Server] = None
         self._shutdown_event = asyncio.Event()
         self._worker_procs: Dict[str, subprocess.Popen] = {}
 
     # ------------------------------------------------------------ lifecycle
-    async def start(self):
-        store.set_session_tag(str(os.getpid()))
-        store.cleanup_stale_segments()
-        # Native arena (plasma-equivalent): the controller owns the segment;
-        # drivers/workers attach after the session-tag handshake.
-        self.local_store = store.make_store(
-            create_arena=True, arena_capacity=self.object_store_memory
-        )
+    @property
+    def _snapshot_path(self) -> str:
+        return os.path.join(self.session_dir, "controller_state.pkl")
+
+    async def start(self, restore: bool = False):
+        restored = restore and os.path.exists(self._snapshot_path)
+        if restored:
+            restored = self._load_snapshot()  # adopts the dead session's tag
+        if not restored:
+            store.set_session_tag(str(os.getpid()))
+            store.cleanup_stale_segments()
+            # Native arena (plasma-equivalent): the controller owns the
+            # segment; drivers/workers attach after the session-tag handshake.
+            self.local_store = store.make_store(
+                create_arena=True, arena_capacity=self.object_store_memory
+            )
         self._server = await asyncio.start_server(
             self._on_connection, host="127.0.0.1", port=self.port
         )
@@ -255,9 +272,148 @@ class Controller:
         )
         self.metrics_port = self._metrics_server.sockets[0].getsockname()[1]
         self._write_session_info()
-        for _ in range(self._min_workers):
-            self._spawn_worker()
+        if self.standalone:
+            store.mark_restorable(store.SESSION_TAG, True)
+        if not restored:
+            for _ in range(self._min_workers):
+                self._spawn_worker()
         asyncio.ensure_future(self._gc_loop())
+        asyncio.ensure_future(self._snapshot_loop())
+
+    # --------------------------------------------------- persistence (GCS FT)
+    # Reference analog: GCS tables behind `RedisStoreClient`
+    # (`redis_store_client.h:33`) + replay via `gcs_init_data.cc`. Redesign:
+    # a periodic pickle of the durable directories to the session dir; a
+    # restarted controller replays it, re-binds the SAME port, and re-adopts
+    # workers as they reconnect (their shm arena survived the crash — kill -9
+    # skips teardown, and segment names key off the ORIGINAL session tag).
+    def _snapshot_state(self) -> dict:
+        return {
+            "session_tag": store.SESSION_TAG,
+            "port": self.port,
+            "object_store_memory": self.object_store_memory,
+            "store_bytes_used": self.store_bytes_used,
+            "named_actors": dict(self.named_actors),
+            "actors": {
+                h: {
+                    "spec": cloudpickle.dumps(a.spec) if a.spec is not None else None,
+                    "name": a.name,
+                    "namespace": a.namespace,
+                    "handle_bytes": a.handle_bytes,
+                    "state": a.state,
+                    "worker_id": a.worker_id,
+                    "restarts_used": a.restarts_used,
+                    "detached": a.detached,
+                }
+                for h, a in self.actors.items()
+            },
+            "pgs": {k: dict(v) for k, v in self.pgs.items()},
+            "objects": {
+                h: {
+                    "status": o.status,
+                    "inline": o.inline,
+                    "locations": dict(o.locations),
+                    "spilled_path": o.spilled_path,
+                    "spilled_node": o.spilled_node,
+                    "size": o.size,
+                    "ever_held": o.ever_held,
+                    "expected": o.expected,
+                    "contains": list(o.contains),
+                }
+                for h, o in self.objects.items()
+                if o.status == "ready"
+            },
+        }
+
+    async def _snapshot_loop(self):
+        # Driver-owned sessions (non-standalone) die with their driver and
+        # can never restore — don't pay the snapshot cost for them.
+        if not self.standalone:
+            return
+        loop = asyncio.get_running_loop()
+
+        def dump(state: dict):
+            blob = cloudpickle.dumps(state)
+            tmp = self._snapshot_path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, self._snapshot_path)  # atomic vs kill -9
+
+        while not self._shutdown_event.is_set():
+            await asyncio.sleep(rt_config.get("snapshot_interval_s"))
+            try:
+                # Build the (shallow-copied) state on-loop, serialize + write
+                # OFF-loop — large tables must not stall scheduling/RPC.
+                state = self._snapshot_state()
+                await loop.run_in_executor(None, dump, state)
+            except Exception:  # noqa: BLE001
+                traceback.print_exc()
+
+    def _load_snapshot(self) -> bool:
+        try:
+            with open(self._snapshot_path, "rb") as f:
+                snap = cloudpickle.loads(f.read())
+        except Exception:  # noqa: BLE001
+            return False
+        store.set_session_tag(snap["session_tag"])
+        self.local_store = store.make_store(create_arena=False)  # re-attach
+        self.port = snap["port"]
+        self.object_store_memory = snap["object_store_memory"]
+        self.store_bytes_used = snap.get("store_bytes_used", 0)
+        self.named_actors = dict(snap["named_actors"])
+        for h, a in snap["actors"].items():
+            astate = ActorState(
+                actor_hex=h,
+                spec=cloudpickle.loads(a["spec"]) if a["spec"] else None,
+                name=a["name"],
+                namespace=a["namespace"],
+                handle_bytes=a["handle_bytes"],
+                detached=a["detached"],
+            )
+            astate.restarts_used = a["restarts_used"]
+            astate.worker_id = a["worker_id"]
+            # Until its worker reconnects, the actor is "restarting": calls
+            # queue instead of failing (reference: actor restart states).
+            astate.state = "restarting" if a["state"] in ("alive", "pending", "restarting") else a["state"]
+            self.actors[h] = astate
+        for k, v in snap["pgs"].items():
+            self.pgs[k] = dict(v)
+            # Bundles were reserved against head capacity pre-crash; re-apply.
+            for b, nid in zip(v["bundles"], v.get("bundle_nodes", [])):
+                if nid == HEAD_NODE:
+                    self._acquire(self.head, b)
+        for h, o in snap["objects"].items():
+            obj = self._obj(h)
+            obj.status = o["status"]
+            obj.inline = o["inline"]
+            obj.locations = dict(o["locations"])
+            obj.spilled_path = o["spilled_path"]
+            obj.spilled_node = o["spilled_node"]
+            obj.size = o["size"]
+            obj.ever_held = o["ever_held"]
+            obj.expected = o["expected"]
+            obj.contains = list(o["contains"])
+            for c in obj.contains:
+                self._obj(c).pinned += 1
+        self._event("controller_restored", actors=len(self.actors),
+                    objects=len(self.objects))
+        asyncio.get_running_loop().call_later(
+            40.0, lambda: asyncio.ensure_future(self._readopt_deadline())
+        )
+        return True
+
+    async def _readopt_deadline(self):
+        """Actors still 'restarting' after the reconnect window lost their
+        worker during the outage — run the normal death path so they restart
+        from spec (or die) instead of queueing calls forever."""
+        for actor_hex, astate in list(self.actors.items()):
+            if astate.state != "restarting":
+                continue
+            ws = self.workers.get(astate.worker_id)
+            if ws is not None and ws.state == ACTOR and ws.actor_hex == actor_hex:
+                continue  # reconnected fine
+            self._event("actor_readopt_timeout", actor=actor_hex)
+            await self._on_actor_worker_death(actor_hex)
 
     def _write_session_info(self):
         """address.json + /tmp/ray_tpu/session_latest symlink — CLI discovery
@@ -309,6 +465,8 @@ class Controller:
         arena = getattr(self.local_store, "arena", None)
         if arena is not None:
             arena.unlink()  # whole-session segment; workers are exiting
+        if self.standalone:  # graceful end — session no longer restorable
+            store.mark_restorable(store.SESSION_TAG, False)
         if self._server:
             self._server.close()
 
@@ -424,7 +582,7 @@ class Controller:
             await self._on_node_death(meta["node_id"])
         elif meta["kind"] == "driver":
             self.drivers.discard(conn)
-            if not self.drivers:
+            if not self.drivers and not self.standalone:
                 # Last driver gone → end the session.
                 self._shutdown_event.set()
 
@@ -459,6 +617,39 @@ class Controller:
             node_id=node_id,
         )
         self.workers[worker_id] = ws
+        # Re-adoption after a controller restart: a surviving actor worker
+        # reconnects carrying its actor id — restore the binding and wake
+        # the actor's queued calls (reference analog: GCS restart replaying
+        # actor tables + workers re-registering).
+        actor_hex = msg.get("actor_hex")
+        if actor_hex:
+            astate = self.actors.get(actor_hex)
+            if astate is not None and astate.state != "dead":
+                ws.state = ACTOR
+                ws.actor_hex = actor_hex
+                astate.worker_id = worker_id
+                # Re-acquire the actor's capacity grant or the books show
+                # its resources free (double-booking). PG-backed actors skip
+                # the deduction: the snapshotted bundle_avail already
+                # reflects their consumption.
+                if astate.spec is not None:
+                    demand = astate.spec.resources
+                    strat = astate.spec.options.scheduling_strategy
+                    if (
+                        isinstance(strat, PlacementGroupSchedulingStrategy)
+                        and strat.placement_group is not None
+                    ):
+                        pg_hex = strat.placement_group.id.hex()
+                        bidx = max(strat.placement_group_bundle_index, 0)
+                        ws.assigned = dict(demand)
+                        ws.assigned_pg = (pg_hex, bidx)
+                    else:
+                        node0 = self.nodes.get(node_id)
+                        if node0 is not None:
+                            self._acquire(node0, demand)
+                        ws.assigned = dict(demand)
+                self._set_actor_state(astate, "alive")
+                self._event("actor_readopted", actor=actor_hex, worker=worker_id)
         node = self.nodes.get(node_id)
         if node is not None:
             node.spawning = max(0, node.spawning - 1)
@@ -604,7 +795,7 @@ class Controller:
                     req["name"] = src["name"]
                 else:
                     req["path"] = src["path"]
-                resp = await node.conn.request(req, timeout=120)
+                resp = await node.conn.request(req, timeout=rt_config.get("pull_timeout_s"))
                 if not resp.get("ok"):
                     raise RuntimeError(f"pull failed: {resp.get('error')}")
                 name = resp["name"]
@@ -816,7 +1007,8 @@ class Controller:
                 self._maybe_gc(hex_id)
         return None
 
-    _GC_GRACE = 1.0  # > 2× the client flush interval: lets in-flight adds land
+    _GC_GRACE = property(lambda self: rt_config.get("gc_grace_s"))
+    # must stay > 2× the client flush interval so in-flight adds land
 
     def _maybe_gc(self, hex_id: str):
         """Schedule a holderless, unpinned object for the GC sweep. The grace
@@ -838,7 +1030,7 @@ class Controller:
 
     async def _gc_loop(self):
         while not self._shutdown_event.is_set():
-            await asyncio.sleep(0.4)
+            await asyncio.sleep(rt_config.get("gc_sweep_interval_s"))
             now = time.monotonic()
             for hex_id in list(self._gc_candidates):
                 obj = self.objects.get(hex_id)
@@ -1221,7 +1413,7 @@ class Controller:
             # Bounded head scan: dispatch FIFO, skipping over at most a small
             # window of blocked tasks (so a TPU task at the head can't starve
             # CPU tasks behind it, but a long queue isn't rescanned per event).
-            scan = min(len(self.ready_queue), 64)
+            scan = min(len(self.ready_queue), rt_config.get("scheduler_scan_window"))
             for _ in range(scan):
                 pt = self.ready_queue.popleft()
                 spec = pt.spec
@@ -1359,7 +1551,7 @@ class Controller:
                 1 for w in self.workers.values()
                 if w.state == STARTING and w.node_id == node_id
             )
-            for _ in range(max(0, min(wanted - booting, 4))):
+            for _ in range(max(0, min(wanted - booting, rt_config.get("spawn_burst_cap")))):
                 self._spawn_worker(node=node)
         # Top the head pool up to the queue depth.
         starting = self.head.spawning + sum(
@@ -1367,7 +1559,7 @@ class Controller:
         )
         cpu_backlog = sum(1 for pt in self.ready_queue if pt.spec.resources.get("TPU", 0) == 0)
         deficit = cpu_backlog - starting
-        for _ in range(max(0, min(deficit, 6))):
+        for _ in range(max(0, min(deficit, rt_config.get("worker_prestart_cap")))):
             self._spawn_worker()
 
     def _finish_cancelled(self, pt: PendingTask):
@@ -1892,6 +2084,25 @@ class Controller:
             self._schedule()
         return {"ok": True}
 
+    # ------------------------------------------------------ fault injection
+    async def h_kill_worker(self, conn, meta, msg):
+        """Chaos hook (reference: `WorkerKillerActor`, `test_utils.py:1527`)."""
+        ws = self.workers.get(msg["worker_id"])
+        if ws is None or ws.state == DEAD:
+            return {"ok": False}
+        self._terminate_worker(ws)
+        self._event("chaos_worker_killed", worker=ws.worker_id)
+        return {"ok": True}
+
+    async def h_kill_node(self, conn, meta, msg):
+        """Chaos hook: tell a node agent to exit (its workers die with it)."""
+        node = self.nodes.get(msg["node_id"])
+        if node is None or not node.alive or node.conn is None:
+            return {"ok": False}
+        await node.conn.send({"type": "exit"})
+        self._event("chaos_node_killed", node=node.node_id)
+        return {"ok": True}
+
     # -------------------------------------------------------------- state
     async def h_cluster_resources(self, conn, meta, msg):
         total = self._cluster_totals()
@@ -2129,8 +2340,9 @@ async def run_controller(args: dict):
         session_dir=args["session_dir"],
         object_store_memory=args.get("object_store_memory"),
         port=args.get("port", 0),
+        standalone=bool(args.get("standalone")),
     )
-    await ctrl.start()
+    await ctrl.start(restore=bool(args.get("restore")))
     # Handshake: parent reads this line to learn the port.
     print(f"RAY_TPU_CONTROLLER_PORT={ctrl.port}", flush=True)
     await ctrl.serve_forever()

@@ -5,7 +5,13 @@ from __future__ import annotations
 
 from typing import Optional, Tuple
 
-LOG_CHUNK = 256 * 1024
+def _log_chunk() -> int:
+    from . import config as rt_config
+
+    return rt_config.get("log_chunk_bytes")
+
+
+LOG_CHUNK = _log_chunk()
 
 
 def read_log_chunk(path: str, offset: int, cap: int = LOG_CHUNK) -> Optional[Tuple[bytes, int]]:

@@ -16,7 +16,10 @@ import time
 from collections import deque
 from typing import Callable, Dict, Optional, Set
 
-_FLUSH_INTERVAL = 0.25
+def _flush_interval() -> float:
+    from . import config as rt_config
+
+    return rt_config.get("ref_flush_interval_s")
 
 
 class RefTracker:
@@ -104,7 +107,7 @@ class RefTracker:
 
     def _flush_loop(self, gen: int):
         while True:
-            time.sleep(_FLUSH_INTERVAL)
+            time.sleep(_flush_interval())
             with self._lock:
                 if self._gen != gen or self._flusher is None:
                     return

@@ -21,7 +21,13 @@ from . import serialization
 
 # Objects smaller than this ride the control plane inline instead of shm
 # (reference: small objects go to the in-process memory store, big to plasma).
-INLINE_THRESHOLD = 64 * 1024
+def _inline_threshold() -> int:
+    from . import config as rt_config
+
+    return rt_config.get("inline_threshold_bytes")
+
+
+INLINE_THRESHOLD = _inline_threshold()
 
 _SHM_PREFIX = "rtpu-"
 
@@ -222,10 +228,34 @@ def cleanup_stale_segments():
             continue
         if os.path.exists(f"/proc/{tag}"):
             continue  # owning controller still alive
+        if os.path.exists(restorable_marker_path(tag)):
+            # A standalone controller died holding this tag but its session
+            # is restorable (GCS-FT): a restart will re-adopt the segments.
+            # The marker is removed on graceful teardown.
+            continue
         try:
             os.unlink(os.path.join(shm_dir, fn))
         except OSError:
             pass
+
+
+def restorable_marker_path(tag: str) -> str:
+    return f"/tmp/ray_tpu/restorable_{tag}"
+
+
+def mark_restorable(tag: str, on: bool):
+    """Standalone controllers protect their dead-session segments from
+    other sessions' startup cleanup while a restore remains possible."""
+    path = restorable_marker_path(tag)
+    try:
+        if on:
+            os.makedirs("/tmp/ray_tpu", exist_ok=True)
+            with open(path, "w") as f:
+                f.write("")
+        else:
+            os.unlink(path)
+    except OSError:
+        pass
 
 
 # =============================================================== native arena

@@ -38,6 +38,7 @@ class WorkerProcess:
         self.conn: Optional[Connection] = None
         self.task_queue: "queue.Queue[dict]" = queue.Queue()
         self.actor_instance: Any = None
+        self._actor_hex: Optional[str] = None
         self.actor_pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
         self._stop = False
 
@@ -49,24 +50,55 @@ class WorkerProcess:
         conn = Connection(reader, writer, on_push=self._on_push, on_close=self._on_close)
         conn.start()
         self.conn = conn
-        await conn.request(
-            {
-                "type": "register_worker",
-                "worker_id": self.worker_id,
-                "pid": os.getpid(),
-                "has_tpu": os.environ.get("RAY_TPU_WORKER_TPU") == "1",
-                "node_id": os.environ.get("RAY_TPU_NODE_ID", "node0"),
-            }
-        )
+        payload = {
+            "type": "register_worker",
+            "worker_id": self.worker_id,
+            "pid": os.getpid(),
+            "has_tpu": os.environ.get("RAY_TPU_WORKER_TPU") == "1",
+            "node_id": os.environ.get("RAY_TPU_NODE_ID", "node0"),
+        }
+        if self.actor_instance is not None and self._actor_hex:
+            payload["actor_hex"] = self._actor_hex  # controller-restart re-adoption
+        await conn.request(payload)
 
     async def _on_push(self, msg: dict):
         self.task_queue.put(msg)
 
     async def _on_close(self):
-        self.task_queue.put({"type": "exit"})
+        # Controller connection dropped. A plain worker exits; a worker
+        # HOSTING AN ACTOR tries to reconnect — the controller may be
+        # restarting from its snapshot (GCS-FT semantics: actor state
+        # survives in this process, the directory re-adopts us).
+        if self.actor_instance is not None:
+            print(f"[worker {self.worker_id}] controller connection lost; "
+                  "attempting reconnect (actor host)", flush=True)
+            self.task_queue.put({"type": "reconnect"})
+        else:
+            self.task_queue.put({"type": "exit"})
+
+    async def _reconnect(self, deadline_s: float = 30.0) -> bool:
+        import asyncio
+        import time as _time
+
+        end = _time.monotonic() + deadline_s
+        while _time.monotonic() < end:
+            try:
+                await self._connect()
+                print(f"[worker {self.worker_id}] reconnected to controller", flush=True)
+                return True
+            except (OSError, ConnectionError) as e:
+                await asyncio.sleep(0.5)
+                err = e
+        print(f"[worker {self.worker_id}] reconnect gave up: {err!r}", flush=True)
+        return False
 
     def send(self, msg: dict):
-        self.io.call(self.conn.send(msg))
+        try:
+            self.io.call(self.conn.send(msg))
+        except ConnectionError:
+            # Mid-outage result delivery is lost; the restarted controller's
+            # retry/ref machinery handles it. Don't kill the worker thread.
+            pass
 
     # ------------------------------------------------------------ obj I/O
     def read_location(self, loc: dict) -> Any:
@@ -146,6 +178,7 @@ class WorkerProcess:
             runtime.set_task_context(spec.task_id, spec.actor_id)
             try:
                 self.actor_instance = cls(*args, **kwargs)
+                self._actor_hex = spec.actor_id.hex()
             finally:
                 runtime.set_task_context(None)
             if spec.options.max_concurrency > 1:
@@ -180,6 +213,10 @@ class WorkerProcess:
             mtype = msg["type"]
             if mtype == "exit":
                 break
+            if mtype == "reconnect":
+                if not self.io.call(self._reconnect(), timeout=40):
+                    break
+                continue
             spec: TaskSpec = cloudpickle.loads(msg["spec"])
             deps = msg.get("deps", {})
             if mtype == "execute_task":

@@ -1,0 +1,116 @@
+"""Chaos testing actors (reference: `python/ray/_private/test_utils.py:1527`
+`WorkerKillerActor` / `ResourceKillerActor`, and the chaos release suites
+under `python/ray/tests/chaos/`).
+
+Reusable kill-loops for fault-tolerance tests: run them as actors next to a
+workload and assert the workload still completes (task retries, actor
+restarts, lineage reconstruction absorb the damage).
+
+    killer = WorkerKiller.options(name="chaos").remote(interval_s=1.0, max_kills=3)
+    killer.run.remote()            # fire-and-forget kill loop
+    ... run workload ...
+    print(ray_tpu.get(killer.kills.remote()))
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import List, Optional
+
+
+class _KillerBase:
+    def __init__(self, interval_s: float = 1.0, max_kills: int = 3, seed: int = 0):
+        self.interval_s = interval_s
+        self.max_kills = max_kills
+        self._rng = random.Random(seed)
+        self._kills: List[str] = []
+        self._stop = False
+
+    def _backend(self):
+        from ..core import api
+
+        return api._global_runtime().backend
+
+    def kills(self) -> List[str]:
+        return list(self._kills)
+
+    def stop(self):
+        self._stop = True
+        return True
+
+    def _pick(self) -> Optional[str]:
+        raise NotImplementedError
+
+    def _kill(self, target: str) -> bool:
+        raise NotImplementedError
+
+    def run(self) -> bool:
+        """Start the kill loop on a background thread and return immediately
+        — `stop()`/`kills()` stay callable mid-chaos even on a default
+        (max_concurrency=1) actor."""
+        import threading
+
+        def loop():
+            while not self._stop and len(self._kills) < self.max_kills:
+                time.sleep(self.interval_s)
+                if self._stop:
+                    break
+                target = self._pick()
+                if target is None:
+                    continue
+                if self._kill(target):
+                    self._kills.append(target)
+
+        self._thread = threading.Thread(target=loop, name="chaos-killer", daemon=True)
+        self._thread.start()
+        return True
+
+    def join(self, timeout: float = 60.0) -> int:
+        """Wait for the loop to finish; returns kills performed."""
+        t = getattr(self, "_thread", None)
+        if t is not None:
+            t.join(timeout)
+        return len(self._kills)
+
+
+class WorkerKiller(_KillerBase):
+    """Kills BUSY workers (never itself, never actor hosts unless
+    `include_actors=True`) — exercising task retry paths."""
+
+    def __init__(self, interval_s: float = 1.0, max_kills: int = 3, seed: int = 0,
+                 include_actors: bool = False):
+        super().__init__(interval_s, max_kills, seed)
+        self.include_actors = include_actors
+
+    def _pick(self) -> Optional[str]:
+        backend = self._backend()
+        me = getattr(getattr(backend, "worker", None), "worker_id", None)
+        workers = backend._request({"type": "list_workers"})["workers"]
+        victims = [
+            w["worker_id"]
+            for w in workers
+            if w["worker_id"] != me
+            and (w["state"] == "busy" or (self.include_actors and w["state"] == "actor"))
+        ]
+        return self._rng.choice(victims) if victims else None
+
+    def _kill(self, worker_id: str) -> bool:
+        return bool(
+            self._backend()._request({"type": "kill_worker", "worker_id": worker_id})["ok"]
+        )
+
+
+class NodeKiller(_KillerBase):
+    """Kills non-head nodes (agent + its workers) — exercising node-death
+    retry and lineage reconstruction."""
+
+    def _pick(self) -> Optional[str]:
+        nodes = self._backend()._request({"type": "nodes"})["nodes"]
+        victims = [n["NodeID"] for n in nodes if n["Alive"] and n["NodeID"] != "node0"]
+        return self._rng.choice(victims) if victims else None
+
+    def _kill(self, node_id: str) -> bool:
+        return bool(
+            self._backend()._request({"type": "kill_node", "node_id": node_id})["ok"]
+        )

@@ -1,0 +1,87 @@
+"""Chaos harness + config registry tests.
+
+Reference analogs: `WorkerKillerActor` (`test_utils.py:1527`) driving
+kill-based FT tests; `ray_config_def.h` flag registry with env overrides.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core import config as rt_config
+from ray_tpu.util.chaos import NodeKiller, WorkerKiller
+
+pytestmark = pytest.mark.cluster
+
+
+class TestConfigRegistry:
+    def test_defaults_and_env_override(self, monkeypatch):
+        assert rt_config.get("scheduler_scan_window") == 64
+        monkeypatch.setenv("RAY_TPU_GC_GRACE_S", "2.5")
+        rt_config._reset_cache_for_tests()
+        try:
+            assert rt_config.get("gc_grace_s") == 2.5
+        finally:
+            monkeypatch.delenv("RAY_TPU_GC_GRACE_S")
+            rt_config._reset_cache_for_tests()
+
+    def test_unknown_flag_raises(self):
+        with pytest.raises(KeyError, match="Unknown config flag"):
+            rt_config.get("definitely_not_a_flag")
+
+    def test_all_flags_resolves(self):
+        flags = rt_config.all_flags()
+        assert "inline_threshold_bytes" in flags and flags["lineage_cap"] == 20_000
+
+
+def test_worker_killer_tasks_survive():
+    """Tasks with retries complete despite a WorkerKiller murdering busy
+    workers mid-flight (VERDICT item 10 done-criterion: FT tests use the
+    chaos actors)."""
+    ray_tpu.init(num_cpus=4)
+    try:
+        Killer = ray_tpu.remote(WorkerKiller)
+        killer = Killer.remote(interval_s=0.5, max_kills=2, include_actors=False)
+        run_ref = killer.run.remote()
+
+        @ray_tpu.remote(num_cpus=1, max_retries=5)
+        def slow(i):
+            time.sleep(1.0)
+            return i * 10
+
+        results = ray_tpu.get([slow.remote(i) for i in range(8)], timeout=120)
+        assert results == [i * 10 for i in range(8)]
+        ray_tpu.get(killer.stop.remote())
+        kills = ray_tpu.get(killer.kills.remote())
+        assert len(kills) >= 1, "chaos actor never killed anything"
+        _ = run_ref
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_node_killer_node_death_recovery():
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    cluster.add_node(num_cpus=2)
+    ray_tpu.init(address=cluster.address)
+    try:
+        Killer = ray_tpu.remote(NodeKiller)
+        killer = Killer.remote(interval_s=1.0, max_kills=1)
+
+        @ray_tpu.remote(num_cpus=1, max_retries=5)
+        def slow(i):
+            time.sleep(1.5)
+            return i
+
+        refs = [slow.remote(i) for i in range(6)]
+        killer.run.remote()
+        assert sorted(ray_tpu.get(refs, timeout=120)) == list(range(6))
+        kills = ray_tpu.get(killer.kills.remote())
+        assert kills == ["node1"]
+        nodes = {n["NodeID"]: n["Alive"] for n in ray_tpu.nodes()}
+        assert nodes["node1"] is False  # the chaos kill registered as node death
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()

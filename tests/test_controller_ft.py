@@ -1,0 +1,72 @@
+"""Controller persistence + restart (GCS fault tolerance).
+
+Reference analog: `python/ray/tests/test_gcs_fault_tolerance.py` — kill the
+GCS, restart it against persisted state (RedisStoreClient role), detached
+actors stay reachable (VERDICT item 9 done-criterion).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+pytestmark = pytest.mark.cluster
+
+
+def test_controller_kill9_restart_detached_actor_reachable():
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    ray_tpu.init(address=cluster.address)
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    try:
+        c = Counter.options(name="survivor", lifetime="detached").remote()
+        assert ray_tpu.get(c.incr.remote()) == 1
+        assert ray_tpu.get(c.incr.remote()) == 2
+        time.sleep(1.5)  # let a snapshot cycle land
+
+        cluster.kill_head()
+        cluster.restart_head()
+        ray_tpu.shutdown()  # old backend is dead; local cleanup only
+
+        ray_tpu.init(address=cluster.address)
+        c2 = ray_tpu.get_actor("survivor")
+        # In-process actor state survived the controller's death: the worker
+        # reconnected and was re-adopted with its counter intact.
+        assert ray_tpu.get(c2.incr.remote(), timeout=60) == 3
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_objects_survive_controller_restart():
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    ray_tpu.init(address=cluster.address)
+    try:
+        ref = ray_tpu.put(np.arange(100_000, dtype=np.float64))  # shm object
+        small = ray_tpu.put({"k": 42})  # inline object
+        time.sleep(1.5)  # snapshot
+
+        cluster.kill_head()
+        cluster.restart_head()
+        ray_tpu.shutdown()  # old backend is dead; local cleanup only
+
+        ray_tpu.init(address=cluster.address)
+        # Same session tag → the restarted controller serves the surviving
+        # arena segment; inline objects replay from the snapshot.
+        val = ray_tpu.get(ref, timeout=30)
+        assert float(val.sum()) == float(np.arange(100_000).sum())
+        assert ray_tpu.get(small, timeout=30) == {"k": 42}
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
