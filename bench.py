@@ -96,9 +96,9 @@ def main():
             d_mlp=256, max_seq=S, attn_impl="ref", remat=False,
         )
     else:
-        # B=16 is the single-chip sweet spot (scripts/bench_sweep.py r2):
-        # 0.405 MFU vs 0.390 at B=8 / 0.395 at B=32.
-        B, S = 16, 1024
+        # B=24 is the single-chip sweet spot (scripts/bench_sweep.py r2):
+        # 0.409 MFU vs 0.400@16 / 0.402@12 / 0.395@32; blocks 512/512.
+        B, S = 24, 1024
         cfg = gpt2_medium(max_seq=S, attn_impl="flash", remat=True)
 
     # Initialize on-device (jit) — host-side random init of 350M params on a

@@ -111,6 +111,10 @@ class GroupRendezvous:
         with self._lock:
             r = self._round(key)
             if r.get("aborted"):
+                # Tombstone: fail fast; reclaim once every member observed it.
+                r["served"] += 1
+                if r["served"] >= self.world_size:
+                    self._rounds.pop(key, None)
                 return None
             r["refs"][rank] = ref
             if len(r["refs"]) >= self.world_size:
@@ -122,9 +126,15 @@ class GroupRendezvous:
                 r["refs"].clear()  # drop payload refs; KEEP the tombstone so
                 # a straggler arriving later fails fast instead of opening a
                 # fresh round and stalling its own full timeout.
+                r["served"] += 1
+                if r["served"] >= self.world_size:
+                    self._rounds.pop(key, None)
             return None
         with self._lock:
             if r.get("aborted"):
+                r["served"] += 1
+                if r["served"] >= self.world_size:
+                    self._rounds.pop(key, None)
                 return None
             refs = dict(r["refs"])
             r["served"] += 1

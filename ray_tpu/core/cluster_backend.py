@@ -108,7 +108,23 @@ class ClusterBackend(RuntimeBackend):
             )
         return f"127.0.0.1:{int(val)}", proc
 
+    def reconnect(self) -> bool:
+        """Re-establish this backend's connection after a controller restart
+        (used by actor workers being re-adopted — their nested API must not
+        keep pointing at the dead socket)."""
+        try:
+            if self.conn is not None:
+                self.conn.close()
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            self._connect(self._register_as)
+            return True
+        except Exception:  # noqa: BLE001
+            return False
+
     def _connect(self, register_as: str):
+        self._register_as = register_as
         async def go():
             host, port = self.address.rsplit(":", 1)
             reader, writer = await asyncio.open_connection(host, int(port))
@@ -368,16 +384,18 @@ class ClusterBackend(RuntimeBackend):
         if getattr(self, "_log_tailer", None) is not None:
             self._log_tailer_stop.set()
             self._log_tailer = None
-        if self.role == "driver":
+        if self.role == "driver" and self._controller_proc is not None:
+            # Only the driver that STARTED the controller ends the session —
+            # a secondary driver (e.g. a submitted job) disconnecting must
+            # not take the cluster down with it.
             try:
                 self._request({"type": "shutdown"}, timeout=2)
             except Exception:  # noqa: BLE001
                 pass
-            if self._controller_proc is not None:
-                try:
-                    self._controller_proc.wait(timeout=5)
-                except subprocess.TimeoutExpired:
-                    self._controller_proc.terminate()
+            try:
+                self._controller_proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self._controller_proc.terminate()
         if self.conn is not None:
             self.conn.close()
         self.local_store.close_all()

@@ -229,6 +229,8 @@ class Controller:
 
         self.objects: Dict[str, ObjectState] = {}
         self.workers: Dict[str, WorkerState] = {}
+        self.jobs: Dict[str, dict] = {}
+        self._spec_blobs: Dict[str, bytes] = {}  # snapshot pickle cache
         self.actors: Dict[str, ActorState] = {}
         self.named_actors: Dict[Tuple[str, str], str] = {}
         self.pgs: Dict[str, dict] = {}
@@ -287,6 +289,15 @@ class Controller:
     # restarted controller replays it, re-binds the SAME port, and re-adopts
     # workers as they reconnect (their shm arena survived the crash — kill -9
     # skips teardown, and segment names key off the ORIGINAL session tag).
+    def _spec_blob(self, actor_hex: str, spec) -> Optional[bytes]:
+        """Specs are immutable — pickle once, not on every snapshot tick."""
+        if spec is None:
+            return None
+        blob = self._spec_blobs.get(actor_hex)
+        if blob is None:
+            blob = self._spec_blobs[actor_hex] = cloudpickle.dumps(spec)
+        return blob
+
     def _snapshot_state(self) -> dict:
         return {
             "session_tag": store.SESSION_TAG,
@@ -294,9 +305,15 @@ class Controller:
             "object_store_memory": self.object_store_memory,
             "store_bytes_used": self.store_bytes_used,
             "named_actors": dict(self.named_actors),
+            "jobs": {
+                jid: {k: j[k] for k in
+                      ("pid", "entrypoint", "status", "log_path",
+                       "start_time", "end_time")}
+                for jid, j in self.jobs.items()
+            },
             "actors": {
                 h: {
-                    "spec": cloudpickle.dumps(a.spec) if a.spec is not None else None,
+                    "spec": self._spec_blob(h, a.spec),
                     "name": a.name,
                     "namespace": a.namespace,
                     "handle_bytes": a.handle_bytes,
@@ -361,6 +378,8 @@ class Controller:
         self.object_store_memory = snap["object_store_memory"]
         self.store_bytes_used = snap.get("store_bytes_used", 0)
         self.named_actors = dict(snap["named_actors"])
+        for jid, j in snap.get("jobs", {}).items():
+            self.jobs[jid] = {**j, "proc": None}  # re-adopted by pid
         for h, a in snap["actors"].items():
             astate = ActorState(
                 actor_hex=h,
@@ -442,6 +461,15 @@ class Controller:
         await self._teardown()
 
     async def _teardown(self):
+        for j in self.jobs.values():  # supervised jobs die with the session
+            proc = j.get("proc")
+            try:
+                if proc is not None and proc.poll() is None:
+                    proc.terminate()
+                elif proc is None and j.get("pid"):
+                    os.kill(j["pid"], 15)
+            except OSError:
+                pass
         for node in self.nodes.values():
             if node.conn is not None and node.alive:
                 try:
@@ -2082,6 +2110,111 @@ class Controller:
                 if node is not None and node.alive:
                     self._release(node, b)
             self._schedule()
+        return {"ok": True}
+
+    # ---------------------------------------------------------------- jobs
+    # Reference analog: `dashboard/modules/job/job_manager.py` — the job
+    # runs as a supervised DRIVER subprocess on the head node; the client
+    # (`JobSubmissionClient`) polls status and streams logs.
+    async def h_submit_job(self, conn, meta, msg):
+        import shlex
+
+        job_id = f"job-{next(self._conn_counter):04d}-{os.getpid() % 10000}"
+        entrypoint = msg["entrypoint"]
+        runtime_env = msg.get("runtime_env") or {}
+        env = dict(os.environ)
+        env.update({k: str(v) for k, v in (runtime_env.get("env_vars") or {}).items()})
+        env["RAY_TPU_ADDRESS"] = f"127.0.0.1:{self.port}"
+        env["RAY_TPU_JOB_ID"] = job_id
+        pkg_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        env["PYTHONUNBUFFERED"] = "1"
+        cwd = runtime_env.get("working_dir") or pkg_root
+        log_path = os.path.join(self.session_dir, f"{job_id}.log")
+        try:
+            proc = subprocess.Popen(
+                shlex.split(entrypoint),
+                env=env,
+                stdout=open(log_path, "ab"),
+                stderr=subprocess.STDOUT,
+                cwd=cwd,
+            )
+        except OSError as e:
+            return {"job_id": job_id, "status": "FAILED", "error": repr(e)}
+        self.jobs[job_id] = {
+            "proc": proc,
+            "pid": proc.pid,
+            "entrypoint": entrypoint,
+            "status": "RUNNING",
+            "log_path": log_path,
+            "start_time": time.time(),
+            "end_time": None,
+        }
+        self._event("job_submitted", job=job_id, entrypoint=entrypoint)
+        return {"job_id": job_id, "status": "RUNNING"}
+
+    def _job_view(self, job_id: str, j: dict) -> dict:
+        proc = j.get("proc")
+        if j["status"] == "RUNNING":
+            if proc is not None:
+                if proc.poll() is not None:
+                    j["status"] = "SUCCEEDED" if proc.returncode == 0 else "FAILED"
+                    j["end_time"] = time.time()
+            elif not os.path.exists(f"/proc/{j.get('pid', 0)}"):
+                # Re-adopted after controller restart: the job isn't our
+                # child, so its exit code is unknowable.
+                j["status"] = "UNKNOWN"
+                j["end_time"] = time.time()
+        return {
+            "job_id": job_id,
+            "status": j["status"],
+            "entrypoint": j["entrypoint"],
+            "returncode": proc.poll() if proc is not None else None,
+            "start_time": j["start_time"],
+            "end_time": j["end_time"],
+        }
+
+    async def h_job_status(self, conn, meta, msg):
+        j = self.jobs.get(msg["job_id"])
+        if j is None:
+            return {"error": f"no such job {msg['job_id']}"}
+        return self._job_view(msg["job_id"], j)
+
+    async def h_list_jobs(self, conn, meta, msg):
+        return {"jobs": [self._job_view(jid, j) for jid, j in self.jobs.items()]}
+
+    async def h_job_logs(self, conn, meta, msg):
+        j = self.jobs.get(msg["job_id"])
+        if j is None:
+            return {"error": f"no such job {msg['job_id']}"}
+        try:
+            with open(j["log_path"], "rb") as f:
+                f.seek(msg.get("offset", 0))
+                data = f.read(1 << 20)
+            return {"data": data.decode(errors="replace"),
+                    "offset": msg.get("offset", 0) + len(data)}
+        except OSError:
+            return {"data": "", "offset": 0}
+
+    async def h_stop_job(self, conn, meta, msg):
+        j = self.jobs.get(msg["job_id"])
+        if j is None:
+            return {"ok": False}
+        proc = j.get("proc")
+        try:
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+                j["status"] = "STOPPED"
+                j["end_time"] = time.time()
+            elif proc is None and os.path.exists(f"/proc/{j.get('pid', 0)}"):
+                os.kill(j["pid"], 15)  # re-adopted job (not our child)
+                j["status"] = "STOPPED"
+                j["end_time"] = time.time()
+        except OSError:
+            pass
+        self._event("job_stopped", job=msg["job_id"])
         return {"ok": True}
 
     # ------------------------------------------------------ fault injection

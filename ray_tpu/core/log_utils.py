@@ -5,19 +5,15 @@ from __future__ import annotations
 
 from typing import Optional, Tuple
 
-def _log_chunk() -> int:
-    from . import config as rt_config
 
-    return rt_config.get("log_chunk_bytes")
-
-
-LOG_CHUNK = _log_chunk()
-
-
-def read_log_chunk(path: str, offset: int, cap: int = LOG_CHUNK) -> Optional[Tuple[bytes, int]]:
+def read_log_chunk(path: str, offset: int, cap: Optional[int] = None) -> Optional[Tuple[bytes, int]]:
     """Read a log increment, holding back a trailing partial line so the
     consumer never prints fragments or splits multi-byte characters (unless
     a single line exceeds the cap). Returns (data, new_offset) or None."""
+    if cap is None:
+        from . import config as rt_config
+
+        cap = rt_config.get("log_chunk_bytes")
     try:
         with open(path, "rb") as f:
             f.seek(offset)

@@ -21,13 +21,11 @@ from . import serialization
 
 # Objects smaller than this ride the control plane inline instead of shm
 # (reference: small objects go to the in-process memory store, big to plasma).
-def _inline_threshold() -> int:
-    from . import config as rt_config
+# Resolved at import: set RAY_TPU_INLINE_THRESHOLD_BYTES before the process
+# starts (it shapes wire formats; mid-run changes would desync processes).
+from . import config as _rt_config  # noqa: E402
 
-    return rt_config.get("inline_threshold_bytes")
-
-
-INLINE_THRESHOLD = _inline_threshold()
+INLINE_THRESHOLD = _rt_config.get("inline_threshold_bytes")
 
 _SHM_PREFIX = "rtpu-"
 
@@ -228,11 +226,22 @@ def cleanup_stale_segments():
             continue
         if os.path.exists(f"/proc/{tag}"):
             continue  # owning controller still alive
-        if os.path.exists(restorable_marker_path(tag)):
+        marker = restorable_marker_path(tag)
+        try:
+            marker_age = __import__("time").time() - os.path.getmtime(marker)
+        except OSError:
+            marker_age = None
+        if marker_age is not None and marker_age < 3600.0:
             # A standalone controller died holding this tag but its session
             # is restorable (GCS-FT): a restart will re-adopt the segments.
-            # The marker is removed on graceful teardown.
+            # The marker is removed on graceful teardown; after an hour a
+            # never-restarted session stops shielding its segments (leak cap).
             continue
+        if marker_age is not None:
+            try:
+                os.unlink(marker)  # expired marker
+            except OSError:
+                pass
         try:
             os.unlink(os.path.join(shm_dir, fn))
         except OSError:

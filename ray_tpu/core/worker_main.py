@@ -85,6 +85,13 @@ class WorkerProcess:
             try:
                 await self._connect()
                 print(f"[worker {self.worker_id}] reconnected to controller", flush=True)
+                # The nested API backend must follow — actor code calling
+                # ray_tpu.* would otherwise hit the dead socket.
+                from . import api
+
+                runtime = api._global_runtime()
+                if hasattr(runtime.backend, "reconnect"):
+                    runtime.backend.reconnect()
                 return True
             except (OSError, ConnectionError) as e:
                 await asyncio.sleep(0.5)
@@ -129,6 +136,39 @@ class WorkerProcess:
     def _resolve(self, spec: TaskSpec, deps: Dict[str, dict]) -> List[Any]:
         return [self.read_location(deps[oid.hex()]) for oid in spec.arg_refs]
 
+    _ENV_LOCK = threading.RLock()  # os.environ is process-global
+
+    @classmethod
+    def _runtime_env_vars(cls, spec: TaskSpec):
+        """Per-task/actor env vars (reference: `runtime_env={"env_vars":…}`,
+        the most-used slice of `_private/runtime_env/`). Returns a restore
+        closure; full isolation (pip/conda/working_dir) is per-JOB instead
+        (jobs run as fresh driver subprocesses).
+
+        Tasks CARRYING env_vars hold a process lock until restore — two
+        concurrent actor methods (max_concurrency > 1) mutating the global
+        environment would otherwise race. Tasks without env_vars never
+        touch the lock."""
+        renv = spec.options.runtime_env or {}
+        env_vars = renv.get("env_vars") or {}
+        if not env_vars:
+            return lambda: None
+        cls._ENV_LOCK.acquire()
+        saved = {k: os.environ.get(k) for k in env_vars}
+        os.environ.update({k: str(v) for k, v in env_vars.items()})
+
+        def restore():
+            try:
+                for k, old in saved.items():
+                    if old is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = old
+            finally:
+                cls._ENV_LOCK.release()
+
+        return restore
+
     def _execute(self, spec: TaskSpec, deps: Dict[str, dict], is_actor_method: bool):
         from . import api
         from .runtime import resolve_payload
@@ -141,9 +181,11 @@ class WorkerProcess:
             if is_actor_method:
                 func = getattr(self.actor_instance, spec.method_name)
             runtime.set_task_context(spec.task_id, spec.actor_id)
+            restore_env = self._runtime_env_vars(spec)
             try:
                 result = func(*args, **kwargs)
             finally:
+                restore_env()
                 runtime.set_task_context(None)
             import inspect
 
@@ -176,6 +218,9 @@ class WorkerProcess:
             resolved = self._resolve(spec, deps)
             cls, args, kwargs = resolve_payload(spec.func_payload, resolved)
             runtime.set_task_context(spec.task_id, spec.actor_id)
+            # Actor env vars persist for the actor's lifetime (its process
+            # is dedicated) — reference behavior for actor runtime_env.
+            self._runtime_env_vars(spec)
             try:
                 self.actor_instance = cls(*args, **kwargs)
                 self._actor_hex = spec.actor_id.hex()

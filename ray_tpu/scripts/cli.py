@@ -123,6 +123,27 @@ def cmd_logs(backend, info, args):
             sys.stdout.write(chunk["data"])
 
 
+def cmd_job(backend, info, args):
+    if args.job_command == "submit":
+        import shlex
+
+        entrypoint = " ".join(shlex.quote(a) for a in args.entrypoint)
+        resp = backend._request(
+            {"type": "submit_job", "entrypoint": entrypoint, "runtime_env": None}
+        )
+        print(resp.get("job_id", resp))
+    elif args.job_command == "status":
+        print(json.dumps(backend._request({"type": "job_status", "job_id": args.job_id})))
+    elif args.job_command == "logs":
+        resp = backend._request({"type": "job_logs", "job_id": args.job_id})
+        sys.stdout.write(resp.get("data", resp.get("error", "")))
+    elif args.job_command == "stop":
+        print(backend._request({"type": "stop_job", "job_id": args.job_id}))
+    elif args.job_command == "list":
+        rows = backend._request({"type": "list_jobs"})["jobs"]
+        _table(rows, ["job_id", "status", "entrypoint", "returncode"])
+
+
 def cmd_timeline(backend, info, args):
     events = backend._request({"type": "state_summary"})["timeline"]
     if args.output:
@@ -148,7 +169,21 @@ def main(argv=None):
     p_tl = sub.add_parser("timeline", help="chrome-trace events")
     p_tl.add_argument("-o", "--output", default=None)
     p_tl.add_argument("--tail", type=int, default=50)
+    p_job = sub.add_parser("job", help="submit/inspect cluster jobs")
+    job_sub = p_job.add_subparsers(dest="job_command", required=True)
+    p_sub = job_sub.add_parser("submit")
+    p_sub.add_argument("entrypoint", nargs=argparse.REMAINDER,
+                       help="command line, e.g. -- python train.py")
+    for name in ("status", "logs", "stop"):
+        p = job_sub.add_parser(name)
+        p.add_argument("job_id")
+    job_sub.add_parser("list")
     args = parser.parse_args(argv)
+    if args.command == "job" and args.job_command == "submit":
+        ep = list(args.entrypoint)
+        if ep and ep[0] == "--":  # drop ONLY the argparse separator; a later
+            ep = ep[1:]           # literal -- belongs to the entrypoint
+        args.entrypoint = ep
 
     info = _resolve_address(args.address)
     backend = _backend(info)
@@ -158,6 +193,7 @@ def main(argv=None):
             "list": cmd_list,
             "logs": cmd_logs,
             "timeline": cmd_timeline,
+            "job": cmd_job,
         }[args.command](backend, info, args)
     finally:
         backend.conn.close()

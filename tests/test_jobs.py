@@ -1,0 +1,102 @@
+"""Job submission + runtime_env tests.
+
+Reference analogs: `dashboard/modules/job/tests` (`JobSubmissionClient`
+round-trips) and `python/ray/tests/test_runtime_env*.py` (env_vars slice).
+"""
+
+import sys
+
+import pytest
+
+import ray_tpu
+from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+pytestmark = pytest.mark.cluster
+
+
+@pytest.fixture
+def cluster_rt():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_job_submit_succeeds_and_logs(cluster_rt):
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint=f'{sys.executable} -c "print(41 + 1)"'
+    )
+    assert client.wait_until_finish(job_id, timeout=60) == JobStatus.SUCCEEDED
+    assert "42" in client.get_job_logs(job_id)
+    jobs = client.list_jobs()
+    assert any(j["job_id"] == job_id and j["status"] == "SUCCEEDED" for j in jobs)
+    client.close()
+
+
+def test_job_failure_and_env_vars(cluster_rt):
+    client = JobSubmissionClient()
+    ok = client.submit_job(
+        entrypoint=f'{sys.executable} -c "import os; print(os.environ[\'MY_FLAG\'])"',
+        runtime_env={"env_vars": {"MY_FLAG": "prod-7"}},
+    )
+    bad = client.submit_job(entrypoint=f'{sys.executable} -c "raise SystemExit(3)"')
+    assert client.wait_until_finish(ok, timeout=60) == JobStatus.SUCCEEDED
+    assert "prod-7" in client.get_job_logs(ok)
+    assert client.wait_until_finish(bad, timeout=60) == JobStatus.FAILED
+    assert client.get_job_info(bad)["returncode"] == 3
+    client.close()
+
+
+def test_job_uses_cluster(cluster_rt):
+    """The job's driver connects back to THIS cluster and runs tasks."""
+    client = JobSubmissionClient()
+    script = (
+        "import os, ray_tpu; "
+        "ray_tpu.init(address=os.environ['RAY_TPU_ADDRESS']); "
+        "f = ray_tpu.remote(lambda: 'from-the-cluster'); "
+        "print(ray_tpu.get(f.remote()))"
+    )
+    job_id = client.submit_job(entrypoint=f'{sys.executable} -c "{script}"')
+    assert client.wait_until_finish(job_id, timeout=120) == JobStatus.SUCCEEDED
+    assert "from-the-cluster" in client.get_job_logs(job_id)
+    client.close()
+
+
+def test_job_stop(cluster_rt):
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint=f'{sys.executable} -c "import time; time.sleep(60)"'
+    )
+    assert client.get_job_status(job_id) == JobStatus.RUNNING
+    assert client.stop_job(job_id)
+    assert client.wait_until_finish(job_id, timeout=30) == JobStatus.STOPPED
+    client.close()
+
+
+def test_task_runtime_env_vars(cluster_rt):
+    @ray_tpu.remote(runtime_env={"env_vars": {"TASK_FLAG": "abc123"}})
+    def read_flag():
+        import os
+
+        return os.environ.get("TASK_FLAG")
+
+    @ray_tpu.remote
+    def read_unset():
+        import os
+
+        return os.environ.get("TASK_FLAG", "unset")
+
+    assert ray_tpu.get(read_flag.remote()) == "abc123"
+    assert ray_tpu.get(read_unset.remote()) == "unset"  # restored after task
+
+
+def test_actor_runtime_env_vars(cluster_rt):
+    @ray_tpu.remote(runtime_env={"env_vars": {"ACTOR_MODE": "tpu-prod"}})
+    class A:
+        def mode(self):
+            import os
+
+            return os.environ.get("ACTOR_MODE")
+
+    a = A.remote()
+    assert ray_tpu.get(a.mode.remote()) == "tpu-prod"
