@@ -95,3 +95,12 @@ class RuntimeBackend(abc.ABC):
 
     def state_summary(self) -> dict:
         return {}
+
+    def stream_next(self, task_hex: str, index: int, timeout=300.0) -> str:
+        """Streaming-generator protocol: block until item `index` exists
+        ("ready"), the stream ended before it ("end"), or raise
+        GetTimeoutError. Required for num_returns="streaming" tasks."""
+        raise NotImplementedError(f"{type(self).__name__} does not support streaming")
+
+    def stream_release(self, task_hex: str, from_index: int) -> None:
+        """Consumer will never claim items >= from_index (GC hint)."""

@@ -319,6 +319,19 @@ class ClusterBackend(RuntimeBackend):
     def free_objects(self, refs: Sequence[ObjectRef]) -> None:
         self._request({"type": "free_objects", "ids": [r.id.hex() for r in refs]})
 
+    # ------------------------------------------------- streaming generators
+    def stream_next(self, task_hex: str, index: int, timeout: Optional[float] = 300.0) -> str:
+        resp = self._request(
+            {"type": "stream_next", "task": task_hex, "index": index, "timeout": timeout},
+            timeout=timeout,
+        )
+        if resp["status"] == "timeout":
+            raise GetTimeoutError(f"stream item {index} of {task_hex[:12]} timed out")
+        return resp["status"]  # "ready" | "end"
+
+    def stream_release(self, task_hex: str, from_index: int) -> None:
+        self._send({"type": "stream_release", "task": task_hex, "from_index": from_index})
+
     # ------------------------------------------------------------- metrics
     def record_metric(self, name: str, kind: str, value: float, tags: dict) -> None:
         self._send(

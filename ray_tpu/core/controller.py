@@ -39,6 +39,7 @@ from .exceptions import (
 )
 from . import config as rt_config
 from .rpc import Connection, read_msg
+from .ids import ObjectID
 from .task_spec import (
     NodeAffinitySchedulingStrategy,
     PlacementGroupSchedulingStrategy,
@@ -230,6 +231,7 @@ class Controller:
         self.objects: Dict[str, ObjectState] = {}
         self.workers: Dict[str, WorkerState] = {}
         self.jobs: Dict[str, dict] = {}
+        self.streams: Dict[str, dict] = {}  # streaming-generator progress
         self._spec_blobs: Dict[str, bytes] = {}  # snapshot pickle cache
         self.actors: Dict[str, ActorState] = {}
         self.named_actors: Dict[Tuple[str, str], str] = {}
@@ -574,7 +576,7 @@ class Controller:
     # they run as detached tasks — otherwise a long-poll would block the
     # connection's read loop and deadlock clients that get() on one thread
     # while another thread produces the object.
-    _LONG_POLL = frozenset({"get_object", "wait_objects", "tail_logs"})
+    _LONG_POLL = frozenset({"get_object", "wait_objects", "tail_logs", "stream_next"})
 
     async def _dispatch_msg(self, conn: Connection, meta: dict, msg: dict):
         mtype = msg["type"]
@@ -1593,11 +1595,27 @@ class Controller:
     def _finish_cancelled(self, pt: PendingTask):
         self._fail_task(pt, TaskError(TaskCancelledError(), "", pt.spec.name))
 
+    def _fail_stream(self, spec: TaskSpec, err: TaskError):
+        """Terminal failure of a streaming task: one error item, then end —
+        a waiting consumer must never hang."""
+        s = self._stream(spec.task_id.hex())
+        if s["done"]:
+            return
+        idx = s["produced"]
+        oid_hex = ObjectID.of(spec.task_id, idx).hex()
+        self._obj(oid_hex).expected = True
+        self._store_error_object(oid_hex, err)
+        s["produced"] = idx + 1
+        s["done"] = True
+        self._wake_stream(s)
+
     def _fail_task(self, pt: PendingTask, err: TaskError):
         """Terminal failure for a not-yet-dispatched task: unpin args, error
         the returns, and mark a would-be actor dead."""
         spec = pt.spec
         self._unpin_args(spec)
+        if spec.num_returns == -1:
+            self._fail_stream(spec, err)
         if spec.task_type == TaskType.ACTOR_CREATION_TASK and spec.actor_id:
             astate = self.actors.get(spec.actor_id.hex())
             if astate is not None:
@@ -1635,6 +1653,11 @@ class Controller:
                     item["id"], shm_name=item["name"], size=item["size"],
                     node_id=node_id, contains=item.get("contains"),
                 )
+        if msg.get("stream_count") is not None:
+            s = self._stream(task_hex)
+            s["produced"] = max(s["produced"], msg["stream_count"])
+            s["done"] = True
+            self._wake_stream(s)
         self._event("task_done", task=task_hex)
         self._schedule()
         return None
@@ -1873,6 +1896,8 @@ class Controller:
                         pt.spec.name,
                     )
                     self._unpin_args(pt.spec)
+                    if pt.spec.num_returns == -1:
+                        self._fail_stream(pt.spec, err)
                     for oid in pt.spec.return_ids:
                         self._store_error_object(oid.hex(), err)
         if prev_state == ACTOR and ws.actor_hex:
@@ -2111,6 +2136,86 @@ class Controller:
                     self._release(node, b)
             self._schedule()
         return {"ok": True}
+
+    # ------------------------------------------------- streaming generators
+    # Reference analog: `returns_dynamic` / ObjectRefGenerator
+    # (`_raylet.pyx:272`) — a task's yields become objects as produced.
+    def _stream(self, task_hex: str) -> dict:
+        s = self.streams.get(task_hex)
+        if s is None:
+            s = self.streams[task_hex] = {"produced": 0, "done": False, "events": []}
+        return s
+
+    def _wake_stream(self, s: dict):
+        for ev in s["events"]:
+            ev.set()
+        s["events"].clear()
+
+    async def h_stream_item(self, conn, meta, msg):
+        ws = self.workers.get(meta["worker_id"]) if meta.get("worker_id") else None
+        node_id = ws.node_id if ws is not None else HEAD_NODE
+        item = msg["item"]
+        hex_id = item["id"]
+        self._obj(hex_id).expected = True
+        if item.get("inline") is not None:
+            self._mark_ready(hex_id, inline=item["inline"], size=len(item["inline"]),
+                             contains=item.get("contains"))
+        else:
+            self._mark_ready(hex_id, shm_name=item["name"], size=item["size"],
+                             node_id=node_id, contains=item.get("contains"))
+        s = self._stream(msg["task"])
+        s["produced"] = max(s["produced"], msg["index"] + 1)
+        self._wake_stream(s)
+        return None
+
+    async def h_stream_release(self, conn, meta, msg):
+        """Consumer abandoned/finished the stream: indices it never claimed
+        become GC-eligible (they were never announced as held), and the
+        stream bookkeeping goes once the producer is done."""
+        task_hex = msg["task"]
+        s = self.streams.get(task_hex)
+        if s is None:
+            return None
+        task_id = None
+        for i in range(msg.get("from_index", 0), s["produced"]):
+            if task_id is None:
+                from .ids import TaskID
+
+                task_id = TaskID.from_hex(task_hex)
+            hex_id = ObjectID.of(task_id, i).hex()
+            obj = self.objects.get(hex_id)
+            if obj is not None:
+                obj.ever_held = True  # unclaimed → GC-eligible
+                self._maybe_gc(hex_id)
+        if s["done"]:
+            self.streams.pop(task_hex, None)
+        return None
+
+    async def h_stream_next(self, conn, meta, msg):
+        """Long-poll for the consumer: next index ready | end | timeout."""
+        task_hex, index = msg["task"], msg["index"]
+        timeout = msg.get("timeout")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            s = self._stream(task_hex)
+            if index < s["produced"]:
+                return {"status": "ready"}
+            if s["done"]:
+                return {"status": "end"}
+            ev = asyncio.Event()
+            s["events"].append(ev)
+            try:
+                if deadline is None:
+                    await ev.wait()
+                else:
+                    await asyncio.wait_for(
+                        ev.wait(), max(0.0, deadline - time.monotonic())
+                    )
+            except asyncio.TimeoutError:
+                return {"status": "timeout"}
+            finally:
+                if ev in s["events"]:
+                    s["events"].remove(ev)
 
     # ---------------------------------------------------------------- jobs
     # Reference analog: `dashboard/modules/job/job_manager.py` — the job

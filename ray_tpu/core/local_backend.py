@@ -86,6 +86,7 @@ class LocalBackend(RuntimeBackend):
         # (namespace, name) -> (actor_id, pickled ActorHandle)
         self._named_actors: Dict[Tuple[str, str], Tuple[ActorID, bytes]] = {}
         self._cancelled: set = set()
+        self._streams: Dict[str, dict] = {}  # streaming-generator progress
         self._lock = threading.Lock()
         self._resources = {"CPU": float(num_cpus), **(resources or {})}
         self._pgs: Dict[PlacementGroupID, dict] = {}
@@ -154,7 +155,11 @@ class LocalBackend(RuntimeBackend):
         from .runtime import resolve_payload
 
         if spec.task_id in self._cancelled:
-            self._store_error(spec, TaskError(TaskCancelledError(), "", spec.name))
+            err = TaskError(TaskCancelledError(), "", spec.name)
+            if spec.num_returns == -1:
+                self._end_stream(spec, error=err)  # consumers must not hang
+            else:
+                self._store_error(spec, err)
             return
         try:
             resolved = self._resolve_args(spec)
@@ -168,11 +173,74 @@ class LocalBackend(RuntimeBackend):
                     self._runtime.set_task_context(None)
             import inspect
 
+            if spec.num_returns == -1:  # streaming generator
+                gen = result if inspect.isgenerator(result) else iter((result,))
+                self._run_stream(spec, gen)
+                return
             if inspect.isgenerator(result):
                 result = tuple(result) if spec.num_returns > 1 else list(result)
             self._store_results(spec, result)
         except BaseException as e:  # noqa: BLE001
-            self._store_error(spec, TaskError(e, traceback.format_exc(), spec.name))
+            err = TaskError(e, traceback.format_exc(), spec.name)
+            if spec.num_returns == -1:
+                self._end_stream(spec, error=err)
+            else:
+                self._store_error(spec, err)
+
+    def _stream_state(self, task_hex: str) -> dict:
+        with self._lock:
+            s = self._streams.get(task_hex)
+            if s is None:
+                s = self._streams[task_hex] = {
+                    "produced": 0, "done": False, "cv": threading.Condition()
+                }
+            return s
+
+    def _run_stream(self, spec: TaskSpec, gen):
+        s = self._stream_state(spec.task_id.hex())
+        idx = 0
+        try:
+            for item in gen:
+                self._objects.put(ObjectID.of(spec.task_id, idx), item)
+                with s["cv"]:
+                    idx += 1
+                    s["produced"] = idx
+                    s["cv"].notify_all()
+        except BaseException as e:  # noqa: BLE001
+            self._end_stream(spec, TaskError(e, traceback.format_exc(), spec.name), base=idx)
+            return
+        with s["cv"]:
+            s["done"] = True
+            s["cv"].notify_all()
+
+    def _end_stream(self, spec: TaskSpec, error=None, base: int = 0):
+        s = self._stream_state(spec.task_id.hex())
+        with s["cv"]:
+            if error is not None:
+                self._objects.put(ObjectID.of(spec.task_id, base), error)
+                s["produced"] = base + 1
+            s["done"] = True
+            s["cv"].notify_all()
+
+    def stream_release(self, task_hex: str, from_index: int) -> None:
+        with self._lock:
+            s = self._streams.get(task_hex)
+            if s is not None and s["done"]:
+                self._streams.pop(task_hex, None)
+
+    def stream_next(self, task_hex: str, index: int, timeout=300.0) -> str:
+        s = self._stream_state(task_hex)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with s["cv"]:
+            while True:
+                if index < s["produced"]:
+                    return "ready"
+                if s["done"]:
+                    return "end"
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise GetTimeoutError(f"stream item {index} timed out")
+                s["cv"].wait(remaining if remaining is not None else 1.0)
 
     def submit_task(self, spec: TaskSpec) -> None:
         self._pool.submit(self._run_task, spec)

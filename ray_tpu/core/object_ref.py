@@ -80,27 +80,61 @@ class ObjectRef:
 
 
 class ObjectRefGenerator:
-    """Streaming generator handle (reference: `_raylet.pyx:272` ObjectRefGenerator).
+    """Streaming generator handle (reference: `_raylet.pyx:272`
+    ObjectRefGenerator / `returns_dynamic`).
 
-    Yields ObjectRefs for the results of a generator task as they are produced.
-    """
+    Yields ObjectRefs for a `num_returns="streaming"` task AS THE TASK
+    PRODUCES THEM: each `__next__` long-polls the directory for the next
+    yielded index (ObjectID = task_id + index, so refs mint locally) and
+    raises StopIteration when the producer finishes."""
 
-    def __init__(self, refs):
-        self._refs = list(refs)
+    def __init__(self, task_id, owner_address: Optional[str] = None):
+        self._task_id = task_id
+        self._owner_address = owner_address
         self._index = 0
 
     def __iter__(self):
         return self
 
     def __next__(self) -> ObjectRef:
-        if self._index >= len(self._refs):
+        from . import api
+        from .ids import ObjectID
+
+        backend = api._global_runtime().backend
+        status = backend.stream_next(self._task_id.hex(), self._index)
+        if status == "end":
+            self._release()
             raise StopIteration
-        ref = self._refs[self._index]
+        ref = ObjectRef(ObjectID.of(self._task_id, self._index), self._owner_address)
         self._index += 1
         return ref
 
-    def __len__(self) -> int:
-        return len(self._refs)
+    def completed(self) -> list:
+        """Drain the remaining stream into a list of refs."""
+        return list(self)
+
+    def _release(self):
+        """Tell the directory which indices this consumer will never claim
+        (items past _index) so they become GC-eligible, and let the stream's
+        bookkeeping go once done. Runs on exhaustion AND on drop."""
+        if getattr(self, "_released", False):
+            return
+        self._released = True
+        try:
+            from . import api
+
+            backend = api._global_runtime().backend
+            release = getattr(backend, "stream_release", None)
+            if release is not None:
+                release(self._task_id.hex(), self._index)
+        except Exception:  # noqa: BLE001 — interpreter teardown / backend gone
+            pass
+
+    def __del__(self):
+        try:
+            self._release()
+        except Exception:  # noqa: BLE001
+            pass
 
 
 # Alias kept for API parity with the reference (`DynamicObjectRefGenerator`).

@@ -50,6 +50,8 @@ class RemoteFunction:
 
         runtime = api._global_runtime()
         refs = runtime.submit_task(self._function, args, kwargs, opts)
+        if opts.num_returns in ("streaming", "dynamic"):
+            return refs  # an ObjectRefGenerator
         if opts.num_returns == 1:
             return refs[0]
         if opts.num_returns == 0:

@@ -131,11 +131,16 @@ class Runtime:
         args: tuple,
         kwargs: dict,
         options: TaskOptions,
-    ) -> List[ObjectRef]:
+    ):
         task_id = self._next_task_id()
         payload, arg_refs = self._build_payload(func, args, kwargs)
         num_returns = options.num_returns
-        return_ids = [ObjectID.of(task_id, i) for i in range(max(num_returns, 1))]
+        streaming = num_returns in ("streaming", "dynamic")
+        if streaming:
+            num_returns = -1  # wire sentinel (reference: returns_dynamic)
+            return_ids: List[ObjectID] = []
+        else:
+            return_ids = [ObjectID.of(task_id, i) for i in range(max(num_returns, 1))]
         spec = TaskSpec(
             task_id=task_id,
             job_id=self.job_id,
@@ -150,8 +155,11 @@ class Runtime:
             owner_address=self.address,
         )
         self.backend.submit_task(spec)
-        refs = [ObjectRef(oid, self.address) for oid in return_ids]
-        return refs
+        if streaming:
+            from .object_ref import ObjectRefGenerator
+
+            return ObjectRefGenerator(task_id, self.address)
+        return [ObjectRef(oid, self.address) for oid in return_ids]
 
     # --------------------------------------------------------------- actors
     def create_actor(
@@ -197,6 +205,11 @@ class Runtime:
         task_id = TaskID.of(actor_id)
         payload, arg_refs = self._build_payload(None, args, kwargs)
         num_returns = options.num_returns
+        if num_returns in ("streaming", "dynamic"):
+            raise NotImplementedError(
+                "num_returns='streaming' is supported for tasks only; actor "
+                "method streaming is not implemented yet"
+            )
         return_ids = [ObjectID.of(task_id, i) for i in range(max(num_returns, 1))]
         spec = TaskSpec(
             task_id=task_id,

@@ -136,6 +136,17 @@ class WorkerProcess:
     def _resolve(self, spec: TaskSpec, deps: Dict[str, dict]) -> List[Any]:
         return [self.read_location(deps[oid.hex()]) for oid in spec.arg_refs]
 
+    def _end_stream_with_error(self, spec: TaskSpec, err: "TaskError", index: int):
+        """Terminate a streaming task: one error item at `index`, then
+        end-of-stream (a waiting consumer must never hang)."""
+        from .ids import ObjectID
+
+        d = self.store_result(ObjectID.of(spec.task_id, index).hex(), err)
+        self.send({"type": "stream_item", "task": spec.task_id.hex(),
+                   "index": index, "item": d})
+        self.send({"type": "task_done", "task": spec.task_id.hex(),
+                   "results": [], "stream_count": index + 1})
+
     _ENV_LOCK = threading.RLock()  # os.environ is process-global
 
     @classmethod
@@ -189,6 +200,27 @@ class WorkerProcess:
                 runtime.set_task_context(None)
             import inspect
 
+            if spec.num_returns == -1:
+                # Streaming generator (reference: `returns_dynamic`): each
+                # yield becomes object (task_id, index) the moment it is
+                # produced — consumers iterate while the task still runs.
+                gen = result if inspect.isgenerator(result) else iter((result,))
+                count = 0
+                from .ids import ObjectID
+
+                try:
+                    for item in gen:
+                        d = self.store_result(ObjectID.of(spec.task_id, count).hex(), item)
+                        self.send({"type": "stream_item", "task": spec.task_id.hex(),
+                                   "index": count, "item": d})
+                        count += 1
+                except BaseException as e:  # noqa: BLE001 — mid-stream error
+                    err = TaskError(e, traceback.format_exc(), spec.name)
+                    self._end_stream_with_error(spec, err, count)
+                    return
+                self.send({"type": "task_done", "task": spec.task_id.hex(),
+                           "results": [], "stream_count": count})
+                return
             if inspect.isgenerator(result):
                 result = tuple(result) if spec.num_returns > 1 else list(result)
             n = spec.num_returns
@@ -204,6 +236,10 @@ class WorkerProcess:
                     results.append(self.store_result(oid.hex(), v))
         except BaseException as e:  # noqa: BLE001
             err = TaskError(e, traceback.format_exc(), spec.name)
+            if spec.num_returns == -1:
+                # Pre-generator failure of a streaming task.
+                self._end_stream_with_error(spec, err, 0)
+                return
             results = [
                 self.store_result(oid.hex(), err) for oid in spec.return_ids
             ]

@@ -164,3 +164,4 @@ def test_cluster_resources():
 def test_nodes():
     ns = ray_tpu.nodes()
     assert len(ns) == 1 and ns[0]["Alive"]
+

@@ -1,0 +1,65 @@
+"""Streaming generator tests (reference: `returns_dynamic` /
+ObjectRefGenerator `_raylet.pyx:272`) — refs arrive as produced."""
+
+import pytest
+
+import ray_tpu
+
+pytestmark = pytest.mark.cluster
+
+
+class TestStreamingGenerators:
+    """num_returns="streaming" (reference: `returns_dynamic` /
+    ObjectRefGenerator `_raylet.pyx:272`) — refs arrive as produced."""
+
+    def test_streaming_yields_as_produced(self, cluster_runtime):
+        import time as _time
+
+        @ray_tpu.remote(num_returns="streaming")
+        def producer(n):
+            for i in range(n):
+                yield i * i
+
+        gen = producer.remote(5)
+        from ray_tpu import ObjectRefGenerator
+
+        assert isinstance(gen, ObjectRefGenerator)
+        assert [ray_tpu.get(r) for r in gen] == [0, 1, 4, 9, 16]
+
+    def test_streaming_consumer_overlaps_producer(self, cluster_runtime):
+        import time as _time
+
+        @ray_tpu.remote(num_returns="streaming")
+        def slow_producer():
+            for i in range(3):
+                _time.sleep(0.4)
+                yield i
+
+        t0 = _time.monotonic()
+        gen = slow_producer.remote()
+        first = ray_tpu.get(next(gen))
+        first_at = _time.monotonic() - t0
+        rest = [ray_tpu.get(r) for r in gen]
+        assert first == 0 and rest == [1, 2]
+        # The first item arrived BEFORE the producer finished (~1.2s).
+        assert first_at < 1.0, f"first item took {first_at:.2f}s — not streaming"
+
+    def test_streaming_mid_error_surfaces_at_index(self, cluster_runtime):
+        @ray_tpu.remote(num_returns="streaming")
+        def flaky():
+            yield "ok"
+            raise ValueError("stream boom")
+
+        gen = flaky.remote()
+        assert ray_tpu.get(next(gen)) == "ok"
+        with pytest.raises(ValueError, match="stream boom"):
+            ray_tpu.get(next(gen))
+        with pytest.raises(StopIteration):
+            next(gen)
+
+    def test_streaming_local_mode(self, local_runtime):
+        @ray_tpu.remote(num_returns="streaming")
+        def producer():
+            yield from ("a", "b")
+
+        assert [ray_tpu.get(r) for r in producer.remote()] == ["a", "b"]
