@@ -1,0 +1,131 @@
+"""Bin-packing of unmet resource demand onto node types.
+
+Reference analog: `python/ray/autoscaler/_private/resource_demand_scheduler.py`
+— first-fit-decreasing over existing capacity, then over planned new nodes,
+choosing node types that fit; bounded by per-type and global max_workers.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+EPS = 1e-9
+
+
+def _fits(avail: Dict[str, float], demand: Dict[str, float]) -> bool:
+    return all(avail.get(k, 0.0) + EPS >= v for k, v in demand.items())
+
+
+def _take(avail: Dict[str, float], demand: Dict[str, float]):
+    for k, v in demand.items():
+        avail[k] = avail.get(k, 0.0) - v
+
+
+def _size(demand: Dict[str, float]) -> float:
+    # TPU demand dominates the ordering so accelerator bundles pack first.
+    return demand.get("TPU", 0.0) * 1e6 + sum(demand.values())
+
+
+def pack_feasible(
+    capacities: List[Dict[str, float]], demands: List[Dict[str, float]]
+) -> bool:
+    """First-fit-decreasing check: do all demand bundles pack into the given
+    capacities? Used for idle-termination safety against the explicit floor."""
+    scratch = [dict(c) for c in capacities]
+    for demand in sorted(demands, key=_size, reverse=True):
+        for cap in scratch:
+            if _fits(cap, demand):
+                _take(cap, demand)
+                break
+        else:
+            return False
+    return True
+
+
+def get_nodes_to_launch(
+    node_types: Dict[str, dict],
+    counts_by_type: Dict[str, int],
+    existing_avail: List[Dict[str, float]],
+    demands: List[Dict[str, float]],
+    explicit_demands: List[Dict[str, float]],
+    existing_totals: List[Dict[str, float]] | None = None,
+    max_workers: int = 64,
+) -> Dict[str, int]:
+    """Decide how many new nodes of each type to launch.
+
+    `node_types`: {type_name: {"resources": {...}, "min_workers": int,
+    "max_workers": int}}. `counts_by_type`: live worker-node counts.
+    `existing_avail`: available resources of live nodes (demands consume
+    these first). `explicit_demands` are matched against whole-node *totals*
+    (capacity floor semantics of `request_resources`).
+    """
+    to_launch: Dict[str, int] = {}
+    planned: List[Tuple[str, Dict[str, float]]] = []  # (type, remaining avail)
+    total_workers = sum(counts_by_type.values())
+
+    def type_count(t: str) -> int:
+        return counts_by_type.get(t, 0) + to_launch.get(t, 0)
+
+    def can_add(t: str) -> bool:
+        spec = node_types[t]
+        return (
+            type_count(t) < spec.get("max_workers", max_workers)
+            and total_workers + sum(to_launch.values()) < max_workers
+        )
+
+    def add_node(t: str) -> Dict[str, float]:
+        to_launch[t] = to_launch.get(t, 0) + 1
+        avail = dict(node_types[t]["resources"])
+        planned.append((t, avail))
+        return avail
+
+    # 1. min_workers floors.
+    for t, spec in node_types.items():
+        while type_count(t) < spec.get("min_workers", 0) and can_add(t):
+            add_node(t)
+
+    # 2. Queued-task / PG-bundle demand: first-fit-decreasing against live
+    # availability, then planned nodes, then new nodes.
+    scratch = [dict(a) for a in existing_avail]
+    for demand in sorted(demands, key=_size, reverse=True):
+        placed = False
+        for avail in scratch:
+            if _fits(avail, demand):
+                _take(avail, demand)
+                placed = True
+                break
+        if placed:
+            continue
+        for _, avail in planned:
+            if _fits(avail, demand):
+                _take(avail, demand)
+                placed = True
+                break
+        if placed:
+            continue
+        for t in sorted(node_types, key=lambda t: _size(node_types[t]["resources"])):
+            if _fits(node_types[t]["resources"], demand) and can_add(t):
+                _take(add_node(t), demand)
+                break
+
+    # 3. Explicit requests are a capacity floor: pack them against node
+    # *totals* (live + planned), ignoring current usage.
+    totals = [dict(t) for t in (existing_totals if existing_totals is not None else existing_avail)]
+    totals += [dict(node_types[t]["resources"]) for t, _ in planned]
+    for demand in sorted(explicit_demands, key=_size, reverse=True):
+        placed = False
+        for cap in totals:
+            if _fits(cap, demand):
+                _take(cap, demand)
+                placed = True
+                break
+        if placed:
+            continue
+        for t in sorted(node_types, key=lambda t: _size(node_types[t]["resources"])):
+            if _fits(node_types[t]["resources"], demand) and can_add(t):
+                cap = add_node(t)
+                _take(cap, demand)
+                totals.append(cap)
+                break
+
+    return to_launch

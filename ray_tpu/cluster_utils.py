@@ -54,6 +54,46 @@ def read_sentinel(proc: subprocess.Popen, prefix: str, timeout: float) -> Option
     return None
 
 
+def launch_node_agent(
+    address: str,
+    session_dir: str,
+    node_id: str,
+    resources: Dict[str, float],
+    object_store_memory: Optional[int] = None,
+    wait_ready: bool = True,
+) -> subprocess.Popen:
+    """Spawn one `node_agent` daemon process joining the cluster at
+    `address`. Shared by the test `Cluster` fixture and the autoscaler's
+    `FakeMultiNodeProvider` (reference analog: the fake multinode provider
+    launching raylets as local processes —
+    `autoscaler/_private/fake_multi_node/node_provider.py`)."""
+    args = {
+        "node_id": node_id,
+        "address": address,
+        "resources": resources,
+        "session_dir": session_dir,
+        "object_store_memory": object_store_memory,
+    }
+    env = dict(os.environ)
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+    env["RAY_TPU_NODE_ARGS"] = json.dumps(args)
+    log_f = open(os.path.join(session_dir, f"agent-{node_id}.log"), "ab")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.core.node_agent"],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=log_f,
+        cwd=pkg_root,
+    )
+    if wait_ready and read_sentinel(proc, "RAY_TPU_NODE_READY=", 30) is None:
+        proc.terminate()
+        raise RuntimeError(
+            f"node {node_id} failed to start; see {session_dir}/agent-{node_id}.log"
+        )
+    return proc
+
+
 @dataclass
 class NodeHandle:
     node_id: str
@@ -149,31 +189,9 @@ class Cluster:
         self._node_counter += 1
         node_id = node_id or f"node{self._node_counter}"
         total = {"CPU": float(num_cpus), **(resources or {})}
-        args = {
-            "node_id": node_id,
-            "address": self.address,
-            "resources": total,
-            "session_dir": self.session_dir,
-            "object_store_memory": object_store_memory,
-        }
-        env = dict(os.environ)
-        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
-        env["RAY_TPU_NODE_ARGS"] = json.dumps(args)
-        log_f = open(os.path.join(self.session_dir, f"agent-{node_id}.log"), "ab")
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.core.node_agent"],
-            env=env,
-            stdout=subprocess.PIPE,
-            stderr=log_f,
-            cwd=pkg_root,
+        proc = launch_node_agent(
+            self.address, self.session_dir, node_id, total, object_store_memory
         )
-        if read_sentinel(proc, "RAY_TPU_NODE_READY=", 30) is None:
-            proc.terminate()
-            raise RuntimeError(
-                f"node {node_id} failed to start; see "
-                f"{self.session_dir}/agent-{node_id}.log"
-            )
         handle = NodeHandle(node_id=node_id, process=proc, resources=total)
         self.nodes.append(handle)
         return handle

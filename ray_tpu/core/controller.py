@@ -91,6 +91,10 @@ class NodeState:
     spawning: int = 0
     spawning_tpu: int = 0
     object_store_memory: int = 0
+    # Last time resources were acquired/released here — drives the
+    # autoscaler's idle-node detection (reference: `LoadMetrics`
+    # `load_metrics.py:63` last_used_time_by_ip).
+    last_active: float = field(default_factory=time.monotonic)
 
     def utilization(self) -> float:
         fracs = [
@@ -240,6 +244,9 @@ class Controller:
         self.waiting_tasks: Dict[str, PendingTask] = {}  # task hex -> waiting on deps
         self.running: Dict[str, Tuple[str, PendingTask]] = {}  # task hex -> (worker, pt)
         self.cancelled: Set[str] = set()
+        # Explicit capacity requests from `autoscaler.sdk.request_resources`
+        # (reference: `python/ray/autoscaler/sdk` → GCS resource_request).
+        self._explicit_demands: List[Dict[str, float]] = []
         self.timeline: List[dict] = []
         self.drivers: Set[Connection] = set()
         self._worker_counter = itertools.count()
@@ -707,8 +714,46 @@ class Controller:
             object_store_memory=msg.get("object_store_memory", 0),
         )
         self._event("node_added", node=node_id, resources=total)
-        self._schedule()
+        self._schedule()  # also retries pending PGs against the new capacity
         return {"ok": True}
+
+    def _retry_pending_pgs(self):
+        """Re-attempt placement of PGs that are not ready — new capacity (an
+        autoscaled/added node, or resources freed by finished tasks) may
+        satisfy them (reference:
+        `GcsPlacementGroupManager::SchedulePendingPlacementGroups`).
+
+        Partially-placed PGs (a node died, re-placement was infeasible) keep
+        their surviving bundles' reservations: only the `None` slots are
+        re-placed, seeded with the surviving nodes so STRICT_SPREAD keeps its
+        distinctness invariant."""
+        for pg_hex, pg in self.pgs.items():
+            if pg["ready"]:
+                continue
+            if pg["bundle_nodes"] and any(n is not None for n in pg["bundle_nodes"]):
+                missing = [i for i, n in enumerate(pg["bundle_nodes"]) if n is None]
+                surviving = {n for n in pg["bundle_nodes"] if n is not None}
+                placement = self._place_bundles(
+                    [pg["bundles"][i] for i in missing],
+                    pg["strategy"],
+                    occupied=surviving,
+                )
+                if placement is None:
+                    continue
+                for i, nid in zip(missing, placement):
+                    self._acquire(self.nodes[nid], pg["bundles"][i])
+                    pg["bundle_nodes"][i] = nid
+                    pg["bundle_avail"][i] = dict(pg["bundles"][i])
+            else:
+                placement = self._place_bundles(pg["bundles"], pg["strategy"])
+                if placement is None:
+                    continue
+                for b, nid in zip(pg["bundles"], placement):
+                    self._acquire(self.nodes[nid], b)
+                pg["bundle_nodes"] = placement
+                pg["bundle_avail"] = [dict(b) for b in pg["bundles"]]
+            pg["ready"] = True
+            self._event("pg_placed", pg=pg_hex)
 
     async def h_shutdown(self, conn, meta, msg):
         self._shutdown_event.set()
@@ -1245,10 +1290,12 @@ class Controller:
         )
 
     def _acquire(self, node: NodeState, demand: Dict[str, float]):
+        node.last_active = time.monotonic()
         for k, v in demand.items():
             node.available[k] = node.available.get(k, 0.0) - v
 
     def _release(self, node: NodeState, demand: Dict[str, float]):
+        node.last_active = time.monotonic()
         for k, v in demand.items():
             node.available[k] = node.available.get(k, 0.0) + v
 
@@ -1434,6 +1481,10 @@ class Controller:
         Reference analog: `ClusterTaskManager::ScheduleAndDispatchTasks` (node
         pick) + `LocalTaskManager` (worker grant), collapsed into one pass.
         """
+        # Pending PGs first: capacity freed since the last pass may fit them
+        # (reference: `SchedulePendingPlacementGroups` on resource change).
+        if any(not pg["ready"] for pg in self.pgs.values()):
+            self._retry_pending_pgs()
         made_progress = True
         # node_id -> CPU workers wanted this pass; flushed bounded below so a
         # task waiting out a worker boot doesn't fork one per scheduling event.
@@ -2342,6 +2393,55 @@ class Controller:
         return {"ok": True}
 
     # -------------------------------------------------------------- state
+    async def h_request_resources(self, conn, meta, msg):
+        """Pin an explicit capacity floor for the autoscaler (reference:
+        `ray.autoscaler.sdk.request_resources` → GCS resource_request)."""
+        self._explicit_demands = [
+            {k: float(v) for k, v in b.items()} for b in (msg.get("bundles") or [])
+        ]
+        return {"ok": True}
+
+    async def h_load_metrics(self, conn, meta, msg):
+        """Demand + utilization snapshot for `StandardAutoscaler.update`
+        (reference: `LoadMetrics` fed from GCS — `load_metrics.py:63`)."""
+        now = time.monotonic()
+        # PG-bound tasks are excluded: their capacity is already reserved by
+        # the PG's bundles, so counting them would launch nodes the tasks can
+        # never use (they are pinned to the bundle's node).
+        pending: List[Dict[str, float]] = [
+            dict(pt.spec.resources)
+            for pt in list(self.ready_queue)[:1000]
+            if not isinstance(
+                pt.spec.options.scheduling_strategy, PlacementGroupSchedulingStrategy
+            )
+        ]
+        pending_pgs = [
+            {"bundles": pg["bundles"], "strategy": pg["strategy"]}
+            for pg in self.pgs.values()
+            if not pg["ready"]
+        ]
+        node_report = []
+        for n in self.nodes.values():
+            busy = any(v < t - 1e-9 for k, t in n.total.items()
+                       for v in [n.available.get(k, 0.0)]) \
+                or n.spawning > 0 or n.spawning_tpu > 0
+            node_report.append(
+                {
+                    "node_id": n.node_id,
+                    "alive": n.alive,
+                    "is_head": n.node_id == HEAD_NODE,
+                    "total": dict(n.total),
+                    "available": dict(n.available),
+                    "idle_s": 0.0 if busy else max(0.0, now - n.last_active),
+                }
+            )
+        return {
+            "pending_demands": pending,
+            "pending_pgs": pending_pgs,
+            "explicit_demands": list(self._explicit_demands),
+            "nodes": node_report,
+        }
+
     async def h_cluster_resources(self, conn, meta, msg):
         total = self._cluster_totals()
         avail: Dict[str, float] = {}

@@ -1,0 +1,256 @@
+"""Autoscaler tests.
+
+Reference analog: `python/ray/tests/test_autoscaler_fake_multinode.py` and
+`test_resource_demand_scheduler.py` — demand-driven scale-up and idle
+scale-down over a hermetic fake node provider.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (
+    FakeMultiNodeProvider,
+    StandardAutoscaler,
+    get_nodes_to_launch,
+    sdk,
+)
+from ray_tpu.cluster_utils import Cluster
+
+pytestmark = pytest.mark.cluster
+
+
+# ------------------------------------------------------- unit: bin packing
+def test_demand_scheduler_packs_onto_existing_capacity():
+    node_types = {"cpu": {"resources": {"CPU": 4}, "min_workers": 0, "max_workers": 4}}
+    out = get_nodes_to_launch(
+        node_types,
+        counts_by_type={},
+        existing_avail=[{"CPU": 4}],
+        demands=[{"CPU": 1}, {"CPU": 1}],
+        explicit_demands=[],
+    )
+    assert out == {}
+
+
+def test_demand_scheduler_launches_for_unmet_demand():
+    node_types = {"cpu": {"resources": {"CPU": 2}, "min_workers": 0, "max_workers": 8}}
+    out = get_nodes_to_launch(
+        node_types,
+        counts_by_type={},
+        existing_avail=[{"CPU": 0}],
+        demands=[{"CPU": 1}] * 5,
+        explicit_demands=[],
+    )
+    assert out == {"cpu": 3}  # ceil(5 / 2)
+
+
+def test_demand_scheduler_honors_max_workers():
+    node_types = {"cpu": {"resources": {"CPU": 1}, "min_workers": 0, "max_workers": 2}}
+    out = get_nodes_to_launch(
+        node_types,
+        counts_by_type={"cpu": 1},
+        existing_avail=[],
+        demands=[{"CPU": 1}] * 10,
+        explicit_demands=[],
+    )
+    assert out == {"cpu": 1}
+
+
+def test_demand_scheduler_min_workers_floor():
+    node_types = {
+        "cpu": {"resources": {"CPU": 1}, "min_workers": 2, "max_workers": 4}
+    }
+    out = get_nodes_to_launch(
+        node_types, counts_by_type={}, existing_avail=[], demands=[],
+        explicit_demands=[],
+    )
+    assert out == {"cpu": 2}
+
+
+def test_demand_scheduler_picks_tpu_type_for_tpu_demand():
+    node_types = {
+        "cpu": {"resources": {"CPU": 8}, "min_workers": 0, "max_workers": 8},
+        "tpu": {
+            "resources": {"CPU": 4, "TPU": 4},
+            "min_workers": 0,
+            "max_workers": 2,
+        },
+    }
+    out = get_nodes_to_launch(
+        node_types,
+        counts_by_type={},
+        existing_avail=[],
+        demands=[{"TPU": 4.0}, {"CPU": 1.0}],
+        explicit_demands=[],
+    )
+    # TPU bundle needs the tpu type; the CPU task fits on that same node.
+    assert out == {"tpu": 1}
+
+
+def test_demand_scheduler_explicit_capacity_floor():
+    node_types = {"cpu": {"resources": {"CPU": 2}, "min_workers": 0, "max_workers": 8}}
+    out = get_nodes_to_launch(
+        node_types,
+        counts_by_type={},
+        existing_avail=[{"CPU": 0}],  # busy node...
+        existing_totals=[{"CPU": 2}],  # ...but capacity counts for the floor
+        demands=[],
+        explicit_demands=[{"CPU": 1}] * 4,
+    )
+    assert out == {"cpu": 1}  # 2 existing capacity + one new node of 2
+
+
+# ----------------------------------------------------------- e2e: scale up
+@pytest.fixture
+def head_only_cluster():
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    ray_tpu.init(address=cluster.address)
+    yield cluster
+    ray_tpu.shutdown()
+    cluster.shutdown()
+
+
+def _make_autoscaler(cluster, node_types, **cfg):
+    provider = FakeMultiNodeProvider(
+        {"address": cluster.address, "session_dir": cluster.session_dir}
+    )
+    backend = ray_tpu.core.api._global_runtime().backend
+    autoscaler = StandardAutoscaler(
+        {"available_node_types": node_types, "max_workers": 8, **cfg},
+        provider,
+        backend,
+    )
+    return provider, autoscaler
+
+
+def test_autoscaler_scales_up_for_queued_tasks(head_only_cluster):
+    cluster = head_only_cluster
+    provider, autoscaler = _make_autoscaler(
+        cluster,
+        {"cpu": {"resources": {"CPU": 2}, "min_workers": 0, "max_workers": 4}},
+        idle_timeout_s=3600,
+    )
+    try:
+        @ray_tpu.remote(num_cpus=1)
+        def busy(x):
+            time.sleep(1.5)
+            return x
+
+        refs = [busy.remote(i) for i in range(5)]
+        time.sleep(0.5)  # let the queue build
+        launched = autoscaler.update()
+        assert sum(launched.values()) >= 1
+        # All tasks finish once the new capacity joins.
+        assert sorted(ray_tpu.get(refs, timeout=60)) == list(range(5))
+    finally:
+        provider.shutdown()
+
+
+def test_autoscaler_scales_down_idle_nodes(head_only_cluster):
+    cluster = head_only_cluster
+    provider, autoscaler = _make_autoscaler(
+        cluster,
+        {"cpu": {"resources": {"CPU": 2}, "min_workers": 1, "max_workers": 4}},
+        idle_timeout_s=0.5,
+    )
+    try:
+        # Launch 3 worker nodes by explicit request, then clear it.
+        sdk.request_resources(bundles=[{"CPU": 2}] * 3)
+        autoscaler.update()
+        assert len(provider.non_terminated_nodes({})) == 3
+        # Wait for registration then clear the floor and let them idle out.
+        time.sleep(1.5)
+        sdk.request_resources()
+        for _ in range(20):
+            autoscaler.update()
+            if len(provider.non_terminated_nodes({})) == 1:
+                break
+            time.sleep(0.3)
+        # min_workers=1 keeps exactly one alive.
+        assert len(provider.non_terminated_nodes({})) == 1
+    finally:
+        provider.shutdown()
+
+
+def test_request_resources_drives_scale_up(head_only_cluster):
+    cluster = head_only_cluster
+    provider, autoscaler = _make_autoscaler(
+        cluster,
+        {"cpu": {"resources": {"CPU": 4}, "min_workers": 0, "max_workers": 4}},
+        idle_timeout_s=3600,
+    )
+    try:
+        sdk.request_resources(num_cpus=6)
+        launched = autoscaler.update()
+        # Head has CPU=1 capacity; 6 CPUs requested → need 2 nodes of 4.
+        assert launched == {"cpu": 2}
+        # Idempotent: capacity now covers the floor.
+        time.sleep(1.5)
+        assert autoscaler.update() == {}
+    finally:
+        provider.shutdown()
+
+
+def test_idle_nodes_kept_while_explicit_floor_active(head_only_cluster):
+    """request_resources capacity must be held stably — no terminate/relaunch
+    churn while the floor is active."""
+    cluster = head_only_cluster
+    provider, autoscaler = _make_autoscaler(
+        cluster,
+        {"cpu": {"resources": {"CPU": 2}, "min_workers": 0, "max_workers": 4}},
+        idle_timeout_s=0.2,
+    )
+    try:
+        sdk.request_resources(bundles=[{"CPU": 2}] * 2)
+        autoscaler.update()
+        assert len(provider.non_terminated_nodes({})) == 2
+        time.sleep(1.5)  # idle well past the timeout
+        for _ in range(3):
+            autoscaler.update()
+            time.sleep(0.3)
+        # Floor still active → both nodes alive, and no extras launched.
+        assert len(provider.non_terminated_nodes({})) == 2
+    finally:
+        provider.shutdown()
+
+
+def test_pending_pg_places_when_capacity_frees(head_only_cluster):
+    """A PG infeasible at creation becomes ready once running tasks release
+    enough resources — no new node required."""
+    import threading
+    from ray_tpu.util.placement_group import placement_group
+
+    @ray_tpu.remote(num_cpus=1)
+    def hog():
+        time.sleep(2.0)
+        return 1
+
+    ref = hog.remote()
+    for _ in range(100):  # wait until the head's single CPU is actually held
+        if ray_tpu.available_resources().get("CPU", 0) < 0.5:
+            break
+        time.sleep(0.1)
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert not pg.wait(0.2)
+    assert ray_tpu.get(ref, timeout=30) == 1
+    assert pg.wait(10)
+
+
+def test_autoscaler_satisfies_pending_placement_group(head_only_cluster):
+    cluster = head_only_cluster
+    provider, autoscaler = _make_autoscaler(
+        cluster,
+        {"cpu": {"resources": {"CPU": 2}, "min_workers": 0, "max_workers": 4}},
+        idle_timeout_s=3600,
+    )
+    try:
+        from ray_tpu.util.placement_group import placement_group
+
+        pg = placement_group([{"CPU": 2}, {"CPU": 2}], strategy="STRICT_SPREAD")
+        assert not pg.wait(0.2)  # infeasible on the 1-CPU head
+        autoscaler.update()
+        assert pg.wait(30)
+    finally:
+        provider.shutdown()
