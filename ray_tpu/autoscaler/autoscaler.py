@@ -83,6 +83,7 @@ class StandardAutoscaler:
             existing_totals=list(self.load_metrics.alive_node_total().values())
             + [dict(c) for c in pending_caps],
             max_workers=self.config["max_workers"],
+            strict_spread_groups=self.load_metrics.strict_spread_groups,
         )
         for t, count in to_launch.items():
             logger.info("autoscaler: launching %d x %s", count, t)

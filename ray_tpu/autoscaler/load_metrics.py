@@ -14,16 +14,27 @@ class LoadMetrics:
     def __init__(self):
         self.pending_demands: List[Dict[str, float]] = []
         self.pending_pg_bundles: List[Dict[str, float]] = []
+        self.strict_spread_groups: List[List[Dict[str, float]]] = []
         self.explicit_demands: List[Dict[str, float]] = []
         self.nodes: List[dict] = []  # controller node reports
 
     def update(self, raw: dict):
         self.pending_demands = raw.get("pending_demands", [])
         self.explicit_demands = raw.get("explicit_demands", [])
+        # STRICT_SPREAD groups keep their identity — each bundle needs a
+        # DISTINCT node, which plain bin-packing would violate (co-packing
+        # two bundles onto one planned node would under-launch and deadlock
+        # the PG). Other strategies flatten into ordinary demands.
         self.pending_pg_bundles = [
             dict(b)
             for pg in raw.get("pending_pgs", [])
+            if pg.get("strategy") != "STRICT_SPREAD"
             for b in pg.get("bundles", [])
+        ]
+        self.strict_spread_groups = [
+            [dict(b) for b in pg.get("bundles", [])]
+            for pg in raw.get("pending_pgs", [])
+            if pg.get("strategy") == "STRICT_SPREAD"
         ]
         self.nodes = raw.get("nodes", [])
 

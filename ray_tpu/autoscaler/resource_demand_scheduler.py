@@ -50,6 +50,7 @@ def get_nodes_to_launch(
     explicit_demands: List[Dict[str, float]],
     existing_totals: List[Dict[str, float]] | None = None,
     max_workers: int = 64,
+    strict_spread_groups: List[List[Dict[str, float]]] = (),
 ) -> Dict[str, int]:
     """Decide how many new nodes of each type to launch.
 
@@ -87,6 +88,38 @@ def get_nodes_to_launch(
     # 2. Queued-task / PG-bundle demand: first-fit-decreasing against live
     # availability, then planned nodes, then new nodes.
     scratch = [dict(a) for a in existing_avail]
+
+    # 2a. STRICT_SPREAD placement groups: every bundle in a group must land
+    # on a DISTINCT capacity unit (existing node or planned node) — plain
+    # packing would co-pack them and permanently under-launch.
+    for group in strict_spread_groups:
+        used_ids: set = set()
+        for demand in sorted(group, key=_size, reverse=True):
+            placed = False
+            for avail in scratch:
+                if id(avail) not in used_ids and _fits(avail, demand):
+                    _take(avail, demand)
+                    used_ids.add(id(avail))
+                    placed = True
+                    break
+            if placed:
+                continue
+            for _, avail in planned:
+                if id(avail) not in used_ids and _fits(avail, demand):
+                    _take(avail, demand)
+                    used_ids.add(id(avail))
+                    placed = True
+                    break
+            if placed:
+                continue
+            for t in sorted(
+                node_types, key=lambda t: _size(node_types[t]["resources"])
+            ):
+                if _fits(node_types[t]["resources"], demand) and can_add(t):
+                    avail = add_node(t)
+                    _take(avail, demand)
+                    used_ids.add(id(avail))
+                    break
     for demand in sorted(demands, key=_size, reverse=True):
         placed = False
         for avail in scratch:

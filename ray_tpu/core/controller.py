@@ -2415,16 +2415,38 @@ class Controller:
                 pt.spec.options.scheduling_strategy, PlacementGroupSchedulingStrategy
             )
         ]
-        pending_pgs = [
-            {"bundles": pg["bundles"], "strategy": pg["strategy"]}
-            for pg in self.pgs.values()
-            if not pg["ready"]
-        ]
+        pending_pgs = []
+        for pg in self.pgs.values():
+            if pg["ready"]:
+                continue
+            # Partially-placed PGs (node death) keep surviving reservations —
+            # only the unplaced slots represent new demand.
+            if pg["bundle_nodes"]:
+                bundles = [
+                    b
+                    for b, nid in zip(pg["bundles"], pg["bundle_nodes"])
+                    if nid is None
+                ]
+            else:
+                bundles = pg["bundles"]
+            if bundles:
+                pending_pgs.append(
+                    {"bundles": bundles, "strategy": pg["strategy"]}
+                )
+        # Nodes hosting live workers with work or actors are busy even when
+        # they hold zero resources (default actors are 0-CPU): terminating
+        # such a node would destroy the actor.
+        occupied_nodes = {
+            ws.node_id
+            for ws in self.workers.values()
+            if ws.state == ACTOR or ws.current_task is not None
+        }
         node_report = []
         for n in self.nodes.values():
             busy = any(v < t - 1e-9 for k, t in n.total.items()
                        for v in [n.available.get(k, 0.0)]) \
-                or n.spawning > 0 or n.spawning_tpu > 0
+                or n.spawning > 0 or n.spawning_tpu > 0 \
+                or n.node_id in occupied_nodes
             node_report.append(
                 {
                     "node_id": n.node_id,

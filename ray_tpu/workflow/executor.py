@@ -1,0 +1,159 @@
+"""Workflow executor — checkpointed DAG walking with retries/continuations.
+
+Reference analog: `python/ray/workflow/workflow_executor.py` +
+`workflow_state_from_dag.py`: each step runs as a task, its result is durably
+checkpointed, and resume replays only the steps without checkpoints.
+
+Step identity: nodes get deterministic keys from a structural DFS of the
+bound DAG (same DAG → same keys across processes/pickling), so resume after
+a crash matches checkpoints to steps without a registry.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from ..dag import DAGNode, FunctionNode, InputNode, MultiOutputNode
+from .storage import WorkflowStorage
+
+# Workflow statuses (reference: `python/ray/workflow/common.py` WorkflowStatus).
+RUNNING = "RUNNING"
+SUCCESSFUL = "SUCCESSFUL"
+FAILED = "FAILED"
+CANCELED = "CANCELED"
+RESUMABLE = "RESUMABLE"
+
+
+class WorkflowCancellationError(Exception):
+    pass
+
+
+def _step_name(node: DAGNode) -> str:
+    fn = getattr(node, "_remote_fn", None)
+    return getattr(fn, "__name__", None) or type(node).__name__.lower()
+
+
+def assign_step_keys(root: DAGNode, prefix: str = "") -> Dict[int, str]:
+    """Deterministic structural DFS over bound args — stable across pickle
+    round-trips, which is what makes crash-resume line up with checkpoints."""
+    keys: Dict[int, str] = {}
+    counter = [0]
+
+    def visit(node):
+        if not isinstance(node, DAGNode) or id(node) in keys:
+            return
+        for a in node._bound_args:
+            visit(a)
+        for v in node._bound_kwargs.values():
+            visit(v)
+        if isinstance(node, MultiOutputNode):
+            for o in node._outputs:
+                visit(o)
+        idx = counter[0]
+        counter[0] += 1
+        keys[id(node)] = f"{prefix}{idx}_{_step_name(node)}"
+
+    visit(root)
+    return keys
+
+
+class WorkflowExecutor:
+    def __init__(self, storage: WorkflowStorage, workflow_id: str):
+        self.storage = storage
+        self.workflow_id = workflow_id
+
+    # ------------------------------------------------------------ execution
+    def run(self, dag: DAGNode, input_value=None) -> Any:
+        """Execute to completion (or raise); returns the final output."""
+        self.storage.set_status(self.workflow_id, RUNNING)
+        try:
+            out = self._exec_subdag(dag, input_value, prefix="")
+            # Continuations: a step may return another DAG to keep going
+            # (reference: `workflow.continuation`).
+            depth = 0
+            while isinstance(out, DAGNode):
+                depth += 1
+                out = self._exec_subdag(out, input_value, prefix=f"c{depth}.")
+            self.storage.save_output(self.workflow_id, out)
+            self.storage.set_status(self.workflow_id, SUCCESSFUL)
+            return out
+        except WorkflowCancellationError:
+            self.storage.set_status(self.workflow_id, CANCELED)
+            raise
+        except BaseException:
+            self.storage.set_status(self.workflow_id, FAILED)
+            raise
+
+    def _exec_subdag(self, root: DAGNode, input_value, prefix: str) -> Any:
+        keys = assign_step_keys(root, prefix)
+        cache: Dict[int, Any] = {}
+        return self._exec_node(root, keys, cache, input_value)
+
+    def _exec_node(self, node, keys, cache, input_value) -> Any:
+        if not isinstance(node, DAGNode):
+            return node
+        if id(node) in cache:
+            return cache[id(node)]
+        if isinstance(node, InputNode):
+            cache[id(node)] = input_value
+            return input_value
+        if isinstance(node, MultiOutputNode):
+            val = [
+                self._exec_node(o, keys, cache, input_value) for o in node._outputs
+            ]
+            cache[id(node)] = val
+            return val
+
+        key = keys[id(node)]
+        if self.storage.has_step(self.workflow_id, key):
+            val = self.storage.load_step(self.workflow_id, key)
+            cache[id(node)] = val
+            return val
+
+        if self.storage.cancel_requested(self.workflow_id):
+            raise WorkflowCancellationError(self.workflow_id)
+
+        args = [self._exec_node(a, keys, cache, input_value) for a in node._bound_args]
+        kwargs = {
+            k: self._exec_node(v, keys, cache, input_value)
+            for k, v in node._bound_kwargs.items()
+        }
+        val = self._run_step(node, key, args, kwargs)
+        opts = getattr(node, "_workflow_options", None) or {}
+        if opts.get("checkpoint", True):
+            self.storage.save_step(self.workflow_id, key, val)
+        cache[id(node)] = val
+        return val
+
+    def _run_step(self, node, key: str, args: List, kwargs: dict) -> Any:
+        """One step = one task submission, retried per step options
+        (reference: per-step `max_retries` in `workflow/common.py`)."""
+        from ..core import api
+
+        opts = getattr(node, "_workflow_options", None) or {}
+        max_retries = int(opts.get("max_retries", 0))
+        catch = bool(opts.get("catch_exceptions", False))
+        if not isinstance(node, FunctionNode):
+            raise TypeError(
+                f"workflow steps must be function nodes, got {type(node).__name__} "
+                "(actor nodes are not durable — reference workflow has the "
+                "same task-only restriction for checkpointed steps)"
+            )
+        attempt = 0
+        while True:
+            if self.storage.cancel_requested(self.workflow_id):
+                raise WorkflowCancellationError(self.workflow_id)
+            try:
+                val = api.get(node._remote_fn.remote(*args, **kwargs))
+                return (val, None) if catch else val
+            except WorkflowCancellationError:
+                raise
+            except Exception as e:  # noqa: BLE001
+                if attempt < max_retries:
+                    attempt += 1
+                    time.sleep(min(0.2 * attempt, 2.0))
+                    continue
+                if catch:
+                    return (None, e)
+                raise

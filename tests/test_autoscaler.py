@@ -102,6 +102,20 @@ def test_demand_scheduler_explicit_capacity_floor():
     assert out == {"cpu": 1}  # 2 existing capacity + one new node of 2
 
 
+def test_demand_scheduler_strict_spread_needs_distinct_nodes():
+    node_types = {"cpu": {"resources": {"CPU": 4}, "min_workers": 0, "max_workers": 8}}
+    out = get_nodes_to_launch(
+        node_types,
+        counts_by_type={},
+        existing_avail=[],
+        demands=[],
+        explicit_demands=[],
+        strict_spread_groups=[[{"CPU": 2}, {"CPU": 2}]],
+    )
+    # Both bundles would fit one CPU:4 node, but STRICT_SPREAD forbids it.
+    assert out == {"cpu": 2}
+
+
 # ----------------------------------------------------------- e2e: scale up
 @pytest.fixture
 def head_only_cluster():
@@ -219,7 +233,6 @@ def test_idle_nodes_kept_while_explicit_floor_active(head_only_cluster):
 def test_pending_pg_places_when_capacity_frees(head_only_cluster):
     """A PG infeasible at creation becomes ready once running tasks release
     enough resources — no new node required."""
-    import threading
     from ray_tpu.util.placement_group import placement_group
 
     @ray_tpu.remote(num_cpus=1)

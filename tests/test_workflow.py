@@ -1,0 +1,210 @@
+"""Workflow library tests.
+
+Reference analog: `python/ray/workflow/tests/` — durable execution, resume
+from checkpoints, retries, cancellation, continuations, events.
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+from ray_tpu.workflow import TimerListener, wait_for_event, with_options
+
+
+@pytest.fixture
+def wf(tmp_path, local_runtime):
+    workflow.init(str(tmp_path / "wf_storage"))
+    yield
+    workflow.init(None)  # reset to default root for other tests
+
+
+def _touch_count(path):
+    """Append-a-byte execution counter usable from worker processes."""
+    with open(path, "ab") as f:
+        f.write(b"x")
+
+
+def _count(path):
+    try:
+        return os.path.getsize(path)
+    except OSError:
+        return 0
+
+
+def test_run_simple_dag(wf):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    dag = add.bind(add.bind(1, 2), 3)
+    assert workflow.run(dag, workflow_id="sum3") == 6
+    assert workflow.get_status("sum3") == workflow.WorkflowStatus.SUCCESSFUL
+    assert workflow.get_output("sum3") == 6
+    assert ("sum3", "SUCCESSFUL") in workflow.list_all()
+    meta = workflow.get_metadata("sum3")
+    assert meta["status"] == "SUCCESSFUL" and "created_at" in meta
+
+
+def test_rerun_finished_workflow_returns_cached_output(wf, tmp_path):
+    marker = str(tmp_path / "ran")
+
+    @ray_tpu.remote
+    def effect():
+        _touch_count(marker)
+        return 41
+
+    dag = effect.bind()
+    assert workflow.run(dag, workflow_id="once") == 41
+    assert workflow.run(dag, workflow_id="once") == 41
+    assert _count(marker) == 1  # second run = cached output, no re-execution
+
+
+def test_failure_then_resume_skips_completed_steps(wf, tmp_path):
+    first_count = str(tmp_path / "first")
+    gate = str(tmp_path / "gate")
+
+    @ray_tpu.remote
+    def first():
+        _touch_count(first_count)
+        return 10
+
+    @ray_tpu.remote
+    def second(x):
+        if not os.path.exists(gate):
+            raise RuntimeError("gate closed")
+        return x + 5
+
+    dag = second.bind(first.bind())
+    with pytest.raises(Exception, match="gate closed"):
+        workflow.run(dag, workflow_id="resumable")
+    assert workflow.get_status("resumable") == "FAILED"
+    assert _count(first_count) == 1
+
+    open(gate, "w").close()
+    assert workflow.resume("resumable") == 15
+    assert workflow.get_status("resumable") == "SUCCESSFUL"
+    # The completed first step was replayed from its checkpoint, not re-run.
+    assert _count(first_count) == 1
+
+
+def test_resume_all(wf, tmp_path):
+    gate = str(tmp_path / "gate2")
+
+    @ray_tpu.remote
+    def gated():
+        if not os.path.exists(gate):
+            raise RuntimeError("closed")
+        return "done"
+
+    with pytest.raises(Exception):
+        workflow.run(gated.bind(), workflow_id="wf_a")
+    with pytest.raises(Exception):
+        workflow.run(gated.bind(), workflow_id="wf_b")
+    open(gate, "w").close()
+    results = {wid: fut.result() for wid, fut in workflow.resume_all()}
+    assert results == {"wf_a": "done", "wf_b": "done"}
+
+
+def test_step_retries(wf, tmp_path):
+    attempts = str(tmp_path / "attempts")
+
+    @ray_tpu.remote
+    def flaky():
+        _touch_count(attempts)
+        if _count(attempts) < 3:
+            raise RuntimeError("boom")
+        return "ok"
+
+    dag = with_options(flaky.bind(), max_retries=5)
+    assert workflow.run(dag, workflow_id="retry") == "ok"
+    assert _count(attempts) == 3
+
+
+def test_catch_exceptions_option(wf):
+    @ray_tpu.remote
+    def bad():
+        raise ValueError("expected")
+
+    dag = with_options(bad.bind(), catch_exceptions=True)
+    val, err = workflow.run(dag, workflow_id="caught")
+    assert val is None and isinstance(err, Exception)
+    assert workflow.get_status("caught") == "SUCCESSFUL"
+
+
+def test_cancel_mid_run(wf, tmp_path):
+    step_done = str(tmp_path / "step_done")
+
+    @ray_tpu.remote
+    def slow(i):
+        _touch_count(step_done)
+        time.sleep(0.4)
+        return i
+
+    # Chain of slow steps; cancel after the first completes.
+    dag = slow.bind(slow.bind(slow.bind(slow.bind(0))))
+    fut = workflow.run_async(dag, workflow_id="cancelme")
+    while _count(step_done) == 0:
+        time.sleep(0.05)
+    workflow.cancel("cancelme")
+    with pytest.raises(Exception):
+        fut.result(timeout=30)
+    assert workflow.get_status("cancelme") == "CANCELED"
+    assert _count(step_done) < 4
+
+
+def test_continuation(wf):
+    @ray_tpu.remote
+    def final(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def start(x):
+        return workflow.continuation(final.bind(x + 1))
+
+    assert workflow.run(start.bind(10), workflow_id="cont") == 22
+
+
+def test_wait_for_event_timer(wf):
+    @ray_tpu.remote
+    def after(ts):
+        return ts > 0
+
+    dag = after.bind(wait_for_event(TimerListener, 0.2))
+    assert workflow.run(dag, workflow_id="evt") is True
+
+
+def test_no_checkpoint_option_reexecutes(wf, tmp_path):
+    cnt = str(tmp_path / "cnt")
+    gate = str(tmp_path / "gate3")
+
+    @ray_tpu.remote
+    def side():
+        _touch_count(cnt)
+        return _count(cnt)
+
+    @ray_tpu.remote
+    def gated(x):
+        if not os.path.exists(gate):
+            raise RuntimeError("closed")
+        return x
+
+    dag = gated.bind(with_options(side.bind(), checkpoint=False))
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="nockpt")
+    open(gate, "w").close()
+    workflow.resume("nockpt")
+    assert _count(cnt) == 2  # un-checkpointed step ran again on resume
+
+
+def test_delete_workflow(wf):
+    @ray_tpu.remote
+    def one():
+        return 1
+
+    workflow.run(one.bind(), workflow_id="todelete")
+    workflow.delete("todelete")
+    assert workflow.get_status("todelete") is None
+    assert ("todelete", "SUCCESSFUL") not in workflow.list_all()
