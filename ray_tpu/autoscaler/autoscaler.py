@@ -66,22 +66,26 @@ class StandardAutoscaler:
         # fast second update() doesn't double-launch (reference: pending-launch
         # accounting in `resource_demand_scheduler` via `pending_launches`).
         registered = set(self.load_metrics.alive_node_avail())
-        pending_caps = [
-            dict(node_types[t]["resources"])
+        pending_caps = {
+            nid: dict(node_types[t]["resources"])
             for t, nids in by_type.items()
             if t in node_types
             for nid in nids
             if nid not in registered
-        ]
+        }
         to_launch = get_nodes_to_launch(
             node_types=node_types,
             counts_by_type=counts,
-            existing_avail=list(self.load_metrics.alive_node_avail().values())
-            + [dict(c) for c in pending_caps],
+            existing_avail={
+                **self.load_metrics.alive_node_avail(),
+                **{k: dict(v) for k, v in pending_caps.items()},
+            },
             demands=self.load_metrics.unmet_demands(),
             explicit_demands=self.load_metrics.explicit_demands,
-            existing_totals=list(self.load_metrics.alive_node_total().values())
-            + [dict(c) for c in pending_caps],
+            existing_totals={
+                **self.load_metrics.alive_node_total(),
+                **{k: dict(v) for k, v in pending_caps.items()},
+            },
             max_workers=self.config["max_workers"],
             strict_spread_groups=self.load_metrics.strict_spread_groups,
         )

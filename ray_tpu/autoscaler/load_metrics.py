@@ -14,7 +14,7 @@ class LoadMetrics:
     def __init__(self):
         self.pending_demands: List[Dict[str, float]] = []
         self.pending_pg_bundles: List[Dict[str, float]] = []
-        self.strict_spread_groups: List[List[Dict[str, float]]] = []
+        self.strict_spread_groups: List[dict] = []  # {"bundles": [...], "occupied": [...]}
         self.explicit_demands: List[Dict[str, float]] = []
         self.nodes: List[dict] = []  # controller node reports
 
@@ -32,7 +32,10 @@ class LoadMetrics:
             for b in pg.get("bundles", [])
         ]
         self.strict_spread_groups = [
-            [dict(b) for b in pg.get("bundles", [])]
+            {
+                "bundles": [dict(b) for b in pg.get("bundles", [])],
+                "occupied": list(pg.get("occupied", [])),
+            }
             for pg in raw.get("pending_pgs", [])
             if pg.get("strategy") == "STRICT_SPREAD"
         ]

@@ -45,21 +45,30 @@ def pack_feasible(
 def get_nodes_to_launch(
     node_types: Dict[str, dict],
     counts_by_type: Dict[str, int],
-    existing_avail: List[Dict[str, float]],
+    existing_avail,
     demands: List[Dict[str, float]],
     explicit_demands: List[Dict[str, float]],
-    existing_totals: List[Dict[str, float]] | None = None,
+    existing_totals=None,
     max_workers: int = 64,
-    strict_spread_groups: List[List[Dict[str, float]]] = (),
+    strict_spread_groups: List[dict] = (),
 ) -> Dict[str, int]:
     """Decide how many new nodes of each type to launch.
 
     `node_types`: {type_name: {"resources": {...}, "min_workers": int,
     "max_workers": int}}. `counts_by_type`: live worker-node counts.
-    `existing_avail`: available resources of live nodes (demands consume
-    these first). `explicit_demands` are matched against whole-node *totals*
-    (capacity floor semantics of `request_resources`).
+    `existing_avail`: available resources of live nodes — a
+    {node_id: resources} mapping, or a bare list when node identity does not
+    matter (demands consume these first). `explicit_demands` are matched
+    against whole-node *totals* (capacity floor semantics of
+    `request_resources`). `strict_spread_groups` entries are
+    {"bundles": [...], "occupied": [node_id, ...]} — each bundle needs a
+    distinct node, and nodes in `occupied` are excluded (they already host
+    this PG's surviving bundles).
     """
+    if not isinstance(existing_avail, dict):
+        existing_avail = {f"#{i}": a for i, a in enumerate(existing_avail)}
+    if existing_totals is not None and not isinstance(existing_totals, dict):
+        existing_totals = {f"#{i}": t for i, t in enumerate(existing_totals)}
     to_launch: Dict[str, int] = {}
     planned: List[Tuple[str, Dict[str, float]]] = []  # (type, remaining avail)
     total_workers = sum(counts_by_type.values())
@@ -87,14 +96,21 @@ def get_nodes_to_launch(
 
     # 2. Queued-task / PG-bundle demand: first-fit-decreasing against live
     # availability, then planned nodes, then new nodes.
-    scratch = [dict(a) for a in existing_avail]
+    by_node = {nid: dict(a) for nid, a in existing_avail.items()}
+    scratch = list(by_node.values())
 
     # 2a. STRICT_SPREAD placement groups: every bundle in a group must land
     # on a DISTINCT capacity unit (existing node or planned node) — plain
-    # packing would co-pack them and permanently under-launch.
+    # packing would co-pack them and permanently under-launch. Nodes already
+    # hosting the group's surviving bundles are excluded up front.
     for group in strict_spread_groups:
-        used_ids: set = set()
-        for demand in sorted(group, key=_size, reverse=True):
+        if isinstance(group, dict):
+            bundles = group.get("bundles", [])
+            occupied = group.get("occupied", [])
+        else:  # bare bundle list (tests/back-compat)
+            bundles, occupied = group, []
+        used_ids = {id(by_node[nid]) for nid in occupied if nid in by_node}
+        for demand in sorted(bundles, key=_size, reverse=True):
             placed = False
             for avail in scratch:
                 if id(avail) not in used_ids and _fits(avail, demand):
@@ -143,7 +159,8 @@ def get_nodes_to_launch(
 
     # 3. Explicit requests are a capacity floor: pack them against node
     # *totals* (live + planned), ignoring current usage.
-    totals = [dict(t) for t in (existing_totals if existing_totals is not None else existing_avail)]
+    source = existing_totals if existing_totals is not None else existing_avail
+    totals = [dict(t) for t in source.values()]
     totals += [dict(node_types[t]["resources"]) for t, _ in planned]
     for demand in sorted(explicit_demands, key=_size, reverse=True):
         placed = False

@@ -139,6 +139,8 @@ class ClusterBackend(RuntimeBackend):
         result = self.io.call(go(), timeout=20)
         if not (result or {}).get("ok"):
             raise RayTpuError(f"Failed to register with controller: {result}")
+        if result.get("session_dir"):
+            self.session_dir = result["session_dir"]
         # Adopt the head's session tag unless this process is env-pinned to a
         # node arena: a worker on a remote node carries ITS node's tag
         # (RAY_TPU_SESSION_TAG from the agent) and must keep attaching there.

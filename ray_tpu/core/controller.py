@@ -2427,11 +2427,22 @@ class Controller:
                     for b, nid in zip(pg["bundles"], pg["bundle_nodes"])
                     if nid is None
                 ]
+                occupied = sorted(
+                    {nid for nid in pg["bundle_nodes"] if nid is not None}
+                )
             else:
                 bundles = pg["bundles"]
+                occupied = []
             if bundles:
+                # `occupied` lets the autoscaler's STRICT_SPREAD packer
+                # exclude surviving nodes — the controller's re-placement
+                # will refuse them, so capacity there cannot satisfy this PG.
                 pending_pgs.append(
-                    {"bundles": bundles, "strategy": pg["strategy"]}
+                    {
+                        "bundles": bundles,
+                        "strategy": pg["strategy"],
+                        "occupied": occupied,
+                    }
                 )
         # Nodes hosting live workers with work or actors are busy even when
         # they hold zero resources (default actors are 0-CPU): terminating
@@ -2439,7 +2450,8 @@ class Controller:
         occupied_nodes = {
             ws.node_id
             for ws in self.workers.values()
-            if ws.state == ACTOR or ws.current_task is not None
+            if ws.state != DEAD
+            and (ws.state == ACTOR or ws.current_task is not None)
         }
         node_report = []
         for n in self.nodes.values():

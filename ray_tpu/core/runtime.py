@@ -9,6 +9,7 @@ within creating task — `common/id.h:272`), and routes to the backend.
 from __future__ import annotations
 
 import concurrent.futures
+import os
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -133,6 +134,7 @@ class Runtime:
         options: TaskOptions,
     ):
         task_id = self._next_task_id()
+        options = self._prepare_runtime_env(options)
         payload, arg_refs = self._build_payload(func, args, kwargs)
         num_returns = options.num_returns
         streaming = num_returns in ("streaming", "dynamic")
@@ -161,6 +163,26 @@ class Runtime:
             return ObjectRefGenerator(task_id, self.address)
         return [ObjectRef(oid, self.address) for oid in return_ids]
 
+    def _prepare_runtime_env(self, options: TaskOptions) -> TaskOptions:
+        """Submission-time runtime_env validation + packaging (reference:
+        driver-side upload in `_private/runtime_env/working_dir.py`)."""
+        renv = options.runtime_env
+        if not renv:
+            return options
+        import dataclasses
+
+        from .. import runtime_env as renv_mod
+
+        session_dir = (
+            getattr(self.backend, "session_dir", None)
+            or os.environ.get("RAY_TPU_SESSION_DIR")
+            or "/tmp/ray_tpu/local_session"
+        )
+        prepared = renv_mod.prepare_runtime_env(renv, session_dir)
+        if prepared == renv:
+            return options
+        return dataclasses.replace(options, runtime_env=prepared)
+
     # --------------------------------------------------------------- actors
     def create_actor(
         self,
@@ -174,6 +196,7 @@ class Runtime:
     ) -> ActorID:
         actor_id = ActorID.of(self.job_id)
         task_id = self._next_task_id()
+        options = self._prepare_runtime_env(options)
         payload, arg_refs = self._build_payload(cls, args, kwargs)
         spec = TaskSpec(
             task_id=task_id,

@@ -151,25 +151,52 @@ class WorkerProcess:
 
     @classmethod
     def _runtime_env_vars(cls, spec: TaskSpec):
-        """Per-task/actor env vars (reference: `runtime_env={"env_vars":…}`,
-        the most-used slice of `_private/runtime_env/`). Returns a restore
-        closure; full isolation (pip/conda/working_dir) is per-JOB instead
-        (jobs run as fresh driver subprocesses).
+        """Per-task/actor runtime_env: env vars applied here; working_dir /
+        py_modules / pip / plugins via `ray_tpu.runtime_env.apply_runtime_env`
+        (reference: `_private/runtime_env/` agent-applied envs). Returns a
+        restore closure; setup failure raises `RuntimeEnvSetupError`, failing
+        the task like the reference's RUNTIME_ENV_SETUP_FAILED.
 
-        Tasks CARRYING env_vars hold a process lock until restore — two
+        Tasks CARRYING a runtime_env hold a process lock until restore — two
         concurrent actor methods (max_concurrency > 1) mutating the global
-        environment would otherwise race. Tasks without env_vars never
-        touch the lock."""
+        environment (env/cwd/sys.path) would otherwise race. Tasks without
+        one never touch the lock."""
         renv = spec.options.runtime_env or {}
         env_vars = renv.get("env_vars") or {}
-        if not env_vars:
+        has_env = bool(
+            renv.get("_working_dir_pkg")
+            or renv.get("working_dir")
+            or renv.get("_py_module_pkgs")
+            or renv.get("pip")
+            or any(
+                isinstance(v, dict) and "__plugin__" in v for v in renv.values()
+            )
+        )
+        if not env_vars and not has_env:
             return lambda: None
         cls._ENV_LOCK.acquire()
         saved = {k: os.environ.get(k) for k in env_vars}
         os.environ.update({k: str(v) for k, v in env_vars.items()})
+        try:
+            from ..runtime_env import apply_runtime_env
+
+            cache_root = os.path.join(
+                os.environ.get("RAY_TPU_SESSION_DIR", "/tmp/ray_tpu"),
+                "runtime_env_cache",
+            )
+            restore_renv = apply_runtime_env(renv, cache_root)
+        except BaseException:
+            for k, old in saved.items():
+                if old is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = old
+            cls._ENV_LOCK.release()
+            raise
 
         def restore():
             try:
+                restore_renv()
                 for k, old in saved.items():
                     if old is None:
                         os.environ.pop(k, None)

@@ -77,6 +77,10 @@ class WorkflowStatus:
 
 _storage: Optional[WorkflowStorage] = None
 _lock = threading.Lock()
+# Workflow ids executing in THIS process — closes the submit→RUNNING race a
+# status file alone cannot (two quick run_async calls before the first
+# executor sets its status).
+_running_local: set = set()
 
 
 def init(storage: Optional[str] = None):
@@ -114,6 +118,8 @@ def run_async(
 ) -> Future:
     storage = _get_storage()
     workflow_id = workflow_id or f"workflow-{uuid.uuid4().hex[:12]}"
+    with _lock:
+        already_local = workflow_id in _running_local
     if storage.exists(workflow_id):
         status = storage.get_status(workflow_id)
         if status == SUCCESSFUL:
@@ -121,7 +127,7 @@ def run_async(
             fut: Future = Future()
             fut.set_result(storage.load_output(workflow_id))
             return fut
-        if status == RUNNING:
+        if status == RUNNING or already_local:
             raise RuntimeError(
                 f"workflow '{workflow_id}' is already running; use resume() "
                 "after a crash or wait for it to finish"
@@ -135,6 +141,13 @@ def run_async(
 
 
 def _spawn(storage: WorkflowStorage, workflow_id: str, dag: DAGNode) -> Future:
+    # Claim RUNNING synchronously — before the executor thread exists — so a
+    # concurrent run_async for the same id cannot start a duplicate executor.
+    with _lock:
+        if workflow_id in _running_local:
+            raise RuntimeError(f"workflow '{workflow_id}' is already running")
+        _running_local.add(workflow_id)
+    storage.set_status(workflow_id, RUNNING)
     fut: Future = Future()
     executor = WorkflowExecutor(storage, workflow_id)
 
@@ -143,6 +156,9 @@ def _spawn(storage: WorkflowStorage, workflow_id: str, dag: DAGNode) -> Future:
             fut.set_result(executor.run(dag))
         except BaseException as e:  # noqa: BLE001
             fut.set_exception(e)
+        finally:
+            with _lock:
+                _running_local.discard(workflow_id)
 
     t = threading.Thread(target=go, daemon=True, name=f"workflow-{workflow_id}")
     t.start()

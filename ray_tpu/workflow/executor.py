@@ -11,6 +11,7 @@ a crash matches checkpoints to steps without a registry.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -62,19 +63,14 @@ class WorkflowExecutor:
     def __init__(self, storage: WorkflowStorage, workflow_id: str):
         self.storage = storage
         self.workflow_id = workflow_id
+        self._cache_lock = threading.Lock()
 
     # ------------------------------------------------------------ execution
     def run(self, dag: DAGNode, input_value=None) -> Any:
-        """Execute to completion (or raise); returns the final output."""
-        self.storage.set_status(self.workflow_id, RUNNING)
+        """Execute to completion (or raise); returns the final output.
+        The caller (run_async) has already marked the workflow RUNNING."""
         try:
             out = self._exec_subdag(dag, input_value, prefix="")
-            # Continuations: a step may return another DAG to keep going
-            # (reference: `workflow.continuation`).
-            depth = 0
-            while isinstance(out, DAGNode):
-                depth += 1
-                out = self._exec_subdag(out, input_value, prefix=f"c{depth}.")
             self.storage.save_output(self.workflow_id, out)
             self.storage.set_status(self.workflow_id, SUCCESSFUL)
             return out
@@ -90,40 +86,97 @@ class WorkflowExecutor:
         cache: Dict[int, Any] = {}
         return self._exec_node(root, keys, cache, input_value)
 
+    def _exec_many(self, nodes, keys, cache, input_value) -> List[Any]:
+        """Evaluate sibling subtrees concurrently — independent DAG branches
+        run in parallel on the cluster instead of serializing on the
+        driver's blocking get (one thread per extra branch; DAG widths are
+        small)."""
+        dag_children = [n for n in nodes if isinstance(n, DAGNode)]
+        if len(dag_children) > 1:
+            results: Dict[int, Any] = {}
+            errors: List[BaseException] = []
+
+            def work(i, n):
+                try:
+                    results[i] = self._exec_node(n, keys, cache, input_value)
+                except BaseException as e:  # noqa: BLE001
+                    errors.append(e)
+
+            threads = [
+                threading.Thread(target=work, args=(i, n), daemon=True)
+                for i, n in enumerate(nodes)
+                if isinstance(n, DAGNode)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if errors:
+                raise errors[0]
+            return [
+                results[i] if isinstance(n, DAGNode) else n
+                for i, n in enumerate(nodes)
+            ]
+        return [self._exec_node(n, keys, cache, input_value) for n in nodes]
+
     def _exec_node(self, node, keys, cache, input_value) -> Any:
         if not isinstance(node, DAGNode):
             return node
-        if id(node) in cache:
-            return cache[id(node)]
+        # Memoized single execution per node, safe under branch threads:
+        # the first visitor claims the slot; later visitors wait on its event.
+        with self._cache_lock:
+            slot = cache.get(id(node))
+            owner = slot is None
+            if owner:
+                slot = cache[id(node)] = {"event": threading.Event()}
+        if not owner:
+            slot["event"].wait()
+            if "error" in slot:
+                raise slot["error"]
+            return slot["value"]
+        try:
+            val = self._compute_node(node, keys, cache, input_value)
+            slot["value"] = val
+            return val
+        except BaseException as e:  # noqa: BLE001
+            slot["error"] = e
+            raise
+        finally:
+            slot["event"].set()
+
+    def _compute_node(self, node, keys, cache, input_value) -> Any:
         if isinstance(node, InputNode):
-            cache[id(node)] = input_value
             return input_value
         if isinstance(node, MultiOutputNode):
-            val = [
-                self._exec_node(o, keys, cache, input_value) for o in node._outputs
-            ]
-            cache[id(node)] = val
-            return val
+            return self._exec_many(node._outputs, keys, cache, input_value)
 
         key = keys[id(node)]
         if self.storage.has_step(self.workflow_id, key):
-            val = self.storage.load_step(self.workflow_id, key)
-            cache[id(node)] = val
-            return val
+            return self._resolve_continuations(
+                self.storage.load_step(self.workflow_id, key), key, input_value
+            )
 
         if self.storage.cancel_requested(self.workflow_id):
             raise WorkflowCancellationError(self.workflow_id)
 
-        args = [self._exec_node(a, keys, cache, input_value) for a in node._bound_args]
-        kwargs = {
-            k: self._exec_node(v, keys, cache, input_value)
-            for k, v in node._bound_kwargs.items()
-        }
+        bound = list(node._bound_args) + list(node._bound_kwargs.values())
+        vals = self._exec_many(bound, keys, cache, input_value)
+        args = vals[: len(node._bound_args)]
+        kwargs = dict(zip(node._bound_kwargs.keys(), vals[len(node._bound_args):]))
         val = self._run_step(node, key, args, kwargs)
         opts = getattr(node, "_workflow_options", None) or {}
         if opts.get("checkpoint", True):
             self.storage.save_step(self.workflow_id, key, val)
-        cache[id(node)] = val
+        return self._resolve_continuations(val, key, input_value)
+
+    def _resolve_continuations(self, val, key: str, input_value) -> Any:
+        """A step (root or nested) may return another DAG — keep walking it
+        durably under a key-prefixed namespace (reference:
+        `workflow.continuation`)."""
+        depth = 0
+        while isinstance(val, DAGNode):
+            depth += 1
+            val = self._exec_subdag(val, input_value, prefix=f"{key}.c{depth}.")
         return val
 
     def _run_step(self, node, key: str, args: List, kwargs: dict) -> Any:

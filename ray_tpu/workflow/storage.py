@@ -31,7 +31,10 @@ def default_root() -> str:
 
 
 def _atomic_write(path: str, data: bytes):
-    tmp = f"{path}.tmp.{os.getpid()}"
+    import threading
+    import uuid
+
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}.{uuid.uuid4().hex[:6]}"
     with open(tmp, "wb") as f:
         f.write(data)
         f.flush()

@@ -1,0 +1,154 @@
+"""Runtime environment tests.
+
+Reference analog: `python/ray/tests/test_runtime_env*.py` — env_vars,
+working_dir, py_modules, pip verification, plugins.
+"""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu.runtime_env import (
+    RuntimeEnv,
+    RuntimeEnvPlugin,
+    RuntimeEnvSetupError,
+    register_plugin,
+    validate,
+)
+from ray_tpu.runtime_env.packaging import (
+    ensure_unpacked,
+    hash_directory,
+    package_directory,
+)
+
+pytestmark = pytest.mark.cluster
+
+
+# ------------------------------------------------------------------ units
+def test_validate_rejects_unknown_fields():
+    with pytest.raises(ValueError, match="Unknown runtime_env field"):
+        validate({"working_dirs": "/tmp"})
+    with pytest.raises(ValueError, match="non-goal"):
+        validate({"conda": {"dependencies": []}})
+    validate({"env_vars": {"A": "1"}, "pip": ["numpy"]})
+    assert RuntimeEnv(env_vars={"A": "1"})["env_vars"] == {"A": "1"}
+
+
+def test_packaging_content_addressed(tmp_path):
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "mod.py").write_text("X = 1\n")
+    pkgs = str(tmp_path / "pkgs")
+    z1 = package_directory(str(src), pkgs)
+    z2 = package_directory(str(src), pkgs)
+    assert z1 == z2  # same content → same package
+    h1 = hash_directory(str(src))
+    (src / "mod.py").write_text("X = 2\n")
+    assert hash_directory(str(src)) != h1
+    z3 = package_directory(str(src), pkgs)
+    assert z3 != z1
+
+    out = ensure_unpacked(z1, str(tmp_path / "cache"))
+    assert open(os.path.join(out, "mod.py")).read() == "X = 1\n"
+    assert ensure_unpacked(z1, str(tmp_path / "cache")) == out  # idempotent
+
+
+# ------------------------------------------------------------------- e2e
+def test_env_vars_roundtrip(cluster_runtime):
+    @ray_tpu.remote(runtime_env={"env_vars": {"RTENV_PROBE": "42"}})
+    def read_env():
+        return os.environ.get("RTENV_PROBE")
+
+    @ray_tpu.remote
+    def read_plain():
+        return os.environ.get("RTENV_PROBE")
+
+    assert ray_tpu.get(read_env.remote()) == "42"
+    assert ray_tpu.get(read_plain.remote()) is None  # restored
+
+
+def test_working_dir_ships_files(cluster_runtime, tmp_path):
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "data.txt").write_text("payload-7")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(proj)})
+    def read_file():
+        with open("data.txt") as f:
+            return f.read()
+
+    assert ray_tpu.get(read_file.remote()) == "payload-7"
+
+
+def test_py_modules_importable(cluster_runtime, tmp_path):
+    mod_dir = tmp_path / "mods"
+    pkg = mod_dir / "rtenv_test_pkg"
+    pkg.mkdir(parents=True)
+    (pkg / "__init__.py").write_text("VALUE = 'imported-ok'\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(mod_dir)]})
+    def use_module():
+        import rtenv_test_pkg
+
+        return rtenv_test_pkg.VALUE
+
+    assert ray_tpu.get(use_module.remote()) == "imported-ok"
+
+
+def test_actor_runtime_env_persists(cluster_runtime, tmp_path):
+    proj = tmp_path / "aproj"
+    proj.mkdir()
+    (proj / "marker.txt").write_text("actor-env")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(proj), "env_vars": {"AENV": "y"}})
+    class Reader:
+        def read(self):
+            with open("marker.txt") as f:
+                return f.read(), os.environ.get("AENV")
+
+    r = Reader.remote()
+    # Env persists across method calls (actor-lifetime semantics).
+    assert ray_tpu.get(r.read.remote()) == ("actor-env", "y")
+    assert ray_tpu.get(r.read.remote()) == ("actor-env", "y")
+
+
+def test_pip_requirement_satisfied(cluster_runtime):
+    @ray_tpu.remote(runtime_env={"pip": ["numpy"]})
+    def use_numpy():
+        import numpy as np
+
+        return int(np.int32(7))
+
+    assert ray_tpu.get(use_numpy.remote()) == 7
+
+
+def test_pip_requirement_missing_fails_task(cluster_runtime):
+    @ray_tpu.remote(runtime_env={"pip": ["definitely_not_a_real_pkg_xyz"]})
+    def doomed():
+        return 1
+
+    with pytest.raises(Exception, match="not available in the worker image"):
+        ray_tpu.get(doomed.remote())
+
+
+def test_custom_plugin(cluster_runtime):
+    class MarkerPlugin(RuntimeEnvPlugin):
+        def prepare(self, value, session_dir):
+            return f"prepared:{value}"
+
+        def apply(self, value, cache_root):
+            os.environ["PLUGIN_MARK"] = value
+            return lambda: os.environ.pop("PLUGIN_MARK", None)
+
+    register_plugin("marker", MarkerPlugin())
+    try:
+        @ray_tpu.remote(runtime_env={"marker": "m1"})
+        def probe():
+            return os.environ.get("PLUGIN_MARK")
+
+        assert ray_tpu.get(probe.remote()) == "prepared:m1"
+    finally:
+        from ray_tpu import runtime_env as renv_mod
+
+        renv_mod._PLUGINS.pop("marker", None)

@@ -167,6 +167,46 @@ def test_continuation(wf):
     assert workflow.run(start.bind(10), workflow_id="cont") == 22
 
 
+def test_nested_continuation(wf):
+    """A NON-root step returning a continuation must resolve before its
+    parent consumes the value."""
+
+    @ray_tpu.remote
+    def leaf(x):
+        return x + 100
+
+    @ray_tpu.remote
+    def inner():
+        return workflow.continuation(leaf.bind(1))
+
+    @ray_tpu.remote
+    def outer(v):
+        return v * 2  # must see 101, not a DAGNode
+
+    assert workflow.run(outer.bind(inner.bind()), workflow_id="nested") == 202
+
+
+def test_parallel_branches_overlap(wf):
+    import time as _t
+
+    @ray_tpu.remote
+    def slow(i):
+        _t.sleep(0.5)
+        return i
+
+    @ray_tpu.remote
+    def gather(*xs):
+        return sum(xs)
+
+    t0 = _t.monotonic()
+    out = workflow.run(
+        gather.bind(slow.bind(1), slow.bind(2), slow.bind(3)), workflow_id="par"
+    )
+    dt = _t.monotonic() - t0
+    assert out == 6
+    assert dt < 1.3, f"independent branches serialized ({dt:.2f}s)"
+
+
 def test_wait_for_event_timer(wf):
     @ray_tpu.remote
     def after(ts):
