@@ -90,3 +90,6 @@ define("spawn_burst_cap", 4, doc="Max workers spawned per node per pass")
 # Persistence.
 define("snapshot_interval_s", 1.0, doc="Controller state snapshot period")
 define("pull_timeout_s", 120.0, doc="Cross-node object pull timeout")
+# Observability.
+define("dashboard", True, doc="Serve the HTTP dashboard from the controller")
+define("dashboard_port", 0, doc="Dashboard port (0 = ephemeral)")

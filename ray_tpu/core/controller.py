@@ -282,6 +282,14 @@ class Controller:
             self._on_metrics_connection, host="127.0.0.1", port=0
         )
         self.metrics_port = self._metrics_server.sockets[0].getsockname()[1]
+        # Dashboard (reference: `dashboard/head.py`; here an in-process HTTP
+        # server over the same state the state API serves).
+        self.dashboard = None
+        if rt_config.get("dashboard"):
+            from ..dashboard import DashboardServer
+
+            self.dashboard = DashboardServer(self)
+            await self.dashboard.start(rt_config.get("dashboard_port"))
         self._write_session_info()
         if self.standalone:
             store.mark_restorable(store.SESSION_TAG, True)
@@ -454,6 +462,8 @@ class Controller:
             "session_dir": self.session_dir,
             "pid": os.getpid(),
         }
+        if getattr(self, "dashboard", None) is not None:
+            info["dashboard_url"] = f"http://127.0.0.1:{self.dashboard.port}"
         with open(os.path.join(self.session_dir, "address.json"), "w") as f:
             json.dump(info, f)
         link = "/tmp/ray_tpu/session_latest"

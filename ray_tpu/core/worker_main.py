@@ -213,6 +213,7 @@ class WorkerProcess:
 
         runtime = api._global_runtime()
         results: List[dict] = []
+        restore_once = None
         try:
             resolved = self._resolve(spec, deps)
             func, args, kwargs = resolve_payload(spec.func_payload, resolved)
@@ -220,14 +221,26 @@ class WorkerProcess:
                 func = getattr(self.actor_instance, spec.method_name)
             runtime.set_task_context(spec.task_id, spec.actor_id)
             restore_env = self._runtime_env_vars(spec)
+            streaming = spec.num_returns == -1
+            _restored = [False]
+
+            def restore_once():
+                if not _restored[0]:
+                    _restored[0] = True
+                    restore_env()
+                    runtime.set_task_context(None)
+
             try:
                 result = func(*args, **kwargs)
             finally:
-                restore_env()
-                runtime.set_task_context(None)
+                # Streaming tasks keep env + task context ALIVE past the call:
+                # func() only built the lazy generator — its body runs during
+                # iteration below and must still see cwd/sys.path/env_vars.
+                if not streaming:
+                    restore_once()
             import inspect
 
-            if spec.num_returns == -1:
+            if streaming:
                 # Streaming generator (reference: `returns_dynamic`): each
                 # yield becomes object (task_id, index) the moment it is
                 # produced — consumers iterate while the task still runs.
@@ -245,6 +258,8 @@ class WorkerProcess:
                     err = TaskError(e, traceback.format_exc(), spec.name)
                     self._end_stream_with_error(spec, err, count)
                     return
+                finally:
+                    restore_once()
                 self.send({"type": "task_done", "task": spec.task_id.hex(),
                            "results": [], "stream_count": count})
                 return
@@ -262,6 +277,8 @@ class WorkerProcess:
                 for oid, v in zip(spec.return_ids, result):
                     results.append(self.store_result(oid.hex(), v))
         except BaseException as e:  # noqa: BLE001
+            if restore_once is not None:
+                restore_once()  # streaming path may still hold env + context
             err = TaskError(e, traceback.format_exc(), spec.name)
             if spec.num_returns == -1:
                 # Pre-generator failure of a streaming task.

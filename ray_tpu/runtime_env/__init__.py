@@ -12,10 +12,12 @@ Redesign (TPU-first, zero-egress aware):
     submission into the session package root and unpacked once per worker
     node cache; applied as cwd / sys.path mutations around execution.
   * `pip` — requirement availability is VERIFIED against the worker's
-    interpreter (this image has no egress, so installation is gated behind
-    RAY_TPU_RUNTIME_ENV_ALLOW_PIP=1 → `pip install` into a venv); missing
+    interpreter (distribution metadata first, import fallback); missing
     requirements raise `RuntimeEnvSetupError` exactly like the reference's
-    failed env setup.
+    failed env setup. RAY_TPU_RUNTIME_ENV_ALLOW_PIP=1 additionally installs
+    missing requirements into the (shared, non-isolated) worker interpreter
+    — a bootstrap escape hatch for images with an index, not per-task
+    isolation.
   * `conda` — declared non-goal (no conda in the image); raises.
   * custom plugins — `register_plugin(name, plugin)` with driver-side
     `prepare` and worker-side `apply` hooks.
@@ -150,19 +152,34 @@ def prepare_runtime_env(renv: Optional[dict], session_dir: str) -> Optional[dict
 _REQ_SPLIT = re.compile(r"[<>=!~\[;]")
 
 
+def _requirement_available(req: str) -> bool:
+    name = _REQ_SPLIT.split(req)[0].strip()
+    # Distribution lookup first — module names often differ from PyPI names
+    # (pillow→PIL, scikit-learn→sklearn); import guess only as fallback.
+    try:
+        import importlib.metadata as md
+
+        md.distribution(re.sub(r"[-_.]+", "-", name))
+        return True
+    except Exception:  # noqa: BLE001 — PackageNotFoundError and exotica
+        pass
+    try:
+        importlib.import_module(name.replace("-", "_"))
+        return True
+    except ImportError:
+        return False
+
+
 def _check_pip(requirements) -> None:
     if isinstance(requirements, dict):
         requirements = requirements.get("packages", [])
-    missing = []
-    for req in requirements:
-        mod = _REQ_SPLIT.split(req)[0].strip().replace("-", "_")
-        try:
-            importlib.import_module(mod)
-        except ImportError:
-            missing.append(req)
+    missing = [req for req in requirements if not _requirement_available(req)]
     if not missing:
         return
     if os.environ.get("RAY_TPU_RUNTIME_ENV_ALLOW_PIP") == "1":
+        # Deliberately NOT isolated: installs into the worker interpreter and
+        # persists for the process (a bootstrap escape hatch, not per-task
+        # isolation — bake real deps into the image).
         import subprocess
 
         subprocess.check_call(

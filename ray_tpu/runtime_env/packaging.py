@@ -81,7 +81,13 @@ def package_directory(
     if os.path.exists(zip_path):
         _PKG_CACHE[cache_key] = (sig, zip_path)
         return zip_path
-    tmp = f"{zip_path}.tmp.{os.getpid()}"
+    import threading
+    import uuid
+
+    tmp = (
+        f"{zip_path}.tmp.{os.getpid()}.{threading.get_ident()}."
+        f"{uuid.uuid4().hex[:6]}"
+    )
     total = 0
     with zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as zf:
         for fp in _walk_files(path, excludes):

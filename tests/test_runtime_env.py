@@ -132,6 +132,41 @@ def test_pip_requirement_missing_fails_task(cluster_runtime):
         ray_tpu.get(doomed.remote())
 
 
+def test_streaming_generator_keeps_env_during_iteration(cluster_runtime, tmp_path):
+    """The generator BODY runs during iteration, after func() returns — the
+    runtime_env (cwd, env vars) must stay applied until the stream ends."""
+    proj = tmp_path / "sproj"
+    proj.mkdir()
+    (proj / "item.txt").write_text("streamed")
+
+    @ray_tpu.remote(
+        num_returns="streaming",
+        runtime_env={"working_dir": str(proj), "env_vars": {"SENV": "live"}},
+    )
+    def produce():
+        for _ in range(3):
+            with open("item.txt") as f:
+                yield f.read(), os.environ.get("SENV")
+
+    gen = produce.remote()
+    items = [ray_tpu.get(r) for r in gen]
+    assert items == [("streamed", "live")] * 3
+
+
+def test_pip_distribution_name_differs_from_module(cluster_runtime):
+    """PyPI names that don't match import names must still verify (checked
+    via distribution metadata, not import guessing)."""
+    # scikit-learn may not be baked in; use a dist-name/module-name pair that
+    # is: 'typing-extensions' imports as typing_extensions but its dist name
+    # has a dash — and PyYAML's dist name is 'PyYAML' while it imports as
+    # yaml, exercising the metadata path case-insensitively.
+    @ray_tpu.remote(runtime_env={"pip": ["typing-extensions", "PyYAML"]})
+    def ok():
+        return "verified"
+
+    assert ray_tpu.get(ok.remote()) == "verified"
+
+
 def test_custom_plugin(cluster_runtime):
     class MarkerPlugin(RuntimeEnvPlugin):
         def prepare(self, value, session_dir):
