@@ -67,8 +67,15 @@ def init(
     * default → per-machine cluster plane (shared-memory store + worker
       processes), auto-started if ``address`` is None.
     * ``address="<host:port>"`` → connect to an existing controller.
+    * ``address="ray://<host:port>"`` → REMOTE-driver (client) mode:
+      no shared-memory locality assumed; objects ride the RPC plane
+      (reference analog: Ray Client, `python/ray/util/client`).
     """
     global _runtime, _job_counter
+    remote_client = False
+    if address and address.startswith("ray://"):
+        address = address[len("ray://"):]
+        remote_client = True
     with _runtime_lock:
         if _runtime is not None:
             if ignore_reinit_error:
@@ -98,11 +105,14 @@ def init(
         else:
             from .cluster_backend import ClusterBackend
 
+            if remote_client and not address:
+                raise ValueError("ray:// client mode requires a host:port")
             backend = ClusterBackend.connect_or_start(
                 address=address,
                 num_cpus=num_cpus if _node_cpus is None else _node_cpus,
                 resources=_with_tpus(resources, num_tpus),
                 object_store_memory=object_store_memory,
+                remote_client=remote_client,
             )
             runtime = Runtime(backend, job_id, address=backend.client_address)
             backend.set_runtime(runtime)
@@ -118,16 +128,16 @@ def _with_tpus(resources: Optional[dict], num_tpus: Optional[float]) -> dict:
     resources = dict(resources or {})
     if num_tpus is not None:
         resources["TPU"] = float(num_tpus)
-    elif "TPU" not in resources:
-        # Autodetect local TPU chips (reference: `_private/accelerators/tpu.py`).
-        try:
-            from ..util.accelerators import tpu as tpu_util
+    # Autodetect via the accelerator-manager plugin layer (reference:
+    # `_private/accelerators/` consulted at node start). Explicit user
+    # values always win.
+    try:
+        from ..util.accelerators import detect_node_accelerator_resources
 
-            n = tpu_util.detect_num_chips()
-            if n:
-                resources["TPU"] = float(n)
-        except Exception:  # noqa: BLE001
-            pass
+        for key, val in detect_node_accelerator_resources().items():
+            resources.setdefault(key, val)
+    except Exception:  # noqa: BLE001
+        pass
     return resources
 
 

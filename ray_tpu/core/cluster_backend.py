@@ -39,6 +39,11 @@ class ClusterBackend(RuntimeBackend):
         self._runtime = None
         self._put_idx = 0
         self._put_lock = __import__("threading").Lock()
+        # Remote-driver ("Ray Client") mode: no shared-memory locality with
+        # the cluster — objects ride the RPC plane both ways (reference:
+        # `python/ray/util/client`, redesigned onto the native protocol
+        # instead of a separate proxy server).
+        self.remote_client = False
 
     def set_runtime(self, runtime):
         self._runtime = runtime
@@ -51,6 +56,7 @@ class ClusterBackend(RuntimeBackend):
         num_cpus: Optional[float],
         resources: Optional[dict],
         object_store_memory: Optional[int],
+        remote_client: bool = False,
     ) -> "ClusterBackend":
         proc = None
         if address is None:
@@ -60,6 +66,7 @@ class ClusterBackend(RuntimeBackend):
                 object_store_memory,
             )
         backend = cls(address, role="driver")
+        backend.remote_client = remote_client
         backend._controller_proc = proc
         backend._connect(register_as="register_driver")
         return backend
@@ -179,8 +186,23 @@ class ClusterBackend(RuntimeBackend):
             idx = self._put_idx
         oid = ObjectID.of(TaskID.from_hex(owner_task_hex), 2**24 + idx)
         hex_id = oid.hex()
-        shm_name, inline, size = self.local_store.put(hex_id, value)
-        contains = serialization.last_contained_refs()
+        if self.remote_client:
+            # No shm on a remote driver: the packed frame ships over RPC.
+            # Large frames land in the HEAD's arena (put_data) so they stay
+            # under object-store accounting/spilling instead of growing the
+            # controller heap; small ones ride inline as usual.
+            frame = serialization.pack(value)
+            contains = serialization.last_contained_refs()
+            if len(frame) > store.INLINE_THRESHOLD:
+                self._request(
+                    {"type": "put_data", "id": hex_id, "data": frame,
+                     "contains": contains}
+                )
+                return ObjectRef(oid, self.client_address)
+            shm_name, inline, size = None, frame, len(frame)
+        else:
+            shm_name, inline, size = self.local_store.put(hex_id, value)
+            contains = serialization.last_contained_refs()
         if inline is not None:
             self._request(
                 {"type": "put_inline", "id": hex_id, "data": inline, "contains": contains}
@@ -203,10 +225,22 @@ class ClusterBackend(RuntimeBackend):
         if status == "inline":
             return serialization.unpack(loc["data"])
         if status == "shm":
+            if self.remote_client:
+                return self._fetch_remote(name=loc["name"])
             return self.local_store.read(loc["name"])
         if status == "spilled":
+            if self.remote_client:
+                return self._fetch_remote(path=loc["path"])
             return self.local_store.read_from_file(loc["path"])
         raise RayTpuError(f"Object {hex_id} unavailable: {status}")
+
+    def _fetch_remote(self, **where) -> Any:
+        """Client-mode object fetch: the controller serves the packed frame
+        over the control plane (reference analog: Ray Client data channel)."""
+        resp = self._request({"type": "fetch_object", **where})
+        if resp.get("error"):
+            raise RayTpuError(f"client fetch failed: {resp['error']}")
+        return serialization.unpack(resp["data"])
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float]) -> List[Any]:
         if not refs:

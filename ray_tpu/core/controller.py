@@ -286,10 +286,16 @@ class Controller:
         # server over the same state the state API serves).
         self.dashboard = None
         if rt_config.get("dashboard"):
-            from ..dashboard import DashboardServer
+            # Observability must never be fatal to the cluster: a taken port
+            # (second cluster, stale process) degrades to no dashboard.
+            try:
+                from ..dashboard import DashboardServer
 
-            self.dashboard = DashboardServer(self)
-            await self.dashboard.start(rt_config.get("dashboard_port"))
+                self.dashboard = DashboardServer(self)
+                await self.dashboard.start(rt_config.get("dashboard_port"))
+            except OSError as e:
+                print(f"dashboard disabled: {e}", file=sys.stderr)
+                self.dashboard = None
         self._write_session_info()
         if self.standalone:
             store.mark_restorable(store.SESSION_TAG, True)
@@ -935,6 +941,15 @@ class Controller:
         self._mark_ready(
             msg["id"], inline=msg["data"], size=len(msg["data"]),
             contains=msg.get("contains"),
+        )
+        return {"ok": True}
+
+    async def h_put_data(self, conn, meta, msg):
+        """Client-mode put of a large frame: store in the HEAD arena so it is
+        accounted (store_bytes_used) and spillable like any worker object."""
+        name, size = self.local_store.create_raw(msg["id"], msg["data"])
+        self._mark_ready(
+            msg["id"], shm_name=name, size=size, contains=msg.get("contains")
         )
         return {"ok": True}
 

@@ -219,8 +219,10 @@ class WorkerProcess:
             func, args, kwargs = resolve_payload(spec.func_payload, resolved)
             if is_actor_method:
                 func = getattr(self.actor_instance, spec.method_name)
-            runtime.set_task_context(spec.task_id, spec.actor_id)
+            # Env setup BEFORE context: if it raises (RuntimeEnvSetupError),
+            # no task context was set, so nothing leaks onto later work.
             restore_env = self._runtime_env_vars(spec)
+            runtime.set_task_context(spec.task_id, spec.actor_id)
             streaming = spec.num_returns == -1
             _restored = [False]
 

@@ -54,7 +54,7 @@ class DashboardServer:
             line = await asyncio.wait_for(reader.readline(), 5)
             if len(line) > MAX_REQUEST_LINE:
                 return
-            while True:  # drain request headers
+            for _ in range(100):  # drain request headers (bounded)
                 h = await asyncio.wait_for(reader.readline(), 5)
                 if h in (b"\r\n", b"\n", b""):
                     break
@@ -116,12 +116,27 @@ class DashboardServer:
                     ]
                 }
             elif name == "events":
-                limit = int(query.get("limit", 100))
-                data = {"events": list(c.timeline[-limit:])}
+                limit = max(0, int(query.get("limit", 100)))
+                data = {"events": list(c.timeline[-limit:]) if limit else []}
             elif name == "logs":
                 wid = query.get("worker_id", "")
+                if not wid:
+                    return (
+                        "400 Bad Request",
+                        "application/json",
+                        b'{"error": "worker_id query parameter required"}',
+                    )
+                # Real tail: learn the end offset first, then read only the
+                # last chunk (a long-lived worker log can be GBs).
+                tail_bytes = min(int(query.get("bytes", 65536)), 1 << 20)
+                head = await c.h_tail_logs(
+                    None, {}, {"worker_id": wid, "init": True}
+                )
+                end = head.get("logs", {}).get(wid, {}).get("offset", 0)
                 got = await c.h_tail_logs(
-                    None, {}, {"worker_id": wid, "cursors": {wid: 0}}
+                    None, {},
+                    {"worker_id": wid,
+                     "cursors": {wid: max(0, end - tail_bytes)}},
                 )
                 data = {"worker_id": wid,
                         "log": got.get("logs", {}).get(wid, {}).get("data", "")}

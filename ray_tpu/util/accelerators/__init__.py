@@ -1,3 +1,21 @@
 from . import tpu
+from .accelerator import (
+    AcceleratorManager,
+    NvidiaGPUAcceleratorManager,
+    TPUAcceleratorManager,
+    detect_node_accelerator_resources,
+    get_accelerator_manager_for_resource,
+    get_all_accelerator_managers,
+    register_accelerator_manager,
+)
 
-__all__ = ["tpu"]
+__all__ = [
+    "tpu",
+    "AcceleratorManager",
+    "TPUAcceleratorManager",
+    "NvidiaGPUAcceleratorManager",
+    "detect_node_accelerator_resources",
+    "get_accelerator_manager_for_resource",
+    "get_all_accelerator_managers",
+    "register_accelerator_manager",
+]
