@@ -1,4 +1,6 @@
 from . import metrics
+from .actor_pool import ActorPool
+from .queue import Empty, Full, Queue
 from .placement_group import (
     PlacementGroup,
     get_current_placement_group,
@@ -13,6 +15,10 @@ from ..core.task_spec import (
 )
 
 __all__ = [
+    "ActorPool",
+    "Queue",
+    "Empty",
+    "Full",
     "PlacementGroup",
     "placement_group",
     "remove_placement_group",

@@ -1,0 +1,121 @@
+"""Distributed Queue — an actor-backed multi-producer/consumer queue.
+
+Reference analog: `python/ray/util/queue.py` (asyncio-actor-backed Queue
+with Empty/Full mirroring the stdlib `queue` contract).
+"""
+
+from __future__ import annotations
+
+import time
+from queue import Empty, Full  # re-exported, stdlib-compatible
+from typing import Any, List, Optional
+
+from ..core import api
+
+__all__ = ["Queue", "Empty", "Full"]
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        from collections import deque
+
+        self.maxsize = maxsize
+        self.items: deque = deque()
+
+    def qsize(self) -> int:
+        return len(self.items)
+
+    def put_nowait(self, item) -> bool:
+        if self.maxsize > 0 and len(self.items) >= self.maxsize:
+            return False
+        self.items.append(item)
+        return True
+
+    def put_nowait_batch(self, items: List[Any]) -> bool:
+        if self.maxsize > 0 and len(self.items) + len(items) > self.maxsize:
+            return False
+        self.items.extend(items)
+        return True
+
+    def get_nowait(self):
+        if not self.items:
+            return False, None
+        return True, self.items.popleft()
+
+    def get_nowait_batch(self, n: int):
+        got = []
+        while self.items and len(got) < n:
+            got.append(self.items.popleft())
+        return got
+
+
+class Queue:
+    """Sync facade over the queue actor. Blocking put/get poll the actor
+    (control-plane messages are cheap; poll interval backs off to 50ms)."""
+
+    def __init__(self, maxsize: int = 0, actor_options: Optional[dict] = None):
+        opts = dict(actor_options or {})
+        opts.setdefault("num_cpus", 0)
+        self.maxsize = maxsize
+        self.actor = api.remote(**opts)(_QueueActor).remote(maxsize)
+
+    # ------------------------------------------------------------- inspect
+    def qsize(self) -> int:
+        return api.get(self.actor.qsize.remote())
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def full(self) -> bool:
+        return self.maxsize > 0 and self.qsize() >= self.maxsize
+
+    # ----------------------------------------------------------------- put
+    def put(self, item: Any, block: bool = True, timeout: Optional[float] = None):
+        if not block:
+            if not api.get(self.actor.put_nowait.remote(item)):
+                raise Full
+            return
+        deadline = None if timeout is None else time.monotonic() + timeout
+        delay = 0.001
+        while True:
+            if api.get(self.actor.put_nowait.remote(item)):
+                return
+            if deadline is not None and time.monotonic() >= deadline:
+                raise Full
+            time.sleep(delay)
+            delay = min(delay * 2, 0.05)
+
+    def put_nowait(self, item: Any):
+        self.put(item, block=False)
+
+    def put_nowait_batch(self, items: List[Any]):
+        if not api.get(self.actor.put_nowait_batch.remote(list(items))):
+            raise Full
+
+    # ----------------------------------------------------------------- get
+    def get(self, block: bool = True, timeout: Optional[float] = None) -> Any:
+        if not block:
+            ok, item = api.get(self.actor.get_nowait.remote())
+            if not ok:
+                raise Empty
+            return item
+        deadline = None if timeout is None else time.monotonic() + timeout
+        delay = 0.001
+        while True:
+            ok, item = api.get(self.actor.get_nowait.remote())
+            if ok:
+                return item
+            if deadline is not None and time.monotonic() >= deadline:
+                raise Empty
+            time.sleep(delay)
+            delay = min(delay * 2, 0.05)
+
+    def get_nowait(self) -> Any:
+        return self.get(block=False)
+
+    def get_nowait_batch(self, n: int) -> List[Any]:
+        return api.get(self.actor.get_nowait_batch.remote(n))
+
+    # -------------------------------------------------------------- manage
+    def shutdown(self):
+        api.kill(self.actor)
