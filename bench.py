@@ -53,7 +53,7 @@ def _check_device_reachable(timeout_s: float = 180.0):
         print(
             json.dumps(
                 {
-                    "metric": "gpt2_medium_train_tokens_per_sec_per_chip",
+                    "metric": "gpt2_large_train_tokens_per_sec_per_chip",
                     "value": 0,
                     "unit": "tokens/s/chip",
                     "vs_baseline": 0,
@@ -66,7 +66,7 @@ def _check_device_reachable(timeout_s: float = 180.0):
         print(
             json.dumps(
                 {
-                    "metric": "gpt2_medium_train_tokens_per_sec_per_chip",
+                    "metric": "gpt2_large_train_tokens_per_sec_per_chip",
                     "value": 0,
                     "unit": "tokens/s/chip",
                     "vs_baseline": 0,
@@ -83,7 +83,7 @@ def main():
     import jax.numpy as jnp
     import optax
 
-    from ray_tpu.models import gpt2_medium, init_params, make_train_step
+    from ray_tpu.models import gpt2_large, init_params, make_train_step
 
     import os
 
@@ -96,10 +96,13 @@ def main():
             d_mlp=256, max_seq=S, attn_impl="ref", remat=False,
         )
     else:
-        # B=24 is the single-chip sweet spot (scripts/bench_sweep.py r2):
-        # 0.409 MFU vs 0.400@16 / 0.402@12 / 0.395@32; blocks 512/512.
-        B, S = 24, 1024
-        cfg = gpt2_medium(max_seq=S, attn_impl="flash", remat=True)
+        # gpt2_large B=12 is the single-chip sweet spot (scripts/
+        # bench_sweep2.py r2): 0.438 MFU vs medium's 0.409@24; larger
+        # d_model (1280) fills the MXU better. Blocks 512/512 beat
+        # 256/512, 512/1024, 1024/512 (scripts block sweep). B=16/S=2048
+        # fail to compile on the 16G chip.
+        B, S = 12, 1024
+        cfg = gpt2_large(max_seq=S, attn_impl="flash", remat=True)
 
     # Initialize on-device (jit) — host-side random init of 350M params on a
     # 1-core VM costs tens of seconds.
@@ -132,7 +135,7 @@ def main():
     print(
         json.dumps(
             {
-                "metric": "gpt2_medium_train_tokens_per_sec_per_chip",
+                "metric": "gpt2_large_train_tokens_per_sec_per_chip",
                 "value": round(tok_s, 1),
                 "unit": "tokens/s/chip",
                 "vs_baseline": round(mfu / 0.40, 3),

@@ -1769,6 +1769,10 @@ class Controller:
         while astate.send_queue:
             spec = astate.send_queue.popleft()
             self._unpin_args(spec)
+            if spec.num_returns == -1:
+                # Queued streaming call: end its stream with the error so the
+                # consumer's generator raises instead of long-polling forever.
+                self._fail_stream(spec, err)
             for oid in spec.return_ids:
                 self._store_error_object(oid.hex(), err)
 
@@ -2003,6 +2007,8 @@ class Controller:
             )
             for ispec in astate.inflight.values():
                 self._unpin_args(ispec)
+                if ispec.num_returns == -1:
+                    self._fail_stream(ispec, err)  # streaming method call
                 for oid in ispec.return_ids:
                     if self._obj(oid.hex()).status != "ready":
                         self._store_error_object(oid.hex(), err)
@@ -2017,6 +2023,8 @@ class Controller:
             self._drain_actor_queue(astate, err)
             for ispec in astate.inflight.values():
                 self._unpin_args(ispec)
+                if ispec.num_returns == -1:
+                    self._fail_stream(ispec, err)  # streaming method call
                 for oid in ispec.return_ids:
                     if self._obj(oid.hex()).status != "ready":
                         self._store_error_object(oid.hex(), err)

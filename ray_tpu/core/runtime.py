@@ -228,12 +228,16 @@ class Runtime:
         task_id = TaskID.of(actor_id)
         payload, arg_refs = self._build_payload(None, args, kwargs)
         num_returns = options.num_returns
-        if num_returns in ("streaming", "dynamic"):
-            raise NotImplementedError(
-                "num_returns='streaming' is supported for tasks only; actor "
-                "method streaming is not implemented yet"
-            )
-        return_ids = [ObjectID.of(task_id, i) for i in range(max(num_returns, 1))]
+        streaming = num_returns in ("streaming", "dynamic")
+        if streaming:
+            # Streaming generator method (reference: `returns_dynamic` on
+            # actor tasks) — items flow through the same stream bookkeeping
+            # normal tasks use; the actor stays busy until the stream ends
+            # (ordered per-actor delivery is preserved).
+            num_returns = -1
+            return_ids: List[ObjectID] = []
+        else:
+            return_ids = [ObjectID.of(task_id, i) for i in range(max(num_returns, 1))]
         spec = TaskSpec(
             task_id=task_id,
             job_id=self.job_id,
@@ -251,6 +255,10 @@ class Runtime:
             owner_address=self.address,
         )
         self.backend.submit_actor_task(spec)
+        if streaming:
+            from .object_ref import ObjectRefGenerator
+
+            return ObjectRefGenerator(task_id, self.address)
         return [ObjectRef(oid, self.address) for oid in return_ids]
 
     # -------------------------------------------------------------- futures

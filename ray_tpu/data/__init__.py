@@ -47,7 +47,10 @@ from .read_api import (
     read_parquet,
     read_parquet_bulk,
     read_text,
+    read_images,
+    read_sql,
     read_tfrecords,
+    read_webdataset,
 )
 
 _warm_pyarrow_now()
@@ -96,6 +99,9 @@ __all__ = [
     "read_text",
     "read_numpy",
     "read_binary_files",
+    "read_images",
+    "read_sql",
     "read_tfrecords",
+    "read_webdataset",
     "read_datasource",
 ]

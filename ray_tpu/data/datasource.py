@@ -272,6 +272,133 @@ class BinaryDatasource(FileBasedDatasource):
         return [block]
 
 
+class ImageDatasource(FileBasedDatasource):
+    """Image files → HWC uint8 arrays (reference:
+    `data/datasource/image_datasource.py`). Optional resize + mode convert."""
+
+    _EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp")
+
+    def __init__(self, paths, size=None, mode=None, include_paths=False, **kw):
+        # Directory/glob inputs are filtered to image extensions; EXPLICIT
+        # file paths are always kept (PIL raises on non-images — honest
+        # failure beats silent, neighbor-dependent dropping).
+        if isinstance(paths, str):
+            paths = [paths]
+        explicit = {
+            os.path.abspath(p)
+            for p in paths
+            if not os.path.isdir(p) and not any(ch in p for ch in "*?[")
+        }
+        super().__init__(paths, size=size, mode=mode, include_paths=include_paths, **kw)
+        self._paths = [
+            p
+            for p in self._paths
+            if os.path.abspath(p) in explicit
+            or os.path.splitext(p)[1].lower() in self._EXTS
+        ]
+        if not self._paths:
+            raise FileNotFoundError(f"No image files found in {paths}")
+
+    def _read_file(self, path, size=None, mode=None, include_paths=False, **kwargs):
+        from PIL import Image
+
+        with Image.open(path) as img:
+            if mode is not None:
+                img = img.convert(mode)
+            if size is not None:
+                img = img.resize((size[1], size[0]))  # PIL takes (W, H)
+            arr = np.asarray(img)
+        col = np.empty(1, dtype=object)
+        col[0] = arr
+        block = {"image": col}
+        if include_paths:
+            block["path"] = np.asarray([path], dtype=object)
+        return [block]
+
+
+class SQLDatasource(Datasource):
+    """SQL query → row blocks (reference: `data/datasource/sql_datasource.py`).
+    Takes a zero-arg `connection_factory` (DB-API 2.0) so each read task can
+    open its own connection in its own worker process."""
+
+    def __init__(self, sql: str, connection_factory: Callable[[], Any]):
+        self._sql = sql
+        self._factory = connection_factory
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        sql, factory = self._sql, self._factory
+
+        def read():
+            conn = factory()
+            try:
+                cur = conn.cursor()
+                cur.execute(sql)
+                cols = [d[0] for d in cur.description]
+                rows = cur.fetchall()
+            finally:
+                conn.close()
+            if not rows:
+                return []
+            return [build_block([dict(zip(cols, r)) for r in rows])]
+
+        # A single task: SQL pushdown-partitioning needs dialect-specific
+        # LIMIT/OFFSET or key-range splitting — the reference also reads
+        # unpartitioned unless the user shards the query.
+        return [ReadTask(read, BlockMetadata(None, None))]
+
+
+class WebDatasetDatasource(FileBasedDatasource):
+    """WebDataset-style tar shards: members grouped by key, field per
+    extension (reference: `data/datasource/webdataset_datasource.py`).
+    Decodes jpg/png→arrays, txt/cls→str/int, json→objects; other
+    extensions stay raw bytes."""
+
+    _FILE_SUFFIX = ".tar"
+
+    def _read_file(self, path, **kwargs):
+        import io
+        import json as _json
+        import tarfile
+
+        samples: dict = {}
+        order: List[str] = []
+        with tarfile.open(path) as tf:
+            for member in tf.getmembers():
+                if not member.isfile():
+                    continue
+                # Key = full path minus extension: same-stem files in
+                # different directories are distinct samples (reference
+                # webdataset semantics).
+                dirname, base = os.path.split(member.name)
+                stem, _, ext = base.partition(".")
+                key = os.path.join(dirname, stem) if dirname else stem
+                data = tf.extractfile(member).read()
+                if key not in samples:
+                    samples[key] = {"__key__": key}
+                    order.append(key)
+                samples[key][ext] = self._decode(ext.lower(), data, _json, io)
+        rows = [samples[k] for k in order]
+        return [build_block(rows)] if rows else []
+
+    @staticmethod
+    def _decode(ext, data, _json, io):
+        if ext in ("jpg", "jpeg", "png", "bmp", "webp"):
+            try:
+                from PIL import Image
+
+                with Image.open(io.BytesIO(data)) as img:
+                    return np.asarray(img)
+            except Exception:  # noqa: BLE001 — undecodable stays raw
+                return data
+        if ext in ("txt", "text"):
+            return data.decode()
+        if ext == "cls":
+            return int(data.decode().strip())
+        if ext == "json":
+            return _json.loads(data)
+        return data
+
+
 class TFRecordDatasource(FileBasedDatasource):
     """Minimal TFRecord reader: raw record bytes (no proto decode without TF)."""
 

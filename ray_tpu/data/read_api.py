@@ -14,12 +14,15 @@ from .datasource import (
     CSVDatasource,
     Datasource,
     ItemsDatasource,
+    ImageDatasource,
     JSONDatasource,
     NumpyDatasource,
     ParquetDatasource,
     RangeDatasource,
+    SQLDatasource,
     TextDatasource,
     TFRecordDatasource,
+    WebDatasetDatasource,
 )
 from .plan import LogicalPlan, ReadOp
 
@@ -131,6 +134,34 @@ def read_binary_files(paths, *, include_paths: bool = False, parallelism: int = 
 
 def read_tfrecords(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
     return _from_source(TFRecordDatasource(paths, **kwargs), parallelism)
+
+
+def read_images(
+    paths,
+    *,
+    size: Optional[tuple] = None,
+    mode: Optional[str] = None,
+    include_paths: bool = False,
+    parallelism: int = -1,
+) -> Dataset:
+    """Image files → 'image' column of HWC arrays (reference:
+    `ray.data.read_images`)."""
+    return _from_source(
+        ImageDatasource(paths, size=size, mode=mode, include_paths=include_paths),
+        parallelism,
+    )
+
+
+def read_sql(sql: str, connection_factory, *, parallelism: int = -1) -> Dataset:
+    """DB-API query → Dataset (reference: `ray.data.read_sql`). Pass a
+    zero-arg connection factory, e.g. `lambda: sqlite3.connect(path)`."""
+    return _from_source(SQLDatasource(sql, connection_factory), parallelism)
+
+
+def read_webdataset(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
+    """WebDataset tar shards → per-sample rows keyed by extension
+    (reference: `ray.data.read_webdataset`)."""
+    return _from_source(WebDatasetDatasource(paths, **kwargs), parallelism)
 
 
 def read_datasource(datasource: Datasource, *, parallelism: int = -1, **kwargs) -> Dataset:

@@ -21,6 +21,8 @@ from .algorithms.impala import IMPALA, IMPALAConfig
 from .algorithms.dqn import DQN, DQNConfig
 from .algorithms.sac import SAC, SACConfig
 from .algorithms.appo import APPO, APPOConfig
+from .algorithms.bc import BC, BCConfig
+from . import offline
 from .env import register_env, make_env
 from .env.env_runner import EnvRunner
 from .env.multi_agent import MultiAgentEnv, SharedPolicyVectorEnv, make_multi_agent
@@ -39,6 +41,9 @@ __all__ = [
     "SACConfig",
     "APPO",
     "APPOConfig",
+    "BC",
+    "BCConfig",
+    "offline",
     "register_env",
     "make_env",
     "EnvRunner",

@@ -1,0 +1,127 @@
+"""Behavior Cloning — offline RL (reference: `rllib/algorithms/bc/bc.py`).
+
+Supervised policy learning from demonstrations: maximize log π(a|s) over an
+`OfflineDataset`. No environment interaction during training; the env is
+only used for evaluation. The whole minibatch-epoch loop runs as one jitted
+XLA program per iteration (same TPU-learner pattern as PPO).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..core.learner import Learner
+from ..offline import OfflineDataset
+from .algorithm import Algorithm
+from .algorithm_config import AlgorithmConfig
+
+
+class BCConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.train_batch_size = 2048      # transitions sampled per iteration
+        self.minibatch_size = 256
+        self.num_epochs = 4
+        self.dataset: Optional[OfflineDataset] = None
+        self.input_path: Optional[str] = None  # JSONL alternative
+        # BC never samples the env for training.
+        self.num_env_runners = 0
+
+    def offline_data(self, dataset: Optional[OfflineDataset] = None,
+                     input_path: Optional[str] = None) -> "BCConfig":
+        self.dataset = dataset
+        self.input_path = input_path
+        return self
+
+    def validate(self):
+        super().validate()
+        if self.dataset is None and self.input_path is None:
+            raise ValueError("BC needs offline_data(dataset=...) or input_path")
+        if self.train_batch_size % self.minibatch_size != 0:
+            raise ValueError("train_batch_size must divide into minibatches")
+
+
+def make_bc_update(module, opt, cfg: BCConfig):
+    n_mb = cfg.train_batch_size // cfg.minibatch_size
+
+    def loss_fn(params, mb):
+        dist, _ = module.forward(params, mb["obs"])
+        logp = module.log_prob(dist, mb["actions"])
+        return -jnp.mean(logp)
+
+    def update(state, batch, rng):
+        params, opt_state = state
+
+        def epoch(carry, key):
+            params, opt_state = carry
+            perm = jax.random.permutation(key, cfg.train_batch_size)
+
+            def minibatch(carry, idx):
+                params, opt_state = carry
+                mb = {k: v[idx] for k, v in batch.items()}
+                loss, grads = jax.value_and_grad(loss_fn)(params, mb)
+                updates, opt_state = opt.update(grads, opt_state, params)
+                params = jax.tree_util.tree_map(
+                    lambda p, u: p + u.astype(p.dtype), params, updates
+                )
+                return (params, opt_state), loss
+
+            idxs = perm.reshape(n_mb, cfg.minibatch_size)
+            (params, opt_state), losses = lax.scan(
+                minibatch, (params, opt_state), idxs
+            )
+            return (params, opt_state), jnp.mean(losses)
+
+        keys = jax.random.split(rng, cfg.num_epochs)
+        (params, opt_state), losses = lax.scan(epoch, (params, opt_state), keys)
+        return (params, opt_state), {"bc_loss": jnp.mean(losses)}
+
+    return update
+
+
+class BC(Algorithm):
+    config_class = BCConfig
+
+    def setup(self):
+        cfg = self.config
+        if cfg.dataset is None:
+            cfg.dataset = OfflineDataset.read_json(cfg.input_path)
+        self._np_rng = np.random.default_rng(cfg.seed)
+        super().setup()
+
+    def _make_learner(self) -> Learner:
+        from ..utils.optim import make_optimizer
+
+        cfg = self.config
+        opt = make_optimizer(cfg)
+        learner = Learner(
+            self.module, make_bc_update(self.module, opt, cfg), seed=cfg.seed
+        )
+        learner.opt_state = opt.init(learner.params)
+        return learner
+
+    def training_step(self) -> Dict:
+        cfg = self.config
+        batch = cfg.dataset.sample(self._np_rng, cfg.train_batch_size)
+        metrics = self.learner_group.update(batch)
+        self._weights = self.learner_group.get_weights()
+        # Offline: "reward" comes from evaluation rollouts, not sampling.
+        ev = self.evaluate()
+        self._episode_returns.extend(
+            [ev["episode_reward_mean"]] if "episode_reward_mean" in ev else []
+        )
+        return {
+            "_env_steps_this_iter": 0,
+            "num_offline_transitions_this_iter": cfg.train_batch_size,
+            "info": {"learner": metrics},
+            "evaluation": ev,
+        }
+
+
+BCConfig.algo_class = BC

@@ -1,0 +1,82 @@
+"""Offline RL data plane.
+
+Reference analog: `python/ray/rllib/offline/` (JsonReader/JsonWriter sample
+batches for BC/CQL/MARWIL). Here an offline dataset is a dict of numpy
+arrays ({"obs": [N, obs_dim], "actions": [N]/[N, act_dim]}) with JSONL
+persistence, plus a collector that rolls a policy (scripted or learned) in a
+native vector env.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from ..env import make_env
+
+
+class OfflineDataset:
+    def __init__(self, obs: np.ndarray, actions: np.ndarray):
+        if len(obs) != len(actions):
+            raise ValueError("obs and actions must align")
+        self.obs = np.asarray(obs, np.float32)
+        self.actions = np.asarray(actions)
+
+    def __len__(self) -> int:
+        return len(self.obs)
+
+    def sample(self, rng: np.random.Generator, n: int) -> Dict[str, np.ndarray]:
+        idx = rng.integers(0, len(self.obs), size=n)
+        return {"obs": self.obs[idx], "actions": self.actions[idx]}
+
+    # ------------------------------------------------------------- storage
+    def write_json(self, path: str):
+        """JSONL, one transition per line (reference: `offline/json_writer.py`)."""
+        with open(path, "w") as f:
+            for o, a in zip(self.obs, self.actions):
+                f.write(json.dumps({"obs": o.tolist(),
+                                    "action": a.tolist() if hasattr(a, "tolist") else a})
+                        + "\n")
+
+    @classmethod
+    def read_json(cls, path: str) -> "OfflineDataset":
+        obs, actions = [], []
+        with open(path) as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                row = json.loads(line)
+                obs.append(row["obs"])
+                actions.append(row["action"])
+        return cls(np.asarray(obs, np.float32), np.asarray(actions))
+
+
+def collect_dataset(
+    env_name: str,
+    policy_fn: Callable[[np.ndarray], np.ndarray],
+    n_steps: int,
+    *,
+    num_envs: int = 8,
+    seed: int = 0,
+    env_kwargs: Optional[dict] = None,
+) -> OfflineDataset:
+    """Roll `policy_fn(obs_batch) -> action_batch` in the native vector env
+    and record transitions (expert-demonstration collection for BC)."""
+    env = make_env(env_name, num_envs, **(env_kwargs or {}))
+    obs, _ = env.reset(seed=seed)
+    all_obs, all_act = [], []
+    steps = 0
+    while steps < n_steps:
+        actions = np.asarray(policy_fn(obs))
+        all_obs.append(obs.copy())
+        all_act.append(actions.copy())
+        obs = env.step(actions)[0]
+        steps += len(actions)
+    env.close()
+    return OfflineDataset(
+        np.concatenate(all_obs, axis=0)[:n_steps],
+        np.concatenate(all_act, axis=0)[:n_steps],
+    )

@@ -19,7 +19,7 @@ from .api import (
 from .batching import batch, multiplexed
 from .context import get_multiplexed_model_id, get_replica_context
 from .deployment import Application, AutoscalingConfig, Deployment, deployment
-from .handle import DeploymentHandle, DeploymentResponse
+from .handle import DeploymentHandle, DeploymentResponse, DeploymentResponseGenerator
 from .http_proxy import Request
 
 __all__ = [
@@ -39,6 +39,7 @@ __all__ = [
     "get_replica_context",
     "DeploymentHandle",
     "DeploymentResponse",
+    "DeploymentResponseGenerator",
     "Request",
 ]
 

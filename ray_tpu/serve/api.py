@@ -105,8 +105,23 @@ def run(
         )
 
     ingress_name = app.deployment.name
+    # Streaming ingress: a generator-function __call__ makes the HTTP proxy
+    # stream chunks as they are produced (reference: Serve StreamingResponse).
+    import inspect as _inspect
+
+    ingress_callable = app.deployment._callable
+    ingress_fn = (
+        getattr(ingress_callable, "__call__", None)
+        if isinstance(ingress_callable, type)
+        else ingress_callable
+    )
+    ingress_streaming = bool(
+        ingress_fn is not None and _inspect.isgeneratorfunction(ingress_fn)
+    )
     ray.get(
-        controller.deploy_application.remote(name, specs, route_prefix, ingress_name)
+        controller.deploy_application.remote(
+            name, specs, route_prefix, ingress_name, ingress_streaming
+        )
     )
     if _blocking:
         _wait_healthy(name, timeout_s)

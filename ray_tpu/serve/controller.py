@@ -49,6 +49,7 @@ class ServeController:
         dep_specs: List[Dict[str, Any]],
         route_prefix: str,
         ingress_name: str,
+        ingress_streaming: bool = False,
     ) -> None:
         import ray_tpu
 
@@ -83,6 +84,7 @@ class ServeController:
                 "deployments": deployments,
                 "route_prefix": route_prefix,
                 "ingress": ingress_name,
+                "streaming": ingress_streaming,
             }
             self._version += 1
         for ref in reconfigure_refs:
@@ -128,7 +130,11 @@ class ServeController:
         """route_prefix -> {app, ingress} for HTTP proxies."""
         with self._lock:
             return {
-                app["route_prefix"]: {"app": name, "ingress": app["ingress"]}
+                app["route_prefix"]: {
+                    "app": name,
+                    "ingress": app["ingress"],
+                    "streaming": app.get("streaming", False),
+                }
                 for name, app in self._apps.items()
                 if app["route_prefix"]
             }

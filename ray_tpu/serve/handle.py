@@ -58,6 +58,26 @@ class DeploymentResponse:
         return self._ref
 
 
+class DeploymentResponseGenerator:
+    """Iterate chunks of a streaming deployment call (reference:
+    `serve.handle.DeploymentResponseGenerator`)."""
+
+    def __init__(self, ref_generator, on_done=None):
+        self._gen = ref_generator
+        self._on_done = on_done
+
+    def __iter__(self):
+        import ray_tpu
+
+        try:
+            for ref in self._gen:
+                yield ray_tpu.get(ref)
+        finally:
+            if self._on_done is not None:
+                self._on_done()
+                self._on_done = None
+
+
 class _Batcher:
     """Router-side batch former for one (deployment, method)."""
 
@@ -231,6 +251,24 @@ class Router:
         # Outstanding count drops when the caller consumes the result.
         return DeploymentResponse(ref=ref, on_done=lambda: self._done(idx))
 
+    def call_streaming(
+        self, method: str, args, kwargs, model_id: str = ""
+    ) -> "DeploymentResponseGenerator":
+        """Streaming call: chunks arrive as the replica's generator yields
+        (reference: `handle.options(stream=True)` →
+        ObjectRefGenerator-backed responses)."""
+        self._refresh()
+        idx, replica = self._pick_replica(model_id)
+        try:
+            gen = getattr(replica, "handle_request_streaming").options(
+                num_returns="streaming"
+            ).remote(method, args, kwargs, model_id)
+        except Exception:
+            self._done(idx)
+            raise
+        self._maybe_report_metrics()
+        return DeploymentResponseGenerator(gen, on_done=lambda: self._done(idx))
+
     def call_batch(self, method: str, batched_args: List, model_id: str) -> List:
         import ray_tpu
 
@@ -259,16 +297,29 @@ class DeploymentHandle:
     """Serializable reference to a deployment; composable across replicas
     (reference `serve/handle.py:827`)."""
 
-    def __init__(self, app_name: str, deployment_name: str, multiplexed_model_id: str = ""):
+    def __init__(
+        self,
+        app_name: str,
+        deployment_name: str,
+        multiplexed_model_id: str = "",
+        stream: bool = False,
+    ):
         self._app_name = app_name
         self._deployment_name = deployment_name
         self._model_id = multiplexed_model_id
+        self._stream = stream
 
-    def options(self, *, multiplexed_model_id: Optional[str] = None) -> "DeploymentHandle":
+    def options(
+        self,
+        *,
+        multiplexed_model_id: Optional[str] = None,
+        stream: Optional[bool] = None,
+    ) -> "DeploymentHandle":
         return DeploymentHandle(
             self._app_name,
             self._deployment_name,
             multiplexed_model_id if multiplexed_model_id is not None else self._model_id,
+            self._stream if stream is None else stream,
         )
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
@@ -290,10 +341,15 @@ class DeploymentHandle:
             for k, v in kwargs.items()
         }
         router = Router.get_or_create(self._app_name, self._deployment_name)
+        if self._stream:
+            return router.call_streaming(method, args, kwargs, self._model_id)
         return router.call(method, args, kwargs, self._model_id)
 
     def __reduce__(self):
-        return (DeploymentHandle, (self._app_name, self._deployment_name, self._model_id))
+        return (
+            DeploymentHandle,
+            (self._app_name, self._deployment_name, self._model_id, self._stream),
+        )
 
     def __repr__(self):
         return f"DeploymentHandle({self._app_name}/{self._deployment_name})"

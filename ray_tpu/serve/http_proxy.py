@@ -18,6 +18,19 @@ from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
 
+def _chain_first(first, rest):
+    yield first
+    yield from rest
+
+
+def _as_bytes(chunk) -> bytes:
+    if isinstance(chunk, bytes):
+        return chunk
+    if isinstance(chunk, str):
+        return chunk.encode()
+    return json.dumps(chunk).encode()
+
+
 class Request:
     """What ingress `__call__` receives for HTTP traffic."""
 
@@ -55,6 +68,43 @@ class HTTPProxy:
                     status, payload = proxy._handle(self)
                 except Exception as e:  # noqa: BLE001
                     status, payload = 500, json.dumps({"error": repr(e)}).encode()
+                if callable(payload):
+                    # Streaming route: chunked transfer, flushed per chunk as
+                    # the replica's generator yields (reference: Serve
+                    # StreamingResponse over ASGI). Pull the FIRST chunk
+                    # before committing status so a failing generator still
+                    # gets a proper 500.
+                    it = iter(payload())
+                    try:
+                        first = next(it, None)
+                    except Exception as e:  # noqa: BLE001
+                        err = json.dumps({"error": repr(e)}).encode()
+                        self.send_response(500)
+                        self.send_header("Content-Length", str(len(err)))
+                        self.send_header("Content-Type", "application/json")
+                        self.end_headers()
+                        self.wfile.write(err)
+                        return
+                    self.send_response(status)
+                    self.send_header("Content-Type", "application/octet-stream")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    try:
+                        chunks = iter(()) if first is None else _chain_first(first, it)
+                        for chunk in chunks:
+                            data = _as_bytes(chunk)
+                            self.wfile.write(
+                                f"{len(data):X}\r\n".encode() + data + b"\r\n"
+                            )
+                            self.wfile.flush()
+                        self.wfile.write(b"0\r\n\r\n")
+                    except BrokenPipeError:
+                        pass
+                    except Exception:  # noqa: BLE001 — mid-stream failure:
+                        # the only honest signal left is an aborted chunked
+                        # body (no terminal 0-chunk), like ASGI servers.
+                        pass
+                    return
                 self.send_response(status)
                 self.send_header("Content-Length", str(len(payload)))
                 self.send_header("Content-Type", "application/json")
@@ -111,6 +161,11 @@ class HTTPProxy:
             body=body,
             headers=dict(h.headers),
         )
+        if route.get("streaming"):
+            handle = DeploymentHandle(route["app"], route["ingress"], stream=True)
+            gen = handle.remote(req)
+            return 200, lambda: iter(gen)
+
         handle = DeploymentHandle(route["app"], route["ingress"])
         result = handle.remote(req).result(timeout_s=60.0)
 

@@ -64,6 +64,29 @@ class Replica:
             return self._callable(*args, **kwargs)
         return getattr(self._callable, method)(*args, **kwargs)
 
+    def handle_request_streaming(
+        self,
+        method: str,
+        args: Tuple,
+        kwargs: Dict,
+        multiplexed_model_id: str = "",
+    ):
+        """Generator variant: yields response chunks as the user generator
+        produces them (reference: Serve streaming responses /
+        `handle.options(stream=True)`). Runs as a streaming actor task."""
+        _set_replica_context(self._ctx)
+        _set_multiplexed_model_id(multiplexed_model_id)
+        self._num_processed += 1
+        fn = self._callable if self._is_function else getattr(self._callable, method)
+        out = fn(*args, **kwargs)
+        import inspect
+
+        if not inspect.isgenerator(out):
+            raise TypeError(
+                f"stream=True requires {method} to be a generator function"
+            )
+        yield from out
+
     def handle_batch(
         self,
         method: str,

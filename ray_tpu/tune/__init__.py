@@ -7,6 +7,7 @@ from ..train.session import report as _session_report
 from .schedulers import (
     ASHAScheduler,
     AsyncHyperBandScheduler,
+    HyperBandScheduler,
     FIFOScheduler,
     MedianStoppingRule,
     PopulationBasedTraining,
@@ -58,6 +59,7 @@ __all__ = [
     "FIFOScheduler",
     "ASHAScheduler",
     "AsyncHyperBandScheduler",
+    "HyperBandScheduler",
     "MedianStoppingRule",
     "PopulationBasedTraining",
     "Searcher",

@@ -17,6 +17,9 @@ class TrialScheduler:
         self.metric = metric
         self.mode = mode
 
+    def on_trial_add(self, trial):
+        """Called when a trial is created (before any result)."""
+
     def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
         return CONTINUE
 
@@ -80,6 +83,91 @@ class AsyncHyperBandScheduler(TrialScheduler):
 
 
 ASHAScheduler = AsyncHyperBandScheduler
+
+
+class HyperBandScheduler(TrialScheduler):
+    """Synchronous HyperBand (reference: `schedulers/hyperband.py`): trials
+    are assigned round-robin to brackets with different (initial budget,
+    halving count) trade-offs; within a bracket, each halving keeps the top
+    1/eta fraction once ALL its members reported the milestone."""
+
+    def __init__(
+        self,
+        time_attr: str = "training_iteration",
+        max_t: int = 81,
+        reduction_factor: float = 3,
+    ):
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.eta = reduction_factor
+        s_max = int(math.log(max_t) / math.log(reduction_factor))
+        # Bracket k starts trials at budget max_t * eta^-k and halves k times.
+        self._bracket_budgets = [
+            int(max_t * self.eta ** -k) or 1 for k in range(s_max + 1)
+        ]
+        self._assign: Dict[Any, int] = {}  # trial_id -> bracket
+        self._next_bracket = 0
+        # bracket -> milestone -> {trial_id: score}
+        self._rungs: Dict[int, Dict[int, Dict[Any, float]]] = defaultdict(
+            lambda: defaultdict(dict)
+        )
+        self._stopped: set = set()
+
+    def on_trial_add(self, trial):
+        """Bracket assignment happens at trial CREATION so rung populations
+        are complete before any result arrives (lazy first-result assignment
+        under limited concurrency would make early rungs fire with a partial
+        population)."""
+        if trial.trial_id not in self._assign:
+            self._assign[trial.trial_id] = (
+                self._next_bracket % len(self._bracket_budgets)
+            )
+            self._next_bracket += 1
+
+    def _bracket_of(self, trial) -> int:
+        self.on_trial_add(trial)  # direct-driven schedulers (tests) lack add
+        return self._assign[trial.trial_id]
+
+    def _milestones(self, bracket: int) -> List[int]:
+        out = []
+        t = self._bracket_budgets[bracket]
+        while t < self.max_t:
+            out.append(int(t))
+            t *= self.eta
+        return out
+
+    def on_trial_result(self, trial, result):
+        t = result.get(self.time_attr)
+        score = self._score(result)
+        if t is None or score is None:
+            return CONTINUE
+        if trial.trial_id in self._stopped:
+            return STOP
+        if t >= self.max_t:
+            return STOP
+        bracket = self._bracket_of(trial)
+        population = max(
+            1, sum(1 for b in self._assign.values() if b == bracket)
+        )
+        # `t >= milestone`, recorded once per (trial, rung): reporting
+        # cadences that step past the exact milestone still register.
+        for milestone in self._milestones(bracket):
+            if t >= milestone:
+                rung = self._rungs[bracket][milestone]
+                if trial.trial_id not in rung:
+                    rung[trial.trial_id] = score
+                else:
+                    rung[trial.trial_id] = max(rung[trial.trial_id], score)
+                    continue
+                # Synchronous: decide only when the whole bracket reported.
+                if len(rung) >= population:
+                    keep = max(1, int(len(rung) / self.eta))
+                    ranked = sorted(rung, key=rung.get, reverse=True)
+                    for tid in ranked[keep:]:
+                        self._stopped.add(tid)
+                    if trial.trial_id in self._stopped:
+                        return STOP
+        return CONTINUE
 
 
 class MedianStoppingRule(TrialScheduler):

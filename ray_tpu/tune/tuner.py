@@ -85,6 +85,7 @@ class TuneController:
             return None
         trial = Trial(trial_id, config)
         self.trials.append(trial)
+        self.scheduler.on_trial_add(trial)
         return trial
 
     def _start_trial(self, trial: Trial, checkpoint: Optional[Checkpoint] = None):

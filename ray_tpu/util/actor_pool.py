@@ -26,7 +26,13 @@ class ActorPool:
         """fn(actor, value) -> ObjectRef; blocks only when no actor is idle
         (waits for the oldest in-flight call and re-queues its actor)."""
         if not self._idle:
+            if not self._future_to_actor:
+                raise RuntimeError(
+                    "ActorPool has no actors (all were pop_idle()d away)"
+                )
             self._wait_for_any()
+        if not self._idle:
+            raise RuntimeError("ActorPool could not reclaim an idle actor")
         actor = self._idle.pop(0)
         ref = fn(actor, value)
         self._future_to_actor[ref] = actor
@@ -54,14 +60,17 @@ class ActorPool:
         """Next result in SUBMISSION order."""
         if not self.has_next():
             raise StopIteration("no pending results")
-        ref = self._index_to_future.pop(self._next_return_index, None)
+        ref = self._index_to_future.get(self._next_return_index)
         if ref is None:
             raise RuntimeError(
                 "get_next after get_next_unordered consumed this index — "
                 "pick one consumption order per batch"
             )
-        self._next_return_index += 1
+        # Fetch BEFORE mutating bookkeeping: a timeout leaves the pool state
+        # untouched so get_next can simply be retried.
         value = api.get(ref, timeout=timeout)
+        del self._index_to_future[self._next_return_index]
+        self._next_return_index += 1
         actor = self._future_to_actor.pop(ref, None)
         if actor is not None and actor not in self._idle:
             self._idle.append(actor)

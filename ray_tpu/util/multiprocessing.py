@@ -86,12 +86,18 @@ class Pool:
     def _submit_all(self, fn: Callable, iterables) -> List[Any]:
         refs = []
         window = self._processes if self._processes > 0 else None
+        in_flight: set = set()
         for args in iterables:
-            if window is not None and len(refs) >= window:
-                # Backpressure: cap in-flight tasks at `processes`.
-                done_target = len(refs) - window + 1
-                api.wait(refs, num_returns=done_target, timeout=None)
-            refs.append(self._call.remote(fn, args, None))
+            if window is not None and len(in_flight) >= window:
+                # Backpressure: wait only over the in-flight window (waiting
+                # over the full accumulated list would re-confirm the done
+                # prefix on every submission — O(n²) control traffic).
+                ready, _ = api.wait(list(in_flight), num_returns=1, timeout=None)
+                in_flight.difference_update(ready)
+            ref = self._call.remote(fn, args, None)
+            refs.append(ref)
+            if window is not None:
+                in_flight.add(ref)
         return refs
 
     def map(self, fn: Callable, iterable: Iterable[Any], chunksize: Optional[int] = None):

@@ -1,0 +1,82 @@
+"""Round-2 MFU sweep: model size × remat policy × optimizer precision.
+
+Levers beyond round 1's (B, blocks) sweep: gpt2_large's bigger matmuls use
+the MXU better; remat_policy='dots' trades HBM for recompute; bf16 Adam
+moments halve optimizer-state bandwidth. Run on the real TPU.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.models import gpt2_large, gpt2_medium, init_params, make_train_step
+
+
+def peak_flops():
+    kind = jax.devices()[0].device_kind.lower()
+    if "v5 lite" in kind or "v5e" in kind:
+        return 197e12
+    if "v4" in kind:
+        return 275e12
+    return 197e12
+
+
+def run(tag, cfg_fn, B, S, remat, policy, mu_dtype, steps=6):
+    cfg = cfg_fn(max_seq=S, attn_impl="flash", remat=remat, remat_policy=policy)
+    params = jax.jit(lambda key: init_params(key, cfg))(jax.random.PRNGKey(0))
+    opt = optax.adamw(3e-4, weight_decay=0.1, mu_dtype=mu_dtype)
+    opt_state = opt.init(params)
+    step = jax.jit(make_train_step(cfg, opt), donate_argnums=(0,))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (B, S + 1), 0, cfg.vocab_size)
+    batch = {"tokens": tokens}
+    state = (params, opt_state)
+    for _ in range(2):
+        state, metrics = step(state, batch)
+    _ = float(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch)
+    loss = float(metrics["loss"])
+    dt = (time.perf_counter() - t0) / steps
+    tok_s = B * S / dt
+    mfu = cfg.flops_per_token(S) * tok_s / peak_flops()
+    return {"tag": tag, "B": B, "remat": remat, "policy": policy or "none",
+            "mu": str(mu_dtype.__name__ if mu_dtype else "f32"),
+            "step_ms": round(dt * 1000, 1), "tok_s": round(tok_s),
+            "mfu": round(mfu, 4), "loss": round(loss, 2)}
+
+
+def main():
+    combos = [
+        ("med", gpt2_medium, 24, True, "dots", None),
+        ("med", gpt2_medium, 24, True, None, jnp.bfloat16),
+        ("med", gpt2_medium, 16, False, None, None),
+        ("large", gpt2_large, 12, True, None, None),
+        ("large", gpt2_large, 16, True, None, None),
+        ("large", gpt2_large, 8, True, "dots", None),
+        ("large", gpt2_large, 16, True, None, jnp.bfloat16),
+    ]
+    results = []
+    for tag, fn, B, remat, policy, mu in combos:
+        try:
+            r = run(tag, fn, B, 1024, remat, policy, mu)
+        except Exception as e:  # noqa: BLE001
+            r = {"tag": tag, "B": B, "policy": policy, "error": repr(e)[:160]}
+        print(json.dumps(r), flush=True)
+        results.append(r)
+    ok = [r for r in results if "mfu" in r]
+    if ok:
+        print("BEST:", json.dumps(max(ok, key=lambda r: r["mfu"])))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,91 @@
+"""New Data readers: images, SQL, webdataset.
+
+Reference analogs: `python/ray/data/tests/test_image.py`, `test_sql.py`,
+`test_webdataset.py`.
+"""
+
+import io
+import json
+import os
+import sqlite3
+import tarfile
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rtd
+
+pytestmark = pytest.mark.cluster
+
+
+@pytest.fixture(scope="module")
+def runtime():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_read_images(runtime, tmp_path):
+    from PIL import Image
+
+    for i in range(3):
+        arr = np.full((8 + i, 10, 3), i * 40, np.uint8)
+        Image.fromarray(arr).save(tmp_path / f"img{i}.png")
+
+    ds = rtd.read_images(str(tmp_path), include_paths=True)
+    rows = sorted(ds.take_all(), key=lambda r: r["path"])
+    assert len(rows) == 3
+    assert rows[0]["image"].shape == (8, 10, 3)
+    assert rows[1]["image"][0, 0, 0] == 40
+
+    # Resize + mode conversion.
+    ds = rtd.read_images(str(tmp_path), size=(4, 6), mode="L")
+    for row in ds.take_all():
+        assert row["image"].shape == (4, 6)
+
+
+def test_read_sql(runtime, tmp_path):
+    db = str(tmp_path / "t.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE metrics (name TEXT, value REAL)")
+    conn.executemany(
+        "INSERT INTO metrics VALUES (?, ?)",
+        [("a", 1.0), ("b", 2.5), ("c", -3.0)],
+    )
+    conn.commit()
+    conn.close()
+
+    ds = rtd.read_sql(
+        "SELECT name, value FROM metrics ORDER BY name",
+        lambda: sqlite3.connect(db),
+    )
+    rows = ds.take_all()
+    assert [r["name"] for r in rows] == ["a", "b", "c"]
+    assert rows[1]["value"] == 2.5
+
+
+def test_read_webdataset(runtime, tmp_path):
+    from PIL import Image
+
+    shard = tmp_path / "shard-000.tar"
+    with tarfile.open(shard, "w") as tf:
+        for i in range(2):
+            img = io.BytesIO()
+            Image.fromarray(np.full((4, 4, 3), i, np.uint8)).save(img, format="PNG")
+            for ext, payload in [
+                ("png", img.getvalue()),
+                ("cls", str(i).encode()),
+                ("json", json.dumps({"idx": i}).encode()),
+            ]:
+                data = payload
+                info = tarfile.TarInfo(f"sample{i}.{ext}")
+                info.size = len(data)
+                tf.addfile(info, io.BytesIO(data))
+
+    ds = rtd.read_webdataset(str(shard))
+    rows = sorted(ds.take_all(), key=lambda r: r["__key__"])
+    assert len(rows) == 2
+    assert rows[0]["png"].shape == (4, 4, 3)
+    assert rows[1]["cls"] == 1
+    assert rows[1]["json"]["idx"] == 1

@@ -1,0 +1,97 @@
+"""Offline RL (BC) + HyperBand scheduler tests.
+
+Reference analogs: `rllib/algorithms/bc/tests` (BC learns CartPole from
+demonstrations) and `tune/tests/test_trial_scheduler.py` (HyperBand).
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib import BCConfig
+from ray_tpu.rllib.offline import OfflineDataset, collect_dataset
+
+
+def _expert(obs: np.ndarray) -> np.ndarray:
+    """Scripted CartPole expert: push toward the pole's fall direction."""
+    theta, theta_dot = obs[:, 2], obs[:, 3]
+    return (theta + 0.5 * theta_dot > 0).astype(np.int64)
+
+
+def test_offline_dataset_json_roundtrip(tmp_path):
+    ds = collect_dataset("CartPole-v1", _expert, n_steps=256, num_envs=4)
+    assert len(ds) == 256 and ds.obs.shape[1] == 4
+    path = str(tmp_path / "demos.jsonl")
+    ds.write_json(path)
+    ds2 = OfflineDataset.read_json(path)
+    np.testing.assert_allclose(ds.obs, ds2.obs, rtol=1e-6)
+    np.testing.assert_array_equal(ds.actions, ds2.actions)
+
+
+def test_bc_learns_cartpole_from_demonstrations():
+    """Learning bar: BC must clone the scripted expert well enough to hold
+    the pole ≥150 steps (the PPO baseline bar) — pure offline training."""
+    demos = collect_dataset("CartPole-v1", _expert, n_steps=4096, num_envs=8, seed=3)
+    config = (
+        BCConfig()
+        .environment("CartPole-v1")
+        .training(lr=1e-3, train_batch_size=2048)
+        .offline_data(dataset=demos)
+    )
+    algo = config.build()
+    best = 0.0
+    for _ in range(10):
+        result = algo.train()
+        best = max(best, result["evaluation"]["episode_reward_mean"])
+        if best >= 150:
+            break
+    algo.stop()
+    assert best >= 150, f"BC reached only {best:.0f} reward"
+
+
+def test_bc_requires_offline_data():
+    with pytest.raises(ValueError, match="offline_data"):
+        BCConfig().environment("CartPole-v1").build()
+
+
+def test_hyperband_scheduler_prunes_bottom():
+    from ray_tpu.tune.schedulers import CONTINUE, STOP, HyperBandScheduler
+
+    class T:
+        def __init__(self, tid):
+            self.trial_id = tid
+
+    sched = HyperBandScheduler(max_t=9, reduction_factor=3)
+    sched.set_objective("score", "max")
+    trials = [T(f"t{i}") for i in range(3)]
+    # All three land in distinct brackets round-robin; force one bracket by
+    # re-registering: use 3 trials → brackets 0,1,2 with budgets 9,3,1.
+    # Trial in bracket 0 never hits a sub-max milestone; bracket 1 (budget 3)
+    # has milestone 3.
+    decisions = {}
+    for t in trials:
+        decisions[t.trial_id] = sched.on_trial_result(
+            t, {"training_iteration": 1, "score": 1.0}
+        )
+    # Nothing stops before milestones resolve with full populations.
+    assert set(decisions.values()) <= {CONTINUE, STOP}
+    # max_t stops unconditionally.
+    assert sched.on_trial_result(trials[0], {"training_iteration": 9, "score": 5}) == STOP
+
+
+def test_hyperband_single_bracket_halving():
+    from ray_tpu.tune.schedulers import CONTINUE, STOP, HyperBandScheduler
+
+    class T:
+        def __init__(self, tid):
+            self.trial_id = tid
+
+    # One bracket (max_t=3, eta=3 → brackets budgets [3, 1]); pin all trials
+    # to bracket 1 (budget 1, milestone 1) by creating 2 trials: t0→b0, t1→b1.
+    sched = HyperBandScheduler(max_t=3, reduction_factor=3)
+    sched.set_objective("score", "max")
+    a, b = T("a"), T("b")
+    # a → bracket 0 (budget 3: no milestones below max_t→ just CONTINUE)
+    assert sched.on_trial_result(a, {"training_iteration": 1, "score": 0.1}) == CONTINUE
+    # b → bracket 1 (budget 1, milestone 1). Population of bracket 1 is 1,
+    # so the rung resolves immediately and keeps top 1/3 → max(1) = itself.
+    assert sched.on_trial_result(b, {"training_iteration": 1, "score": 0.2}) == CONTINUE

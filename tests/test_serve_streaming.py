@@ -1,0 +1,147 @@
+"""Actor-method streaming + Serve streaming responses.
+
+Reference analogs: `returns_dynamic` actor tasks (`_raylet.pyx:272`) and
+Serve StreamingResponse / `handle.options(stream=True)`.
+"""
+
+import http.client
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+pytestmark = pytest.mark.cluster
+
+
+# -------------------------------------------------- actor method streaming
+def test_actor_method_streaming(cluster_runtime):
+    @ray_tpu.remote
+    class Producer:
+        def __init__(self):
+            self.calls = 0
+
+        def gen(self, n):
+            self.calls += 1
+            for i in range(n):
+                yield i * 10
+
+        def count(self):
+            return self.calls
+
+    p = Producer.remote()
+    gen = p.gen.options(num_returns="streaming").remote(4)
+    assert [ray_tpu.get(r) for r in gen] == [0, 10, 20, 30]
+    # The actor is still healthy and ordered delivery continues.
+    assert ray_tpu.get(p.count.remote()) == 1
+    gen2 = p.gen.options(num_returns="streaming").remote(2)
+    assert [ray_tpu.get(r) for r in gen2] == [0, 10]
+
+
+def test_actor_streaming_overlaps(cluster_runtime):
+    @ray_tpu.remote
+    class Slow:
+        def gen(self):
+            for i in range(3):
+                time.sleep(0.4)
+                yield i
+
+    s = Slow.remote()
+    t0 = time.monotonic()
+    gen = s.gen.options(num_returns="streaming").remote()
+    first = ray_tpu.get(next(gen))
+    first_at = time.monotonic() - t0
+    rest = [ray_tpu.get(r) for r in gen]
+    total = time.monotonic() - t0
+    assert first == 0 and rest == [1, 2]
+    assert first_at <= total - 0.5, f"first at {first_at:.2f}s of {total:.2f}s"
+
+
+def test_actor_streaming_mid_error(cluster_runtime):
+    @ray_tpu.remote
+    class Flaky:
+        def gen(self):
+            yield "ok"
+            raise ValueError("actor stream boom")
+
+    f = Flaky.remote()
+    gen = f.gen.options(num_returns="streaming").remote()
+    assert ray_tpu.get(next(gen)) == "ok"
+    with pytest.raises(ValueError, match="actor stream boom"):
+        ray_tpu.get(next(gen))
+
+
+def test_queued_streaming_call_fails_on_actor_death(cluster_runtime):
+    """A streaming call still QUEUED behind a busy call must error (not hang)
+    when the actor dies."""
+
+    @ray_tpu.remote(max_restarts=0)
+    class Doomed:
+        def busy(self):
+            time.sleep(1.0)
+            return "done"
+
+        def gen(self):
+            yield 1
+
+    d = Doomed.remote()
+    busy_ref = d.busy.remote()          # occupies the actor
+    gen = d.gen.options(num_returns="streaming").remote()  # queued behind it
+    time.sleep(0.2)
+    ray_tpu.kill(d)
+    with pytest.raises(Exception):
+        ray_tpu.get(next(gen), timeout=20)
+
+
+# ------------------------------------------------------- serve handle stream
+@pytest.fixture
+def serve_session(cluster_runtime):
+    serve.start()
+    yield
+    serve.shutdown()
+
+
+def test_serve_handle_stream(serve_session):
+    @serve.deployment
+    class Tokens:
+        def __call__(self, req):
+            for tok in ["alpha", "beta", "gamma"]:
+                yield tok
+
+    handle = serve.run(Tokens.bind(), name="stream_app", route_prefix="/stream")
+    chunks = list(handle.options(stream=True).remote(None))
+    assert chunks == ["alpha", "beta", "gamma"]
+
+
+def test_serve_http_streaming(serve_session):
+    @serve.deployment
+    class SlowTokens:
+        def __call__(self, req):
+            for i in range(3):
+                time.sleep(0.3)
+                yield f"tok{i} "
+
+    serve.start(http_options={"host": "127.0.0.1", "port": 0})
+    serve.run(SlowTokens.bind(), name="stream_http", route_prefix="/sse")
+    port = serve.http_port()
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    t0 = time.monotonic()
+    conn.request("GET", "/sse")
+    resp = conn.getresponse()
+    first_chunk_at = None
+    body = b""
+    while True:
+        chunk = resp.read1(64)  # read1: returns available bytes, no fill-wait
+        if not chunk:
+            break
+        if first_chunk_at is None:
+            first_chunk_at = time.monotonic() - t0
+        body += chunk
+    total = time.monotonic() - t0
+    conn.close()
+    assert b"tok0" in body and b"tok2" in body
+    # First chunk arrived before the generator finished (~0.9s).
+    assert first_chunk_at is not None and first_chunk_at <= total - 0.4, (
+        f"first chunk at {first_chunk_at:.2f}s of {total:.2f}s — not streaming"
+    )
