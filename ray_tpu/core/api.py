@@ -37,6 +37,16 @@ def _global_runtime() -> Runtime:
     return _runtime
 
 
+def _runtime_if_initialized() -> Optional[Runtime]:
+    """Lock-free, non-initializing peek at the runtime. The ONLY safe
+    accessor from __del__/GC paths: a destructor can fire on ANY thread —
+    including a backend's io loop thread during init(), while the MAIN
+    thread holds _runtime_lock waiting on that same loop. _global_runtime()
+    there deadlocks the client (observed: connect coroutines frozen
+    mid-sock_connect for the full timeout)."""
+    return _runtime
+
+
 def set_global_runtime(runtime: Optional[Runtime]):
     """Install the process-wide runtime (used by worker bootstrap)."""
     global _runtime

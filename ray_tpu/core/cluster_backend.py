@@ -68,7 +68,16 @@ class ClusterBackend(RuntimeBackend):
         backend = cls(address, role="driver")
         backend.remote_client = remote_client
         backend._controller_proc = proc
-        backend._connect(register_as="register_driver")
+        try:
+            backend._connect(register_as="register_driver")
+        except BaseException:
+            # Failed bootstrap must not leak the controller we just spawned
+            # (observed: timed-out registrations piling up orphan controllers
+            # that load the machine and poison later runs).
+            backend.io.stop()
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+            raise
         return backend
 
     @classmethod
@@ -132,18 +141,40 @@ class ClusterBackend(RuntimeBackend):
 
     def _connect(self, register_as: str):
         self._register_as = register_as
+        phases = {}  # diagnostic: where did a timed-out connect spend time?
+
         async def go():
+            import time as _t
+
+            t0 = _t.monotonic()
+            phases["enter"] = 0.0  # loop ran the coroutine at all
             host, port = self.address.rsplit(":", 1)
-            reader, writer = await asyncio.open_connection(host, int(port))
+            try:
+                reader, writer = await asyncio.wait_for(
+                    asyncio.open_connection(host, int(port)), 10
+                )
+            except TimeoutError:
+                phases["tcp_timeout"] = round(_t.monotonic() - t0, 2)
+                raise
+            phases["tcp"] = round(_t.monotonic() - t0, 2)
             conn = Connection(reader, writer)
             conn.start()
             self.conn = conn
             payload = {"type": register_as, "node_id": os.environ.get("RAY_TPU_NODE_ID", "node0")}
             if register_as == "register_worker" and self.worker is not None:
                 payload["worker_id"] = self.worker.worker_id
-            return await conn.request(payload, timeout=15)
+            out = await conn.request(payload, timeout=15)
+            phases["register"] = round(_t.monotonic() - t0, 2)
+            return out
 
-        result = self.io.call(go(), timeout=20)
+        try:
+            result = self.io.call(go(), timeout=20)
+        except TimeoutError as e:
+            raise RayTpuError(
+                f"controller connect timed out (phases reached: {phases}; "
+                f"an empty dict means the io loop never ran the coroutine — "
+                f"loop blocked?)"
+            ) from e
         if not (result or {}).get("ok"):
             raise RayTpuError(f"Failed to register with controller: {result}")
         if result.get("session_dir"):
@@ -175,7 +206,12 @@ class ClusterBackend(RuntimeBackend):
             raise RayTpuError(f"Lost connection to controller: {e}") from e
 
     def _send(self, msg: dict):
-        self.io.call(self.conn.send(msg))
+        # Fire-and-forget — NEVER block on the io loop here. GC can trigger
+        # ObjectRef/ObjectRefGenerator __del__ → release sends on ANY thread,
+        # including the io loop thread itself (observed: a future-chain
+        # callback freeing a generator's refs); a blocking call from that
+        # thread deadlocks the whole client.
+        self.io.call_nowait(self.conn.send(msg))
 
     # ----------------------------------------------------------------- put
     def put(self, value: Any, owner_task_hex: str) -> ObjectRef:

@@ -93,3 +93,7 @@ define("pull_timeout_s", 120.0, doc="Cross-node object pull timeout")
 # Observability.
 define("dashboard", True, doc="Serve the HTTP dashboard from the controller")
 define("dashboard_port", 0, doc="Dashboard port (0 = ephemeral)")
+# Failure detection (reference: `gcs_health_check_manager.h:55`).
+define("health_check_period_s", 5.0, doc="Node agent liveness probe period")
+define("health_check_timeout_s", 2.0, doc="Per-probe response deadline")
+define("health_check_failures", 3, doc="Consecutive misses before node death")

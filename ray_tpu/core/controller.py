@@ -304,6 +304,7 @@ class Controller:
                 self._spawn_worker()
         asyncio.ensure_future(self._gc_loop())
         asyncio.ensure_future(self._snapshot_loop())
+        asyncio.ensure_future(self._health_check_loop())
 
     # --------------------------------------------------- persistence (GCS FT)
     # Reference analog: GCS tables behind `RedisStoreClient`
@@ -574,6 +575,10 @@ class Controller:
             stdout=log_f,
             stderr=subprocess.STDOUT,
             cwd=pkg_root,
+            # NO pdeathsig here: head workers deliberately survive a
+            # controller crash so a restarted controller re-adopts them
+            # (controller FT). Orphan cleanup is the worker's reconnect
+            # grace timeout, not process lineage.
         )
         self._worker_procs[worker_id] = proc
 
@@ -2031,6 +2036,45 @@ class Controller:
             astate.inflight.clear()
 
     # ---------------------------------------------------------- node death
+    async def _health_check_loop(self):
+        """Active liveness probing of node agents (reference:
+        `GcsHealthCheckManager`, `gcs_health_check_manager.h:39`): a wedged
+        agent whose TCP connection is still up would otherwise hold its
+        node 'alive' forever — connection-close detection only covers
+        process death."""
+        period = rt_config.get("health_check_period_s")
+        timeout = rt_config.get("health_check_timeout_s")
+        threshold = rt_config.get("health_check_failures")
+        misses: Dict[str, int] = {}
+        async def probe(node: NodeState):
+            try:
+                resp = await node.conn.request({"type": "ping"}, timeout=timeout)
+                ok = bool((resp or {}).get("ok"))
+            except Exception:  # noqa: BLE001
+                ok = False
+            if ok:
+                misses.pop(node.node_id, None)
+                return
+            misses[node.node_id] = misses.get(node.node_id, 0) + 1
+            if misses[node.node_id] >= threshold:
+                self._event("node_health_check_failed", node=node.node_id)
+                misses.pop(node.node_id, None)
+                try:
+                    node.conn.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                await self._on_node_death(node.node_id)
+
+        while not self._shutdown_event.is_set():
+            await asyncio.sleep(period)
+            # Concurrent probes: one wedged node must not delay (or inflate
+            # the detection latency of) every other node's probe.
+            targets = [
+                n for n in self.nodes.values() if n.alive and n.conn is not None
+            ]
+            if targets:
+                await asyncio.gather(*(probe(n) for n in targets))
+
     async def _on_node_death(self, node_id: str):
         """A node agent's connection dropped (reference analog: GCS node
         death pubsub after `GcsHealthCheckManager` misses)."""

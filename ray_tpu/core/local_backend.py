@@ -148,6 +148,11 @@ class LocalBackend(RuntimeBackend):
                 self._objects.put(oid, v)
 
     def _store_error(self, spec: TaskSpec, err: TaskError):
+        if spec.num_returns == -1:
+            # Streaming spec has no return ids — end the stream with the
+            # error so consumers raise instead of long-polling forever.
+            self._end_stream(spec, error=err)
+            return
         for oid in spec.return_ids:
             self._objects.put(oid, err)
 
@@ -308,6 +313,16 @@ class LocalBackend(RuntimeBackend):
                     self._runtime.set_task_context(spec.task_id, spec.actor_id)
                 try:
                     result = method(*args, **kwargs)
+                    if spec.num_returns == -1:  # streaming actor method
+                        import inspect
+
+                        gen = (
+                            result
+                            if inspect.isgenerator(result)
+                            else iter((result,))
+                        )
+                        self._run_stream(spec, gen)
+                        return
                 finally:
                     if self._runtime is not None:
                         self._runtime.set_task_context(None)

@@ -122,7 +122,10 @@ class NodeAgent:
     async def _on_controller_push(self, msg: dict):
         try:
             mtype = msg["type"]
-            if mtype == "spawn_worker":
+            if mtype == "ping" and msg.get("req_id") is not None:
+                # Liveness probe (controller `_health_check_loop`).
+                await self.conn.respond(msg["req_id"], {"ok": True})
+            elif mtype == "spawn_worker":
                 self._spawn_worker(msg["worker_id"], tpu=bool(msg.get("tpu")))
             elif mtype == "pull_object":
                 # Long transfer — detach so other commands keep flowing.

@@ -123,8 +123,12 @@ class ObjectRefGenerator:
         try:
             from . import api
 
-            backend = api._global_runtime().backend
-            release = getattr(backend, "stream_release", None)
+            # _release runs from __del__/GC on arbitrary threads — only the
+            # lock-free peek is safe here (never _global_runtime()).
+            runtime = api._runtime_if_initialized()
+            if runtime is None:
+                return
+            release = getattr(runtime.backend, "stream_release", None)
             if release is not None:
                 release(self._task_id.hex(), self._index)
         except Exception:  # noqa: BLE001 — interpreter teardown / backend gone

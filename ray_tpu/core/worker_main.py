@@ -16,6 +16,7 @@ import os
 import queue
 import sys
 import threading
+import time
 import traceback
 from typing import Any, Dict, List, Optional
 
@@ -41,6 +42,27 @@ class WorkerProcess:
         self._actor_hex: Optional[str] = None
         self.actor_pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
         self._stop = False
+        self._start_orphan_watchdog()
+
+    def _start_orphan_watchdog(self):
+        """A STATELESS worker whose controller died must not linger: normally
+        the connection close triggers exit, but a SIGKILLed controller can
+        leave the close undetected (observed: orphans parked in queue.get for
+        minutes, loading the machine). Reparenting to init (ppid==1) is the
+        unambiguous signal. Actor hosts are exempt — controller-FT re-adopts
+        them after a restart, and they run their own reconnect grace logic."""
+        def watch():
+            strikes = 0
+            while not self._stop:
+                time.sleep(5.0)
+                if os.getppid() == 1 and self.actor_instance is None:
+                    strikes += 1
+                    if strikes >= 2:  # ~10s of confirmed orphanhood
+                        os._exit(0)
+                else:
+                    strikes = 0
+
+        threading.Thread(target=watch, daemon=True, name="orphan-watchdog").start()
 
     # ----------------------------------------------------------------- io
     async def _connect(self):

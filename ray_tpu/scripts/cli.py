@@ -144,6 +144,36 @@ def cmd_job(backend, info, args):
         _table(rows, ["job_id", "status", "entrypoint", "returncode"])
 
 
+def cmd_serve(backend, info, args):
+    """`serve deploy/status/shutdown/delete` (reference: `serve/scripts.py`).
+    Runs as a driver so it can reach the Serve controller actor."""
+    import ray_tpu
+
+    ray_tpu.init(address=info["address"], ignore_reinit_error=True, log_to_driver=False)
+    from ray_tpu import serve
+
+    if args.serve_command == "deploy":
+        sys.path.insert(0, os.getcwd())  # import_path resolves from cwd
+        with open(args.config_file) as f:
+            text = f.read()
+        if args.config_file.endswith((".yaml", ".yml")):
+            import yaml
+
+            cfg = yaml.safe_load(text)
+        else:
+            cfg = json.loads(text)
+        handles = serve.run_config(cfg)
+        print(f"deployed: {', '.join(handles) or '(nothing)'}")
+    elif args.serve_command == "status":
+        print(json.dumps(serve.status(), indent=2, default=str))
+    elif args.serve_command == "delete":
+        serve.delete(args.app)
+        print(f"deleted {args.app}")
+    elif args.serve_command == "shutdown":
+        serve.shutdown()
+        print("serve shut down")
+
+
 def cmd_timeline(backend, info, args):
     events = backend._request({"type": "state_summary"})["timeline"]
     if args.output:
@@ -178,6 +208,14 @@ def main(argv=None):
         p = job_sub.add_parser(name)
         p.add_argument("job_id")
     job_sub.add_parser("list")
+    p_serve = sub.add_parser("serve", help="deploy/inspect Serve applications")
+    serve_sub = p_serve.add_subparsers(dest="serve_command", required=True)
+    p_deploy = serve_sub.add_parser("deploy")
+    p_deploy.add_argument("config_file", help="JSON or YAML app config")
+    serve_sub.add_parser("status")
+    p_del = serve_sub.add_parser("delete")
+    p_del.add_argument("app")
+    serve_sub.add_parser("shutdown")
     args = parser.parse_args(argv)
     if args.command == "job" and args.job_command == "submit":
         ep = list(args.entrypoint)
@@ -194,6 +232,7 @@ def main(argv=None):
             "logs": cmd_logs,
             "timeline": cmd_timeline,
             "job": cmd_job,
+            "serve": cmd_serve,
         }[args.command](backend, info, args)
     finally:
         backend.conn.close()

@@ -12,6 +12,7 @@ from .api import (
     get_deployment_handle,
     http_port,
     run,
+    run_config,
     shutdown,
     start,
     status,
@@ -28,6 +29,7 @@ __all__ = [
     "Application",
     "AutoscalingConfig",
     "run",
+    "run_config",
     "start",
     "delete",
     "status",

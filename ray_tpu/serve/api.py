@@ -146,6 +146,51 @@ def _wait_healthy(app_name: str, timeout_s: float):
     raise TimeoutError(f"Application {app_name} failed to become RUNNING in {timeout_s}s")
 
 
+def run_config(config: dict) -> Dict[str, DeploymentHandle]:
+    """Deploy applications from a declarative config (reference: Serve's
+    REST schema `serve/schema.py` + `serve deploy config.yaml`).
+
+    Schema:
+        {"http_options": {"host": ..., "port": ...},           # optional
+         "applications": [
+             {"name": "app", "route_prefix": "/",
+              "import_path": "my_module:app",                  # Application
+              "deployments": [                                 # overrides
+                  {"name": "Model", "num_replicas": 2,
+                   "user_config": {...}}]}]}
+    """
+    import importlib
+
+    from .deployment import Application
+
+    if config.get("http_options"):
+        start(http_options=config["http_options"])
+    handles: Dict[str, DeploymentHandle] = {}
+    for app_cfg in config.get("applications", []):
+        mod_name, _, attr = app_cfg["import_path"].partition(":")
+        target = getattr(importlib.import_module(mod_name), attr)
+        app = target() if callable(target) and not isinstance(target, Application) else target
+        if not isinstance(app, Application):
+            raise TypeError(
+                f"{app_cfg['import_path']} is not a bound Application "
+                "(expected `deployment.bind(...)` or a zero-arg builder)"
+            )
+        overrides = {d["name"]: d for d in app_cfg.get("deployments", [])}
+        for node in app._flatten():
+            o = overrides.get(node.deployment.name)
+            if o:
+                node.deployment = node.deployment.options(
+                    **{k: v for k, v in o.items() if k != "name"}
+                )
+        name = app_cfg.get("name", "default")
+        handles[name] = run(
+            app,
+            name=name,
+            route_prefix=app_cfg.get("route_prefix", "/"),
+        )
+    return handles
+
+
 def delete(name: str, _blocking: bool = True):
     ray = _ensure_ray()
     controller = _get_controller(create=False)

@@ -9,6 +9,7 @@ object with method/path/query/body accessors.
 
 from __future__ import annotations
 
+import itertools
 import json
 import socket
 import threading
@@ -16,11 +17,6 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
-
-
-def _chain_first(first, rest):
-    yield first
-    yield from rest
 
 
 def _as_bytes(chunk) -> bytes:
@@ -90,7 +86,11 @@ class HTTPProxy:
                     self.send_header("Transfer-Encoding", "chunked")
                     self.end_headers()
                     try:
-                        chunks = iter(()) if first is None else _chain_first(first, it)
+                        chunks = (
+                            iter(())
+                            if first is None
+                            else itertools.chain((first,), it)
+                        )
                         for chunk in chunks:
                             data = _as_bytes(chunk)
                             self.wfile.write(
@@ -99,11 +99,12 @@ class HTTPProxy:
                             self.wfile.flush()
                         self.wfile.write(b"0\r\n\r\n")
                     except BrokenPipeError:
-                        pass
+                        self.close_connection = True
                     except Exception:  # noqa: BLE001 — mid-stream failure:
-                        # the only honest signal left is an aborted chunked
-                        # body (no terminal 0-chunk), like ASGI servers.
-                        pass
+                        # abort the chunked body AND close the socket (like
+                        # ASGI servers) so the client unblocks; a kept-alive
+                        # connection would leave it waiting mid-body forever.
+                        self.close_connection = True
                     return
                 self.send_response(status)
                 self.send_header("Content-Length", str(len(payload)))

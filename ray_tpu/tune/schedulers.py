@@ -101,12 +101,17 @@ class HyperBandScheduler(TrialScheduler):
         self.max_t = max_t
         self.eta = reduction_factor
         s_max = int(math.log(max_t) / math.log(reduction_factor))
-        # Bracket k starts trials at budget max_t * eta^-k and halves k times.
+        # Bracket k starts trials at budget max_t * eta^-k and halves k times;
+        # its CAPACITY follows standard HyperBand sizing (more halvings →
+        # more, cheaper trials): n_k = ceil((s_max+1)/(k+1)) * eta^k.
         self._bracket_budgets = [
             int(max_t * self.eta ** -k) or 1 for k in range(s_max + 1)
         ]
+        self._bracket_capacity = [
+            math.ceil((s_max + 1) / (k + 1)) * int(self.eta ** k)
+            for k in range(s_max + 1)
+        ]
         self._assign: Dict[Any, int] = {}  # trial_id -> bracket
-        self._next_bracket = 0
         # bracket -> milestone -> {trial_id: score}
         self._rungs: Dict[int, Dict[int, Dict[Any, float]]] = defaultdict(
             lambda: defaultdict(dict)
@@ -114,15 +119,19 @@ class HyperBandScheduler(TrialScheduler):
         self._stopped: set = set()
 
     def on_trial_add(self, trial):
-        """Bracket assignment happens at trial CREATION so rung populations
-        are complete before any result arrives (lazy first-result assignment
-        under limited concurrency would make early rungs fire with a partial
-        population)."""
+        """Brackets fill SEQUENTIALLY to their capacity at trial creation.
+        A rung only resolves once `capacity` trials reported it, so lazy
+        trial creation (bounded tuner concurrency) cannot fire a rung on a
+        partial population — trials beyond the total capacity wrap around."""
         if trial.trial_id not in self._assign:
-            self._assign[trial.trial_id] = (
-                self._next_bracket % len(self._bracket_budgets)
-            )
-            self._next_bracket += 1
+            n = len(self._assign)
+            total = sum(self._bracket_capacity)
+            n %= total
+            for k, cap in enumerate(self._bracket_capacity):
+                if n < cap:
+                    self._assign[trial.trial_id] = k
+                    return
+                n -= cap
 
     def _bracket_of(self, trial) -> int:
         self.on_trial_add(trial)  # direct-driven schedulers (tests) lack add
@@ -146,9 +155,7 @@ class HyperBandScheduler(TrialScheduler):
         if t >= self.max_t:
             return STOP
         bracket = self._bracket_of(trial)
-        population = max(
-            1, sum(1 for b in self._assign.values() if b == bracket)
-        )
+        population = self._bracket_capacity[bracket]
         # `t >= milestone`, recorded once per (trial, rung): reporting
         # cadences that step past the exact milestone still register.
         for milestone in self._milestones(bracket):

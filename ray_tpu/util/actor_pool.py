@@ -66,9 +66,22 @@ class ActorPool:
                 "get_next after get_next_unordered consumed this index — "
                 "pick one consumption order per batch"
             )
-        # Fetch BEFORE mutating bookkeeping: a timeout leaves the pool state
-        # untouched so get_next can simply be retried.
-        value = api.get(ref, timeout=timeout)
+        # A TIMEOUT leaves pool state untouched (get_next is retryable); a
+        # task-raised error consumes the index so iteration can continue
+        # past the failed task.
+        from ..core.exceptions import GetTimeoutError
+
+        try:
+            value = api.get(ref, timeout=timeout)
+        except GetTimeoutError:
+            raise
+        except BaseException:
+            del self._index_to_future[self._next_return_index]
+            self._next_return_index += 1
+            actor = self._future_to_actor.pop(ref, None)
+            if actor is not None and actor not in self._idle:
+                self._idle.append(actor)
+            raise
         del self._index_to_future[self._next_return_index]
         self._next_return_index += 1
         actor = self._future_to_actor.pop(ref, None)

@@ -53,7 +53,29 @@ def test_bc_requires_offline_data():
         BCConfig().environment("CartPole-v1").build()
 
 
-def test_hyperband_scheduler_prunes_bottom():
+def test_hyperband_bracket_capacities():
+    from ray_tpu.tune.schedulers import HyperBandScheduler
+
+    # max_t=9, eta=3 → s_max=2; budgets [9, 3, 1];
+    # capacities n_k = ceil(3/(k+1)) * 3^k = [3, 6, 9].
+    sched = HyperBandScheduler(max_t=9, reduction_factor=3)
+    assert sched._bracket_budgets == [9, 3, 1]
+    assert sched._bracket_capacity == [3, 6, 9]
+
+    class T:
+        def __init__(self, tid):
+            self.trial_id = tid
+
+    # Sequential fill: first 3 → bracket 0, next 6 → bracket 1, next → 2.
+    trials = [T(f"t{i}") for i in range(10)]
+    for t in trials:
+        sched.on_trial_add(t)
+    assert [sched._assign[t.trial_id] for t in trials[:3]] == [0, 0, 0]
+    assert [sched._assign[t.trial_id] for t in trials[3:9]] == [1] * 6
+    assert sched._assign[trials[9].trial_id] == 2  # wraps into bracket 2
+
+
+def test_hyperband_synchronous_halving_waits_for_full_rung():
     from ray_tpu.tune.schedulers import CONTINUE, STOP, HyperBandScheduler
 
     class T:
@@ -62,36 +84,30 @@ def test_hyperband_scheduler_prunes_bottom():
 
     sched = HyperBandScheduler(max_t=9, reduction_factor=3)
     sched.set_objective("score", "max")
-    trials = [T(f"t{i}") for i in range(3)]
-    # All three land in distinct brackets round-robin; force one bracket by
-    # re-registering: use 3 trials → brackets 0,1,2 with budgets 9,3,1.
-    # Trial in bracket 0 never hits a sub-max milestone; bracket 1 (budget 3)
-    # has milestone 3.
-    decisions = {}
-    for t in trials:
-        decisions[t.trial_id] = sched.on_trial_result(
-            t, {"training_iteration": 1, "score": 1.0}
-        )
-    # Nothing stops before milestones resolve with full populations.
-    assert set(decisions.values()) <= {CONTINUE, STOP}
+    # Fill bracket 0 (capacity 3) then land all of bracket 1's 6 trials.
+    b0 = [T(f"a{i}") for i in range(3)]
+    b1 = [T(f"b{i}") for i in range(6)]
+    for t in b0 + b1:
+        sched.on_trial_add(t)
+    # Bracket 1 milestone is 3. The first five reporters must NOT be judged —
+    # the rung resolves only when all 6 reported (no partial-population fire).
+    for i, t in enumerate(b1[:5]):
+        assert sched.on_trial_result(
+            t, {"training_iteration": 3, "score": float(i)}
+        ) == CONTINUE
+    # Sixth report resolves the rung: keep top 6/3=2 (scores 4,5 → b1[4], and
+    # the reporter with score 5). The reporter itself has the best score.
+    assert sched.on_trial_result(
+        b1[5], {"training_iteration": 3, "score": 5.0}
+    ) == CONTINUE
+    # Everyone below the kept set is now stopped at their next report.
+    assert sched.on_trial_result(
+        b1[0], {"training_iteration": 4, "score": 0.0}
+    ) == STOP
+    assert sched.on_trial_result(
+        b1[4], {"training_iteration": 4, "score": 4.0}
+    ) == CONTINUE
     # max_t stops unconditionally.
-    assert sched.on_trial_result(trials[0], {"training_iteration": 9, "score": 5}) == STOP
-
-
-def test_hyperband_single_bracket_halving():
-    from ray_tpu.tune.schedulers import CONTINUE, STOP, HyperBandScheduler
-
-    class T:
-        def __init__(self, tid):
-            self.trial_id = tid
-
-    # One bracket (max_t=3, eta=3 → brackets budgets [3, 1]); pin all trials
-    # to bracket 1 (budget 1, milestone 1) by creating 2 trials: t0→b0, t1→b1.
-    sched = HyperBandScheduler(max_t=3, reduction_factor=3)
-    sched.set_objective("score", "max")
-    a, b = T("a"), T("b")
-    # a → bracket 0 (budget 3: no milestones below max_t→ just CONTINUE)
-    assert sched.on_trial_result(a, {"training_iteration": 1, "score": 0.1}) == CONTINUE
-    # b → bracket 1 (budget 1, milestone 1). Population of bracket 1 is 1,
-    # so the rung resolves immediately and keeps top 1/3 → max(1) = itself.
-    assert sched.on_trial_result(b, {"training_iteration": 1, "score": 0.2}) == CONTINUE
+    assert sched.on_trial_result(
+        b0[0], {"training_iteration": 9, "score": 99.0}
+    ) == STOP

@@ -1,0 +1,134 @@
+"""Serve declarative config + CLI, and node health checks.
+
+Reference analogs: `serve deploy` (`serve/scripts.py` + `schema.py`) and
+`GcsHealthCheckManager` liveness probing.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+pytestmark = pytest.mark.cluster
+
+
+SERVE_APP_MODULE = """
+from ray_tpu import serve
+
+@serve.deployment
+class Doubler:
+    def __init__(self, factor=2):
+        self.factor = factor
+
+    def __call__(self, req):
+        return {"out": int(req) * self.factor if req is not None else self.factor}
+
+app = Doubler.bind()
+"""
+
+
+def test_run_config_deploys_with_overrides(cluster_runtime, tmp_path, monkeypatch):
+    from ray_tpu import serve
+
+    mod = tmp_path / "demo_serve_app.py"
+    mod.write_text(SERVE_APP_MODULE)
+    monkeypatch.syspath_prepend(str(tmp_path))
+
+    serve.start()
+    try:
+        handles = serve.run_config(
+            {
+                "applications": [
+                    {
+                        "name": "demo",
+                        "route_prefix": "/demo",
+                        "import_path": "demo_serve_app:app",
+                        "deployments": [{"name": "Doubler", "num_replicas": 2}],
+                    }
+                ]
+            }
+        )
+        assert ray_tpu.get(handles["demo"].remote(21)._to_object_ref()) == {"out": 42}
+        st = serve.status()["applications"]
+        assert st["demo"]["deployments"]["Doubler"]["target_replicas"] == 2
+    finally:
+        serve.shutdown()
+
+
+def test_serve_cli_deploy_and_status(tmp_path):
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 4})
+    try:
+        mod = tmp_path / "cli_serve_app.py"
+        mod.write_text(SERVE_APP_MODULE)
+        cfg = tmp_path / "config.json"
+        cfg.write_text(
+            json.dumps(
+                {
+                    "applications": [
+                        {
+                            "name": "cliapp",
+                            "route_prefix": "/",
+                            "import_path": "cli_serve_app:app",
+                        }
+                    ]
+                }
+            )
+        )
+        env = dict(os.environ)
+        env["RAY_TPU_ADDRESS"] = cluster.address
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = (
+            str(tmp_path) + os.pathsep
+            + os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            + os.pathsep + env.get("PYTHONPATH", "")
+        )
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.scripts.cli", "serve", "deploy", str(cfg)],
+            capture_output=True, text=True, timeout=120, env=env, cwd=str(tmp_path),
+        )
+        assert "deployed: cliapp" in out.stdout, out.stderr[-2000:]
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.scripts.cli", "serve", "status"],
+            capture_output=True, text=True, timeout=60, env=env,
+        )
+        assert "cliapp" in out.stdout, out.stderr[-2000:]
+    finally:
+        cluster.shutdown()
+
+
+def test_health_check_detects_wedged_node(monkeypatch):
+    """SIGSTOP keeps the agent's TCP connection open but unresponsive — only
+    active probing can declare the node dead."""
+    monkeypatch.setenv("RAY_TPU_HEALTH_CHECK_PERIOD_S", "0.4")
+    monkeypatch.setenv("RAY_TPU_HEALTH_CHECK_TIMEOUT_S", "0.4")
+    monkeypatch.setenv("RAY_TPU_HEALTH_CHECK_FAILURES", "2")
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    node = cluster.add_node(num_cpus=2, resources={"wedge": 1.0})
+    ray_tpu.init(address=cluster.address)
+    try:
+        assert any(
+            n["NodeID"] == node.node_id and n["Alive"] for n in ray_tpu.nodes()
+        )
+        os.kill(node.process.pid, signal.SIGSTOP)
+        try:
+            deadline = time.monotonic() + 20
+            dead = False
+            while time.monotonic() < deadline:
+                states = {n["NodeID"]: n["Alive"] for n in ray_tpu.nodes()}
+                if states.get(node.node_id) is False:
+                    dead = True
+                    break
+                time.sleep(0.3)
+            assert dead, "wedged node was never declared dead"
+        finally:
+            os.kill(node.process.pid, signal.SIGCONT)
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
