@@ -190,7 +190,7 @@ class ClusterBackend(RuntimeBackend):
 
         def _flush_refs(add, release):
             if self.conn is not None and not self.conn._closed:
-                self._send({"type": "update_refs", "add": add, "release": release})
+                self._send_nowait({"type": "update_refs", "add": add, "release": release})
 
         TRACKER.set_flusher(_flush_refs)
         # With the tag known, upgrade to the native arena store if this
@@ -206,11 +206,18 @@ class ClusterBackend(RuntimeBackend):
             raise RayTpuError(f"Lost connection to controller: {e}") from e
 
     def _send(self, msg: dict):
-        # Fire-and-forget — NEVER block on the io loop here. GC can trigger
-        # ObjectRef/ObjectRefGenerator __del__ → release sends on ANY thread,
-        # including the io loop thread itself (observed: a future-chain
-        # callback freeing a generator's refs); a blocking call from that
-        # thread deadlocks the whole client.
+        """Blocking one-way send — user-thread paths (submit, metrics) get an
+        immediate 'Lost connection' at the call site."""
+        try:
+            self.io.call(self.conn.send(msg))
+        except ConnectionError as e:
+            raise RayTpuError(f"Lost connection to controller: {e}") from e
+
+    def _send_nowait(self, msg: dict):
+        """Fire-and-forget — the ONLY safe send from __del__/GC paths, which
+        can run on ANY thread including the io loop thread itself (observed:
+        a future-chain callback freeing a generator's refs; a blocking call
+        from that thread deadlocks the whole client)."""
         self.io.call_nowait(self.conn.send(msg))
 
     # ----------------------------------------------------------------- put
@@ -402,7 +409,8 @@ class ClusterBackend(RuntimeBackend):
         return resp["status"]  # "ready" | "end"
 
     def stream_release(self, task_hex: str, from_index: int) -> None:
-        self._send({"type": "stream_release", "task": task_hex, "from_index": from_index})
+        # Reachable from ObjectRefGenerator.__del__ — must never block.
+        self._send_nowait({"type": "stream_release", "task": task_hex, "from_index": from_index})
 
     # ------------------------------------------------------------- metrics
     def record_metric(self, name: str, kind: str, value: float, tags: dict) -> None:

@@ -89,6 +89,8 @@ define("worker_prestart_cap", 6, doc="Max head workers prestarted per pass")
 define("spawn_burst_cap", 4, doc="Max workers spawned per node per pass")
 # Persistence.
 define("snapshot_interval_s", 1.0, doc="Controller state snapshot period")
+define("gcs_storage", "file",
+       doc="Metadata backend url: file[://dir] (durable) | memory (volatile)")
 define("pull_timeout_s", 120.0, doc="Cross-node object pull timeout")
 # Observability.
 define("dashboard", True, doc="Serve the HTTP dashboard from the controller")

@@ -257,12 +257,25 @@ class Controller:
         self._worker_procs: Dict[str, subprocess.Popen] = {}
 
     # ------------------------------------------------------------ lifecycle
+    _SNAPSHOT_KEY = "controller_state"
+
     @property
-    def _snapshot_path(self) -> str:
-        return os.path.join(self.session_dir, "controller_state.pkl")
+    def _gcs_store(self):
+        """Pluggable metadata backend (reference: `src/ray/gcs/store_client`
+        — InMemory vs Redis). memory:// disables controller FT; file://
+        (default, session dir) survives kill -9; a shared filesystem gives
+        off-box durability in Redis's role."""
+        if getattr(self, "_gcs_store_client", None) is None:
+            from .store_client import make_store_client
+
+            self._gcs_store_client = make_store_client(
+                rt_config.get("gcs_storage"), self.session_dir
+            )
+        return self._gcs_store_client
 
     async def start(self, restore: bool = False):
-        restored = restore and os.path.exists(self._snapshot_path)
+        # _load_snapshot handles missing/corrupt state itself — one read.
+        restored = restore
         if restored:
             restored = self._load_snapshot()  # adopts the dead session's tag
         if not restored:
@@ -374,11 +387,7 @@ class Controller:
         loop = asyncio.get_running_loop()
 
         def dump(state: dict):
-            blob = cloudpickle.dumps(state)
-            tmp = self._snapshot_path + ".tmp"
-            with open(tmp, "wb") as f:
-                f.write(blob)
-            os.replace(tmp, self._snapshot_path)  # atomic vs kill -9
+            self._gcs_store.put(self._SNAPSHOT_KEY, cloudpickle.dumps(state))
 
         while not self._shutdown_event.is_set():
             await asyncio.sleep(rt_config.get("snapshot_interval_s"))
@@ -392,8 +401,7 @@ class Controller:
 
     def _load_snapshot(self) -> bool:
         try:
-            with open(self._snapshot_path, "rb") as f:
-                snap = cloudpickle.loads(f.read())
+            snap = cloudpickle.loads(self._gcs_store.get(self._SNAPSHOT_KEY))
         except Exception:  # noqa: BLE001
             return False
         store.set_session_tag(snap["session_tag"])

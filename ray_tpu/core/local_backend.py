@@ -161,10 +161,7 @@ class LocalBackend(RuntimeBackend):
 
         if spec.task_id in self._cancelled:
             err = TaskError(TaskCancelledError(), "", spec.name)
-            if spec.num_returns == -1:
-                self._end_stream(spec, error=err)  # consumers must not hang
-            else:
-                self._store_error(spec, err)
+            self._store_error(spec, err)  # stream-aware
             return
         try:
             resolved = self._resolve_args(spec)

@@ -49,13 +49,17 @@ class WorkerProcess:
         the connection close triggers exit, but a SIGKILLed controller can
         leave the close undetected (observed: orphans parked in queue.get for
         minutes, loading the machine). Reparenting to init (ppid==1) is the
-        unambiguous signal. Actor hosts are exempt — controller-FT re-adopts
+        unambiguous signal is the parent pid CHANGING (reparenting) — the
+        literal value 1 is a healthy parent in containers, where the
+        controller IS pid 1. Actor hosts are exempt — controller-FT re-adopts
         them after a restart, and they run their own reconnect grace logic."""
+        parent0 = os.getppid()
+
         def watch():
             strikes = 0
             while not self._stop:
                 time.sleep(5.0)
-                if os.getppid() == 1 and self.actor_instance is None:
+                if os.getppid() != parent0 and self.actor_instance is None:
                     strikes += 1
                     if strikes >= 2:  # ~10s of confirmed orphanhood
                         os._exit(0)

@@ -61,6 +61,27 @@ class Channel:
             self._shm = _attach_untracked(name)
         self._owner = create
         self._last_read_seq = 0
+        self._bind_native()
+
+    def _bind_native(self):
+        """Hot wait/copy ops run in C++ when the native lib builds (proper
+        acquire/release atomics + adaptive spin instead of a 500µs poll);
+        same header layout, so native and Python ends interoperate."""
+        self._native = None
+        self._base_addr = 0
+        try:
+            from ..native import load_channel_lib
+
+            lib = load_channel_lib()
+            if lib is not None:
+                import ctypes
+
+                self._native = lib
+                self._base_addr = ctypes.addressof(
+                    ctypes.c_char.from_buffer(self._shm.buf)
+                )
+        except Exception:  # noqa: BLE001 — fall back to pure Python
+            self._native = None
 
     @property
     def name(self) -> str:
@@ -78,6 +99,8 @@ class Channel:
         ch._shm = self._shm
         ch._owner = False
         ch._last_read_seq = self._last_read_seq
+        ch._native = self._native
+        ch._base_addr = self._base_addr
         return ch
 
     # ------------------------------------------------------------- header
@@ -101,6 +124,15 @@ class Channel:
                 f"({len(self._shm.buf) - self._header}B); recreate the DAG "
                 "with a larger _buffer_size_bytes"
             )
+        if self._native is not None:
+            timeout_us = -1 if timeout is None else int(timeout * 1e6)
+            rc = self._native.rtpu_ch_write(
+                self._base_addr, self.num_readers, payload, len(payload),
+                flag, timeout_us,
+            )
+            if rc == -1:
+                raise TimeoutError("channel write blocked: readers lagging")
+            return
         seq = self._get(0)
         # Backpressure: previous message must be acked by every reader slot.
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -117,6 +149,24 @@ class Channel:
     def begin_read(self, timeout: Optional[float] = None) -> Any:
         """Block until the next message; returns the deserialized value.
         Caller must `end_read()` when done with it."""
+        if self._native is not None:
+            import ctypes
+
+            out_len = ctypes.c_uint64()
+            out_flag = ctypes.c_uint64()
+            timeout_us = -1 if timeout is None else int(timeout * 1e6)
+            rc = self._native.rtpu_ch_wait_read(
+                self._base_addr, self._last_read_seq,
+                ctypes.byref(out_len), ctypes.byref(out_flag), timeout_us,
+            )
+            if rc == -1:
+                raise TimeoutError("channel read timed out")
+            self._last_read_seq += 1
+            if out_flag.value == _FLAG_STOP:
+                self._ack()
+                raise ChannelClosed
+            length = out_len.value
+            return pickle.loads(self._shm.buf[self._header : self._header + length])
         deadline = None if timeout is None else time.monotonic() + timeout
         while self._get(0) <= self._last_read_seq:
             if deadline is not None and time.monotonic() > deadline:
@@ -135,6 +185,11 @@ class Channel:
     def _ack(self):
         # Idempotent absolute store into this reader's own slot — safe under
         # concurrent acks from other readers.
+        if self._native is not None:
+            self._native.rtpu_ch_ack(
+                self._base_addr, self.reader_slot, self._last_read_seq
+            )
+            return
         self._set(24 + 8 * self.reader_slot, self._last_read_seq)
 
     def read(self, timeout: Optional[float] = None) -> Any:

@@ -25,24 +25,28 @@ _lib: Optional[ctypes.CDLL] = None
 _build_error: Optional[str] = None
 
 
-def _ensure_built() -> Optional[str]:
-    """Compile the .so if missing/stale. Returns an error string or None."""
-    if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
+def _compile(src: str, lib_path: str, what: str) -> Optional[str]:
+    """Compile one .so if missing/stale. Returns an error string or None."""
+    if os.path.exists(lib_path) and os.path.getmtime(lib_path) >= os.path.getmtime(src):
         return None
     os.makedirs(_BUILD_DIR, exist_ok=True)
-    tmp = _LIB + f".tmp.{os.getpid()}"
+    tmp = lib_path + f".tmp.{os.getpid()}"
     cmd = [
         "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-        "-o", tmp, _SRC, "-lpthread", "-lrt",
+        "-o", tmp, src, "-lpthread", "-lrt",
     ]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
     except (OSError, subprocess.TimeoutExpired) as e:  # g++ absent/hung
-        return f"arena build failed: {e!r}"
+        return f"{what} build failed: {e!r}"
     if proc.returncode != 0:
-        return f"arena build failed:\n{proc.stderr[-2000:]}"
-    os.replace(tmp, _LIB)  # atomic: concurrent builders race safely
+        return f"{what} build failed:\n{proc.stderr[-2000:]}"
+    os.replace(tmp, lib_path)  # atomic: concurrent builders race safely
     return None
+
+
+def _ensure_built() -> Optional[str]:
+    return _compile(_SRC, _LIB, "arena")
 
 
 def load_arena_lib() -> Optional[ctypes.CDLL]:
@@ -94,6 +98,48 @@ def load_arena_lib() -> Optional[ctypes.CDLL]:
 
 def build_error() -> Optional[str]:
     return _build_error
+
+
+# ------------------------------------------------------- channel (seqlock)
+_CH_SRC = os.path.join(_DIR, "src", "channel.cpp")
+_CH_LIB = os.path.join(_BUILD_DIR, "libray_tpu_channel.so")
+_ch_lib: Optional[ctypes.CDLL] = None
+_ch_error: Optional[str] = None
+
+
+def load_channel_lib() -> Optional[ctypes.CDLL]:
+    """Native seqlock channel ops (`src/channel.cpp`) — used by the
+    compiled-DAG/pipeline channels; None if unbuildable (Python fallback)."""
+    global _ch_lib, _ch_error
+    with _lock:
+        if _ch_lib is not None:
+            return _ch_lib
+        if _ch_error is not None:
+            return None
+        err = _compile(_CH_SRC, _CH_LIB, "channel")
+        if err is not None:
+            _ch_error = err
+            return None
+        lib = ctypes.CDLL(_CH_LIB)
+        lib.rtpu_ch_write.restype = ctypes.c_int64
+        lib.rtpu_ch_write.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p,
+            ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int64,
+        ]
+        lib.rtpu_ch_wait_read.restype = ctypes.c_int64
+        lib.rtpu_ch_wait_read.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_int64,
+        ]
+        lib.rtpu_ch_ack.restype = None
+        lib.rtpu_ch_ack.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64]
+        _ch_lib = lib
+        return _ch_lib
+
+
+def channel_build_error() -> Optional[str]:
+    return _ch_error
 
 
 class Arena:

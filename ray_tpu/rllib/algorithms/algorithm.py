@@ -61,6 +61,14 @@ class Algorithm:
             module=self.module,
             rollout_len=rollout_len,
             env_kwargs=cfg.env_config,
+            env_to_module=(
+                cfg.env_to_module_connector()
+                if cfg.env_to_module_connector is not None else None
+            ),
+            module_to_env=(
+                cfg.module_to_env_connector()
+                if cfg.module_to_env_connector is not None else None
+            ),
         )
         if cfg.num_env_runners > 0:
             import ray_tpu
@@ -82,6 +90,14 @@ class Algorithm:
 
         hidden = tuple(self.config.model.get("hidden", (64, 64)))
         obs_dim = int(np.prod(self.observation_space.shape))
+        if self.config.env_to_module_connector is not None:
+            # The module sees CONNECTOR-transformed observations — size its
+            # input from a transformed probe batch, not the raw space.
+            probe_env = make_env(self.config.env, 1, **self.config.env_config)
+            probe_obs, _ = probe_env.reset(seed=0)
+            probe_env.close()
+            out = self.config.env_to_module_connector()(probe_obs)
+            obs_dim = int(np.prod(np.asarray(out).shape[1:]))
         if isinstance(self.action_space, Discrete):
             return DiscretePolicyModule(obs_dim, self.action_space.n, hidden)
         if isinstance(self.action_space, Box):

@@ -22,6 +22,11 @@ class AlgorithmConfig:
         self.num_env_runners: int = 0  # 0 => sample in the driver process
         self.num_envs_per_env_runner: int = 8
         self.rollout_fragment_length: Optional[int] = None  # derived if None
+        # Connector factories (reference: `rllib/connectors/`): zero-arg
+        # callables returning a Connector/ConnectorPipeline; factories (not
+        # instances) because every runner actor needs its own state.
+        self.env_to_module_connector = None
+        self.module_to_env_connector = None
         # training (common)
         self.gamma: float = 0.99
         self.lr: float = 3e-4
@@ -59,6 +64,10 @@ class AlgorithmConfig:
             self.num_envs_per_env_runner = num_envs_per_env_runner
         if rollout_fragment_length is not None:
             self.rollout_fragment_length = rollout_fragment_length
+        if "env_to_module_connector" in _compat:
+            self.env_to_module_connector = _compat.pop("env_to_module_connector")
+        if "module_to_env_connector" in _compat:
+            self.module_to_env_connector = _compat.pop("module_to_env_connector")
         return self
 
     # reference old-stack alias

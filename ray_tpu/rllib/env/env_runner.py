@@ -29,9 +29,17 @@ class EnvRunner:
         rollout_len: int = 128,
         seed: Optional[int] = None,
         env_kwargs: Optional[dict] = None,
+        env_to_module=None,
+        module_to_env=None,
     ):
         self._env_name = env_name
         self._env_kwargs = dict(env_kwargs or {})
+        # Connector pipelines (reference: `rllib/connectors/`): observation
+        # transforms before the policy forward, action transforms before
+        # env.step. The LEARNER sees connector-transformed obs — policy and
+        # training views must match.
+        self._env_to_module = env_to_module
+        self._module_to_env = module_to_env
         self.env = make_env(env_name, num_envs, **self._env_kwargs)
         # The env may round the slot count (e.g. multi-agent instances ×
         # agents) — its own num_envs is authoritative for buffer shapes.
@@ -41,6 +49,15 @@ class EnvRunner:
         self._discrete = isinstance(self.env.action_space, Discrete)
         self._rng = jax.random.PRNGKey(seed if seed is not None else np.random.randint(2**31))
         self._obs, _ = self.env.reset(seed=seed)
+        # Invariant: self._mobs is the policy-view (connector-transformed)
+        # of self._obs, computed EXACTLY ONCE per raw observation — stateful
+        # connectors (running normalization) must not double-count batches,
+        # and the GAE bootstrap view must equal the next fragment's obs[0].
+        self._mobs = (
+            self._obs if self._env_to_module is None
+            else np.asarray(self._env_to_module(self._obs))
+        )
+        self._mobs_shape = tuple(np.asarray(self._mobs).shape[1:])
 
         mod = self.module
 
@@ -70,7 +87,7 @@ class EnvRunner:
         # on every jit call otherwise (~5ms × n_leaves per env step).
         params = jax.device_put(params)
         T, N = self.rollout_len, self.num_envs
-        obs_buf = np.empty((T, N) + tuple(self.env.observation_space.shape), np.float32)
+        obs_buf = np.empty((T, N) + self._mobs_shape, np.float32)
         act_dtype = np.int32 if self._discrete else np.float32
         act_shape = (T, N) if self._discrete else (T, N) + tuple(self.env.action_space.shape)
         act_buf = np.empty(act_shape, act_dtype)
@@ -80,21 +97,29 @@ class EnvRunner:
         done_buf = np.empty((T, N), np.float32)
 
         ep_returns, ep_lengths = [], []
-        obs = self._obs
+        obs, mobs = self._obs, self._mobs
         for t in range(T):
             self._rng, key = jax.random.split(self._rng)
-            action, logp, value = self._act(params, obs, key)
+            action, logp, value = self._act(params, mobs, key)
             action_np = np.asarray(action)
-            obs_buf[t] = obs
+            obs_buf[t] = mobs
             act_buf[t] = action_np
             logp_buf[t] = np.asarray(logp)
             val_buf[t] = np.asarray(value)
-            obs, rew, term, trunc, info = self.env.step(action_np)
+            env_action = (
+                action_np if self._module_to_env is None
+                else self._module_to_env(action_np)
+            )
+            obs, rew, term, trunc, info = self.env.step(env_action)
+            mobs = (
+                obs if self._env_to_module is None
+                else np.asarray(self._env_to_module(obs))
+            )
             rew_buf[t] = rew
             done_buf[t] = (term | trunc).astype(np.float32)
             ep_returns.extend(info.get("episode_returns", []))
             ep_lengths.extend(info.get("episode_lengths", []))
-        self._obs = obs
+        self._obs, self._mobs = obs, mobs
 
         return {
             "obs": obs_buf,
@@ -103,7 +128,7 @@ class EnvRunner:
             "values": val_buf,
             "rewards": rew_buf,
             "dones": done_buf,
-            "last_obs": obs.copy(),
+            "last_obs": np.asarray(mobs).copy(),
             "episode_returns": np.asarray(ep_returns, np.float64),
             "episode_lengths": np.asarray(ep_lengths, np.int64),
         }
@@ -118,8 +143,12 @@ class EnvRunner:
         guard = 0
         while len(returns) < num_episodes and guard < 100_000:
             guard += 1
-            action, _ = self._act_greedy(params, obs)
-            obs, rew, term, trunc, info = env.step(np.asarray(action))
+            mobs = obs if self._env_to_module is None else self._env_to_module(obs)
+            action, _ = self._act_greedy(params, mobs)
+            action_np = np.asarray(action)
+            if self._module_to_env is not None:
+                action_np = self._module_to_env(action_np)
+            obs, rew, term, trunc, info = env.step(action_np)
             returns.extend(info.get("episode_returns", []))
         return {
             "episode_reward_mean": float(np.mean(returns[:num_episodes])) if returns else float("nan"),

@@ -176,18 +176,26 @@ def run_config(config: dict) -> Dict[str, DeploymentHandle]:
                 "(expected `deployment.bind(...)` or a zero-arg builder)"
             )
         overrides = {d["name"]: d for d in app_cfg.get("deployments", [])}
-        for node in app._flatten():
-            o = overrides.get(node.deployment.name)
-            if o:
-                node.deployment = node.deployment.options(
-                    **{k: v for k, v in o.items() if k != "name"}
-                )
-        name = app_cfg.get("name", "default")
-        handles[name] = run(
-            app,
-            name=name,
-            route_prefix=app_cfg.get("route_prefix", "/"),
-        )
+        # Apply overrides to the (module-cached) graph, deploy, then RESTORE:
+        # a later run_config without the override must see the code defaults,
+        # not this config's leftovers.
+        originals = [(node, node.deployment) for node in app._flatten()]
+        try:
+            for node, dep in originals:
+                o = overrides.get(dep.name)
+                if o:
+                    node.deployment = dep.options(
+                        **{k: v for k, v in o.items() if k != "name"}
+                    )
+            name = app_cfg.get("name", "default")
+            handles[name] = run(
+                app,
+                name=name,
+                route_prefix=app_cfg.get("route_prefix", "/"),
+            )
+        finally:
+            for node, dep in originals:
+                node.deployment = dep
     return handles
 
 

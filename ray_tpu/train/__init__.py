@@ -26,6 +26,8 @@ from .result import Result
 from .base_trainer import BaseTrainer
 from .data_parallel_trainer import DataParallelTrainer
 from .jax_trainer import JaxTrainer
+from . import torch_trainer as torch  # ray_tpu.train.torch.prepare_model(...)
+from .torch_trainer import TorchTrainer
 
 __all__ = [
     "report",
@@ -42,4 +44,6 @@ __all__ = [
     "BaseTrainer",
     "DataParallelTrainer",
     "JaxTrainer",
+    "TorchTrainer",
+    "torch",
 ]

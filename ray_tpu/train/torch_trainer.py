@@ -1,0 +1,132 @@
+"""TorchTrainer — CPU-torch data-parallel training on the cluster.
+
+Reference analog: `python/ray/train/torch/` (`TorchTrainer`,
+`_TorchBackend.on_start` → `dist.init_process_group` in
+`torch/config.py:106,148`, and `prepare_model`/`prepare_data_loader` in
+`train_loop_utils.py:74,369`).
+
+Role here: parity for torch-based workloads on CPU fleets (this framework's
+accelerator path is JAX/TPU — see `jax_trainer.py`; torch on TPU is a
+non-goal). The gang wires a gloo process group exactly like the reference's
+CPU path; `prepare_model` wraps DDP, `prepare_data_loader` injects a
+DistributedSampler.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+from .config import RunConfig, ScalingConfig
+from .data_parallel_trainer import CollectiveBackend, DataParallelTrainer
+
+
+class TorchBackend(CollectiveBackend):
+    """Arranges MASTER_ADDR/PORT/RANK/WORLD_SIZE across the gang; workers
+    call `ray_tpu.train.torch.prepare()` (or init_process_group directly)."""
+
+    def on_start(self, worker_group, scaling):
+        super().on_start(worker_group, scaling)
+        n = len(worker_group)
+        from .jax_trainer import _coordinator_binding
+
+        ip, port = worker_group.execute_single(0, _coordinator_binding)
+        envs = [
+            {
+                "MASTER_ADDR": ip,
+                "MASTER_PORT": str(port),
+                "RANK": str(i),
+                "WORLD_SIZE": str(n),
+                "LOCAL_RANK": "0",
+            }
+            for i in range(n)
+        ]
+        worker_group.set_env_all(envs)
+
+
+class TorchTrainer(DataParallelTrainer):
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[dict] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[Dict] = None,
+        resume_from_checkpoint=None,
+    ):
+        super().__init__(
+            train_loop_per_worker,
+            train_loop_config=train_loop_config,
+            scaling_config=scaling_config,
+            run_config=run_config,
+            datasets=datasets,
+            backend=TorchBackend(),
+            resume_from_checkpoint=resume_from_checkpoint,
+        )
+
+
+# ------------------------------------------------------- in-loop utilities
+def prepare():
+    """Initialize the gloo process group from the gang env (call once at the
+    top of train_loop_per_worker). Reference analog: automatic
+    `dist.init_process_group` in `_TorchBackend.on_start`."""
+    import os
+
+    import torch.distributed as dist
+
+    if dist.is_initialized():
+        return
+    world = int(os.environ.get("WORLD_SIZE", "1"))
+    if world <= 1:
+        return
+    dist.init_process_group(
+        backend="gloo",
+        rank=int(os.environ["RANK"]),
+        world_size=world,
+    )
+
+
+def prepare_model(model):
+    """Wrap in DDP when distributed (reference: `prepare_model`,
+    `train_loop_utils.py:74` — CPU/gloo path, no device move)."""
+    import torch.distributed as dist
+
+    prepare()
+    if not dist.is_initialized() or dist.get_world_size() <= 1:
+        return model
+    from torch.nn.parallel import DistributedDataParallel
+
+    return DistributedDataParallel(model)
+
+
+def prepare_data_loader(data_loader):
+    """Re-build the DataLoader with a DistributedSampler so each worker sees
+    its shard (reference: `prepare_data_loader`, `train_loop_utils.py:369`)."""
+    import torch.distributed as dist
+    from torch.utils.data import DataLoader
+    from torch.utils.data.distributed import DistributedSampler
+
+    prepare()
+    if not dist.is_initialized() or dist.get_world_size() <= 1:
+        return data_loader
+    sampler = DistributedSampler(
+        data_loader.dataset,
+        num_replicas=dist.get_world_size(),
+        rank=dist.get_rank(),
+        shuffle=True,
+    )
+    return DataLoader(
+        data_loader.dataset,
+        batch_size=data_loader.batch_size,
+        sampler=sampler,
+        num_workers=0,
+        collate_fn=data_loader.collate_fn,
+        drop_last=data_loader.drop_last,
+    )
+
+
+def get_device():
+    """Reference-API parity; the torch path here is CPU-only."""
+    import torch
+
+    return torch.device("cpu")

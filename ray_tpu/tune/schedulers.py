@@ -86,10 +86,16 @@ ASHAScheduler = AsyncHyperBandScheduler
 
 
 class HyperBandScheduler(TrialScheduler):
-    """Synchronous HyperBand (reference: `schedulers/hyperband.py`): trials
-    are assigned round-robin to brackets with different (initial budget,
-    halving count) trade-offs; within a bracket, each halving keeps the top
-    1/eta fraction once ALL its members reported the milestone."""
+    """Synchronous HyperBand (reference: `schedulers/hyperband.py`).
+
+    Brackets trade initial budget against halving count; bracket k starts at
+    budget max_t * eta^-k with capacity n_k = ceil((s_max+1)/(k+1)) * eta^k.
+    Trials fill the MOST aggressive bracket (largest k: cheapest budget,
+    most halvings) first — canonical HyperBand order. A rung resolves when
+    its full population reported it: the bracket's capacity once the bracket
+    is full, or its actual membership once the tuner signals no more trials
+    are coming (`on_no_more_trials`) — partial runs still prune instead of
+    degrading to random search."""
 
     def __init__(
         self,
@@ -101,9 +107,6 @@ class HyperBandScheduler(TrialScheduler):
         self.max_t = max_t
         self.eta = reduction_factor
         s_max = int(math.log(max_t) / math.log(reduction_factor))
-        # Bracket k starts trials at budget max_t * eta^-k and halves k times;
-        # its CAPACITY follows standard HyperBand sizing (more halvings →
-        # more, cheaper trials): n_k = ceil((s_max+1)/(k+1)) * eta^k.
         self._bracket_budgets = [
             int(max_t * self.eta ** -k) or 1 for k in range(s_max + 1)
         ]
@@ -111,27 +114,70 @@ class HyperBandScheduler(TrialScheduler):
             math.ceil((s_max + 1) / (k + 1)) * int(self.eta ** k)
             for k in range(s_max + 1)
         ]
+        self._fill_order = list(range(s_max, -1, -1))  # aggressive first
         self._assign: Dict[Any, int] = {}  # trial_id -> bracket
+        self._counts: Dict[int, int] = defaultdict(int)
+        self._exhausted = False
         # bracket -> milestone -> {trial_id: score}
         self._rungs: Dict[int, Dict[int, Dict[Any, float]]] = defaultdict(
             lambda: defaultdict(dict)
         )
         self._stopped: set = set()
+        self._done: set = set()  # completed/errored — will never report again
 
     def on_trial_add(self, trial):
-        """Brackets fill SEQUENTIALLY to their capacity at trial creation.
-        A rung only resolves once `capacity` trials reported it, so lazy
-        trial creation (bounded tuner concurrency) cannot fire a rung on a
-        partial population — trials beyond the total capacity wrap around."""
-        if trial.trial_id not in self._assign:
-            n = len(self._assign)
-            total = sum(self._bracket_capacity)
-            n %= total
-            for k, cap in enumerate(self._bracket_capacity):
-                if n < cap:
-                    self._assign[trial.trial_id] = k
-                    return
-                n -= cap
+        if trial.trial_id in self._assign:
+            return
+        for k in self._fill_order:
+            if self._counts[k] < self._bracket_capacity[k]:
+                self._assign[trial.trial_id] = k
+                self._counts[k] += 1
+                return
+        # All brackets full: start a new cycle in the most aggressive one
+        # (extra entrants join its later rungs; capacities still gate
+        # resolution, so over-full rungs resolve at capacity).
+        k = self._fill_order[0]
+        self._assign[trial.trial_id] = k
+        self._counts[k] += 1
+
+    def on_no_more_trials(self):
+        """The searcher is exhausted: brackets are as full as they will ever
+        get — resolve any rung whose whole current membership has reported."""
+        self._exhausted = True
+        for bracket in list(self._rungs):
+            for milestone in list(self._rungs[bracket]):
+                self._maybe_resolve(bracket, milestone)
+
+    def _population(self, bracket: int) -> Optional[int]:
+        cap = self._bracket_capacity[bracket]
+        assigned = self._counts[bracket]
+        if assigned >= cap:
+            return cap
+        if self._exhausted:
+            return max(1, assigned)
+        return None  # still filling — wait
+
+    def _maybe_resolve(self, bracket: int, milestone: int):
+        rung = self._rungs[bracket][milestone]
+        population = self._population(bracket)
+        if population is None:
+            return
+        # Members that completed/were stopped WITHOUT reporting this rung can
+        # never fill it — don't wait for them.
+        absent = sum(
+            1
+            for tid, b in self._assign.items()
+            if b == bracket
+            and tid not in rung
+            and (tid in self._done or tid in self._stopped)
+        )
+        if len(rung) < max(1, population - absent):
+            return
+        live = {tid: sc for tid, sc in rung.items() if tid not in self._stopped}
+        keep = max(1, int(len(rung) / self.eta))
+        ranked = sorted(live, key=live.get, reverse=True)
+        for tid in ranked[keep:]:
+            self._stopped.add(tid)
 
     def _bracket_of(self, trial) -> int:
         self.on_trial_add(trial)  # direct-driven schedulers (tests) lack add
@@ -155,7 +201,6 @@ class HyperBandScheduler(TrialScheduler):
         if t >= self.max_t:
             return STOP
         bracket = self._bracket_of(trial)
-        population = self._bracket_capacity[bracket]
         # `t >= milestone`, recorded once per (trial, rung): reporting
         # cadences that step past the exact milestone still register.
         for milestone in self._milestones(bracket):
@@ -163,18 +208,21 @@ class HyperBandScheduler(TrialScheduler):
                 rung = self._rungs[bracket][milestone]
                 if trial.trial_id not in rung:
                     rung[trial.trial_id] = score
-                else:
-                    rung[trial.trial_id] = max(rung[trial.trial_id], score)
-                    continue
-                # Synchronous: decide only when the whole bracket reported.
-                if len(rung) >= population:
-                    keep = max(1, int(len(rung) / self.eta))
-                    ranked = sorted(rung, key=rung.get, reverse=True)
-                    for tid in ranked[keep:]:
-                        self._stopped.add(tid)
+                    self._maybe_resolve(bracket, milestone)
                     if trial.trial_id in self._stopped:
                         return STOP
+                else:
+                    rung[trial.trial_id] = max(rung[trial.trial_id], score)
         return CONTINUE
+
+    def on_trial_complete(self, trial, result):
+        # A finished/errored trial can no longer report later rungs — mark it
+        # absent so survivors' rungs still resolve without it.
+        self._done.add(trial.trial_id)
+        bracket = self._assign.get(trial.trial_id)
+        if bracket is not None:
+            for milestone in self._milestones(bracket):
+                self._maybe_resolve(bracket, milestone)
 
 
 class MedianStoppingRule(TrialScheduler):

@@ -82,6 +82,10 @@ class TuneController:
         config = self.searcher.suggest(trial_id)
         if config is None:
             self._exhausted = True
+            # Synchronous schedulers (HyperBand) resolve partially-filled
+            # brackets once they know no more trials are coming.
+            if hasattr(self.scheduler, "on_no_more_trials"):
+                self.scheduler.on_no_more_trials()
             return None
         trial = Trial(trial_id, config)
         self.trials.append(trial)

@@ -53,7 +53,7 @@ def test_bc_requires_offline_data():
         BCConfig().environment("CartPole-v1").build()
 
 
-def test_hyperband_bracket_capacities():
+def test_hyperband_bracket_capacities_and_fill_order():
     from ray_tpu.tune.schedulers import HyperBandScheduler
 
     # max_t=9, eta=3 → s_max=2; budgets [9, 3, 1];
@@ -66,13 +66,14 @@ def test_hyperband_bracket_capacities():
         def __init__(self, tid):
             self.trial_id = tid
 
-    # Sequential fill: first 3 → bracket 0, next 6 → bracket 1, next → 2.
-    trials = [T(f"t{i}") for i in range(10)]
+    # Canonical fill: MOST aggressive bracket first — 9 → bracket 2
+    # (budget 1), next 6 → bracket 1, last 3 → bracket 0.
+    trials = [T(f"t{i}") for i in range(18)]
     for t in trials:
         sched.on_trial_add(t)
-    assert [sched._assign[t.trial_id] for t in trials[:3]] == [0, 0, 0]
-    assert [sched._assign[t.trial_id] for t in trials[3:9]] == [1] * 6
-    assert sched._assign[trials[9].trial_id] == 2  # wraps into bracket 2
+    assert [sched._assign[t.trial_id] for t in trials[:9]] == [2] * 9
+    assert [sched._assign[t.trial_id] for t in trials[9:15]] == [1] * 6
+    assert [sched._assign[t.trial_id] for t in trials[15:]] == [0] * 3
 
 
 def test_hyperband_synchronous_halving_waits_for_full_rung():
@@ -84,30 +85,80 @@ def test_hyperband_synchronous_halving_waits_for_full_rung():
 
     sched = HyperBandScheduler(max_t=9, reduction_factor=3)
     sched.set_objective("score", "max")
-    # Fill bracket 0 (capacity 3) then land all of bracket 1's 6 trials.
-    b0 = [T(f"a{i}") for i in range(3)]
-    b1 = [T(f"b{i}") for i in range(6)]
-    for t in b0 + b1:
+    # First 9 trials land in bracket 2 (budget 1, milestones 1 and 3).
+    b2 = [T(f"b{i}") for i in range(9)]
+    for t in b2:
         sched.on_trial_add(t)
-    # Bracket 1 milestone is 3. The first five reporters must NOT be judged —
-    # the rung resolves only when all 6 reported (no partial-population fire).
-    for i, t in enumerate(b1[:5]):
+    # Milestone 1: the first eight reporters must NOT be judged — the rung
+    # resolves only when all 9 reported (no partial-population fire).
+    for i, t in enumerate(b2[:8]):
         assert sched.on_trial_result(
-            t, {"training_iteration": 3, "score": float(i)}
+            t, {"training_iteration": 1, "score": float(i)}
         ) == CONTINUE
-    # Sixth report resolves the rung: keep top 6/3=2 (scores 4,5 → b1[4], and
-    # the reporter with score 5). The reporter itself has the best score.
+    # Ninth report resolves the rung: keep top 9/3=3 (scores 6, 7, 8).
     assert sched.on_trial_result(
-        b1[5], {"training_iteration": 3, "score": 5.0}
+        b2[8], {"training_iteration": 1, "score": 8.0}
     ) == CONTINUE
-    # Everyone below the kept set is now stopped at their next report.
     assert sched.on_trial_result(
-        b1[0], {"training_iteration": 4, "score": 0.0}
+        b2[0], {"training_iteration": 2, "score": 0.0}
     ) == STOP
     assert sched.on_trial_result(
-        b1[4], {"training_iteration": 4, "score": 4.0}
+        b2[7], {"training_iteration": 2, "score": 7.0}
     ) == CONTINUE
     # max_t stops unconditionally.
     assert sched.on_trial_result(
-        b0[0], {"training_iteration": 9, "score": 99.0}
+        b2[7], {"training_iteration": 9, "score": 99.0}
+    ) == STOP
+
+
+def test_hyperband_partial_bracket_resolves_on_exhaustion():
+    """num_samples below bracket capacity must still prune once the tuner
+    signals no more trials (the regression: silent no-op scheduling)."""
+    from ray_tpu.tune.schedulers import CONTINUE, STOP, HyperBandScheduler
+
+    class T:
+        def __init__(self, tid):
+            self.trial_id = tid
+
+    sched = HyperBandScheduler(max_t=9, reduction_factor=3)
+    sched.set_objective("score", "max")
+    trials = [T(f"t{i}") for i in range(4)]  # bracket 2 capacity is 9
+    for t in trials:
+        sched.on_trial_add(t)
+    for i, t in enumerate(trials):
+        assert sched.on_trial_result(
+            t, {"training_iteration": 1, "score": float(i)}
+        ) == CONTINUE  # bracket still filling — no decisions yet
+    sched.on_no_more_trials()  # searcher exhausted → rung resolves at 4
+    # keep max(1, 4//3) = 1 → only the best survives.
+    assert sched.on_trial_result(
+        trials[0], {"training_iteration": 2, "score": 0.0}
+    ) == STOP
+    assert sched.on_trial_result(
+        trials[3], {"training_iteration": 2, "score": 3.0}
+    ) == CONTINUE
+
+
+def test_hyperband_completed_trial_does_not_wedge_rung():
+    from ray_tpu.tune.schedulers import CONTINUE, STOP, HyperBandScheduler
+
+    class T:
+        def __init__(self, tid):
+            self.trial_id = tid
+
+    sched = HyperBandScheduler(max_t=9, reduction_factor=3)
+    sched.set_objective("score", "max")
+    b2 = [T(f"x{i}") for i in range(9)]
+    for t in b2:
+        sched.on_trial_add(t)
+    # One member completes before ever reporting milestone 1.
+    sched.on_trial_complete(b2[0], {})
+    for i, t in enumerate(b2[1:8], start=1):
+        assert sched.on_trial_result(
+            t, {"training_iteration": 1, "score": float(i)}
+        ) == CONTINUE
+    # 8th live reporter fills the effective population (9 - 1 absent).
+    sched.on_trial_result(b2[8], {"training_iteration": 1, "score": 8.0})
+    assert sched.on_trial_result(
+        b2[1], {"training_iteration": 2, "score": 1.0}
     ) == STOP

@@ -1,0 +1,89 @@
+"""TorchTrainer tests — gloo gang + DDP on CPU workers.
+
+Reference analog: `python/ray/train/tests/test_torch_trainer.py` (the
+CPU/gloo path; GPU/NCCL is a non-goal — the accelerator path is JAX/TPU).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.train import RunConfig, ScalingConfig, TorchTrainer
+
+pytestmark = pytest.mark.cluster
+
+
+@pytest.fixture
+def runtime(tmp_path):
+    ray_tpu.init(num_cpus=4)
+    yield str(tmp_path)
+    ray_tpu.shutdown()
+
+
+def test_torch_trainer_ddp_converges(runtime):
+    """2-worker DDP on a toy regression: gradients sync over gloo, both
+    workers see the same (averaged) loss trajectory, loss decreases."""
+
+    def train_loop(config):
+        import os
+
+        import torch
+        import torch.nn as nn
+        from ray_tpu import train
+        from ray_tpu.train import torch as tt
+
+        tt.prepare()
+        torch.manual_seed(0)  # identical init on every worker
+        model = tt.prepare_model(nn.Linear(4, 1))
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        loss_fn = nn.MSELoss()
+
+        rank = int(os.environ.get("RANK", "0"))
+        g = torch.Generator().manual_seed(100 + rank)
+        X = torch.randn(64, 4, generator=g)
+        w_true = torch.tensor([[1.0, -2.0, 3.0, 0.5]]).T
+        y = X @ w_true
+
+        first = last = None
+        for _ in range(config["epochs"]):
+            opt.zero_grad()
+            loss = loss_fn(model(X), y)
+            loss.backward()  # DDP allreduces grads here
+            opt.step()
+            if first is None:
+                first = float(loss)
+            last = float(loss)
+        train.report({"first_loss": first, "last_loss": last, "rank": rank})
+
+    trainer = TorchTrainer(
+        train_loop,
+        train_loop_config={"epochs": 30},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="torch_ddp"),
+    )
+    result = trainer.fit()
+    assert result.metrics["last_loss"] < result.metrics["first_loss"] * 0.2
+
+
+def test_prepare_data_loader_shards(runtime):
+    def train_loop(config):
+        import torch
+        from torch.utils.data import DataLoader, TensorDataset
+        from ray_tpu import train
+        from ray_tpu.train import torch as tt
+
+        tt.prepare()
+        ds = TensorDataset(torch.arange(32).float())
+        loader = tt.prepare_data_loader(DataLoader(ds, batch_size=4))
+        seen = sum(len(b[0]) for b in loader)
+        train.report({"seen": seen})
+
+    trainer = TorchTrainer(
+        train_loop,
+        train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="torch_shard"),
+    )
+    result = trainer.fit()
+    # DistributedSampler splits 32 rows over 2 workers → 16 each.
+    assert result.metrics["seen"] == 16
