@@ -1,0 +1,142 @@
+// Thread/race stress harness for the native components, built for TSAN.
+//
+// Reference analog: the `build:tsan` bazel config (`.bazelrc:103-110`) that
+// runs the C++ core's tests under ThreadSanitizer — the one place data races
+// in process-shared structures actually matter here is arena.cpp's allocator
+// + channel.cpp's seqlock.
+//
+// Build & run (scripts/tsan_native.sh):
+//   g++ -fsanitize=thread -O1 -g -std=c++17 native_stress_test.cpp \
+//       arena.cpp channel.cpp -lpthread -lrt -o /tmp/native_tsan && /tmp/native_tsan
+//
+// Exit code 0 + no TSAN report = pass.
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+// ---- arena C API (arena.cpp) ----
+extern "C" {
+void* rt_arena_create(const char* name, uint64_t capacity, uint64_t flags);
+void* rt_arena_attach(const char* name);
+int64_t rt_arena_alloc(void* h, const char* id, uint64_t size);
+int rt_arena_seal(void* h, const char* id);
+int64_t rt_arena_get(void* h, const char* id, uint64_t* size_out);
+int rt_arena_release(void* h, const char* id);
+int rt_arena_delete(void* h, const char* id);
+int rt_arena_detach(void* h);
+int rt_arena_unlink(const char* name);
+
+int64_t rtpu_ch_write(uint8_t* base, uint64_t num_readers, const uint8_t* data,
+                      uint64_t len, uint64_t flag, int64_t timeout_us);
+int64_t rtpu_ch_wait_read(uint8_t* base, uint64_t last_seq, uint64_t* out_len,
+                          uint64_t* out_flag, int64_t timeout_us);
+void rtpu_ch_ack(uint8_t* base, uint64_t reader_slot_idx, uint64_t seq);
+}
+
+static std::atomic<int> failures{0};
+
+#define CHECK(cond, msg)                                   \
+    do {                                                   \
+        if (!(cond)) {                                     \
+            std::fprintf(stderr, "FAIL: %s\n", msg);       \
+            failures.fetch_add(1);                         \
+        }                                                  \
+    } while (0)
+
+// ------------------------------------------------------------------ arena
+static void arena_stress() {
+    const char* NAME = "tsan-arena-test";
+    rt_arena_unlink(NAME);
+    void* h = rt_arena_create(NAME, 8ull << 20, 0);
+    CHECK(h != nullptr, "arena create");
+
+    constexpr int kThreads = 8;
+    constexpr int kOps = 300;
+    std::vector<std::thread> ts;
+    for (int t = 0; t < kThreads; ++t) {
+        ts.emplace_back([&, t] {
+            // Each thread attaches its own handle — like separate processes.
+            void* hh = rt_arena_attach(NAME);
+            CHECK(hh != nullptr, "arena attach");
+            for (int i = 0; i < kOps; ++i) {
+                char id[64];
+                std::snprintf(id, sizeof id, "obj-%d-%d", t, i);
+                int64_t off = rt_arena_alloc(hh, id, 512);
+                if (off < 0) continue;  // arena full under churn — fine
+                CHECK(rt_arena_seal(hh, id) == 0, "seal");
+                uint64_t size = 0;
+                CHECK(rt_arena_get(hh, id, &size) >= 0 && size == 512, "get");
+                rt_arena_release(hh, id);
+                if (i % 3 == 0) rt_arena_delete(hh, id);
+            }
+            rt_arena_detach(hh);
+        });
+    }
+    for (auto& th : ts) th.join();
+    rt_arena_detach(h);
+    rt_arena_unlink(NAME);
+}
+
+// ---------------------------------------------------------------- channel
+static void channel_stress() {
+    constexpr uint64_t kReaders = 3;
+    constexpr uint64_t kHeader = 24 + 8 * kReaders;
+    constexpr uint64_t kCap = kHeader + 4096;
+    constexpr int kMsgs = 5000;
+    std::vector<uint8_t> buf(kCap, 0);
+    uint8_t* base = buf.data();
+
+    std::vector<std::thread> readers;
+    for (uint64_t r = 0; r < kReaders; ++r) {
+        readers.emplace_back([&, r] {
+            uint64_t last = 0;
+            for (;;) {
+                uint64_t len = 0, flag = 0;
+                int64_t rc = rtpu_ch_wait_read(base, last, &len, &flag,
+                                               10 * 1000 * 1000);
+                CHECK(rc == 0, "reader timeout");
+                if (rc != 0) return;
+                ++last;
+                if (flag == 1) {  // stop sentinel
+                    rtpu_ch_ack(base, r, last);
+                    return;
+                }
+                // Payload integrity: all bytes must equal (seq & 0xff) —
+                // a torn read under a racing writer would mix values.
+                uint8_t expect = static_cast<uint8_t>(last & 0xff);
+                const uint8_t* payload = base + kHeader;
+                bool ok = len == 128;
+                for (uint64_t i = 0; ok && i < len; ++i)
+                    ok = payload[i] == expect;
+                CHECK(ok, "torn channel payload");
+                rtpu_ch_ack(base, r, last);
+            }
+        });
+    }
+
+    uint8_t msg[128];
+    for (int i = 1; i <= kMsgs; ++i) {
+        std::memset(msg, i & 0xff, sizeof msg);
+        int64_t rc = rtpu_ch_write(base, kReaders, msg, sizeof msg, 0,
+                                   10 * 1000 * 1000);
+        CHECK(rc == 0, "writer timeout");
+    }
+    rtpu_ch_write(base, kReaders, nullptr, 0, 1, 10 * 1000 * 1000);
+    for (auto& th : readers) th.join();
+}
+
+int main() {
+    arena_stress();
+    channel_stress();
+    if (failures.load() != 0) {
+        std::fprintf(stderr, "%d failures\n", failures.load());
+        return 1;
+    }
+    std::printf("native stress OK\n");
+    return 0;
+}

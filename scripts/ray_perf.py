@@ -1,0 +1,148 @@
+"""Core microbenchmark suite — task/actor throughput, put/get latency.
+
+Reference analog: `python/ray/_private/ray_perf.py:26-257` run by
+`release/microbenchmark/run_microbenchmark.py:16` — the numbers that track
+control-plane regressions release over release.
+
+Run: `python scripts/ray_perf.py [--local]` — one JSON line per benchmark:
+    {"perf_metric_name": ..., "value": ..., "unit": ...}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def timeit(name: str, fn, n: int, unit: str = "ops/s"):
+    fn()  # warmup
+    t0 = time.perf_counter()
+    fn()
+    dt = time.perf_counter() - t0
+    value = n / dt
+    print(
+        json.dumps(
+            {"perf_metric_name": name, "value": round(value, 1), "unit": unit}
+        ),
+        flush=True,
+    )
+    return value
+
+
+def main():
+    import ray_tpu
+
+    local = "--local" in sys.argv
+    ray_tpu.init(local_mode=local, num_cpus=8)
+
+    # ------------------------------------------------------------- tasks
+    @ray_tpu.remote
+    def tiny():
+        return b"ok"
+
+    N_TASKS = 1000
+
+    def task_throughput():
+        ray_tpu.get([tiny.remote() for _ in range(N_TASKS)])
+
+    timeit("tasks_per_second", task_throughput, N_TASKS)
+
+    # ------------------------------------------------------- actor calls
+    @ray_tpu.remote
+    class Pinger:
+        def ping(self):
+            return b"pong"
+
+    actor = Pinger.remote()
+    ray_tpu.get(actor.ping.remote())
+    N_CALLS = 1000
+
+    def actor_sync_calls():
+        for _ in range(N_CALLS):
+            ray_tpu.get(actor.ping.remote())
+
+    timeit("actor_calls_sync_per_second", actor_sync_calls, N_CALLS)
+
+    def actor_async_calls():
+        ray_tpu.get([actor.ping.remote() for _ in range(N_CALLS)])
+
+    timeit("actor_calls_async_per_second", actor_async_calls, N_CALLS)
+
+    # -------------------------------------------------------- put / get
+    small = b"x" * 1024
+    N_PUT = 1000
+
+    def put_small():
+        for _ in range(N_PUT):
+            ray_tpu.put(small)
+
+    timeit("put_1kib_per_second", put_small, N_PUT)
+
+    big = np.ones((1280, 1024), np.float64)  # 10 MiB
+    N_BIG = 50
+
+    def put_get_big():
+        for _ in range(N_BIG):
+            ray_tpu.get(ray_tpu.put(big))
+
+    v = timeit("put_get_10mib_roundtrips_per_second", put_get_big, N_BIG)
+    print(
+        json.dumps(
+            {
+                "perf_metric_name": "object_store_bandwidth_gib_s",
+                "value": round(v * 10 / 1024, 2),
+                "unit": "GiB/s",
+            }
+        ),
+        flush=True,
+    )
+
+    # -------------------------------------------- many args / many returns
+    refs = [ray_tpu.put(i) for i in range(1000)]
+
+    @ray_tpu.remote
+    def consume(*args):
+        return len(args)
+
+    t0 = time.perf_counter()
+    assert ray_tpu.get(consume.remote(*refs)) == 1000
+    print(
+        json.dumps(
+            {
+                "perf_metric_name": "1000_object_args_seconds",
+                "value": round(time.perf_counter() - t0, 3),
+                "unit": "s",
+            }
+        ),
+        flush=True,
+    )
+
+    @ray_tpu.remote(num_returns=500)
+    def many_returns():
+        return tuple(range(500))
+
+    t0 = time.perf_counter()
+    out = ray_tpu.get(list(many_returns.remote()))
+    assert out[-1] == 499
+    print(
+        json.dumps(
+            {
+                "perf_metric_name": "500_returns_seconds",
+                "value": round(time.perf_counter() - t0, 3),
+                "unit": "s",
+            }
+        ),
+        flush=True,
+    )
+
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

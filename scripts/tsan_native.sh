@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# ThreadSanitizer run over the native components (reference analog: the
+# `build:tsan` bazel config, `.bazelrc:103-110`). Exit 0 = no races found.
+set -euo pipefail
+cd "$(dirname "$0")/../ray_tpu/native/src"
+OUT=${TMPDIR:-/tmp}/ray_tpu_native_tsan
+g++ -fsanitize=thread -O1 -g -std=c++17 \
+    native_stress_test.cpp arena.cpp channel.cpp \
+    -lpthread -lrt -o "$OUT"
+TSAN_OPTIONS="halt_on_error=1" "$OUT"

@@ -1,0 +1,27 @@
+"""ThreadSanitizer run over the native components.
+
+Reference analog: the `build:tsan` bazel config (`.bazelrc:103-110`) gating
+the C++ core. The stress harness hammers the arena's process-shared
+allocator (8 threads, separate attached handles) and the seqlock channel
+(1 writer / 3 readers, payload integrity asserts); TSAN halts on the first
+race.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+def test_native_components_race_free():
+    script = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts",
+        "tsan_native.sh",
+    )
+    out = subprocess.run(
+        ["bash", script], capture_output=True, text=True, timeout=240
+    )
+    assert out.returncode == 0, f"TSAN failure:\n{out.stdout[-2000:]}\n{out.stderr[-4000:]}"
+    assert "native stress OK" in out.stdout
