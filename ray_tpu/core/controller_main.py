@@ -11,7 +11,21 @@ from .controller import run_controller
 
 def main():
     args = cloudpickle.loads(bytes.fromhex(os.environ["RAY_TPU_CONTROLLER_ARGS"]))
-    asyncio.run(run_controller(args))
+    profile_path = os.environ.get("RAY_TPU_CONTROLLER_PROFILE")
+    if profile_path:
+        # Control-plane profiling (dev tool): cProfile the whole event loop,
+        # dump pstats on exit. `pstats.Stats(path).sort_stats("cumulative")`.
+        import cProfile
+
+        prof = cProfile.Profile()
+        prof.enable()
+        try:
+            asyncio.run(run_controller(args))
+        finally:
+            prof.disable()
+            prof.dump_stats(profile_path)
+    else:
+        asyncio.run(run_controller(args))
 
 
 if __name__ == "__main__":
