@@ -347,16 +347,20 @@ class ClusterBackend(RuntimeBackend):
 
     # --------------------------------------------------------------- tasks
     def submit_task(self, spec: TaskSpec) -> None:
-        self._send_pipelined({"type": "submit_task", "spec": cloudpickle.dumps(spec)})
+        from .task_spec import spec_to_proto_bytes
+
+        self._send_pipelined({"type": "submit_task", "spec": spec_to_proto_bytes(spec)})
 
     def create_actor(self, spec: TaskSpec, name: str, namespace: str) -> None:
+        from .task_spec import spec_to_proto_bytes
+
         from .actor import ActorHandle
 
         handle = ActorHandle(spec.actor_id, spec.name, dict(spec.method_meta))
         resp = self._request(
             {
                 "type": "create_actor",
-                "spec": cloudpickle.dumps(spec),
+                "spec": spec_to_proto_bytes(spec),
                 "name": name,
                 "namespace": namespace or "default",
                 "handle": cloudpickle.dumps(handle),
@@ -366,7 +370,11 @@ class ClusterBackend(RuntimeBackend):
             raise ValueError(resp["error"])
 
     def submit_actor_task(self, spec: TaskSpec) -> None:
-        self._send_pipelined({"type": "submit_actor_task", "spec": cloudpickle.dumps(spec)})
+        from .task_spec import spec_to_proto_bytes
+
+        self._send_pipelined(
+            {"type": "submit_actor_task", "spec": spec_to_proto_bytes(spec)}
+        )
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool) -> None:
         self._request({"type": "kill_actor", "actor": actor_id.hex(), "no_restart": no_restart})

@@ -41,6 +41,8 @@ from . import config as rt_config
 from .rpc import Connection, read_msg
 from .ids import ObjectID
 from .task_spec import (
+    spec_from_proto_bytes,
+    spec_to_proto_bytes,
     NodeAffinitySchedulingStrategy,
     PlacementGroupSchedulingStrategy,
     SpreadSchedulingStrategy,
@@ -253,6 +255,8 @@ class Controller:
         self._max_workers = max(int(num_cpus) * rt_config.get("max_workers_per_cpu"), 8)
         self._min_workers = 2
         self._server: Optional[asyncio.base_events.Server] = None
+        self._scheduling = False
+        self._schedule_again = False
         self._shutdown_event = asyncio.Event()
         self._worker_procs: Dict[str, subprocess.Popen] = {}
 
@@ -1225,7 +1229,7 @@ class Controller:
         return total
 
     async def h_submit_task(self, conn, meta, msg):
-        spec: TaskSpec = cloudpickle.loads(msg["spec"])
+        spec: TaskSpec = spec_from_proto_bytes(msg["spec"])
         bad = self._infeasible(spec.resources)
         if bad:
             err = TaskError(
@@ -1409,14 +1413,20 @@ class Controller:
                     if ws.state == IDLE:
                         kind = "tpu" if ws.has_tpu else "cpu"
                         idx[kind].setdefault(ws.node_id, []).append(ws)
-            if need_tpu:
-                lst = idx["tpu"].get(node_id)
+            def take(lst):
+                # Validate against live state — entries can go stale if any
+                # path mutates workers outside _cache_remove_idle.
+                while lst and lst[-1].state != IDLE:
+                    lst.pop()
                 return lst[-1] if lst else None
-            lst = idx["cpu"].get(node_id)
-            if lst:
-                return lst[-1]
-            lst = idx["tpu"].get(node_id)  # fallback: TPU worker takes CPU task
-            return lst[-1] if lst else None
+
+            if need_tpu:
+                return take(idx["tpu"].get(node_id) or [])
+            got = take(idx["cpu"].get(node_id) or [])
+            if got is not None:
+                return got
+            # Fallback: TPU worker takes CPU task.
+            return take(idx["tpu"].get(node_id) or [])
         fallback = None
         for ws in self.workers.values():
             if ws.state != IDLE or ws.node_id != node_id:
@@ -1557,7 +1567,7 @@ class Controller:
         await ws.conn.send(
             {
                 "type": msg_type,
-                "spec": cloudpickle.dumps(spec),
+                "spec": spec_to_proto_bytes(spec),
                 "deps": self._deps_payload(spec, node.node_id),
             }
         )
@@ -1567,9 +1577,28 @@ class Controller:
     def _schedule(self):
         """Dispatch as many ready tasks as resources + workers allow.
 
-        Reference analog: `ClusterTaskManager::ScheduleAndDispatchTasks` (node
-        pick) + `LocalTaskManager` (worker grant), collapsed into one pass.
+        NON-REENTRANT: failure paths inside a pass (_fail_task →
+        _mark_ready) call _schedule again; a nested pass would grant workers
+        the outer pass still holds in its per-pass idle cache (double-grant).
+        Nested calls just flag a rerun.
         """
+        if self._scheduling:
+            self._schedule_again = True
+            return
+        self._scheduling = True
+        try:
+            while True:
+                self._schedule_again = False
+                self._schedule_pass()
+                if not self._schedule_again:
+                    break
+        finally:
+            self._scheduling = False
+
+    def _schedule_pass(self):
+        """One scheduling pass (reference analog:
+        `ClusterTaskManager::ScheduleAndDispatchTasks` (node pick) +
+        `LocalTaskManager` (worker grant), collapsed)."""
         # Pending PGs first: capacity freed since the last pass may fit them
         # (reference: `SchedulePendingPlacementGroups` on resource change).
         if any(not pg["ready"] for pg in self.pgs.values()):
@@ -1881,7 +1910,7 @@ class Controller:
 
     # -------------------------------------------------------------- actors
     async def h_create_actor(self, conn, meta, msg):
-        spec: TaskSpec = cloudpickle.loads(msg["spec"])
+        spec: TaskSpec = spec_from_proto_bytes(msg["spec"])
         actor_hex = spec.actor_id.hex()
         bad = self._infeasible(spec.resources)
         if bad:
@@ -1938,7 +1967,7 @@ class Controller:
         await ws.conn.send(
             {
                 "type": "execute_actor_task",
-                "spec": cloudpickle.dumps(spec),
+                "spec": spec_to_proto_bytes(spec),
                 "deps": self._deps_payload_safe(spec, ws.node_id),
             }
         )
@@ -1956,7 +1985,7 @@ class Controller:
         return locs
 
     async def h_submit_actor_task(self, conn, meta, msg):
-        spec: TaskSpec = cloudpickle.loads(msg["spec"])
+        spec: TaskSpec = spec_from_proto_bytes(msg["spec"])
         actor_hex = spec.actor_id.hex()
         astate = self.actors.get(actor_hex)
         if astate is None or astate.state == "dead":

@@ -48,10 +48,10 @@ class WorkerProcess:
         """A STATELESS worker whose controller died must not linger: normally
         the connection close triggers exit, but a SIGKILLed controller can
         leave the close undetected (observed: orphans parked in queue.get for
-        minutes, loading the machine). Reparenting to init (ppid==1) is the
-        unambiguous signal is the parent pid CHANGING (reparenting) — the
-        literal value 1 is a healthy parent in containers, where the
-        controller IS pid 1. Actor hosts are exempt — controller-FT re-adopts
+        minutes, loading the machine). The unambiguous signal is the parent
+        pid CHANGING (reparenting) — the literal value 1 is a healthy parent
+        in containers, where the controller IS pid 1. Actor hosts are exempt
+        — controller-FT re-adopts
         them after a restart, and they run their own reconnect grace logic."""
         parent0 = os.getppid()
 
@@ -370,7 +370,9 @@ class WorkerProcess:
                 if not self.io.call(self._reconnect(), timeout=40):
                     break
                 continue
-            spec: TaskSpec = cloudpickle.loads(msg["spec"])
+            from .task_spec import spec_from_proto_bytes
+
+            spec: TaskSpec = spec_from_proto_bytes(msg["spec"])
             deps = msg.get("deps", {})
             if mtype == "execute_task":
                 self._execute(spec, deps, is_actor_method=False)

@@ -10,6 +10,7 @@ from .api import (
     delete,
     get_app_handle,
     get_deployment_handle,
+    grpc_port,
     http_port,
     run,
     run_config,
@@ -41,6 +42,7 @@ __all__ = [
     "get_replica_context",
     "DeploymentHandle",
     "DeploymentResponse",
+    "grpc_port",
     "DeploymentResponseGenerator",
     "Request",
 ]

@@ -13,6 +13,7 @@ from .deployment import Application, AutoscalingConfig, Deployment
 from .handle import DeploymentHandle, Router
 
 _http_proxy = None
+_grpc_proxy = None
 
 
 def _ensure_ray():
@@ -36,9 +37,14 @@ def _get_controller(create: bool = True):
     return handle
 
 
-def start(detached: bool = True, http_options: Optional[dict] = None, **_compat):
-    """Start the Serve control plane (and HTTP proxy if http_options given)."""
-    global _http_proxy
+def start(
+    detached: bool = True,
+    http_options: Optional[dict] = None,
+    grpc_options: Optional[dict] = None,
+    **_compat,
+):
+    """Start the Serve control plane (+ HTTP / gRPC proxies if configured)."""
+    global _http_proxy, _grpc_proxy
     ray = _ensure_ray()
     _get_controller()
     if http_options and _http_proxy is None:
@@ -48,6 +54,13 @@ def start(detached: bool = True, http_options: Optional[dict] = None, **_compat)
             http_options.get("host", "127.0.0.1"), http_options.get("port", 0)
         )
         ray.get(_http_proxy.ping.remote())
+    if grpc_options and _grpc_proxy is None:
+        from .grpc_proxy import GRPCProxy
+
+        _grpc_proxy = ray.remote(GRPCProxy).remote(
+            grpc_options.get("host", "127.0.0.1"), grpc_options.get("port", 0)
+        )
+        ray.get(_grpc_proxy.ping.remote())
     return _http_proxy
 
 
@@ -56,6 +69,13 @@ def http_port() -> Optional[int]:
     if _http_proxy is None:
         return None
     return ray.get(_http_proxy.get_port.remote())
+
+
+def grpc_port() -> Optional[int]:
+    ray = _ensure_ray()
+    if _grpc_proxy is None:
+        return None
+    return ray.get(_grpc_proxy.get_port.remote())
 
 
 def run(
@@ -236,7 +256,7 @@ def get_deployment_handle(deployment_name: str, app_name: str = "default") -> De
 
 def shutdown():
     """Tear down all applications, the controller and proxies."""
-    global _http_proxy
+    global _http_proxy, _grpc_proxy
     ray = _ensure_ray()
     controller = _get_controller(create=False)
     if controller is not None:
@@ -252,5 +272,12 @@ def shutdown():
         except Exception:  # noqa: BLE001
             pass
         _http_proxy = None
+    if _grpc_proxy is not None:
+        try:
+            ray.get(_grpc_proxy.shutdown.remote())
+            ray.kill(_grpc_proxy)
+        except Exception:  # noqa: BLE001
+            pass
+        _grpc_proxy = None
     with Router._routers_lock:
         Router._routers.clear()

@@ -1,0 +1,135 @@
+"""gRPC ingress for Serve.
+
+Reference analog: Serve's gRPCProxy (`serve/_private/proxy.py:556`) over
+`serve.proto`. Contract: `ray_tpu.serve.RayTpuServe/Predict` (unary) and
+`/PredictStream` (server streaming) carrying `ServeRequest`/`ServeReply`
+(`ray_tpu/protocol/serve.proto`). Service wiring is a
+`grpc.GenericRpcHandler` — no generated service stubs needed.
+
+The deployment receives a `GRPCRequest` (payload bytes + method +
+model id); whatever it returns is packed back into `ServeReply.payload`
+(bytes passthrough, str utf-8, else JSON).
+"""
+
+from __future__ import annotations
+
+import json
+from concurrent import futures
+from typing import Optional
+
+SERVICE = "ray_tpu.serve.RayTpuServe"
+
+
+class GRPCRequest:
+    """What a deployment's method receives for gRPC traffic."""
+
+    def __init__(self, payload: bytes, method: str, multiplexed_model_id: str):
+        self.payload = payload
+        self.method = method
+        self.multiplexed_model_id = multiplexed_model_id
+
+    def json(self):
+        return json.loads(self.payload or b"null")
+
+    def text(self) -> str:
+        return (self.payload or b"").decode()
+
+
+def _as_bytes(value) -> bytes:
+    if isinstance(value, bytes):
+        return value
+    if isinstance(value, str):
+        return value.encode()
+    return json.dumps(value).encode()
+
+
+class GRPCProxy:
+    """NOTE: instantiated as a ray_tpu actor by `serve.start(grpc_options=...)`."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        import grpc
+
+        from ..protocol import serve_pb2
+
+        proxy = self
+
+        class Handler(grpc.GenericRpcHandler):
+            def service(self, handler_call_details):
+                method = handler_call_details.method
+                if method == f"/{SERVICE}/Predict":
+                    return grpc.unary_unary_rpc_method_handler(
+                        proxy._predict,
+                        request_deserializer=serve_pb2.ServeRequest.FromString,
+                        response_serializer=serve_pb2.ServeReply.SerializeToString,
+                    )
+                if method == f"/{SERVICE}/PredictStream":
+                    return grpc.unary_stream_rpc_method_handler(
+                        proxy._predict_stream,
+                        request_deserializer=serve_pb2.ServeRequest.FromString,
+                        response_serializer=serve_pb2.ServeReply.SerializeToString,
+                    )
+                return None
+
+        self._pb = serve_pb2
+        self._grpc = grpc
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=16))
+        self._server.add_generic_rpc_handlers((Handler(),))
+        self._port = self._server.add_insecure_port(f"{host}:{port}")
+        self._server.start()
+
+    def get_port(self) -> int:
+        return self._port
+
+    def ping(self) -> str:
+        return "ok"
+
+    # ------------------------------------------------------------ handlers
+    def _resolve(self, request, context):
+        import ray_tpu
+        from .controller import CONTROLLER_NAME, SERVE_NAMESPACE
+        from .handle import DeploymentHandle
+
+        controller = ray_tpu.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
+        routes = ray_tpu.get(controller.routing_snapshot.remote())
+        app = request.app
+        match = None
+        for info in routes.values():
+            if info["app"] == app or (not app and info["app"] == "default"):
+                match = info
+                break
+        if match is None:
+            context.abort(
+                self._grpc.StatusCode.NOT_FOUND,
+                f"no Serve application {app or 'default'!r}",
+            )
+        handle = DeploymentHandle(match["app"], match["ingress"])
+        req = GRPCRequest(
+            request.payload, request.method, request.multiplexed_model_id
+        )
+        if request.multiplexed_model_id:
+            handle = handle.options(
+                multiplexed_model_id=request.multiplexed_model_id
+            )
+        return handle, req, match
+
+    def _predict(self, request, context):
+        handle, req, _ = self._resolve(request, context)
+        if request.method:
+            result = getattr(handle, request.method).remote(req).result(timeout_s=60.0)
+        else:
+            result = handle.remote(req).result(timeout_s=60.0)
+        return self._pb.ServeReply(payload=_as_bytes(result))
+
+    def _predict_stream(self, request, context):
+        handle, req, match = self._resolve(request, context)
+        stream_handle = handle.options(stream=True)
+        gen = (
+            getattr(stream_handle, request.method).remote(req)
+            if request.method
+            else stream_handle.remote(req)
+        )
+        for chunk in gen:
+            yield self._pb.ServeReply(payload=_as_bytes(chunk))
+
+    def shutdown(self):
+        self._server.stop(grace=0.5)

@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# Regenerate protocol stubs (checked in — no protoc needed at runtime).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+protoc --python_out=. ray_tpu/protocol/ray_tpu.proto ray_tpu/protocol/serve.proto
