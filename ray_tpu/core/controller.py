@@ -1248,7 +1248,10 @@ class Controller:
         self._remember_lineage(spec)
         self._expect_returns(spec)
         pt = PendingTask(spec=spec, retries_left=spec.options.max_retries)
-        self._event("task_submitted", task=spec.task_id.hex(), name=spec.name)
+        self._event(
+            "task_submitted", task=spec.task_id.hex(), name=spec.name,
+            parent=spec.parent_task_id.hex() if spec.parent_task_id else None,
+        )
         self._enqueue(pt)
         self._schedule()
         return {"ok": True}

@@ -147,6 +147,7 @@ class Runtime:
             task_id=task_id,
             job_id=self.job_id,
             task_type=TaskType.NORMAL_TASK,
+            parent_task_id=self.current_task_id,
             func_payload=payload,
             arg_refs=[r.id for r in arg_refs],
             num_returns=num_returns,
@@ -202,6 +203,7 @@ class Runtime:
             task_id=task_id,
             job_id=self.job_id,
             task_type=TaskType.ACTOR_CREATION_TASK,
+            parent_task_id=self.current_task_id,
             func_payload=payload,
             arg_refs=[r.id for r in arg_refs],
             num_returns=0,
@@ -242,6 +244,7 @@ class Runtime:
             task_id=task_id,
             job_id=self.job_id,
             task_type=TaskType.ACTOR_TASK,
+            parent_task_id=self.current_task_id,
             func_payload=payload,
             arg_refs=[r.id for r in arg_refs],
             num_returns=num_returns,

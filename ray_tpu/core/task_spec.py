@@ -107,6 +107,10 @@ class TaskSpec:
     # Owner (submitter) address for result routing.
     owner_address: str = ""
     depth: int = 0
+    # Causality: the task (or driver task) that submitted this one —
+    # reference analog: `parent_task_id` in common.proto's TaskSpec; drives
+    # the tracing span tree (`ray_tpu/util/tracing.py`).
+    parent_task_id: Optional[TaskID] = None
 
 
 # ------------------------------------------------------ typed wire contract
@@ -200,7 +204,7 @@ def spec_to_proto_bytes(spec: TaskSpec) -> bytes:
         po.retry_exception_allowlist = cloudpickle.dumps(list(o.retry_exceptions))
     else:
         po.retry_exceptions = bool(o.retry_exceptions)
-    po.name = o.name
+    po.name = o.name or ""
     po.scheduling_strategy.CopyFrom(_strategy_to_proto(pb, o.scheduling_strategy))
     if o.runtime_env:
         po.runtime_env = cloudpickle.dumps(o.runtime_env)
@@ -220,6 +224,8 @@ def spec_to_proto_bytes(spec: TaskSpec) -> bytes:
     msg.attempt_number = spec.attempt_number
     msg.owner_address = spec.owner_address
     msg.depth = spec.depth
+    if spec.parent_task_id is not None:
+        msg.parent_task_id = spec.parent_task_id.binary()
     return msg.SerializeToString()
 
 
@@ -271,4 +277,5 @@ def spec_from_proto_bytes(data: bytes) -> TaskSpec:
         attempt_number=msg.attempt_number,
         owner_address=msg.owner_address,
         depth=msg.depth,
+        parent_task_id=TaskID(msg.parent_task_id) if msg.parent_task_id else None,
     )

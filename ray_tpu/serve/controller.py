@@ -139,6 +139,19 @@ class ServeController:
                 if app["route_prefix"]
             }
 
+    def app_snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """app_name -> {ingress, streaming} — name-addressed ingress lookup
+        (the gRPC proxy addresses apps by NAME; the HTTP route table is
+        keyed by prefix and drops prefix-less apps)."""
+        with self._lock:
+            return {
+                name: {
+                    "ingress": app["ingress"],
+                    "streaming": app.get("streaming", False),
+                }
+                for name, app in self._apps.items()
+            }
+
     def version(self) -> int:
         return self._version
 

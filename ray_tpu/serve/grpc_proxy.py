@@ -14,8 +14,11 @@ model id); whatever it returns is packed back into `ServeReply.payload`
 from __future__ import annotations
 
 import json
+import time
 from concurrent import futures
 from typing import Optional
+
+from ._common import response_bytes as _as_bytes
 
 SERVICE = "ray_tpu.serve.RayTpuServe"
 
@@ -33,14 +36,6 @@ class GRPCRequest:
 
     def text(self) -> str:
         return (self.payload or b"").decode()
-
-
-def _as_bytes(value) -> bytes:
-    if isinstance(value, bytes):
-        return value
-    if isinstance(value, str):
-        return value.encode()
-    return json.dumps(value).encode()
 
 
 class GRPCProxy:
@@ -84,25 +79,38 @@ class GRPCProxy:
         return "ok"
 
     # ------------------------------------------------------------ handlers
-    def _resolve(self, request, context):
+    def _apps(self):
+        """Name-addressed app map with a 1s TTL cache (same pattern as the
+        HTTP proxy's route cache — two controller RPCs per request would
+        make the controller the ingress bottleneck)."""
         import ray_tpu
         from .controller import CONTROLLER_NAME, SERVE_NAMESPACE
+
+        now = time.monotonic()
+        cached = getattr(self, "_apps_cache", None)
+        if cached is not None and now - self._apps_cached_at < 1.0:
+            return cached
+        controller = ray_tpu.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
+        self._apps_cache = ray_tpu.get(controller.app_snapshot.remote())
+        self._apps_cached_at = now
+        return self._apps_cache
+
+    def _resolve(self, request, context):
         from .handle import DeploymentHandle
 
-        controller = ray_tpu.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
-        routes = ray_tpu.get(controller.routing_snapshot.remote())
-        app = request.app
-        match = None
-        for info in routes.values():
-            if info["app"] == app or (not app and info["app"] == "default"):
-                match = info
-                break
+        apps = self._apps()
+        app = request.app or "default"
+        match = apps.get(app)
+        if match is None:
+            # One forced refresh: the app may have deployed inside the TTL.
+            self._apps_cached_at = 0.0
+            match = self._apps().get(app)
         if match is None:
             context.abort(
                 self._grpc.StatusCode.NOT_FOUND,
-                f"no Serve application {app or 'default'!r}",
+                f"no Serve application {app!r}",
             )
-        handle = DeploymentHandle(match["app"], match["ingress"])
+        handle = DeploymentHandle(app, match["ingress"])
         req = GRPCRequest(
             request.payload, request.method, request.multiplexed_model_id
         )
@@ -121,7 +129,7 @@ class GRPCProxy:
         return self._pb.ServeReply(payload=_as_bytes(result))
 
     def _predict_stream(self, request, context):
-        handle, req, match = self._resolve(request, context)
+        handle, req, _ = self._resolve(request, context)
         stream_handle = handle.options(stream=True)
         gen = (
             getattr(stream_handle, request.method).remote(req)

@@ -19,12 +19,7 @@ from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
 
-def _as_bytes(chunk) -> bytes:
-    if isinstance(chunk, bytes):
-        return chunk
-    if isinstance(chunk, str):
-        return chunk.encode()
-    return json.dumps(chunk).encode()
+from ._common import response_bytes as _as_bytes
 
 
 class Request:

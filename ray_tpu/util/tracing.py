@@ -1,0 +1,120 @@
+"""Task-causality tracing over the controller timeline.
+
+Reference analog: `python/ray/util/tracing/tracing_helper.py` (OpenTelemetry
+spans around remote calls) + the chrome-trace timeline
+(`ray.timeline()` / `GcsTaskManager`). Redesign: every TaskSpec carries
+`parent_task_id` (the submitting task), so the controller's existing
+timeline events already form a span tree — no extra exporter process. This
+module assembles it and can emit chrome-trace flow events for causality
+arrows in `chrome://tracing` / Perfetto.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+
+class Span:
+    def __init__(self, task_id: str, name: str, parent: Optional[str]):
+        self.task_id = task_id
+        self.name = name
+        self.parent = parent
+        self.submitted_at: Optional[float] = None
+        self.dispatched_at: Optional[float] = None
+        self.done_at: Optional[float] = None
+        self.children: List["Span"] = []
+
+    @property
+    def duration(self) -> Optional[float]:
+        if self.submitted_at is None or self.done_at is None:
+            return None
+        return self.done_at - self.submitted_at
+
+    def to_dict(self) -> dict:
+        return {
+            "task_id": self.task_id,
+            "name": self.name,
+            "parent": self.parent,
+            "submitted_at": self.submitted_at,
+            "dispatched_at": self.dispatched_at,
+            "done_at": self.done_at,
+            "duration": self.duration,
+            "children": [c.to_dict() for c in self.children],
+        }
+
+
+def build_trace(events: List[dict]) -> Dict[str, Span]:
+    """Assemble spans from timeline events (api.timeline()); returns
+    {task_id: Span} with parent/child links populated."""
+    spans: Dict[str, Span] = {}
+    for ev in events:
+        task = ev.get("task")
+        if not task:
+            continue
+        kind = ev.get("event")
+        if kind == "task_submitted":
+            span = spans.get(task)
+            if span is None:
+                span = spans[task] = Span(task, ev.get("name", ""), ev.get("parent"))
+            span.name = ev.get("name", span.name)
+            span.parent = ev.get("parent", span.parent)
+            span.submitted_at = ev["ts"]
+        elif kind == "task_dispatched":
+            spans.setdefault(task, Span(task, "", None)).dispatched_at = ev["ts"]
+        elif kind == "task_done":
+            spans.setdefault(task, Span(task, "", None)).done_at = ev["ts"]
+    for span in spans.values():
+        if span.parent and span.parent in spans:
+            spans[span.parent].children.append(span)
+    return spans
+
+
+def roots(spans: Dict[str, Span]) -> List[Span]:
+    """Top-level spans (submitted by the driver or an unknown parent)."""
+    return [s for s in spans.values() if not s.parent or s.parent not in spans]
+
+
+def get_task_tree() -> List[dict]:
+    """Span forest for the live session (driver-side helper)."""
+    from ..core import api
+
+    spans = build_trace(api.timeline())
+    return [s.to_dict() for s in roots(spans)]
+
+
+def chrome_trace_with_flows(events: List[dict]) -> List[dict]:
+    """Chrome-trace events + flow arrows (ph 's'/'f') along parent→child
+    submissions, viewable in chrome://tracing / Perfetto."""
+    out: List[dict] = []
+    spans = build_trace(events)
+    for span in spans.values():
+        if span.submitted_at is None:
+            continue
+        end = span.done_at or span.submitted_at
+        out.append(
+            {
+                "name": span.name or span.task_id[:8],
+                "ph": "X",
+                "ts": span.submitted_at * 1e6,
+                "dur": max(0.0, (end - span.submitted_at)) * 1e6,
+                "pid": 1,
+                "tid": abs(hash(span.task_id)) % 1000,
+                "args": {"task_id": span.task_id, "parent": span.parent},
+            }
+        )
+        if span.parent and span.parent in spans:
+            parent = spans[span.parent]
+            if parent.submitted_at is None:
+                continue
+            flow_id = abs(hash((span.parent, span.task_id))) % (1 << 31)
+            out.append(
+                {"name": "submit", "ph": "s", "id": flow_id, "pid": 1,
+                 "tid": abs(hash(span.parent)) % 1000,
+                 "ts": parent.submitted_at * 1e6, "cat": "task"},
+            )
+            out.append(
+                {"name": "submit", "ph": "f", "id": flow_id, "pid": 1,
+                 "tid": abs(hash(span.task_id)) % 1000,
+                 "ts": span.submitted_at * 1e6, "cat": "task", "bp": "e"},
+            )
+    return out

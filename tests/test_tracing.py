@@ -1,0 +1,55 @@
+"""Task-causality tracing tests.
+
+Reference analog: `python/ray/tests/test_tracing.py` (span parent/child
+links around remote calls).
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import tracing
+
+pytestmark = pytest.mark.cluster
+
+
+def test_nested_task_parentage(cluster_runtime):
+    @ray_tpu.remote
+    def child(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def parent():
+        return ray_tpu.get(child.remote(1))
+
+    assert ray_tpu.get(parent.remote()) == 2
+
+    spans = tracing.build_trace(ray_tpu.timeline())
+    by_name = {}
+    for s in spans.values():
+        by_name.setdefault(s.name, []).append(s)
+    assert "parent" in by_name and "child" in by_name
+    child_span = by_name["child"][0]
+    parent_span = by_name["parent"][0]
+    # The child's parent pointer is the submitting task.
+    assert child_span.parent == parent_span.task_id
+    assert child_span in parent_span.children
+    assert parent_span.duration is not None and parent_span.duration > 0
+
+
+def test_task_tree_and_flows(cluster_runtime):
+    @ray_tpu.remote
+    def leaf(i):
+        return i
+
+    @ray_tpu.remote
+    def fan():
+        return ray_tpu.get([leaf.remote(i) for i in range(3)])
+
+    assert ray_tpu.get(fan.remote()) == [0, 1, 2]
+    tree = tracing.get_task_tree()
+    fan_nodes = [t for t in tree if t["name"] == "fan"]
+    assert fan_nodes and len(fan_nodes[0]["children"]) == 3
+
+    flows = tracing.chrome_trace_with_flows(ray_tpu.timeline())
+    kinds = {e["ph"] for e in flows}
+    assert {"X", "s", "f"} <= kinds  # spans + causality arrows
