@@ -61,6 +61,7 @@ def launch_node_agent(
     resources: Dict[str, float],
     object_store_memory: Optional[int] = None,
     wait_ready: bool = True,
+    labels: Optional[Dict[str, str]] = None,
 ) -> subprocess.Popen:
     """Spawn one `node_agent` daemon process joining the cluster at
     `address`. Shared by the test `Cluster` fixture and the autoscaler's
@@ -73,6 +74,7 @@ def launch_node_agent(
         "resources": resources,
         "session_dir": session_dir,
         "object_store_memory": object_store_memory,
+        "labels": labels or {},
     }
     env = dict(os.environ)
     pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -184,13 +186,15 @@ class Cluster:
         resources: Optional[Dict[str, float]] = None,
         object_store_memory: Optional[int] = None,
         node_id: Optional[str] = None,
+        labels: Optional[Dict[str, str]] = None,
     ) -> NodeHandle:
         assert self.address, "head not started"
         self._node_counter += 1
         node_id = node_id or f"node{self._node_counter}"
         total = {"CPU": float(num_cpus), **(resources or {})}
         proc = launch_node_agent(
-            self.address, self.session_dir, node_id, total, object_store_memory
+            self.address, self.session_dir, node_id, total, object_store_memory,
+            labels=labels,
         )
         handle = NodeHandle(node_id=node_id, process=proc, resources=total)
         self.nodes.append(handle)

@@ -44,6 +44,7 @@ from .task_spec import (
     spec_from_proto_bytes,
     spec_to_proto_bytes,
     NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
     PlacementGroupSchedulingStrategy,
     SpreadSchedulingStrategy,
     TaskSpec,
@@ -93,6 +94,8 @@ class NodeState:
     spawning: int = 0
     spawning_tpu: int = 0
     object_store_memory: int = 0
+    # Node labels (reference: `NodeLabelSchedulingStrategy` label matching).
+    labels: Dict[str, str] = field(default_factory=dict)
     # Last time resources were acquired/released here — drives the
     # autoscaler's idle-node detection (reference: `LoadMetrics`
     # `load_metrics.py:63` last_used_time_by_ip).
@@ -745,6 +748,7 @@ class Controller:
             available=dict(total),
             session_tag=msg.get("session_tag", ""),
             object_store_memory=msg.get("object_store_memory", 0),
+            labels={k: str(v) for k, v in (msg.get("labels") or {}).items()},
         )
         self._event("node_added", node=node_id, resources=total)
         self._schedule()  # also retries pending PGs against the new capacity
@@ -1486,6 +1490,13 @@ class Controller:
             if not strat.soft:
                 return pinned
             return pinned + [n for n in alive_sorted if n.node_id != strat.node_id]
+        if isinstance(strat, NodeLabelSchedulingStrategy):
+            # Hard label constraints: only matching nodes are candidates
+            # (reference: `NodeLabelSchedulingPolicy`).
+            return [
+                n for n in alive_sorted
+                if all(n.labels.get(k) == str(v) for k, v in strat.hard.items())
+            ]
         if isinstance(strat, SpreadSchedulingStrategy):
             # True round-robin: each spread decision starts one node further
             # along, so consecutive tasks land on distinct nodes (reference:
@@ -1714,6 +1725,7 @@ class Controller:
                         type(strat).__name__,
                         getattr(strat, "node_id", None),
                         getattr(strat, "soft", None),
+                        tuple(sorted(getattr(strat, "hard", {}).items())),
                         need_tpu,
                         pt.pinned_node,
                     )
@@ -2699,6 +2711,7 @@ class Controller:
                 {
                     "NodeID": n.node_id,
                     "Alive": n.alive,
+                    "Labels": dict(n.labels),
                     "Resources": dict(n.total),
                     "Available": dict(n.available),
                     "NodeManagerAddress": "127.0.0.1",
@@ -2773,6 +2786,21 @@ class Controller:
                 "holders": len(o.holders), "pinned": o.pinned,
             })
         return {"objects": out, "total": len(self.objects)}
+
+    async def h_list_placement_groups(self, conn, meta, msg):
+        return {
+            "placement_groups": [
+                {
+                    "placement_group_id": pg_hex,
+                    "name": pg.get("name", ""),
+                    "strategy": pg["strategy"],
+                    "state": "CREATED" if pg["ready"] else "PENDING",
+                    "bundles": pg["bundles"],
+                    "bundle_nodes": pg["bundle_nodes"],
+                }
+                for pg_hex, pg in self.pgs.items()
+            ]
+        }
 
     async def h_list_workers(self, conn, meta, msg):
         return {

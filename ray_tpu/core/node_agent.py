@@ -53,12 +53,14 @@ class NodeAgent:
         resources: Dict[str, float],
         session_dir: str,
         object_store_memory: Optional[int] = None,
+        labels: Optional[Dict[str, str]] = None,
     ):
         self.node_id = node_id
         self.controller_address = controller_address
         self.resources = resources
         self.session_dir = session_dir
         self.object_store_memory = object_store_memory or (1 << 30)
+        self.labels = dict(labels or {})
         self.local_store: store.LocalStore = store.LocalStore()
         self.conn: Optional[Connection] = None
         self.fetch_port = 0
@@ -92,6 +94,7 @@ class NodeAgent:
                 "fetch_addr": f"127.0.0.1:{self.fetch_port}",
                 "session_tag": store.SESSION_TAG,
                 "object_store_memory": self.object_store_memory,
+                "labels": self.labels,
                 "pid": os.getpid(),
             },
             timeout=15,
@@ -252,6 +255,7 @@ async def run_agent(args: dict):
         resources=args.get("resources", {}),
         session_dir=args["session_dir"],
         object_store_memory=args.get("object_store_memory"),
+        labels=args.get("labels"),
     )
     await agent.start()
     print(f"RAY_TPU_NODE_READY={agent.node_id}", flush=True)

@@ -43,6 +43,15 @@ class NodeAffinitySchedulingStrategy(SchedulingStrategy):
 
 
 @dataclass
+class NodeLabelSchedulingStrategy(SchedulingStrategy):
+    """Schedule only onto nodes whose labels match `hard` exactly
+    (reference: `NodeLabelSchedulingStrategy`, `node_label_scheduling_policy.h`
+    — hard equality constraints; soft preferences are a non-goal here)."""
+
+    hard: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
 class PlacementGroupSchedulingStrategy(SchedulingStrategy):
     placement_group: Any = None
     placement_group_bundle_index: int = -1
@@ -134,6 +143,9 @@ def _strategy_to_proto(pb, strat: Optional[SchedulingStrategy]):
     elif isinstance(strat, NodeAffinitySchedulingStrategy):
         msg.node_affinity.node_id = strat.node_id
         msg.node_affinity.soft = strat.soft
+    elif isinstance(strat, NodeLabelSchedulingStrategy):
+        for k, v in strat.hard.items():
+            msg.node_labels.hard[k] = str(v)
     elif isinstance(strat, PlacementGroupSchedulingStrategy):
         pg = strat.placement_group
         pg_id = getattr(pg, "id", None)
@@ -159,6 +171,8 @@ def _strategy_from_proto(msg) -> Optional[SchedulingStrategy]:
         return NodeAffinitySchedulingStrategy(
             node_id=msg.node_affinity.node_id, soft=msg.node_affinity.soft
         )
+    if kind == "node_labels":
+        return NodeLabelSchedulingStrategy(hard=dict(msg.node_labels.hard))
     from .ids import PlacementGroupID
 
     pg_bytes = msg.placement_group.placement_group_id

@@ -1,4 +1,5 @@
 from . import metrics
+from . import state
 from .actor_pool import ActorPool
 from .queue import Empty, Full, Queue
 from .placement_group import (
@@ -10,6 +11,7 @@ from .placement_group import (
 from ..core.task_spec import (
     DefaultSchedulingStrategy,
     NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
     PlacementGroupSchedulingStrategy,
     SpreadSchedulingStrategy,
 )
@@ -18,6 +20,7 @@ __all__ = [
     "ActorPool",
     "Queue",
     "Empty",
+    "state",
     "Full",
     "PlacementGroup",
     "placement_group",
@@ -26,5 +29,6 @@ __all__ = [
     "DefaultSchedulingStrategy",
     "SpreadSchedulingStrategy",
     "NodeAffinitySchedulingStrategy",
+    "NodeLabelSchedulingStrategy",
     "PlacementGroupSchedulingStrategy",
 ]
