@@ -22,6 +22,7 @@ from .algorithms.dqn import DQN, DQNConfig
 from .algorithms.sac import SAC, SACConfig
 from .algorithms.appo import APPO, APPOConfig
 from .algorithms.bc import BC, BCConfig
+from .algorithms.marwil import MARWIL, MARWILConfig
 from . import offline
 from .env import register_env, make_env
 from .env.env_runner import EnvRunner
@@ -43,6 +44,8 @@ __all__ = [
     "APPOConfig",
     "BC",
     "BCConfig",
+    "MARWIL",
+    "MARWILConfig",
     "offline",
     "register_env",
     "make_env",

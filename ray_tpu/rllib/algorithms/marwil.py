@@ -1,0 +1,109 @@
+"""MARWIL — Monotonic Advantage Re-Weighted Imitation Learning (offline).
+
+Reference analog: `rllib/algorithms/marwil/marwil.py`. Supervised policy
+learning weighted by exponentiated advantages: the value head regresses
+Monte-Carlo returns; the policy maximizes `exp(beta * A) * log pi(a|s)` with
+A = R - V(s). `beta = 0` degenerates to BC. Same jitted minibatch-epoch
+learner shape as BC/PPO.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.learner import Learner
+from .algorithm import Algorithm
+from .bc import BC, BCConfig
+
+
+class MARWILConfig(BCConfig):
+    def __init__(self):
+        super().__init__()
+        self.beta: float = 1.0
+        self.vf_coeff: float = 1.0
+        self.advantage_clip: float = 10.0  # cap exp-weights (wild advantages)
+
+    def validate(self):
+        super().validate()
+        if self.dataset is not None and self.dataset.returns is None:
+            raise ValueError(
+                "MARWIL needs Monte-Carlo returns in the offline dataset "
+                "(collect with rllib.offline.collect_dataset or provide "
+                "OfflineDataset(..., returns=...))"
+            )
+
+
+def make_marwil_update(module, opt, cfg: MARWILConfig):
+    n_mb = cfg.train_batch_size // cfg.minibatch_size
+
+    def loss_fn(params, mb):
+        dist, value = module.forward(params, mb["obs"])
+        logp = module.log_prob(dist, mb["actions"])
+        adv = mb["returns"] - value
+        # Policy gradient must not flow into the value baseline.
+        w = jnp.exp(
+            jnp.clip(cfg.beta * lax.stop_gradient(adv), -cfg.advantage_clip,
+                     cfg.advantage_clip)
+        )
+        policy_loss = -jnp.mean(w * logp)
+        vf_loss = jnp.mean(adv**2)
+        return policy_loss + cfg.vf_coeff * vf_loss, (policy_loss, vf_loss)
+
+    def update(state, batch, rng):
+        params, opt_state = state
+
+        def epoch(carry, key):
+            params, opt_state = carry
+            perm = jax.random.permutation(key, cfg.train_batch_size)
+
+            def minibatch(carry, idx):
+                params, opt_state = carry
+                mb = {k: v[idx] for k, v in batch.items()}
+                (loss, (pl, vl)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(params, mb)
+                updates, opt_state = opt.update(grads, opt_state, params)
+                params = jax.tree_util.tree_map(
+                    lambda p, u: p + u.astype(p.dtype), params, updates
+                )
+                return (params, opt_state), (loss, pl, vl)
+
+            idxs = perm.reshape(n_mb, cfg.minibatch_size)
+            (params, opt_state), metrics = lax.scan(
+                minibatch, (params, opt_state), idxs
+            )
+            return (params, opt_state), metrics
+
+        keys = jax.random.split(rng, cfg.num_epochs)
+        (params, opt_state), (loss, pl, vl) = lax.scan(
+            epoch, (params, opt_state), keys
+        )
+        return (params, opt_state), {
+            "marwil_loss": jnp.mean(loss),
+            "policy_loss": jnp.mean(pl),
+            "vf_loss": jnp.mean(vl),
+        }
+
+    return update
+
+
+class MARWIL(BC):
+    config_class = MARWILConfig
+
+    def _make_learner(self) -> Learner:
+        from ..utils.optim import make_optimizer
+
+        cfg = self.config
+        opt = make_optimizer(cfg)
+        learner = Learner(
+            self.module, make_marwil_update(self.module, opt, cfg), seed=cfg.seed
+        )
+        learner.opt_state = opt.init(learner.params)
+        return learner
+
+
+MARWILConfig.algo_class = MARWIL

@@ -19,31 +19,50 @@ from ..env import make_env
 
 
 class OfflineDataset:
-    def __init__(self, obs: np.ndarray, actions: np.ndarray):
+    def __init__(
+        self,
+        obs: np.ndarray,
+        actions: np.ndarray,
+        returns: Optional[np.ndarray] = None,
+    ):
         if len(obs) != len(actions):
             raise ValueError("obs and actions must align")
         self.obs = np.asarray(obs, np.float32)
         self.actions = np.asarray(actions)
+        # Monte-Carlo returns per transition — required by advantage-weighted
+        # methods (MARWIL); BC ignores them.
+        self.returns = None if returns is None else np.asarray(returns, np.float32)
 
     def __len__(self) -> int:
         return len(self.obs)
 
     def sample(self, rng: np.random.Generator, n: int) -> Dict[str, np.ndarray]:
         idx = rng.integers(0, len(self.obs), size=n)
-        return {"obs": self.obs[idx], "actions": self.actions[idx]}
+        out = {"obs": self.obs[idx], "actions": self.actions[idx]}
+        if self.returns is not None:
+            out["returns"] = self.returns[idx]
+        return out
 
     # ------------------------------------------------------------- storage
     def write_json(self, path: str):
         """JSONL, one transition per line (reference: `offline/json_writer.py`)."""
         with open(path, "w") as f:
-            for o, a in zip(self.obs, self.actions):
-                f.write(json.dumps({"obs": o.tolist(),
-                                    "action": a.tolist() if hasattr(a, "tolist") else a})
-                        + "\n")
+            for i in range(len(self.obs)):
+                row = {
+                    "obs": self.obs[i].tolist(),
+                    "action": (
+                        self.actions[i].tolist()
+                        if hasattr(self.actions[i], "tolist")
+                        else self.actions[i]
+                    ),
+                }
+                if self.returns is not None:
+                    row["return"] = float(self.returns[i])
+                f.write(json.dumps(row) + "\n")
 
     @classmethod
     def read_json(cls, path: str) -> "OfflineDataset":
-        obs, actions = [], []
+        obs, actions, returns = [], [], []
         with open(path) as f:
             for line in f:
                 if not line.strip():
@@ -51,7 +70,13 @@ class OfflineDataset:
                 row = json.loads(line)
                 obs.append(row["obs"])
                 actions.append(row["action"])
-        return cls(np.asarray(obs, np.float32), np.asarray(actions))
+                if "return" in row:
+                    returns.append(row["return"])
+        return cls(
+            np.asarray(obs, np.float32),
+            np.asarray(actions),
+            np.asarray(returns, np.float32) if returns else None,
+        )
 
 
 def collect_dataset(
@@ -67,16 +92,27 @@ def collect_dataset(
     and record transitions (expert-demonstration collection for BC)."""
     env = make_env(env_name, num_envs, **(env_kwargs or {}))
     obs, _ = env.reset(seed=seed)
-    all_obs, all_act = [], []
+    all_obs, all_act, all_rew, all_done = [], [], [], []
     steps = 0
     while steps < n_steps:
         actions = np.asarray(policy_fn(obs))
         all_obs.append(obs.copy())
         all_act.append(actions.copy())
-        obs = env.step(actions)[0]
+        obs, rew, term, trunc, _ = env.step(actions)
+        all_rew.append(np.asarray(rew, np.float32))
+        all_done.append((term | trunc).astype(np.float32))
         steps += len(actions)
     env.close()
-    return OfflineDataset(
-        np.concatenate(all_obs, axis=0)[:n_steps],
-        np.concatenate(all_act, axis=0)[:n_steps],
-    )
+    # Monte-Carlo returns down each env's transition stream (gamma=0.99;
+    # truncated tails bootstrap to 0 — standard offline-data approximation).
+    rew = np.stack(all_rew)        # [T, N]
+    done = np.stack(all_done)
+    ret = np.zeros_like(rew)
+    acc = np.zeros(rew.shape[1], np.float32)
+    for t in range(len(rew) - 1, -1, -1):
+        acc = rew[t] + 0.99 * acc * (1.0 - done[t])
+        ret[t] = acc
+    def flat(xs):
+        return np.concatenate(list(xs), axis=0)[:n_steps]
+
+    return OfflineDataset(flat(all_obs), flat(all_act), flat(ret))
