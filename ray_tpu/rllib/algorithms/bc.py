@@ -47,13 +47,11 @@ class BCConfig(AlgorithmConfig):
             raise ValueError("train_batch_size must divide into minibatches")
 
 
-def make_bc_update(module, opt, cfg: BCConfig):
+def make_supervised_update(opt, cfg, loss_fn):
+    """Shared offline SGD program (BC/MARWIL): epochs of permuted minibatch
+    scans, one jitted call per iteration. `loss_fn(params, mb) ->
+    (loss, metrics_dict)`."""
     n_mb = cfg.train_batch_size // cfg.minibatch_size
-
-    def loss_fn(params, mb):
-        dist, _ = module.forward(params, mb["obs"])
-        logp = module.log_prob(dist, mb["actions"])
-        return -jnp.mean(logp)
 
     def update(state, batch, rng):
         params, opt_state = state
@@ -65,24 +63,38 @@ def make_bc_update(module, opt, cfg: BCConfig):
             def minibatch(carry, idx):
                 params, opt_state = carry
                 mb = {k: v[idx] for k, v in batch.items()}
-                loss, grads = jax.value_and_grad(loss_fn)(params, mb)
+                (loss, metrics), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(params, mb)
                 updates, opt_state = opt.update(grads, opt_state, params)
                 params = jax.tree_util.tree_map(
                     lambda p, u: p + u.astype(p.dtype), params, updates
                 )
-                return (params, opt_state), loss
+                return (params, opt_state), metrics
 
             idxs = perm.reshape(n_mb, cfg.minibatch_size)
-            (params, opt_state), losses = lax.scan(
+            (params, opt_state), metrics = lax.scan(
                 minibatch, (params, opt_state), idxs
             )
-            return (params, opt_state), jnp.mean(losses)
+            return (params, opt_state), metrics
 
         keys = jax.random.split(rng, cfg.num_epochs)
-        (params, opt_state), losses = lax.scan(epoch, (params, opt_state), keys)
-        return (params, opt_state), {"bc_loss": jnp.mean(losses)}
+        (params, opt_state), metrics = lax.scan(epoch, (params, opt_state), keys)
+        return (params, opt_state), {
+            k: jnp.mean(v) for k, v in metrics.items()
+        }
 
     return update
+
+
+def make_bc_update(module, opt, cfg: BCConfig):
+    def loss_fn(params, mb):
+        dist, _ = module.forward(params, mb["obs"])
+        logp = module.log_prob(dist, mb["actions"])
+        loss = -jnp.mean(logp)
+        return loss, {"bc_loss": loss}
+
+    return make_supervised_update(opt, cfg, loss_fn)
 
 
 class BC(Algorithm):

@@ -9,15 +9,11 @@ learner shape as BC/PPO.
 
 from __future__ import annotations
 
-from typing import Dict
-
-import jax
 import jax.numpy as jnp
 from jax import lax
 
 from ..core.learner import Learner
-from .algorithm import Algorithm
-from .bc import BC, BCConfig
+from .bc import BC, BCConfig, make_supervised_update
 
 
 class MARWILConfig(BCConfig):
@@ -38,8 +34,6 @@ class MARWILConfig(BCConfig):
 
 
 def make_marwil_update(module, opt, cfg: MARWILConfig):
-    n_mb = cfg.train_batch_size // cfg.minibatch_size
-
     def loss_fn(params, mb):
         dist, value = module.forward(params, mb["obs"])
         logp = module.log_prob(dist, mb["actions"])
@@ -51,48 +45,27 @@ def make_marwil_update(module, opt, cfg: MARWILConfig):
         )
         policy_loss = -jnp.mean(w * logp)
         vf_loss = jnp.mean(adv**2)
-        return policy_loss + cfg.vf_coeff * vf_loss, (policy_loss, vf_loss)
-
-    def update(state, batch, rng):
-        params, opt_state = state
-
-        def epoch(carry, key):
-            params, opt_state = carry
-            perm = jax.random.permutation(key, cfg.train_batch_size)
-
-            def minibatch(carry, idx):
-                params, opt_state = carry
-                mb = {k: v[idx] for k, v in batch.items()}
-                (loss, (pl, vl)), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True
-                )(params, mb)
-                updates, opt_state = opt.update(grads, opt_state, params)
-                params = jax.tree_util.tree_map(
-                    lambda p, u: p + u.astype(p.dtype), params, updates
-                )
-                return (params, opt_state), (loss, pl, vl)
-
-            idxs = perm.reshape(n_mb, cfg.minibatch_size)
-            (params, opt_state), metrics = lax.scan(
-                minibatch, (params, opt_state), idxs
-            )
-            return (params, opt_state), metrics
-
-        keys = jax.random.split(rng, cfg.num_epochs)
-        (params, opt_state), (loss, pl, vl) = lax.scan(
-            epoch, (params, opt_state), keys
-        )
-        return (params, opt_state), {
-            "marwil_loss": jnp.mean(loss),
-            "policy_loss": jnp.mean(pl),
-            "vf_loss": jnp.mean(vl),
+        loss = policy_loss + cfg.vf_coeff * vf_loss
+        return loss, {
+            "marwil_loss": loss,
+            "policy_loss": policy_loss,
+            "vf_loss": vf_loss,
         }
 
-    return update
+    return make_supervised_update(opt, cfg, loss_fn)
 
 
 class MARWIL(BC):
     config_class = MARWILConfig
+
+    def setup(self):
+        super().setup()  # may load the dataset from input_path
+        if self.config.dataset.returns is None:
+            raise ValueError(
+                "MARWIL needs Monte-Carlo returns; this dataset (loaded from "
+                f"{self.config.input_path!r}) has none — regenerate with "
+                "collect_dataset (records returns) or add a 'return' field"
+            )
 
     def _make_learner(self) -> Learner:
         from ..utils.optim import make_optimizer

@@ -32,6 +32,10 @@ class OfflineDataset:
         # Monte-Carlo returns per transition — required by advantage-weighted
         # methods (MARWIL); BC ignores them.
         self.returns = None if returns is None else np.asarray(returns, np.float32)
+        if self.returns is not None and len(self.returns) != len(self.obs):
+            raise ValueError(
+                f"returns ({len(self.returns)}) must align with obs ({len(self.obs)})"
+            )
 
     def __len__(self) -> int:
         return len(self.obs)
@@ -72,6 +76,12 @@ class OfflineDataset:
                 actions.append(row["action"])
                 if "return" in row:
                     returns.append(row["return"])
+        if returns and len(returns) != len(obs):
+            raise ValueError(
+                f"{path}: {len(returns)} of {len(obs)} rows carry 'return' — "
+                "mixed files would silently mis-pair returns with obs; "
+                "regenerate the data with uniform fields"
+            )
         return cls(
             np.asarray(obs, np.float32),
             np.asarray(actions),
@@ -86,6 +96,7 @@ def collect_dataset(
     *,
     num_envs: int = 8,
     seed: int = 0,
+    gamma: float = 0.99,
     env_kwargs: Optional[dict] = None,
 ) -> OfflineDataset:
     """Roll `policy_fn(obs_batch) -> action_batch` in the native vector env
@@ -103,14 +114,14 @@ def collect_dataset(
         all_done.append((term | trunc).astype(np.float32))
         steps += len(actions)
     env.close()
-    # Monte-Carlo returns down each env's transition stream (gamma=0.99;
-    # truncated tails bootstrap to 0 — standard offline-data approximation).
+    # Monte-Carlo returns down each env's transition stream (match `gamma`
+    # to the consuming algorithm's discount; truncated tails bootstrap to 0).
     rew = np.stack(all_rew)        # [T, N]
     done = np.stack(all_done)
     ret = np.zeros_like(rew)
     acc = np.zeros(rew.shape[1], np.float32)
     for t in range(len(rew) - 1, -1, -1):
-        acc = rew[t] + 0.99 * acc * (1.0 - done[t])
+        acc = rew[t] + gamma * acc * (1.0 - done[t])
         ret[t] = acc
     def flat(xs):
         return np.concatenate(list(xs), axis=0)[:n_steps]
