@@ -23,6 +23,7 @@ from .algorithms.sac import SAC, SACConfig
 from .algorithms.appo import APPO, APPOConfig
 from .algorithms.bc import BC, BCConfig
 from .algorithms.marwil import MARWIL, MARWILConfig
+from .algorithms.td3 import TD3, TD3Config
 from . import offline
 from .env import register_env, make_env
 from .env.env_runner import EnvRunner
@@ -46,6 +47,8 @@ __all__ = [
     "BCConfig",
     "MARWIL",
     "MARWILConfig",
+    "TD3",
+    "TD3Config",
     "offline",
     "register_env",
     "make_env",

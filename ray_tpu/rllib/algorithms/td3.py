@@ -1,0 +1,260 @@
+"""TD3 — Twin Delayed Deep Deterministic Policy Gradient.
+
+Reference analog: `rllib/algorithms/td3/td3.py` (DDPG + the three TD3
+tricks): twin critics with the min-target, target-policy smoothing (clipped
+Gaussian noise on the target action), and delayed policy/target updates.
+Same TPU-learner shape as SAC: all `num_grad_steps` minibatch updates run
+inside ONE jitted `lax.scan` per iteration; exploration noise is injected
+by the EnvRunner-side `sample`.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+
+from ..core.learner import Learner
+from ..core.rl_module import RLModule, _mlp_apply, _mlp_init
+from ..env.spaces import Box
+from ..utils.replay_buffers import ReplayBuffer
+from .algorithm import Algorithm
+from .algorithm_config import AlgorithmConfig
+
+
+class TD3Config(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.train_batch_size = 400        # env steps sampled per iteration
+        self.replay_buffer_capacity: int = 100_000
+        self.learning_starts: int = 1_000
+        self.minibatch_size: int = 256
+        self.num_grad_steps: int = 32      # grad steps per iteration
+        self.tau: float = 0.005            # Polyak for targets
+        self.exploration_noise: float = 0.1   # behavior-policy sigma
+        self.target_noise: float = 0.2        # smoothing sigma
+        self.noise_clip: float = 0.5
+        self.policy_delay: int = 2            # actor updates every N critic steps
+        self.grad_clip = None
+
+
+class TD3Module(RLModule):
+    """Deterministic actor + twin critics; params = {actor, actor_t, q1, q2,
+    q1_t, q2_t}. The EnvRunner 'dist' is the (unscaled) tanh action mean;
+    `sample` adds exploration noise, `greedy` is the mean."""
+
+    def __init__(self, obs_dim: int, act_dim: int, action_scale: float,
+                 hidden=(256, 256), exploration_noise: float = 0.1):
+        self.obs_dim = obs_dim
+        self.act_dim = act_dim
+        self.action_scale = float(action_scale)
+        self.hidden = tuple(hidden)
+        self.exploration_noise = float(exploration_noise)
+
+    def init(self, rng):
+        ka, k1, k2 = jax.random.split(rng, 3)
+        actor = _mlp_init(ka, (self.obs_dim, *self.hidden, self.act_dim),
+                          scale_last=0.01)
+        q_sizes = (self.obs_dim + self.act_dim, *self.hidden, 1)
+        q1 = _mlp_init(k1, q_sizes, scale_last=1.0)
+        q2 = _mlp_init(k2, q_sizes, scale_last=1.0)
+        return {
+            "actor": actor,
+            "actor_t": jax.tree.map(jnp.copy, actor),
+            "q1": q1,
+            "q2": q2,
+            "q1_t": jax.tree.map(jnp.copy, q1),
+            "q2_t": jax.tree.map(jnp.copy, q2),
+        }
+
+    # ---- heads ----
+    def act(self, actor_params, obs):
+        """Deterministic tanh action in [-1, 1] (unscaled)."""
+        return jnp.tanh(_mlp_apply(actor_params, obs, activation=jax.nn.relu))
+
+    def q_value(self, q_params, obs, actions_unit):
+        x = jnp.concatenate([obs, actions_unit], axis=-1)
+        return _mlp_apply(q_params, x, activation=jax.nn.relu)[..., 0]
+
+    # ---- EnvRunner interface ----
+    def forward(self, params, obs):
+        return self.act(params["actor"], obs), jnp.zeros(obs.shape[:-1], jnp.float32)
+
+    def sample(self, rng, dist):
+        noise = self.exploration_noise * jax.random.normal(rng, dist.shape)
+        return jnp.clip(dist + noise, -1.0, 1.0) * self.action_scale
+
+    def greedy(self, dist):
+        return dist * self.action_scale
+
+    def log_prob(self, dist, actions):
+        # Deterministic policy: logp is meaningless; the runner records it
+        # but TD3 never consumes it.
+        return jnp.zeros(dist.shape[:-1], jnp.float32)
+
+    def entropy(self, dist):
+        return jnp.zeros(dist.shape[:-1], jnp.float32)
+
+
+def make_td3_update(module: TD3Module, actor_opt, critic_opt, cfg: TD3Config):
+    gamma, tau = cfg.gamma, cfg.tau
+
+    def critic_loss(qs, params, mb, key):
+        # Target-policy smoothing: clipped noise on the target action.
+        noise = jnp.clip(
+            cfg.target_noise * jax.random.normal(key, mb["actions"].shape),
+            -cfg.noise_clip, cfg.noise_clip,
+        )
+        next_a = jnp.clip(
+            module.act(params["actor_t"], mb["next_obs"]) + noise, -1.0, 1.0
+        )
+        y = mb["rewards"] + gamma * (1.0 - mb["dones"]) * jnp.minimum(
+            module.q_value(params["q1_t"], mb["next_obs"], next_a),
+            module.q_value(params["q2_t"], mb["next_obs"], next_a),
+        )
+        y = lax.stop_gradient(y)
+        unit_a = mb["actions"] / module.action_scale
+        q1 = module.q_value(qs["q1"], mb["obs"], unit_a)
+        q2 = module.q_value(qs["q2"], mb["obs"], unit_a)
+        return ((q1 - y) ** 2 + (q2 - y) ** 2).mean(), q1.mean()
+
+    def actor_loss(actor, params, mb):
+        a = module.act(actor, mb["obs"])
+        return -module.q_value(params["q1"], mb["obs"], a).mean()
+
+    def update(state, batches, rng):
+        params, opt_states = state
+
+        def grad_step(carry, inp):
+            params, (a_opt, c_opt), step = carry
+            mb, key = inp
+            (c_loss, q_mean), c_grads = jax.value_and_grad(
+                critic_loss, has_aux=True
+            )({"q1": params["q1"], "q2": params["q2"]}, params, mb, key)
+            c_updates, c_opt = critic_opt.update(
+                c_grads, c_opt, {"q1": params["q1"], "q2": params["q2"]}
+            )
+            new_qs = optax.apply_updates(
+                {"q1": params["q1"], "q2": params["q2"]}, c_updates
+            )
+            params = {**params, **new_qs}
+
+            def do_actor(operand):
+                params, a_opt = operand
+                a_loss, a_grads = jax.value_and_grad(actor_loss)(
+                    params["actor"], params, mb
+                )
+                a_updates, a_opt = actor_opt.update(a_grads, a_opt, params["actor"])
+                params = {
+                    **params,
+                    "actor": optax.apply_updates(params["actor"], a_updates),
+                }
+                # Delayed Polyak of actor AND critic targets (TD3 couples
+                # target updates to the policy cadence).
+                params = {
+                    **params,
+                    "actor_t": jax.tree.map(
+                        lambda t, o: (1 - tau) * t + tau * o,
+                        params["actor_t"], params["actor"],
+                    ),
+                    "q1_t": jax.tree.map(
+                        lambda t, o: (1 - tau) * t + tau * o,
+                        params["q1_t"], params["q1"],
+                    ),
+                    "q2_t": jax.tree.map(
+                        lambda t, o: (1 - tau) * t + tau * o,
+                        params["q2_t"], params["q2"],
+                    ),
+                }
+                return params, a_opt, a_loss
+
+            def skip_actor(operand):
+                params, a_opt = operand
+                return params, a_opt, jnp.float32(0.0)
+
+            params, a_opt, a_loss = lax.cond(
+                step % cfg.policy_delay == 0, do_actor, skip_actor, (params, a_opt)
+            )
+            aux = {"critic_loss": c_loss, "actor_loss": a_loss, "q_mean": q_mean}
+            return (params, (a_opt, c_opt), step + 1), aux
+
+        k = jax.tree_util.tree_leaves(batches)[0].shape[0]
+        keys = jax.random.split(rng, k)
+        (params, opt_states, _), auxs = lax.scan(
+            grad_step, (params, opt_states, jnp.int32(0)), (batches, keys)
+        )
+        return (params, opt_states), jax.tree.map(lambda x: x.mean(), auxs)
+
+    return update
+
+
+class TD3(Algorithm):
+    config_class = TD3Config
+
+    def setup(self):
+        super().setup()
+        cfg = self.config
+        obs_dim = int(np.prod(self.observation_space.shape))
+        act_dim = int(np.prod(self.action_space.shape))
+        self._buffer = ReplayBuffer(
+            cfg.replay_buffer_capacity, obs_dim,
+            act_shape=(act_dim,), act_dtype=np.float32,
+        )
+        self._np_rng = np.random.default_rng(cfg.seed)
+
+    def _make_module(self):
+        if not isinstance(self.action_space, Box):
+            raise TypeError("TD3 requires a continuous (Box) action space")
+        hidden = tuple(self.config.model.get("hidden", (256, 256)))
+        obs_dim = int(np.prod(self.observation_space.shape))
+        act_dim = int(np.prod(self.action_space.shape))
+        scale = float(np.max(np.abs(self.action_space.high)))
+        return TD3Module(
+            obs_dim, act_dim, scale, hidden,
+            exploration_noise=self.config.exploration_noise,
+        )
+
+    def _make_learner(self) -> Learner:
+        from ..utils.optim import make_optimizer
+
+        cfg = self.config
+        actor_opt = make_optimizer(cfg)
+        critic_opt = make_optimizer(cfg)
+        learner = Learner(
+            self.module,
+            make_td3_update(self.module, actor_opt, critic_opt, cfg),
+            seed=cfg.seed,
+        )
+        learner.opt_state = (
+            actor_opt.init(learner.params["actor"]),
+            critic_opt.init(
+                {"q1": learner.params["q1"], "q2": learner.params["q2"]}
+            ),
+        )
+        return learner
+
+    def training_step(self) -> Dict:
+        cfg = self.config
+        batches = self._sample_batches()
+        env_steps = 0
+        for b in batches:
+            T, B = b["rewards"].shape
+            env_steps += T * B
+            self._buffer.add_fragment(b)
+
+        metrics: Dict = {}
+        if len(self._buffer) >= cfg.learning_starts:
+            mbs = self._buffer.sample(
+                self._np_rng, cfg.num_grad_steps, cfg.minibatch_size
+            )
+            metrics = self.learner_group.update(mbs)
+            self._weights = self.learner_group.get_weights()
+        return {"_env_steps_this_iter": env_steps, "info": {"learner": metrics}}
+
+
+TD3Config.algo_class = TD3

@@ -167,3 +167,21 @@ class TestMultiAgent:
         )
         best = _train_until(algo, 150, 25)  # team reward (2 agents)
         assert best >= 150, f"shared-policy PPO failed on MultiCartPole: best={best}"
+
+
+class TestTD3:
+    def test_td3_pendulum_learning(self):
+        from ray_tpu.rllib import TD3Config
+
+        algo = (
+            TD3Config()
+            .environment("Pendulum-v1")
+            .env_runners(num_env_runners=0, num_envs_per_env_runner=8)
+            .training(train_batch_size=256, learning_starts=512,
+                      num_grad_steps=256, minibatch_size=128,
+                      model={"hidden": (64, 64)}, lr=1e-3)
+            .debugging(seed=0)
+            .build()
+        )
+        best = _train_until(algo, -350, 200)
+        assert best >= -350, f"TD3 failed to learn Pendulum: best={best}"
