@@ -174,6 +174,37 @@ def cmd_serve(backend, info, args):
         print("serve shut down")
 
 
+def cmd_workflow(backend, info, args):
+    """`workflow list/status/resume/cancel/delete` (reference:
+    `ray.workflow` ops surface). Storage-rooted, so no live cluster needed
+    for list/status; resume runs as a driver."""
+    from ray_tpu import workflow
+
+    if args.storage:
+        workflow.init(args.storage)
+    cmd = args.workflow_command
+    if cmd == "list":
+        rows = [
+            {"workflow_id": wid, "status": status}
+            for wid, status in workflow.list_all()
+        ]
+        _table(rows, ["workflow_id", "status"])
+    elif cmd == "status":
+        print(json.dumps(workflow.get_metadata(args.workflow_id), indent=2, default=str))
+    elif cmd == "resume":
+        import ray_tpu
+
+        ray_tpu.init(address=info["address"], ignore_reinit_error=True, log_to_driver=False)
+        out = workflow.resume(args.workflow_id)
+        print(f"resumed {args.workflow_id} -> {out!r}")
+    elif cmd == "cancel":
+        workflow.cancel(args.workflow_id)
+        print(f"cancel requested for {args.workflow_id}")
+    elif cmd == "delete":
+        workflow.delete(args.workflow_id)
+        print(f"deleted {args.workflow_id}")
+
+
 def cmd_timeline(backend, info, args):
     events = backend._request({"type": "state_summary"})["timeline"]
     if args.output:
@@ -208,6 +239,13 @@ def main(argv=None):
         p = job_sub.add_parser(name)
         p.add_argument("job_id")
     job_sub.add_parser("list")
+    p_wf = sub.add_parser("workflow", help="list/inspect/resume durable workflows")
+    wf_sub = p_wf.add_subparsers(dest="workflow_command", required=True)
+    for wname in ("list", "status", "resume", "cancel", "delete"):
+        p = wf_sub.add_parser(wname)
+        if wname != "list":
+            p.add_argument("workflow_id")
+        p.add_argument("--storage", default=None, help="workflow storage root")
     p_serve = sub.add_parser("serve", help="deploy/inspect Serve applications")
     serve_sub = p_serve.add_subparsers(dest="serve_command", required=True)
     p_deploy = serve_sub.add_parser("deploy")
@@ -233,6 +271,7 @@ def main(argv=None):
             "timeline": cmd_timeline,
             "job": cmd_job,
             "serve": cmd_serve,
+            "workflow": cmd_workflow,
         }[args.command](backend, info, args)
     finally:
         backend.conn.close()

@@ -132,3 +132,60 @@ def test_health_check_detects_wedged_node(monkeypatch):
     finally:
         ray_tpu.shutdown()
         cluster.shutdown()
+
+
+def test_workflow_cli(tmp_path):
+    """workflow list/status/resume through the CLI binary."""
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    try:
+        storage = str(tmp_path / "wfs")
+        gate = str(tmp_path / "gate")
+        code = f"""
+import os
+import ray_tpu
+from ray_tpu import workflow
+ray_tpu.init(address="{cluster.address}")
+workflow.init({storage!r})
+
+@ray_tpu.remote
+def gated():
+    if not os.path.exists({gate!r}):
+        raise RuntimeError("closed")
+    return "done"
+
+try:
+    workflow.run(gated.bind(), workflow_id="cli_wf")
+except Exception:
+    pass
+print("SEEDED")
+"""
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["RAY_TPU_ADDRESS"] = cluster.address
+        env["PYTHONPATH"] = (
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            + os.pathsep + env.get("PYTHONPATH", "")
+        )
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=90, env=env,
+        )
+        assert "SEEDED" in out.stdout, out.stderr[-1500:]
+
+        def cli(*argv):
+            return subprocess.run(
+                [sys.executable, "-m", "ray_tpu.scripts.cli", *argv],
+                capture_output=True, text=True, timeout=90, env=env,
+            )
+
+        out = cli("workflow", "list", "--storage", storage)
+        assert "cli_wf" in out.stdout and "FAILED" in out.stdout, out.stderr[-800:]
+        out = cli("workflow", "status", "cli_wf", "--storage", storage)
+        assert '"status": "FAILED"' in out.stdout
+        open(gate, "w").close()
+        out = cli("workflow", "resume", "cli_wf", "--storage", storage)
+        assert "'done'" in out.stdout, out.stderr[-800:]
+        out = cli("workflow", "status", "cli_wf", "--storage", storage)
+        assert '"status": "SUCCESSFUL"' in out.stdout
+    finally:
+        cluster.shutdown()
