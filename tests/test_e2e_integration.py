@@ -23,7 +23,7 @@ pytestmark = pytest.mark.cluster
 
 
 @pytest.fixture
-def two_node_cluster():
+def e2e_cluster():
     cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 3})
     cluster.add_node(num_cpus=3)
     ray_tpu.init(address=cluster.address)
@@ -32,7 +32,7 @@ def two_node_cluster():
     cluster.shutdown()
 
 
-def test_data_train_serve_pipeline(two_node_cluster, tmp_path):
+def test_data_train_serve_pipeline(e2e_cluster, tmp_path):
     # ------------------------------------------------- 1. Data: y = X @ w
     rng = np.random.default_rng(0)
     w_true = np.array([2.0, -1.0, 0.5, 3.0], np.float32)
