@@ -314,11 +314,22 @@ class ClusterBackend(RuntimeBackend):
             self.worker.send({"type": "worker_blocked", "worker_id": self.worker.worker_id})
         try:
             async def gather():
-                reqs = [
-                    self.conn.request({"type": "get_object", "id": r.id.hex(), "timeout": timeout})
-                    for r in refs
-                ]
-                return await asyncio.gather(*reqs)
+                # One batched RPC per chunk instead of one per ref — envelope
+                # + response framing dominates many-ref gets otherwise.
+                CHUNK = 2000
+                chunks = [refs[i:i + CHUNK] for i in range(0, len(refs), CHUNK)]
+                replies = await asyncio.gather(*(
+                    self.conn.request(
+                        {"type": "get_objects",
+                         "ids": [r.id.hex() for r in chunk],
+                         "timeout": timeout}
+                    )
+                    for chunk in chunks
+                ))
+                out = []
+                for reply in replies:
+                    out.extend(reply["locations"])
+                return out
 
             locs = self.io.call(gather(), None if timeout is None else timeout + 30)
         finally:

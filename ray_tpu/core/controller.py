@@ -619,7 +619,7 @@ class Controller:
     # they run as detached tasks — otherwise a long-poll would block the
     # connection's read loop and deadlock clients that get() on one thread
     # while another thread produces the object.
-    _LONG_POLL = frozenset({"get_object", "wait_objects", "tail_logs", "stream_next"})
+    _LONG_POLL = frozenset({"get_object", "get_objects", "wait_objects", "tail_logs", "stream_next"})
 
     async def _dispatch_msg(self, conn: Connection, meta: dict, msg: dict):
         mtype = msg["type"]
@@ -1001,10 +1001,23 @@ class Controller:
                 obj.events.remove(ev)
 
     async def h_get_object(self, conn, meta, msg):
-        hex_id = msg["id"]
-        timeout = msg.get("timeout")
-        deadline = None if timeout is None else time.monotonic() + timeout
+        return await self._get_object_payload(
+            msg["id"], msg.get("timeout"), meta.get("node_id") or HEAD_NODE
+        )
+
+    async def h_get_objects(self, conn, meta, msg):
+        """Batched resolve: one RPC for N refs (the reference's
+        `CoreWorker::Get` takes the whole id list for the same reason —
+        per-object round trips dominate many-ref gets)."""
         node_id = meta.get("node_id") or HEAD_NODE
+        timeout = msg.get("timeout")
+        payloads = await asyncio.gather(
+            *(self._get_object_payload(h, timeout, node_id) for h in msg["ids"])
+        )
+        return {"locations": payloads}
+
+    async def _get_object_payload(self, hex_id: str, timeout, node_id: str):
+        deadline = None if timeout is None else time.monotonic() + timeout
         obj = self._obj(hex_id)
         if obj.status != "ready" and not await self._wait_ready(obj, deadline):
             return {"status": "timeout"}
