@@ -179,6 +179,31 @@ class PendingTask:
     # passes honor it (otherwise the round-robin re-rolls every pass and the
     # task bounces between half-spawned nodes).
     pinned_node: Optional[str] = None
+    # Cached (demand, strategy) signature for the scheduler's no-capacity
+    # fast path — building it per scan entry per pass dominated deep-queue
+    # profiles (1.6M sorted() calls per 3k tasks). Invalidated when
+    # pinned_node changes (it is part of the signature).
+    _sig_cache: Optional[tuple] = None
+    _sig_pinned: Optional[str] = None
+
+    def sched_sig(self, need_tpu: bool):
+        from .task_spec import SpreadSchedulingStrategy
+
+        strat = self.spec.options.scheduling_strategy
+        if isinstance(strat, SpreadSchedulingStrategy):
+            return None  # rotation → per-decision outcomes; never fast-path
+        if self._sig_cache is None or self._sig_pinned != self.pinned_node:
+            self._sig_cache = (
+                tuple(sorted(self.spec.resources.items())),
+                type(strat).__name__,
+                getattr(strat, "node_id", None),
+                getattr(strat, "soft", None),
+                tuple(sorted(getattr(strat, "hard", {}).items())),
+                need_tpu,
+                self.pinned_node,
+            )
+            self._sig_pinned = self.pinned_node
+        return self._sig_cache
 
 
 class Controller:
@@ -540,21 +565,34 @@ class Controller:
             self._server.close()
 
     # ------------------------------------------------------------- workers
-    def _spawn_worker(self, tpu: bool = False, node: Optional[NodeState] = None):
+    def _spawn_worker(
+        self,
+        tpu: bool = False,
+        node: Optional[NodeState] = None,
+        live_count: Optional[int] = None,
+        force: bool = False,
+    ):
         """Spawn a worker on `node` (default head). Remote nodes spawn via
-        their agent (reference: raylet `WorkerPool::StartWorkerProcess`)."""
+        their agent (reference: raylet `WorkerPool::StartWorkerProcess`).
+        `live_count` (alive workers on the node) skips the O(workers) scan
+        when the caller already counted (the scheduler's per-pass cache).
+        `force` bypasses the task-pool cap — ACTORS own dedicated processes
+        (reference semantics: tens of thousands of actor workers), so the
+        cap that bounds task-worker prestarting must not deadlock actor
+        creation."""
         node = node or self.head
         if tpu:
             if node.spawning_tpu > 0:
                 return
             node.spawning_tpu += 1
-        elif (
-            node.spawning
-            + len([w for w in self.workers.values()
-                   if w.state != DEAD and w.node_id == node.node_id])
-            >= self._max_workers
-        ):
-            return
+        else:
+            if live_count is None:
+                live_count = sum(
+                    1 for w in self.workers.values()
+                    if w.state != DEAD and w.node_id == node.node_id
+                )
+            if not force and node.spawning + live_count >= self._max_workers:
+                return
         node.spawning += 1
         worker_id = f"w{next(self._worker_counter)}"
         if node.conn is not None:
@@ -1643,6 +1681,10 @@ class Controller:
         # node_id -> CPU workers wanted this pass; flushed bounded below so a
         # task waiting out a worker boot doesn't fork one per scheduling event.
         spawn_wanted: Dict[str, int] = {}
+        # Actor creations wanting a worker — flushed with force=True (the
+        # task-pool cap must not deadlock actor creation; each actor owns a
+        # dedicated process).
+        spawn_wanted_actors: Dict[str, int] = {}
         while made_progress and self.ready_queue:
             made_progress = False
             # Bounded head scan: dispatch FIFO, skipping over at most a small
@@ -1713,8 +1755,13 @@ class Controller:
                         if need_tpu:
                             self._spawn_worker(tpu=True, node=node)
                         else:
-                            spawn_wanted[node.node_id] = (
-                                spawn_wanted.get(node.node_id, 0) + 1
+                            target = (
+                                spawn_wanted_actors
+                                if spec.task_type == TaskType.ACTOR_CREATION_TASK
+                                else spawn_wanted
+                            )
+                            target[node.node_id] = (
+                                target.get(node.node_id, 0) + 1
                             )
                         continue
                     avail = self.pgs[pg_hex]["bundle_avail"][bidx]
@@ -1730,23 +1777,17 @@ class Controller:
                         strat,
                         (SpreadSchedulingStrategy, NodeAffinitySchedulingStrategy),
                     )
-                    # Spread rotates candidate order per decision — identical
-                    # demands can have different outcomes, so it never takes
-                    # the no-capacity fast path.
-                    sig = None if isinstance(strat, SpreadSchedulingStrategy) else (
-                        tuple(sorted(demand.items())),
-                        type(strat).__name__,
-                        getattr(strat, "node_id", None),
-                        getattr(strat, "soft", None),
-                        tuple(sorted(getattr(strat, "hard", {}).items())),
-                        need_tpu,
-                        pt.pinned_node,
-                    )
+                    sig = pt.sched_sig(need_tpu)
                     if sig is not None and sig in no_capacity:
                         self.ready_queue.append(pt)
                         hint = no_capacity[sig]
                         if hint is not None and not need_tpu:
-                            spawn_wanted[hint] = spawn_wanted.get(hint, 0) + 1
+                            target = (
+                                spawn_wanted_actors
+                                if spec.task_type == TaskType.ACTOR_CREATION_TASK
+                                else spawn_wanted
+                            )
+                            target[hint] = target.get(hint, 0) + 1
                         continue
                     if pt.pinned_node is not None:
                         pin = self.nodes.get(pt.pinned_node)
@@ -1778,8 +1819,14 @@ class Controller:
                             if need_tpu:
                                 self._spawn_worker(tpu=True, node=spawn_on)
                             else:
-                                spawn_wanted[spawn_on.node_id] = (
-                                    spawn_wanted.get(spawn_on.node_id, 0) + 1
+                                target = (
+                                    spawn_wanted_actors
+                                    if spec.task_type
+                                    == TaskType.ACTOR_CREATION_TASK
+                                    else spawn_wanted
+                                )
+                                target[spawn_on.node_id] = (
+                                    target.get(spawn_on.node_id, 0) + 1
                                 )
                         continue
                     node, ws = chosen
@@ -1798,23 +1845,40 @@ class Controller:
                     ws.current_task = task_hex
                 asyncio.ensure_future(self._dispatch(node, ws, pt))
                 made_progress = True
+        # One pass over the worker table serves every spawn decision below
+        # (per-call scans dominated profiles at 58k _spawn_worker calls).
+        starting_by_node: Dict[str, int] = {}
+        live_by_node: Dict[str, int] = {}
+        starting_total = 0
+        if spawn_wanted or spawn_wanted_actors or self.ready_queue:
+            for w in self.workers.values():
+                if w.state == DEAD:
+                    continue
+                live_by_node[w.node_id] = live_by_node.get(w.node_id, 0) + 1
+                if w.state == STARTING:
+                    starting_by_node[w.node_id] = (
+                        starting_by_node.get(w.node_id, 0) + 1
+                    )
+                    starting_total += 1
         # Flush per-node spawn demand, net of workers already booting there
         # (reference analog: worker_pool PrestartWorkers on backlog hints,
         # `worker_pool.h:354` — backlog-sized, not one-per-event).
-        for node_id, wanted in spawn_wanted.items():
-            node = self.nodes.get(node_id)
-            if node is None or not node.alive:
-                continue
-            booting = node.spawning + sum(
-                1 for w in self.workers.values()
-                if w.state == STARTING and w.node_id == node_id
-            )
-            for _ in range(max(0, min(wanted - booting, rt_config.get("spawn_burst_cap")))):
-                self._spawn_worker(node=node)
+        for forced, wants in ((False, spawn_wanted), (True, spawn_wanted_actors)):
+            for node_id, wanted in wants.items():
+                node = self.nodes.get(node_id)
+                if node is None or not node.alive:
+                    continue
+                booting = node.spawning + starting_by_node.get(node_id, 0)
+                for i in range(
+                    max(0, min(wanted - booting, rt_config.get("spawn_burst_cap")))
+                ):
+                    self._spawn_worker(
+                        node=node,
+                        live_count=live_by_node.get(node_id, 0) + i,
+                        force=forced,
+                    )
         # Top the head pool up to the queue depth.
-        starting = self.head.spawning + sum(
-            1 for w in self.workers.values() if w.state == STARTING
-        )
+        starting = self.head.spawning + starting_total
         # Exact CPU-backlog count is O(queue); bound the scan to the first
         # 256 entries — an UNDERestimate for deeper queues (spawning catches
         # up as the queue drains), and still exactly 0 for TPU-only queues
@@ -1825,8 +1889,9 @@ class Controller:
             if pt.spec.resources.get("TPU", 0) == 0
         )
         deficit = cpu_backlog - starting
-        for _ in range(max(0, min(deficit, rt_config.get("worker_prestart_cap")))):
-            self._spawn_worker()
+        head_live = live_by_node.get(self.head.node_id, 0)
+        for i in range(max(0, min(deficit, rt_config.get("worker_prestart_cap")))):
+            self._spawn_worker(live_count=head_live + i)
 
     def _finish_cancelled(self, pt: PendingTask):
         self._fail_task(pt, TaskError(TaskCancelledError(), "", pt.spec.name))

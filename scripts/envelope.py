@@ -1,0 +1,110 @@
+"""Scalability-envelope harness (scaled-down single-machine edition).
+
+Reference analog: `release/benchmarks` (many_tasks / many_actors /
+many_pgs / object-store limits — `release/benchmarks/README.md:9-31`).
+Run: `python scripts/envelope.py [--big]` — one JSON line per probe.
+The --big variant scales toward the reference envelope numbers and is meant
+for beefy machines, not CI.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def report(name, value, unit, extra=None):
+    print(
+        json.dumps(
+            {"envelope_probe": name, "value": value, "unit": unit,
+             **({"extra": extra} if extra else {})}
+        ),
+        flush=True,
+    )
+
+
+def main():
+    import ray_tpu
+
+    big = "--big" in sys.argv
+    ray_tpu.init(num_cpus=8, object_store_memory=4 << 30)
+
+    # ---- many queued tasks on one node (ref: 1,000,000+ queued) ----
+    N_QUEUE = 100_000 if big else 10_000
+
+    @ray_tpu.remote
+    def nop(i):
+        return i
+
+    t0 = time.perf_counter()
+    refs = [nop.remote(i) for i in range(N_QUEUE)]
+    submit_s = time.perf_counter() - t0
+    report("tasks_queued", N_QUEUE, "tasks", {"submit_s": round(submit_s, 2)})
+    t0 = time.perf_counter()
+    out = ray_tpu.get(refs, timeout=3600)
+    assert out[-1] == N_QUEUE - 1
+    report("queued_tasks_drained_s", round(time.perf_counter() - t0, 1), "s")
+
+    # ---- many actors (ref: 40,000+ cluster-wide) ----
+    N_ACTORS = 2000 if big else 200
+
+    @ray_tpu.remote(num_cpus=0)
+    class A:
+        def ping(self):
+            return 1
+
+    t0 = time.perf_counter()
+    actors = [A.remote() for _ in range(N_ACTORS)]
+    assert sum(ray_tpu.get([a.ping.remote() for a in actors])) == N_ACTORS
+    report("actors_created_and_pinged", N_ACTORS, "actors",
+           {"seconds": round(time.perf_counter() - t0, 1)})
+    for a in actors:
+        ray_tpu.kill(a)
+
+    # ---- many placement groups (ref: 1,000+) ----
+    from ray_tpu.util.placement_group import placement_group, remove_placement_group
+
+    N_PGS = 1000 if big else 100
+    t0 = time.perf_counter()
+    pgs = [placement_group([{"CPU": 0.001}]) for _ in range(N_PGS)]
+    assert all(pg.wait(60) for pg in pgs)
+    report("placement_groups", N_PGS, "pgs",
+           {"seconds": round(time.perf_counter() - t0, 1)})
+    for pg in pgs:
+        remove_placement_group(pg)
+
+    # ---- large object put/get (ref: 100 GiB+; scaled) ----
+    GIB = (8 if big else 1)
+    arr = np.ones((GIB << 27,), np.float64)  # GIB GiB
+    t0 = time.perf_counter()
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    dt = time.perf_counter() - t0
+    assert out.nbytes == arr.nbytes
+    report("large_object_roundtrip", GIB, "GiB",
+           {"seconds": round(dt, 2), "gib_per_s": round(2 * GIB / dt, 2)})
+    del arr, out, ref
+
+    # ---- many args / many returns (ref: 10,000+ / 3,000+) ----
+    refs = [ray_tpu.put(i) for i in range(10_000 if big else 2000)]
+
+    @ray_tpu.remote
+    def consume(*args):
+        return len(args)
+
+    t0 = time.perf_counter()
+    n = ray_tpu.get(consume.remote(*refs))
+    report("object_args_to_one_task", n, "args",
+           {"seconds": round(time.perf_counter() - t0, 2)})
+
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

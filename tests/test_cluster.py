@@ -193,3 +193,25 @@ def test_wait_cluster(cluster):
     f, s = fast.remote(), slow.remote()
     ready, not_ready = ray_tpu.wait([f, s], num_returns=1, timeout=5)
     assert ready == [f] and not_ready == [s]
+
+
+def test_actors_beyond_worker_pool_cap(cluster):
+    """Actors own dedicated processes: creating MORE actors than the
+    task-worker pool cap (cpus x max_workers_per_cpu) must not deadlock
+    (regression: the cap silently refused spawns and creations queued
+    forever)."""
+    import ray_tpu
+    from ray_tpu.core import config as rt_config
+
+    cap = max(int(4 * rt_config.get("max_workers_per_cpu")), 8)  # matches init(num_cpus=4)
+    n = cap + 8
+
+    @ray_tpu.remote(num_cpus=0)
+    class Tiny:
+        def ping(self):
+            return 1
+
+    actors = [Tiny.remote() for _ in range(n)]
+    assert sum(ray_tpu.get([a.ping.remote() for a in actors], timeout=180)) == n
+    for a in actors:
+        ray_tpu.kill(a)
