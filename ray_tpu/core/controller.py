@@ -187,8 +187,6 @@ class PendingTask:
     _sig_pinned: Optional[str] = None
 
     def sched_sig(self, need_tpu: bool):
-        from .task_spec import SpreadSchedulingStrategy
-
         strat = self.spec.options.scheduling_strategy
         if isinstance(strat, SpreadSchedulingStrategy):
             return None  # rotation → per-decision outcomes; never fast-path
@@ -587,9 +585,12 @@ class Controller:
             node.spawning_tpu += 1
         else:
             if live_count is None:
+                # Task-POOL occupancy only: dedicated ACTOR workers are
+                # excluded, else long-lived actors eat the cap and starve
+                # plain tasks of workers forever.
                 live_count = sum(
                     1 for w in self.workers.values()
-                    if w.state != DEAD and w.node_id == node.node_id
+                    if w.state not in (DEAD, ACTOR) and w.node_id == node.node_id
                 )
             if not force and node.spawning + live_count >= self._max_workers:
                 return
@@ -1852,8 +1853,8 @@ class Controller:
         starting_total = 0
         if spawn_wanted or spawn_wanted_actors or self.ready_queue:
             for w in self.workers.values():
-                if w.state == DEAD:
-                    continue
+                if w.state in (DEAD, ACTOR):
+                    continue  # task-pool occupancy only (see _spawn_worker)
                 live_by_node[w.node_id] = live_by_node.get(w.node_id, 0) + 1
                 if w.state == STARTING:
                     starting_by_node[w.node_id] = (
@@ -1869,12 +1870,14 @@ class Controller:
                 if node is None or not node.alive:
                     continue
                 booting = node.spawning + starting_by_node.get(node_id, 0)
-                for i in range(
+                for _ in range(
                     max(0, min(wanted - booting, rt_config.get("spawn_burst_cap")))
                 ):
+                    # node.spawning increments per spawn — in-loop spawns are
+                    # already counted; adding i here double-counted them.
                     self._spawn_worker(
                         node=node,
-                        live_count=live_by_node.get(node_id, 0) + i,
+                        live_count=live_by_node.get(node_id, 0),
                         force=forced,
                     )
         # Top the head pool up to the queue depth.
@@ -1890,8 +1893,8 @@ class Controller:
         )
         deficit = cpu_backlog - starting
         head_live = live_by_node.get(self.head.node_id, 0)
-        for i in range(max(0, min(deficit, rt_config.get("worker_prestart_cap")))):
-            self._spawn_worker(live_count=head_live + i)
+        for _ in range(max(0, min(deficit, rt_config.get("worker_prestart_cap")))):
+            self._spawn_worker(live_count=head_live)
 
     def _finish_cancelled(self, pt: PendingTask):
         self._fail_task(pt, TaskError(TaskCancelledError(), "", pt.spec.name))

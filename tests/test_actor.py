@@ -118,4 +118,3 @@ def test_method_num_returns():
     m = Multi.remote()
     a, b = m.pair.remote()
     assert ray_tpu.get([a, b]) == [1, 2]
-

@@ -215,3 +215,27 @@ def test_actors_beyond_worker_pool_cap(cluster):
     assert sum(ray_tpu.get([a.ping.remote() for a in actors], timeout=180)) == n
     for a in actors:
         ray_tpu.kill(a)
+
+
+def test_tasks_not_starved_by_actor_filled_pool(cluster):
+    """Dedicated ACTOR workers must not consume the task-pool cap: with
+    cap-many live actors, a plain task still gets a worker."""
+    from ray_tpu.core import config as rt_config
+
+    cap = max(int(4 * rt_config.get("max_workers_per_cpu")), 8)
+
+    @ray_tpu.remote(num_cpus=0)
+    class Holder:
+        def ping(self):
+            return 1
+
+    actors = [Holder.remote() for _ in range(cap)]
+    assert sum(ray_tpu.get([a.ping.remote() for a in actors], timeout=180)) == cap
+
+    @ray_tpu.remote
+    def plain():
+        return "ran"
+
+    assert ray_tpu.get(plain.remote(), timeout=60) == "ran"
+    for a in actors:
+        ray_tpu.kill(a)
