@@ -72,6 +72,10 @@ class WorkerState:
     assigned: Dict[str, float] = field(default_factory=dict)
     # When set, `assigned` was carved from this PG bundle, not node capacity.
     assigned_pg: Optional[Tuple[str, int]] = None
+    # Lease reuse (reference: cached leases, `direct_task_transport.cc:135`):
+    # one same-shape argless task queued BEHIND current_task on this worker,
+    # promoted at task_done without a scheduler round trip.
+    prefetch_task: Optional[str] = None
     blocked: bool = False
     node_id: str = HEAD_NODE
     has_tpu: bool = False
@@ -1693,6 +1697,8 @@ class Controller:
             # CPU tasks behind it, but a long queue isn't rescanned per event).
             scan = min(len(self.ready_queue), rt_config.get("scheduler_scan_window"))
             for _ in range(scan):
+                if not self.ready_queue:  # prefetch may consume entries mid-scan
+                    break
                 pt = self.ready_queue.popleft()
                 spec = pt.spec
                 if spec.task_id.hex() in self.cancelled:
@@ -1845,6 +1851,7 @@ class Controller:
                     ws.state = BUSY
                     ws.current_task = task_hex
                 asyncio.ensure_future(self._dispatch(node, ws, pt))
+                self._maybe_prefetch(ws, node, pt, cache)
                 made_progress = True
         # One pass over the worker table serves every spawn decision below
         # (per-call scans dominated profiles at 58k _spawn_worker calls).
@@ -1896,6 +1903,70 @@ class Controller:
         for _ in range(max(0, min(deficit, rt_config.get("worker_prestart_cap")))):
             self._spawn_worker(live_count=head_live)
 
+    def _maybe_prefetch(
+        self,
+        ws: WorkerState,
+        node: NodeState,
+        pt: PendingTask,
+        cache: Optional[dict] = None,
+    ):
+        """Queue ONE more same-shape task behind the one just dispatched
+        (reference: lease reuse — steady-state same-shape submission skips
+        the raylet, `direct_task_transport.cc:135-247`). Only argless,
+        non-streaming, non-PG NORMAL tasks at the queue head qualify: no dep
+        materialization, no bundle accounting, FIFO preserved."""
+        spec = pt.spec
+        if (
+            ws.state != BUSY
+            or ws.prefetch_task is not None
+            or not self.ready_queue
+            or spec.task_type != TaskType.NORMAL_TASK
+            or spec.num_returns == -1
+            or spec.arg_refs
+            or ws.assigned_pg is not None
+        ):
+            return
+        sig = pt.sched_sig(spec.resources.get("TPU", 0) > 0)
+        if sig is None:  # spread: placement differs per decision — no reuse
+            return
+        # Only pipeline when no idle worker is left to take the head task
+        # directly — otherwise prefetching steals work from idle capacity
+        # and SERIALIZES a small fan-out.
+        idle_idx = cache.get("idle") if cache is not None else None
+        if idle_idx is None or any(
+            lst for kind in idle_idx.values() for lst in kind.values()
+        ):
+            return
+        head = self.ready_queue[0]
+        hspec = head.spec
+        if (
+            hspec.task_type != TaskType.NORMAL_TASK
+            or hspec.num_returns == -1
+            or hspec.arg_refs
+            or hspec.task_id.hex() in self.cancelled
+            or head.sched_sig(hspec.resources.get("TPU", 0) > 0) != sig
+        ):
+            return
+        self.ready_queue.popleft()
+        task_hex = hspec.task_id.hex()
+        self.running[task_hex] = (ws.worker_id, head)
+        ws.prefetch_task = task_hex
+        asyncio.ensure_future(self._dispatch_prefetch(ws, head))
+
+    async def _dispatch_prefetch(self, ws: WorkerState, pt: PendingTask):
+        spec = pt.spec
+        try:
+            await ws.conn.send(
+                {
+                    "type": "execute_task",
+                    "spec": spec_to_proto_bytes(spec),
+                    "deps": {},
+                }
+            )
+        except Exception:  # noqa: BLE001 — send failed: worker is dying;
+            # _on_worker_death will retry the task via self.running.
+            pass
+
     def _finish_cancelled(self, pt: PendingTask):
         self._fail_task(pt, TaskError(TaskCancelledError(), "", pt.spec.name))
 
@@ -1937,9 +2008,16 @@ class Controller:
         ws = self.workers.get(meta["worker_id"]) if meta["worker_id"] else None
         node_id = ws.node_id if ws is not None else HEAD_NODE
         if ws is not None and ws.state == BUSY:
-            ws.state = IDLE
-            ws.current_task = None
-            self._grant_release(ws)
+            if ws.current_task == task_hex and ws.prefetch_task is not None:
+                # Lease reuse: the next task is already queued on the worker —
+                # keep the grant, promote, skip the idle→dispatch round trip.
+                ws.current_task = ws.prefetch_task
+                ws.prefetch_task = None
+            else:
+                ws.state = IDLE
+                ws.current_task = None
+                ws.prefetch_task = None
+                self._grant_release(ws)
         if ws is not None and ws.actor_hex:
             astate = self.actors.get(ws.actor_hex)
             if astate is not None:
@@ -2186,16 +2264,28 @@ class Controller:
                 ws.assigned_pg = None
         self._worker_procs.pop(worker_id, None)
         if prev_state == BUSY and ws.current_task:
-            entry = self.running.pop(ws.current_task, None)
-            if entry is not None:
+            dead_tasks = [(ws.current_task, True)]
+            if ws.prefetch_task is not None:
+                dead_tasks.append((ws.prefetch_task, False))
+                ws.prefetch_task = None
+            for task_hex, started in dead_tasks:
+                entry = self.running.pop(task_hex, None)
+                if entry is None:
+                    continue
                 _, pt = entry
-                if ws.current_task in self.cancelled:
+                if task_hex in self.cancelled:
                     self._finish_cancelled(pt)
+                elif not started:
+                    # Prefetched-but-never-executed: plain requeue, no retry
+                    # consumed (it would have still been in ready_queue
+                    # without prefetch).
+                    pt.pinned_node = None
+                    self._enqueue(pt)
                 elif pt.retries_left > 0:
                     pt.retries_left -= 1
                     pt.spec.attempt_number += 1
                     pt.pinned_node = None  # re-pick; the node may be gone
-                    self._event("task_retry", task=ws.current_task)
+                    self._event("task_retry", task=task_hex)
                     self._enqueue(pt)
                 else:
                     err = TaskError(
@@ -2370,10 +2460,22 @@ class Controller:
         task_hex = msg["task"]
         self.cancelled.add(task_hex)
         entry = self.running.get(task_hex)
-        if entry is not None and msg.get("force"):
-            worker_id, _ = entry
+        if entry is not None:
+            worker_id, pt = entry
             ws = self.workers.get(worker_id)
-            if ws is not None:
+            if ws is not None and ws.prefetch_task == task_hex:
+                # Prefetched but not yet executing: drop it on the worker —
+                # force-killing would take down the UNRELATED current task.
+                ws.prefetch_task = None
+                self.running.pop(task_hex, None)
+                try:
+                    await ws.conn.send({"type": "drop_task", "task": task_hex})
+                except Exception:  # noqa: BLE001
+                    pass
+                self._finish_cancelled(pt)
+                self._schedule()
+                return {"ok": True}
+            if msg.get("force") and ws is not None:
                 self._terminate_worker(ws)
         # Pending-in-queue tasks are culled in _schedule.
         pt = self.waiting_tasks.pop(task_hex, None)

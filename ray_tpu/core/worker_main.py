@@ -42,6 +42,10 @@ class WorkerProcess:
         self._actor_hex: Optional[str] = None
         self.actor_pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
         self._stop = False
+        # Task hexes cancelled while queued behind the current task
+        # (controller "drop_task") — set from the io thread, read by the
+        # main loop BEFORE executing each queued task.
+        self._dropped: set = set()
         self._start_orphan_watchdog()
 
     def _start_orphan_watchdog(self):
@@ -88,6 +92,11 @@ class WorkerProcess:
         await conn.request(payload)
 
     async def _on_push(self, msg: dict):
+        if msg.get("type") == "drop_task":
+            # Out-of-band: must take effect before the queued execute_task
+            # reaches the main loop.
+            self._dropped.add(msg["task"])
+            return
         self.task_queue.put(msg)
 
     async def _on_close(self):
@@ -374,6 +383,9 @@ class WorkerProcess:
 
             spec: TaskSpec = spec_from_proto_bytes(msg["spec"])
             deps = msg.get("deps", {})
+            if spec.task_id.hex() in self._dropped:
+                self._dropped.discard(spec.task_id.hex())
+                continue  # cancelled while queued — no execution, no task_done
             if mtype == "execute_task":
                 self._execute(spec, deps, is_actor_method=False)
             elif mtype == "create_actor":

@@ -239,3 +239,49 @@ def test_tasks_not_starved_by_actor_filled_pool(cluster):
     assert ray_tpu.get(plain.remote(), timeout=60) == "ran"
     for a in actors:
         ray_tpu.kill(a)
+
+
+def test_cancel_prefetched_task(cluster):
+    """A task queued BEHIND a running one (lease-reuse prefetch) must
+    cancel cleanly — dropped on the worker, no execution, and the running
+    task unharmed."""
+    import time as _time
+
+    from ray_tpu.core.exceptions import TaskCancelledError
+
+    @ray_tpu.remote(num_cpus=4)  # consumes the whole pool → one worker lane
+    def slow():
+        _time.sleep(1.2)
+        return "slow-done"
+
+    @ray_tpu.remote(num_cpus=4)
+    def behind():
+        return "ran"
+
+    a = slow.remote()
+    _time.sleep(0.3)  # a is running; b prefetches behind it
+    b = behind.remote()
+    _time.sleep(0.2)
+    ray_tpu.cancel(b)
+    assert ray_tpu.get(a, timeout=30) == "slow-done"  # untouched
+    with pytest.raises(Exception) as ei:
+        ray_tpu.get(b, timeout=30)
+    assert "Cancel" in type(ei.value).__name__ or "cancel" in str(ei.value).lower()
+
+
+def test_prefetch_does_not_serialize_small_fanout(cluster):
+    """With idle workers available, same-shape tasks must run in PARALLEL
+    (prefetch only pipelines when no idle capacity remains)."""
+    import time as _time
+
+    @ray_tpu.remote(num_cpus=1)
+    def sleepy():
+        _time.sleep(0.8)
+        return 1
+
+    # Warm the pool so workers exist.
+    ray_tpu.get([sleepy.remote() for _ in range(4)], timeout=60)
+    t0 = _time.monotonic()
+    assert sum(ray_tpu.get([sleepy.remote() for _ in range(4)], timeout=60)) == 4
+    dt = _time.monotonic() - t0
+    assert dt < 1.6, f"4 parallel 0.8s tasks took {dt:.2f}s — serialized"
