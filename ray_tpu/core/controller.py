@@ -76,6 +76,10 @@ class WorkerState:
     # one same-shape argless task queued BEHIND current_task on this worker,
     # promoted at task_done without a scheduler round trip.
     prefetch_task: Optional[str] = None
+    # Task hex a reclaim push is in flight for (see
+    # _reclaim_stranded_prefetches) — suppresses duplicate reclaims; cleared
+    # by the worker's task_dropped push or by task_done (reclaim lost).
+    reclaiming_task: Optional[str] = None
     blocked: bool = False
     node_id: str = HEAD_NODE
     has_tpu: bool = False
@@ -1902,6 +1906,94 @@ class Controller:
         head_live = live_by_node.get(self.head.node_id, 0)
         for _ in range(max(0, min(deficit, rt_config.get("worker_prestart_cap")))):
             self._spawn_worker(live_count=head_live)
+        self._reclaim_stranded_prefetches()
+
+    def _reclaim_stranded_prefetches(self):
+        """Un-strand prefetched tasks: a task pipelined behind a busy worker
+        (_maybe_prefetch) waits on that worker's current task — if a worker
+        that could actually RUN it has since gone idle, ask the busy worker
+        to give the un-started spec back. The protocol is event-driven (no
+        timeouts, no ambiguity): the reclaim is a one-way push; the worker
+        answers with its own `task_dropped` push only if the drop beat
+        execution (h_task_dropped requeues), else its `task_done` arrives as
+        usual and the reclaim dissolves."""
+        pending = [
+            ws for ws in self.workers.values()
+            if ws.prefetch_task is not None and ws.reclaiming_task is None
+            and ws.conn is not None
+        ]
+        if not pending:
+            return
+        idle = [
+            w for w in self.workers.values()
+            if w.state == IDLE and w.conn is not None
+        ]
+        if not idle:
+            return
+        for ws in pending:
+            if not idle:
+                break
+            entry = self.running.get(ws.prefetch_task)
+            if entry is None:
+                continue
+            demand = entry[1].spec.resources
+            need_tpu = demand.get("TPU", 0) > 0
+            # Reclaiming only helps if some idle worker can take the task NOW
+            # (TPU-capability match + node capacity) — otherwise the task
+            # would lose its guaranteed next-in-line slot for nothing. Each
+            # matched idle worker is consumed so at most idle-capacity-many
+            # prefetches are pulled back per pass.
+            match = next(
+                (
+                    w for w in idle
+                    if (w.has_tpu or not need_tpu)
+                    and w.node_id in self.nodes
+                    and self._fits_node(self.nodes[w.node_id], demand)
+                ),
+                None,
+            )
+            if match is None:
+                continue
+            idle.remove(match)
+            ws.reclaiming_task = ws.prefetch_task
+            asyncio.ensure_future(self._send_reclaim(ws, ws.prefetch_task))
+
+    async def _send_reclaim(self, ws: WorkerState, task_hex: str):
+        try:
+            await ws.conn.send({"type": "reclaim_task", "task": task_hex})
+        except Exception:  # noqa: BLE001 — worker dying; death path requeues
+            ws.reclaiming_task = None
+
+    async def h_task_dropped(self, conn, meta, msg):
+        """The worker dropped a reclaimed prefetch before executing it.
+        Worker→controller FIFO means any task_done(current) sorted before
+        this, so exactly two worker states are possible: the task is still
+        prefetch-pending, or it was promoted to current (in which case the
+        worker is actually idle — it skipped the spec)."""
+        task_hex = msg["task"]
+        ws = self.workers.get(meta["worker_id"]) if meta.get("worker_id") else None
+        if ws is not None:
+            if ws.reclaiming_task == task_hex:
+                ws.reclaiming_task = None
+            if ws.prefetch_task == task_hex:
+                ws.prefetch_task = None
+            elif ws.current_task == task_hex and ws.state == BUSY:
+                ws.state = IDLE
+                ws.current_task = None
+                self._grant_release(ws)
+        entry = self.running.pop(task_hex, None)
+        if entry is None:
+            return None
+        if task_hex in self.cancelled:
+            self._finish_cancelled(entry[1])
+        else:
+            self.ready_queue.appendleft(entry[1])  # it was the FIFO head
+            self._event(
+                "task_reclaimed", task=task_hex,
+                worker=ws.worker_id if ws is not None else "",
+            )
+        self._schedule()
+        return None
 
     def _maybe_prefetch(
         self,
@@ -2007,6 +2099,8 @@ class Controller:
             self._unpin_args(entry[1].spec)
         ws = self.workers.get(meta["worker_id"]) if meta["worker_id"] else None
         node_id = ws.node_id if ws is not None else HEAD_NODE
+        if ws is not None and ws.reclaiming_task == task_hex:
+            ws.reclaiming_task = None  # reclaim lost the race — task executed
         if ws is not None and ws.state == BUSY:
             if ws.current_task == task_hex and ws.prefetch_task is not None:
                 # Lease reuse: the next task is already queued on the worker —

@@ -22,6 +22,7 @@ def options_from_kwargs(base: TaskOptions, **kwargs) -> TaskOptions:
         if k not in _VALID_OPTION_KEYS:
             raise ValueError(f"Unknown option {k!r}; valid: {sorted(_VALID_OPTION_KEYS)}")
         setattr(opts, k, v)
+    opts.__post_init__()  # re-normalize (e.g. num_returns="streaming" → -1)
     return opts
 
 
@@ -50,7 +51,7 @@ class RemoteFunction:
 
         runtime = api._global_runtime()
         refs = runtime.submit_task(self._function, args, kwargs, opts)
-        if opts.num_returns in ("streaming", "dynamic"):
+        if opts.num_returns == -1:  # streaming/dynamic (canonical sentinel)
             return refs  # an ObjectRefGenerator
         if opts.num_returns == 1:
             return refs[0]

@@ -137,9 +137,8 @@ class Runtime:
         options = self._prepare_runtime_env(options)
         payload, arg_refs = self._build_payload(func, args, kwargs)
         num_returns = options.num_returns
-        streaming = num_returns in ("streaming", "dynamic")
+        streaming = num_returns == -1  # canonical sentinel (TaskOptions)
         if streaming:
-            num_returns = -1  # wire sentinel (reference: returns_dynamic)
             return_ids: List[ObjectID] = []
         else:
             return_ids = [ObjectID.of(task_id, i) for i in range(max(num_returns, 1))]
@@ -230,13 +229,12 @@ class Runtime:
         task_id = TaskID.of(actor_id)
         payload, arg_refs = self._build_payload(None, args, kwargs)
         num_returns = options.num_returns
-        streaming = num_returns in ("streaming", "dynamic")
+        # Streaming generator method (reference: `returns_dynamic` on
+        # actor tasks) — items flow through the same stream bookkeeping
+        # normal tasks use; the actor stays busy until the stream ends
+        # (ordered per-actor delivery is preserved).
+        streaming = num_returns == -1  # canonical sentinel (TaskOptions)
         if streaming:
-            # Streaming generator method (reference: `returns_dynamic` on
-            # actor tasks) — items flow through the same stream bookkeeping
-            # normal tasks use; the actor stays busy until the stream ends
-            # (ordered per-actor delivery is preserved).
-            num_returns = -1
             return_ids: List[ObjectID] = []
         else:
             return_ids = [ObjectID.of(task_id, i) for i in range(max(num_returns, 1))]

@@ -78,6 +78,13 @@ class TaskOptions:
     namespace: Optional[str] = None
     get_if_exists: bool = False
 
+    def __post_init__(self):
+        # -1 is the ONE canonical streaming sentinel (what the proto wire
+        # carries) — normalizing here means no consumer ever has to handle
+        # the "streaming"/"dynamic" string forms past construction.
+        if self.num_returns in ("streaming", "dynamic"):
+            self.num_returns = -1
+
     def resource_demand(self, default_num_cpus: float) -> Dict[str, float]:
         demand = dict(self.resources)
         cpus = self.num_cpus if self.num_cpus is not None else default_num_cpus
@@ -209,9 +216,7 @@ def spec_to_proto_bytes(spec: TaskSpec) -> bytes:
         po.num_tpus = o.num_tpus
     for k, v in o.resources.items():
         po.resources[k] = float(v)
-    po.num_returns = (
-        -1 if o.num_returns in ("streaming", "dynamic") else int(o.num_returns)
-    )
+    po.num_returns = int(o.num_returns)  # -1 sentinel since __post_init__
     po.max_retries = o.max_retries
     if isinstance(o.retry_exceptions, (list, tuple)):
         po.retry_exceptions = True

@@ -11,6 +11,7 @@ prefer main-thread init). Actors with max_concurrency > 1 get a thread pool.
 
 from __future__ import annotations
 
+import collections
 import concurrent.futures
 import os
 import queue
@@ -46,6 +47,17 @@ class WorkerProcess:
         # (controller "drop_task") — set from the io thread, read by the
         # main loop BEFORE executing each queued task.
         self._dropped: set = set()
+        # Guards _dropped + _current_task_hex across the io thread (reclaim
+        # requests) and the main loop (dequeue→execute transition): a reclaim
+        # must land either strictly before execution starts (dropped=True) or
+        # observe the task as started (dropped=False) — never in between.
+        self._task_lock = threading.Lock()
+        self._current_task_hex: Optional[str] = None
+        # Recently completed task hexes (bounded): a reclaim for a task that
+        # already EXECUTED must answer "not dropped" even after current has
+        # moved on — a spurious drop would poison a later re-dispatch of the
+        # same task id (retry/reconstruction) on this worker.
+        self._done_hexes = collections.deque(maxlen=128)
         self._start_orphan_watchdog()
 
     def _start_orphan_watchdog(self):
@@ -95,7 +107,24 @@ class WorkerProcess:
         if msg.get("type") == "drop_task":
             # Out-of-band: must take effect before the queued execute_task
             # reaches the main loop.
-            self._dropped.add(msg["task"])
+            with self._task_lock:
+                self._dropped.add(msg["task"])
+            return
+        if msg.get("type") == "reclaim_task":
+            # Controller wants a queued (prefetched) task back for an idle
+            # worker. Droppable only if execution has not started; executed
+            # tasks stay silent — their task_done is already ahead of any
+            # reply on the FIFO connection. The ack is a one-way push so a
+            # slow reply can never be mistaken for a dead worker.
+            hex_ = msg["task"]
+            with self._task_lock:
+                dropped = (
+                    hex_ != self._current_task_hex and hex_ not in self._done_hexes
+                )
+                if dropped:
+                    self._dropped.add(hex_)
+            if dropped:
+                await self.conn.send({"type": "task_dropped", "task": hex_})
             return
         self.task_queue.put(msg)
 
@@ -383,11 +412,19 @@ class WorkerProcess:
 
             spec: TaskSpec = spec_from_proto_bytes(msg["spec"])
             deps = msg.get("deps", {})
-            if spec.task_id.hex() in self._dropped:
-                self._dropped.discard(spec.task_id.hex())
-                continue  # cancelled while queued — no execution, no task_done
+            with self._task_lock:
+                if spec.task_id.hex() in self._dropped:
+                    self._dropped.discard(spec.task_id.hex())
+                    skip = True  # dropped/reclaimed while queued — no task_done
+                else:
+                    skip = False
+                    self._current_task_hex = spec.task_id.hex()
+            if skip:
+                continue
             if mtype == "execute_task":
                 self._execute(spec, deps, is_actor_method=False)
+                with self._task_lock:
+                    self._done_hexes.append(spec.task_id.hex())
             elif mtype == "create_actor":
                 self._create_actor(spec, deps)
             elif mtype == "execute_actor_task":

@@ -10,6 +10,7 @@ g++ + ctypes per the environment contract).
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -18,16 +19,23 @@ from typing import Optional
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "src", "arena.cpp")
 _BUILD_DIR = os.path.join(_DIR, "_build")
-_LIB = os.path.join(_BUILD_DIR, "libray_tpu_arena.so")
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _build_error: Optional[str] = None
 
 
+def _lib_path(src: str, stem: str) -> str:
+    """Content-addressed output path: staleness keyed on the source hash,
+    never mtime (a fresh clone stamps all files with the same mtime)."""
+    with open(src, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:12]
+    return os.path.join(_BUILD_DIR, f"lib{stem}-{digest}.so")
+
+
 def _compile(src: str, lib_path: str, what: str) -> Optional[str]:
-    """Compile one .so if missing/stale. Returns an error string or None."""
-    if os.path.exists(lib_path) and os.path.getmtime(lib_path) >= os.path.getmtime(src):
+    """Compile one .so if absent. Returns an error string or None."""
+    if os.path.exists(lib_path):
         return None
     os.makedirs(_BUILD_DIR, exist_ok=True)
     tmp = lib_path + f".tmp.{os.getpid()}"
@@ -42,11 +50,16 @@ def _compile(src: str, lib_path: str, what: str) -> Optional[str]:
     if proc.returncode != 0:
         return f"{what} build failed:\n{proc.stderr[-2000:]}"
     os.replace(tmp, lib_path)  # atomic: concurrent builders race safely
+    # Prune siblings from older source revisions (content-addressed names
+    # accumulate otherwise; live processes keep their mmap via the open fd).
+    stem = os.path.basename(lib_path).rsplit("-", 1)[0]
+    for f in os.listdir(_BUILD_DIR):
+        if f.startswith(stem + "-") and f.endswith(".so") and f != os.path.basename(lib_path):
+            try:
+                os.remove(os.path.join(_BUILD_DIR, f))
+            except OSError:
+                pass
     return None
-
-
-def _ensure_built() -> Optional[str]:
-    return _compile(_SRC, _LIB, "arena")
 
 
 def load_arena_lib() -> Optional[ctypes.CDLL]:
@@ -57,11 +70,15 @@ def load_arena_lib() -> Optional[ctypes.CDLL]:
             return _lib
         if _build_error is not None:
             return None
-        err = _ensure_built()
+        lib_path = _lib_path(_SRC, "ray_tpu_arena")
+        err = _compile(_SRC, lib_path, "arena")
         if err is not None:
             _build_error = err
             return None
-        lib = ctypes.CDLL(_LIB)
+        lib = _dlopen(_SRC, lib_path, "arena")
+        if lib is None:
+            _build_error = "arena dlopen failed (see stderr)"
+            return None
         lib.rt_arena_create.restype = ctypes.c_void_p
         lib.rt_arena_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64]
         lib.rt_arena_attach.restype = ctypes.c_void_p
@@ -96,13 +113,27 @@ def load_arena_lib() -> Optional[ctypes.CDLL]:
         return _lib
 
 
+def _dlopen(src: str, lib_path: str, what: str) -> Optional[ctypes.CDLL]:
+    """CDLL with one rebuild retry: a concurrent builder's prune can remove
+    this digest's file between the existence check and dlopen (shared
+    checkout mid-update) — rebuild from source rather than crash."""
+    try:
+        return ctypes.CDLL(lib_path)
+    except OSError:
+        if _compile(src, lib_path, what) is not None:
+            return None
+        try:
+            return ctypes.CDLL(lib_path)
+        except OSError:
+            return None
+
+
 def build_error() -> Optional[str]:
     return _build_error
 
 
 # ------------------------------------------------------- channel (seqlock)
 _CH_SRC = os.path.join(_DIR, "src", "channel.cpp")
-_CH_LIB = os.path.join(_BUILD_DIR, "libray_tpu_channel.so")
 _ch_lib: Optional[ctypes.CDLL] = None
 _ch_error: Optional[str] = None
 
@@ -116,11 +147,15 @@ def load_channel_lib() -> Optional[ctypes.CDLL]:
             return _ch_lib
         if _ch_error is not None:
             return None
-        err = _compile(_CH_SRC, _CH_LIB, "channel")
+        lib_path = _lib_path(_CH_SRC, "ray_tpu_channel")
+        err = _compile(_CH_SRC, lib_path, "channel")
         if err is not None:
             _ch_error = err
             return None
-        lib = ctypes.CDLL(_CH_LIB)
+        lib = _dlopen(_CH_SRC, lib_path, "channel")
+        if lib is None:
+            _ch_error = "channel dlopen failed (see stderr)"
+            return None
         lib.rtpu_ch_write.restype = ctypes.c_int64
         lib.rtpu_ch_write.argtypes = [
             ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p,

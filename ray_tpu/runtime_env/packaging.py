@@ -116,6 +116,16 @@ def ensure_unpacked(zip_path: str, cache_root: str) -> str:
     tmp = f"{target}.tmp.{os.getpid()}"
     shutil.rmtree(tmp, ignore_errors=True)
     with zipfile.ZipFile(zip_path) as zf:
+        # Zip-slip guard: every member must resolve INSIDE the target dir
+        # (the package root is shared across jobs — a crafted archive must
+        # not write elsewhere via absolute paths or '..' components).
+        root = os.path.realpath(tmp)
+        for member in zf.namelist():
+            dest = os.path.realpath(os.path.join(root, member))
+            if dest != root and not dest.startswith(root + os.sep):
+                raise ValueError(
+                    f"unsafe member path {member!r} in {zip_path}"
+                )
         zf.extractall(tmp)
     try:
         os.rename(tmp, target)

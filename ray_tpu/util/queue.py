@@ -1,12 +1,17 @@
 """Distributed Queue — an actor-backed multi-producer/consumer queue.
 
 Reference analog: `python/ray/util/queue.py` (asyncio-actor-backed Queue
-with Empty/Full mirroring the stdlib `queue` contract).
+with Empty/Full mirroring the stdlib `queue` contract). Blocking put/get
+park SERVER-SIDE on a condition variable inside the actor (the reference
+blocks in the asyncio actor the same way) — a blocked caller holds one
+in-flight RPC instead of polling the control plane.
 """
 
 from __future__ import annotations
 
+import threading
 import time
+from collections import deque
 from queue import Empty, Full  # re-exported, stdlib-compatible
 from typing import Any, List, Optional
 
@@ -14,48 +19,85 @@ from ..core import api
 
 __all__ = ["Queue", "Empty", "Full"]
 
+# Server-side waits are chunked: the actor's thread pool is finite, so a
+# wait must release its thread periodically or fully-parked getters could
+# starve the put that would wake them.
+_WAIT_CHUNK_S = 2.0
+
 
 class _QueueActor:
     def __init__(self, maxsize: int):
-        from collections import deque
-
         self.maxsize = maxsize
         self.items: deque = deque()
+        self._cv = threading.Condition()
 
     def qsize(self) -> int:
-        return len(self.items)
+        with self._cv:
+            return len(self.items)
+
+    def _has_room(self, n: int = 1) -> bool:
+        return self.maxsize <= 0 or len(self.items) + n <= self.maxsize
 
     def put_nowait(self, item) -> bool:
-        if self.maxsize > 0 and len(self.items) >= self.maxsize:
-            return False
-        self.items.append(item)
-        return True
+        with self._cv:
+            if not self._has_room():
+                return False
+            self.items.append(item)
+            self._cv.notify_all()
+            return True
 
     def put_nowait_batch(self, items: List[Any]) -> bool:
-        if self.maxsize > 0 and len(self.items) + len(items) > self.maxsize:
-            return False
-        self.items.extend(items)
-        return True
+        with self._cv:
+            if not self._has_room(len(items)):
+                return False
+            self.items.extend(items)
+            self._cv.notify_all()
+            return True
+
+    def put_wait(self, item, timeout_s: float) -> bool:
+        """Blocking put: parks up to timeout_s on the actor, not the caller."""
+        with self._cv:
+            if not self._cv.wait_for(self._has_room, timeout_s):
+                return False
+            self.items.append(item)
+            self._cv.notify_all()
+            return True
 
     def get_nowait(self):
-        if not self.items:
-            return False, None
-        return True, self.items.popleft()
+        with self._cv:
+            if not self.items:
+                return False, None
+            item = self.items.popleft()
+            self._cv.notify_all()
+            return True, item
 
     def get_nowait_batch(self, n: int):
-        got = []
-        while self.items and len(got) < n:
-            got.append(self.items.popleft())
-        return got
+        with self._cv:
+            got = []
+            while self.items and len(got) < n:
+                got.append(self.items.popleft())
+            if got:
+                self._cv.notify_all()
+            return got
+
+    def get_wait(self, timeout_s: float):
+        with self._cv:
+            if not self._cv.wait_for(lambda: len(self.items) > 0, timeout_s):
+                return False, None
+            item = self.items.popleft()
+            self._cv.notify_all()
+            return True, item
 
 
 class Queue:
-    """Sync facade over the queue actor. Blocking put/get poll the actor
-    (control-plane messages are cheap; poll interval backs off to 50ms)."""
+    """Sync facade over the queue actor; blocking calls wait server-side."""
 
     def __init__(self, maxsize: int = 0, actor_options: Optional[dict] = None):
         opts = dict(actor_options or {})
         opts.setdefault("num_cpus", 0)
+        # Enough actor threads that parked waiters leave room for the
+        # put/get that wakes them (waits also self-expire per _WAIT_CHUNK_S).
+        opts.setdefault("max_concurrency", 32)
         self.maxsize = maxsize
         self.actor = api.remote(**opts)(_QueueActor).remote(maxsize)
 
@@ -76,14 +118,14 @@ class Queue:
                 raise Full
             return
         deadline = None if timeout is None else time.monotonic() + timeout
-        delay = 0.001
         while True:
-            if api.get(self.actor.put_nowait.remote(item)):
+            chunk = _WAIT_CHUNK_S
+            if deadline is not None:
+                chunk = min(chunk, deadline - time.monotonic())
+                if chunk <= 0:
+                    raise Full
+            if api.get(self.actor.put_wait.remote(item, chunk)):
                 return
-            if deadline is not None and time.monotonic() >= deadline:
-                raise Full
-            time.sleep(delay)
-            delay = min(delay * 2, 0.05)
 
     def put_nowait(self, item: Any):
         self.put(item, block=False)
@@ -100,15 +142,15 @@ class Queue:
                 raise Empty
             return item
         deadline = None if timeout is None else time.monotonic() + timeout
-        delay = 0.001
         while True:
-            ok, item = api.get(self.actor.get_nowait.remote())
+            chunk = _WAIT_CHUNK_S
+            if deadline is not None:
+                chunk = min(chunk, deadline - time.monotonic())
+                if chunk <= 0:
+                    raise Empty
+            ok, item = api.get(self.actor.get_wait.remote(chunk))
             if ok:
                 return item
-            if deadline is not None and time.monotonic() >= deadline:
-                raise Empty
-            time.sleep(delay)
-            delay = min(delay * 2, 0.05)
 
     def get_nowait(self) -> Any:
         return self.get(block=False)

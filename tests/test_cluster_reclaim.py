@@ -1,0 +1,45 @@
+"""Lease-prefetch reclaim: a task pipelined behind a busy worker is pulled
+back when other capacity idles (controller `_reclaim_stranded_prefetches`)."""
+
+import pytest
+
+import ray_tpu
+
+pytestmark = pytest.mark.cluster
+
+
+def test_prefetch_reclaimed_when_other_worker_idles(monkeypatch):
+    """A task prefetched behind a long-running worker must be RECLAIMED once
+    another worker goes idle — not stranded until the long task finishes."""
+    import time as _time
+
+    ray_tpu.shutdown()
+    # No speculative prestart: the scenario needs exactly two worker lanes so
+    # the dispatch that pipelines t2 behind t1 sees zero idle capacity.
+    monkeypatch.setenv("RAY_TPU_WORKER_PRESTART_CAP", "0")
+    ray_tpu.init(num_cpus=4)
+    try:
+        @ray_tpu.remote(num_cpus=2)
+        def busy(t):
+            _time.sleep(t)
+            return t
+
+        # Warm exactly two 2-CPU worker lanes.
+        ray_tpu.get([busy.remote(0.8), busy.remote(0.8)], timeout=60)
+        a = busy.remote(1.5)   # lane 1
+        b = busy.remote(0.6)   # lane 2
+        _time.sleep(0.2)       # both dispatched
+        t1 = busy.remote(4.0)  # queued: no capacity, no idle worker
+        t2 = busy.remote(0.3)  # queued behind t1 (same scheduling signature)
+        t0 = _time.monotonic()
+        # When b finishes, t1 takes lane 2 and t2 prefetches behind it; when a
+        # finishes, lane 1 idles → t2 must be reclaimed and run there (~1.8s),
+        # not wait out t1's 4s sleep (~4.6s).
+        assert ray_tpu.get(t2, timeout=30) == 0.3
+        dt = _time.monotonic() - t0
+        assert dt < 3.0, f"prefetched task stranded behind busy worker ({dt:.1f}s)"
+        assert ray_tpu.get([a, b, t1], timeout=30) == [1.5, 0.6, 4.0]
+    finally:
+        ray_tpu.shutdown()
+
+
