@@ -26,6 +26,7 @@ from typing import Dict, List, Optional
 import cloudpickle
 
 from .core.cluster_backend import ClusterBackend
+from .core.rpc import ensure_auth_token
 
 
 def read_sentinel(proc: subprocess.Popen, prefix: str, timeout: float) -> Optional[str]:
@@ -62,6 +63,7 @@ def launch_node_agent(
     object_store_memory: Optional[int] = None,
     wait_ready: bool = True,
     labels: Optional[Dict[str, str]] = None,
+    node_ip: Optional[str] = None,
 ) -> subprocess.Popen:
     """Spawn one `node_agent` daemon process joining the cluster at
     `address`. Shared by the test `Cluster` fixture and the autoscaler's
@@ -75,7 +77,9 @@ def launch_node_agent(
         "session_dir": session_dir,
         "object_store_memory": object_store_memory,
         "labels": labels or {},
+        "node_ip": node_ip,
     }
+    ensure_auth_token()
     env = dict(os.environ)
     pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
@@ -134,6 +138,7 @@ class Cluster:
             )
         os.makedirs(self.session_dir, exist_ok=True)
         self._head_args = (num_cpus, resources, object_store_memory)
+        ensure_auth_token()  # controller + agents + drivers share the secret
         args = {
             "num_cpus": float(num_cpus),
             "resources": resources,
@@ -163,7 +168,9 @@ class Cluster:
             )
         port = int(val)
         self.head_proc = proc
-        self.address = f"127.0.0.1:{port}"
+        from .core import config as rt_config
+
+        self.address = f"{rt_config.get('node_ip')}:{port}"
 
     def kill_head(self):
         """kill -9 the controller (GCS-FT chaos; workers survive — they are

@@ -21,7 +21,7 @@ from .backend import RuntimeBackend
 from .exceptions import GetTimeoutError, RayTpuError
 from .ids import ActorID, ObjectID, PlacementGroupID, TaskID
 from .object_ref import ObjectRef
-from .rpc import Connection, EventLoopThread
+from .rpc import Connection, EventLoopThread, ensure_auth_token, open_rpc_connection
 from .task_spec import TaskSpec
 
 
@@ -101,6 +101,7 @@ class ClusterBackend(RuntimeBackend):
             "object_store_memory": object_store_memory,
             "port": 0,
         }
+        ensure_auth_token()  # children inherit; connections authenticate
         env = dict(os.environ)
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
@@ -122,7 +123,9 @@ class ClusterBackend(RuntimeBackend):
             raise RayTpuError(
                 f"Controller failed to start (or timed out); see {session_dir}/controller.log"
             )
-        return f"127.0.0.1:{int(val)}", proc
+        from . import config as rt_config
+
+        return f"{rt_config.get('node_ip')}:{int(val)}", proc
 
     def reconnect(self) -> bool:
         """Re-establish this backend's connection after a controller restart
@@ -140,6 +143,11 @@ class ClusterBackend(RuntimeBackend):
             return False
 
     def _connect(self, register_as: str):
+        from .rpc import adopt_local_session_token
+
+        # Explicit-address clients on the head machine still need the
+        # session secret — discover it from session_latest if env lacks it.
+        adopt_local_session_token()
         self._register_as = register_as
         phases = {}  # diagnostic: where did a timed-out connect spend time?
 
@@ -151,7 +159,7 @@ class ClusterBackend(RuntimeBackend):
             host, port = self.address.rsplit(":", 1)
             try:
                 reader, writer = await asyncio.wait_for(
-                    asyncio.open_connection(host, int(port)), 10
+                    open_rpc_connection(host, int(port)), 10
                 )
             except TimeoutError:
                 phases["tcp_timeout"] = round(_t.monotonic() - t0, 2)
@@ -169,6 +177,12 @@ class ClusterBackend(RuntimeBackend):
 
         try:
             result = self.io.call(go(), timeout=20)
+        except ConnectionError as e:
+            raise RayTpuError(
+                "controller closed the connection during registration — "
+                "likely an auth mismatch (set RAY_TPU_AUTH_TOKEN to the "
+                "session token from the head's address.json)"
+            ) from e
         except TimeoutError as e:
             raise RayTpuError(
                 f"controller connect timed out (phases reached: {phases}; "

@@ -92,6 +92,14 @@ define("snapshot_interval_s", 1.0, doc="Controller state snapshot period")
 define("gcs_storage", "file",
        doc="Metadata backend url: file[://dir] (durable) | memory (volatile)")
 define("pull_timeout_s", 120.0, doc="Cross-node object pull timeout")
+# Networking (reference: `node_ip_address` plumbed through every process,
+# `services.py:295-305`). node_ip is what THIS machine advertises to the
+# cluster; bind_address is the listen interface (empty = node_ip).
+define("node_ip", "127.0.0.1",
+       doc="Address this node advertises to peers (head: controller addr; "
+           "workers/agents: their fetch addr)")
+define("bind_address", "",
+       doc="Interface RPC servers bind; empty = node_ip, 0.0.0.0 = all")
 # Observability.
 define("dashboard", True, doc="Serve the HTTP dashboard from the controller")
 define("dashboard_port", 0, doc="Dashboard port (0 = ephemeral)")

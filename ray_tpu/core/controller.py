@@ -39,6 +39,7 @@ from .exceptions import (
 )
 from . import config as rt_config
 from .rpc import Connection, read_msg
+from .rpc import auth_token as rpc_auth_token, open_rpc_connection
 from .ids import ObjectID
 from .task_spec import (
     spec_from_proto_bytes,
@@ -324,13 +325,17 @@ class Controller:
             self.local_store = store.make_store(
                 create_arena=True, arena_capacity=self.object_store_memory
             )
+        # Real-host networking (reference: node_ip_address plumbing,
+        # `services.py:295-305`): advertise node_ip, listen on bind_address.
+        self.node_ip = rt_config.get("node_ip")
+        bind = rt_config.get("bind_address") or self.node_ip
         self._server = await asyncio.start_server(
-            self._on_connection, host="127.0.0.1", port=self.port
+            self._on_connection, host=bind, port=self.port
         )
         self.port = self._server.sockets[0].getsockname()[1]
         # Prometheus exposition (reference: `metrics_agent.py:83-95`).
         self._metrics_server = await asyncio.start_server(
-            self._on_metrics_connection, host="127.0.0.1", port=0
+            self._on_metrics_connection, host=bind, port=0
         )
         self.metrics_port = self._metrics_server.sockets[0].getsockname()[1]
         # Dashboard (reference: `dashboard/head.py`; here an in-process HTTP
@@ -510,14 +515,21 @@ class Controller:
         import json
 
         info = {
-            "address": f"127.0.0.1:{self.port}",
-            "metrics_url": f"http://127.0.0.1:{self.metrics_port}/metrics",
+            "address": f"{self.node_ip}:{self.port}",
+            "metrics_url": f"http://{self.node_ip}:{self.metrics_port}/metrics",
             "session_dir": self.session_dir,
             "pid": os.getpid(),
+            # Local CLI/driver discovery; remote joiners get the token
+            # out-of-band (documented in README multi-host bring-up).
+            "auth_token": rpc_auth_token(),
         }
         if getattr(self, "dashboard", None) is not None:
-            info["dashboard_url"] = f"http://127.0.0.1:{self.dashboard.port}"
-        with open(os.path.join(self.session_dir, "address.json"), "w") as f:
+            info["dashboard_url"] = f"http://{self.node_ip}:{self.dashboard.port}"
+        # 0600: the file carries the auth token — other local users must not
+        # read their way past the handshake on a multi-user machine.
+        path = os.path.join(self.session_dir, "address.json")
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
             json.dump(info, f)
         link = "/tmp/ray_tpu/session_latest"
         try:
@@ -613,7 +625,7 @@ class Controller:
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
         env["RAY_TPU_WORKER_ID"] = worker_id
-        env["RAY_TPU_ADDRESS"] = f"127.0.0.1:{self.port}"
+        env["RAY_TPU_ADDRESS"] = f"{self.node_ip}:{self.port}"
         env["RAY_TPU_SESSION_DIR"] = self.session_dir
         env["RAY_TPU_SESSION_TAG"] = store.SESSION_TAG
         env["PYTHONUNBUFFERED"] = "1"  # log tailing needs unbuffered stdout
@@ -646,7 +658,7 @@ class Controller:
 
     # ---------------------------------------------------------- connection
     async def _on_connection(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
-        conn = Connection(reader, writer)
+        conn = Connection(reader, writer, expected_token=rpc_auth_token())
         meta = {"kind": None, "worker_id": None, "conn_id": next(self._conn_counter)}
 
         async def on_push(msg: dict):
@@ -913,13 +925,13 @@ class Controller:
             node = self.nodes.get(nid)
             if node is None or not node.alive:
                 continue
-            addr = f"127.0.0.1:{self.port}" if nid == HEAD_NODE else node.fetch_addr
+            addr = f"{self.node_ip}:{self.port}" if nid == HEAD_NODE else node.fetch_addr
             return {"addr": addr, "name": name, "node": nid}
         if obj.spilled_path is not None:
             nid = obj.spilled_node
             node = self.nodes.get(nid)
             if node is not None and (nid == HEAD_NODE or node.alive):
-                addr = f"127.0.0.1:{self.port}" if nid == HEAD_NODE else node.fetch_addr
+                addr = f"{self.node_ip}:{self.port}" if nid == HEAD_NODE else node.fetch_addr
                 return {"addr": addr, "path": obj.spilled_path, "node": nid}
         return None
 
@@ -979,7 +991,7 @@ class Controller:
         conn = self._fetch_conns.get(src["node"])
         if conn is None or conn._closed:
             host, port = src["addr"].rsplit(":", 1)
-            reader, writer = await asyncio.open_connection(host, int(port))
+            reader, writer = await open_rpc_connection(host, int(port))
             conn = Connection(reader, writer)
             conn.start()
             self._fetch_conns[src["node"]] = conn
@@ -2776,7 +2788,7 @@ class Controller:
         runtime_env = msg.get("runtime_env") or {}
         env = dict(os.environ)
         env.update({k: str(v) for k, v in (runtime_env.get("env_vars") or {}).items()})
-        env["RAY_TPU_ADDRESS"] = f"127.0.0.1:{self.port}"
+        env["RAY_TPU_ADDRESS"] = f"{self.node_ip}:{self.port}"
         env["RAY_TPU_JOB_ID"] = job_id
         pkg_root = os.path.dirname(
             os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -2991,7 +3003,7 @@ class Controller:
                     "Labels": dict(n.labels),
                     "Resources": dict(n.total),
                     "Available": dict(n.available),
-                    "NodeManagerAddress": "127.0.0.1",
+                    "NodeManagerAddress": (self.node_ip if n.node_id == HEAD_NODE else n.fetch_addr.rsplit(":", 1)[0] if n.fetch_addr else ""),
                     "object_store_memory": n.object_store_memory
                     or self.object_store_memory,
                 }

@@ -10,6 +10,11 @@ from .controller import run_controller
 
 
 def main():
+    from .rpc import ensure_auth_token
+
+    # Manually-started heads (no driver set the secret yet): generate one —
+    # spawned workers/agents inherit it; drivers discover it in address.json.
+    ensure_auth_token()
     args = cloudpickle.loads(bytes.fromhex(os.environ["RAY_TPU_CONTROLLER_ARGS"]))
     profile_path = os.environ.get("RAY_TPU_CONTROLLER_PROFILE")
     if profile_path:

@@ -31,8 +31,9 @@ import sys
 import traceback
 from typing import Dict, Optional
 
+from . import config as rt_config
 from . import store
-from .rpc import Connection
+from .rpc import Connection, auth_token, open_rpc_connection
 
 
 def _set_pdeathsig():
@@ -54,8 +55,13 @@ class NodeAgent:
         session_dir: str,
         object_store_memory: Optional[int] = None,
         labels: Optional[Dict[str, str]] = None,
+        node_ip: Optional[str] = None,
     ):
         self.node_id = node_id
+        # This machine's advertised address (reference: per-node
+        # node_ip_address, `services.py:295-305`); launcher args override the
+        # per-machine RAY_TPU_NODE_IP env/config default.
+        self.node_ip = node_ip or rt_config.get("node_ip")
         self.controller_address = controller_address
         self.resources = resources
         self.session_dir = session_dir
@@ -75,13 +81,14 @@ class NodeAgent:
         self.local_store = store.make_store(
             create_arena=True, arena_capacity=self.object_store_memory
         )
+        bind = rt_config.get("bind_address") or self.node_ip
         self._server = await asyncio.start_server(
-            self._on_peer_connection, host="127.0.0.1", port=0
+            self._on_peer_connection, host=bind, port=0
         )
         self.fetch_port = self._server.sockets[0].getsockname()[1]
 
         host, port = self.controller_address.rsplit(":", 1)
-        reader, writer = await asyncio.open_connection(host, int(port))
+        reader, writer = await open_rpc_connection(host, int(port))
         self.conn = Connection(
             reader, writer, on_push=self._on_controller_push, on_close=self._on_controller_close
         )
@@ -91,7 +98,7 @@ class NodeAgent:
                 "type": "register_node",
                 "node_id": self.node_id,
                 "resources": self.resources,
-                "fetch_addr": f"127.0.0.1:{self.fetch_port}",
+                "fetch_addr": f"{self.node_ip}:{self.fetch_port}",
                 "session_tag": store.SESSION_TAG,
                 "object_store_memory": self.object_store_memory,
                 "labels": self.labels,
@@ -199,7 +206,7 @@ class NodeAgent:
         if conn is not None and not conn._closed:
             return conn
         host, port = addr.rsplit(":", 1)
-        reader, writer = await asyncio.open_connection(host, int(port))
+        reader, writer = await open_rpc_connection(host, int(port))
         conn = Connection(reader, writer)
         conn.start()
         self._peer_conns[addr] = conn
@@ -229,7 +236,7 @@ class NodeAgent:
 
     # ------------------------------------------------------- peer fetches
     async def _on_peer_connection(self, reader, writer):
-        conn = Connection(reader, writer)
+        conn = Connection(reader, writer, expected_token=auth_token())
 
         async def on_push(msg: dict):
             if msg.get("type") != "fetch_object" or msg.get("req_id") is None:
@@ -256,6 +263,7 @@ async def run_agent(args: dict):
         session_dir=args["session_dir"],
         object_store_memory=args.get("object_store_memory"),
         labels=args.get("labels"),
+        node_ip=args.get("node_ip"),
     )
     await agent.start()
     print(f"RAY_TPU_NODE_READY={agent.node_id}", flush=True)

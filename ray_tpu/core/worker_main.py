@@ -25,7 +25,7 @@ import cloudpickle
 
 from . import serialization, store
 from .exceptions import TaskError
-from .rpc import Connection, EventLoopThread
+from .rpc import Connection, EventLoopThread, open_rpc_connection
 from .task_spec import TaskSpec
 
 
@@ -88,7 +88,7 @@ class WorkerProcess:
     async def _connect(self):
         import asyncio
 
-        reader, writer = await asyncio.open_connection(self.host, self.port)
+        reader, writer = await open_rpc_connection(self.host, self.port)
         conn = Connection(reader, writer, on_push=self._on_push, on_close=self._on_close)
         conn.start()
         self.conn = conn

@@ -39,8 +39,11 @@ class DashboardServer:
         self._server: Optional[asyncio.base_events.Server] = None
 
     async def start(self, port: int = 0):
+        from ..core import config as rt_config
+
+        bind = rt_config.get("bind_address") or rt_config.get("node_ip")
         self._server = await asyncio.start_server(
-            self._on_connection, host="127.0.0.1", port=port
+            self._on_connection, host=bind, port=port
         )
         self.port = self._server.sockets[0].getsockname()[1]
 

@@ -31,7 +31,11 @@ class JobSubmissionClient:
             address = os.environ.get("RAY_TPU_ADDRESS")
         if address is None:
             with open("/tmp/ray_tpu/session_latest/address.json") as f:
-                address = json.load(f)["address"]
+                info = json.load(f)
+            address = info["address"]
+            from .core.rpc import adopt_auth_token
+
+            adopt_auth_token(info.get("auth_token", ""))
         from .core.cluster_backend import ClusterBackend
 
         self._backend = ClusterBackend(address)

@@ -29,6 +29,9 @@ def _resolve_address(flag: str | None) -> dict:
             raise SystemExit(
                 "session_latest points at a dead controller; pass --address"
             )
+        from ..core.rpc import adopt_auth_token
+
+        adopt_auth_token(info.get("auth_token", ""))
         return info
     except FileNotFoundError:
         raise SystemExit(
