@@ -44,6 +44,9 @@ class ClusterBackend(RuntimeBackend):
         # `python/ray/util/client`, redesigned onto the native protocol
         # instead of a separate proxy server).
         self.remote_client = False
+        # Direct call plane (leases + actor channels) — attached on connect
+        # for shm-local drivers/workers (core/direct.py).
+        self.direct = None
 
     def set_runtime(self, runtime):
         self._runtime = runtime
@@ -165,7 +168,7 @@ class ClusterBackend(RuntimeBackend):
                 phases["tcp_timeout"] = round(_t.monotonic() - t0, 2)
                 raise
             phases["tcp"] = round(_t.monotonic() - t0, 2)
-            conn = Connection(reader, writer)
+            conn = Connection(reader, writer, on_push=self._on_controller_push)
             conn.start()
             self.conn = conn
             payload = {"type": register_as, "node_id": os.environ.get("RAY_TPU_NODE_ID", "node0")}
@@ -203,6 +206,14 @@ class ClusterBackend(RuntimeBackend):
         from .ref_tracker import TRACKER
 
         def _flush_refs(add, release):
+            direct = self.direct
+            if direct is not None:
+                # Locally-owned direct results never hit the controller's
+                # directory: filter their adds; releases free the local copy.
+                add = [h for h in add if not direct.owns(h)]
+                release = [h for h in release if not direct.release(h)]
+                if not add and not release:
+                    return
             if self.conn is not None and not self.conn._closed:
                 self._send_nowait({"type": "update_refs", "add": add, "release": release})
 
@@ -210,6 +221,17 @@ class ClusterBackend(RuntimeBackend):
         # With the tag known, upgrade to the native arena store if this
         # session's controller created one (falls back silently otherwise).
         self.local_store = store.make_store()
+        # Steady-state fast path: leases + direct actor channels. Remote
+        # (ray://) clients stay on the classic plane — no shm locality and
+        # possibly no route to worker sockets.
+        if self.role in ("driver", "worker") and not self.remote_client:
+            from .direct import DirectCallManager
+
+            self.direct = DirectCallManager(self)
+
+    async def _on_controller_push(self, msg: dict):
+        if msg.get("type") == "revoke_lease" and self.direct is not None:
+            self.direct.on_revoke(msg["worker_id"])
 
     def _request(self, msg: dict, timeout: Optional[float] = None) -> Any:
         # Leave generous slack over the server-side timeout.
@@ -242,12 +264,10 @@ class ClusterBackend(RuntimeBackend):
         instead of a 300s get timeout)."""
         if self.conn is None or self.conn._closed:
             raise RayTpuError("Lost connection to controller (connection closed)")
-        err = getattr(self, "_pipelined_send_error", None)
-        if err is not None:
-            self._pipelined_send_error = None
-            raise RayTpuError(f"Lost connection to controller: {err}") from err
-        fut = self.io.call_nowait(self.conn.send(msg))
-        fut.add_done_callback(self._note_send_error)
+        try:
+            self.conn.post(msg)  # batched; a dead conn raises on the NEXT call
+        except ConnectionError as e:
+            raise RayTpuError(f"Lost connection to controller: {e}") from e
 
     def _note_send_error(self, fut):
         exc = fut.exception()
@@ -280,6 +300,10 @@ class ClusterBackend(RuntimeBackend):
         else:
             shm_name, inline, size = self.local_store.put(hex_id, value)
             contains = serialization.last_contained_refs()
+        if contains:
+            # The controller pins contained objects — locally-owned direct
+            # results must be in its directory before it learns the container.
+            self.ensure_published(contains)
         if inline is not None:
             self._request(
                 {"type": "put_inline", "id": hex_id, "data": inline, "contains": contains}
@@ -322,6 +346,42 @@ class ClusterBackend(RuntimeBackend):
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float]) -> List[Any]:
         if not refs:
             return []
+        if self.role == "worker" and self.worker is not None:
+            block_hook = getattr(self.worker, "on_nested_block", None)
+            if block_hook is not None:
+                block_hook()
+        if self.direct is None:
+            return self._get_classic(refs, timeout)
+        import time as _t
+
+        t0 = _t.monotonic()
+        pending = []
+        for r in refs:
+            got = self.direct.lookup(r.id.hex())
+            if got is not None and hasattr(got, "event"):
+                pending.append(got)
+        if pending and not self.direct.wait_pending(pending, timeout):
+            raise GetTimeoutError(
+                f"Timed out waiting for {len(pending)} direct task result(s)"
+            )
+        out: List[Any] = [None] * len(refs)
+        classic_refs, classic_pos = [], []
+        for i, r in enumerate(refs):
+            frame = self.direct.local_frame(r.id.hex())
+            if frame is not None:
+                out[i] = serialization.unpack(frame)
+            else:
+                classic_refs.append(r)
+                classic_pos.append(i)
+        if classic_refs:
+            rem = None if timeout is None else max(
+                0.0, timeout - (_t.monotonic() - t0)
+            )
+            for i, v in zip(classic_pos, self._get_classic(classic_refs, rem)):
+                out[i] = v
+        return out
+
+    def _get_classic(self, refs: Sequence[ObjectRef], timeout: Optional[float]) -> List[Any]:
         blocked = False
         if self.role == "worker" and self.worker is not None:
             blocked = True
@@ -359,6 +419,43 @@ class ClusterBackend(RuntimeBackend):
         return out
 
     def wait(self, refs, num_returns, timeout):
+        if self.direct is not None and any(
+            self.direct.lookup(r.id.hex()) is not None for r in refs
+        ):
+            return self._wait_composite(refs, num_returns, timeout)
+        return self._wait_classic(refs, num_returns, timeout)
+
+    def _wait_composite(self, refs, num_returns, timeout):
+        """Direct-owned refs resolve via local events; poll both planes
+        (wait() is not a throughput path)."""
+        import time as _t
+
+        deadline = None if timeout is None else _t.monotonic() + timeout
+        while True:
+            ready = []
+            maybe_classic = []
+            for r in refs:
+                got = self.direct.lookup(r.id.hex())
+                if got is None or got == ("registered",):
+                    maybe_classic.append(r)
+                elif not hasattr(got, "event"):
+                    ready.append(r)  # local frame
+            if maybe_classic and len(ready) < num_returns:
+                c_ready, _ = self._wait_classic(
+                    maybe_classic, min(num_returns, len(maybe_classic)), 0.05
+                )
+                ready.extend(c_ready)
+            if len(ready) >= num_returns or (
+                deadline is not None and _t.monotonic() >= deadline
+            ):
+                chosen = ready[:num_returns]
+                chosen_set = {r.id.hex() for r in chosen}
+                ordered = [r for r in refs if r.id.hex() in chosen_set]
+                not_ready = [r for r in refs if r.id.hex() not in chosen_set]
+                return ordered, not_ready
+            _t.sleep(0.02)
+
+    def _wait_classic(self, refs, num_returns, timeout):
         ids = [r.id.hex() for r in refs]
         resp = self._request(
             {"type": "wait_objects", "ids": ids, "num_returns": num_returns, "timeout": timeout},
@@ -374,6 +471,12 @@ class ClusterBackend(RuntimeBackend):
     def submit_task(self, spec: TaskSpec) -> None:
         from .task_spec import spec_to_proto_bytes
 
+        if (
+            self.direct is not None
+            and self.direct.eligible(spec)
+            and self.direct.submit(spec)
+        ):
+            return
         self._send_pipelined({"type": "submit_task", "spec": spec_to_proto_bytes(spec)})
 
     def create_actor(self, spec: TaskSpec, name: str, namespace: str) -> None:
@@ -397,6 +500,8 @@ class ClusterBackend(RuntimeBackend):
     def submit_actor_task(self, spec: TaskSpec) -> None:
         from .task_spec import spec_to_proto_bytes
 
+        if self.direct is not None and self.direct.submit_actor(spec):
+            return
         self._send_pipelined(
             {"type": "submit_actor_task", "spec": spec_to_proto_bytes(spec)}
         )
@@ -405,6 +510,8 @@ class ClusterBackend(RuntimeBackend):
         self._request({"type": "kill_actor", "actor": actor_id.hex(), "no_restart": no_restart})
 
     def cancel(self, ref: ObjectRef, force: bool, recursive: bool) -> None:
+        if self.direct is not None and self.direct.cancel(ref.id.task_id().hex()):
+            return
         self._request({"type": "cancel", "task": ref.id.task_id().hex(), "force": force})
 
     def get_named_actor(self, name: str, namespace: str) -> Optional[bytes]:
@@ -449,7 +556,34 @@ class ClusterBackend(RuntimeBackend):
         self._request({"type": "remove_pg", "id": pg_id.hex()})
 
     def free_objects(self, refs: Sequence[ObjectRef]) -> None:
-        self._request({"type": "free_objects", "ids": [r.id.hex() for r in refs]})
+        ids = [r.id.hex() for r in refs]
+        if self.direct is not None:
+            ids = [h for h in ids if not self.direct.release(h)]
+            if not ids:
+                return
+        self._request({"type": "free_objects", "ids": ids})
+
+    def ensure_published(self, hexes) -> None:
+        """Promote locally-owned direct results into the controller's object
+        directory before they escape this process (args / nested refs /
+        contained-in-put). FIFO on the controller conn guarantees the
+        publish lands before any dependent submission."""
+        if self.direct is None:
+            return
+        from .ref_tracker import TRACKER
+
+        for h in set(hexes):
+            # Flag FIRST: checking the frame first races task completion —
+            # resolve-between-the-two leaves the object unpublished forever.
+            if self.direct.flag_publish_on_done(h):
+                continue  # in flight — publishes the moment it resolves
+            frame = self.direct.local_frame(h)
+            if frame is None:
+                continue  # not direct-owned (classic or already registered)
+            self._send_pipelined({"type": "put_inline", "id": h, "data": frame})
+            self.direct.mark_registered(h)
+            if TRACKER.local_count(h) > 0:
+                self._send_nowait({"type": "update_refs", "add": [h], "release": []})
 
     # ------------------------------------------------- streaming generators
     def stream_next(self, task_hex: str, index: int, timeout: Optional[float] = 300.0) -> str:
@@ -527,6 +661,8 @@ class ClusterBackend(RuntimeBackend):
         from .ref_tracker import TRACKER
 
         TRACKER.set_flusher(None)
+        if self.direct is not None:
+            self.direct.close()
         if getattr(self, "_log_tailer", None) is not None:
             self._log_tailer_stop.set()
             self._log_tailer = None

@@ -57,6 +57,10 @@ BUSY = "busy"
 STARTING = "starting"
 ACTOR = "actor"
 DEAD = "dead"
+# Leased to a submitter for the direct task path (reference: worker leases,
+# `direct_task_transport.cc:135` — steady-state submissions bypass the
+# scheduler entirely; the controller only grants/returns the lease).
+LEASED = "leased"
 
 
 HEAD_NODE = "node0"
@@ -84,6 +88,13 @@ class WorkerState:
     blocked: bool = False
     node_id: str = HEAD_NODE
     has_tpu: bool = False
+    # Direct task plane: the worker's own listener for submitter→worker
+    # pushes (reference: core-worker gRPC server for PushNormalTask).
+    direct_addr: str = ""
+    # conn_id of the lease holder while state == LEASED.
+    leased_to: Optional[int] = None
+    # A revoke push is in flight to the lease holder.
+    revoking: bool = False
 
 
 @dataclass
@@ -154,6 +165,20 @@ class ObjectState:
             and not self.locations
             and self.spilled_path is None
         )
+
+
+class _HandoffFence:
+    """Direct-channel switch marker riding the actor send queue — duck-typed
+    to the TaskSpec fields the queue paths read (drain/unpin are no-ops)."""
+
+    __slots__ = ("token", "arg_refs", "return_ids", "num_returns", "name")
+
+    def __init__(self, token: str):
+        self.token = token
+        self.arg_refs = []
+        self.return_ids = []
+        self.num_returns = 0
+        self.name = "__handoff_fence__"
 
 
 @dataclass
@@ -261,6 +286,19 @@ class Controller:
         self.lineage: Dict[str, TaskSpec] = {}
         self._lineage_cap = rt_config.get("lineage_cap")
         self._conn_counter = itertools.count(1)
+        # conn_id → live Connection (lease revocation pushes to holders).
+        self._conns_by_id: Dict[int, Connection] = {}
+        # Direct actor-call handoff fences (h_actor_handoff).
+        self._handoff_counter = itertools.count(1)
+        self._handoff_waiters: Dict[str, asyncio.Future] = {}
+        # Unsatisfied lease requests → autoscaler demand (expires in 5s).
+        self._lease_backlog: Dict[tuple, tuple] = {}
+        # Pulsed on every worker registration — parked lease requests and
+        # other capacity waiters re-check on it.
+        self._worker_arrival = asyncio.Event()
+        # Direct tasks currently executing, reported via batched task_events
+        # (observability only — the scheduler never touches these).
+        self.direct_running: Dict[str, dict] = {}
         self._gc_candidates: Set[str] = set()
         # Reverse index: conn_id -> hex ids it holds (O(refs) disconnects).
         self._conn_refs: Dict[int, Set[str]] = {}
@@ -626,6 +664,7 @@ class Controller:
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
         env["RAY_TPU_WORKER_ID"] = worker_id
         env["RAY_TPU_ADDRESS"] = f"{self.node_ip}:{self.port}"
+        env["RAY_TPU_NODE_IP"] = self.node_ip  # workers bind/advertise here
         env["RAY_TPU_SESSION_DIR"] = self.session_dir
         env["RAY_TPU_SESSION_TAG"] = store.SESSION_TAG
         env["PYTHONUNBUFFERED"] = "1"  # log tailing needs unbuffered stdout
@@ -660,6 +699,7 @@ class Controller:
     async def _on_connection(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         conn = Connection(reader, writer, expected_token=rpc_auth_token())
         meta = {"kind": None, "worker_id": None, "conn_id": next(self._conn_counter)}
+        self._conns_by_id[meta["conn_id"]] = conn
 
         async def on_push(msg: dict):
             try:
@@ -678,7 +718,8 @@ class Controller:
     # they run as detached tasks — otherwise a long-poll would block the
     # connection's read loop and deadlock clients that get() on one thread
     # while another thread produces the object.
-    _LONG_POLL = frozenset({"get_object", "get_objects", "wait_objects", "tail_logs", "stream_next"})
+    _LONG_POLL = frozenset({"get_object", "get_objects", "wait_objects",
+                            "tail_logs", "stream_next", "request_lease"})
 
     async def _dispatch_msg(self, conn: Connection, meta: dict, msg: dict):
         mtype = msg["type"]
@@ -702,6 +743,15 @@ class Controller:
         # A dead process's refs die with it (reference: borrower death
         # detection via pubsub channel close).
         conn_id = meta.get("conn_id")
+        if conn_id is not None:
+            self._conns_by_id.pop(conn_id, None)
+        # Leases die with their holder.
+        for worker_id in meta.get("leases") or ():
+            ws = self.workers.get(worker_id)
+            if ws is not None and ws.leased_to == conn_id:
+                self._release_lease(ws, requeue=False)
+        if meta.get("leases"):
+            self._schedule()
         if conn_id is not None:
             for hex_id in self._conn_refs.pop(conn_id, ()):
                 obj = self.objects.get(hex_id)
@@ -747,6 +797,7 @@ class Controller:
             state=IDLE,
             has_tpu=bool(msg.get("has_tpu")),
             node_id=node_id,
+            direct_addr=msg.get("direct_addr", ""),
         )
         self.workers[worker_id] = ws
         # Re-adoption after a controller restart: a surviving actor worker
@@ -787,6 +838,8 @@ class Controller:
             node.spawning = max(0, node.spawning - 1)
             if ws.has_tpu:
                 node.spawning_tpu = max(0, node.spawning_tpu - 1)
+        self._worker_arrival.set()
+        self._worker_arrival.clear()
         self._schedule()
         return {"ok": True}
 
@@ -1919,6 +1972,7 @@ class Controller:
         for _ in range(max(0, min(deficit, rt_config.get("worker_prestart_cap")))):
             self._spawn_worker(live_count=head_live)
         self._reclaim_stranded_prefetches()
+        self._revoke_leases_for_backlog()
 
     def _reclaim_stranded_prefetches(self):
         """Un-strand prefetched tasks: a task pipelined behind a busy worker
@@ -2005,6 +2059,165 @@ class Controller:
                 worker=ws.worker_id if ws is not None else "",
             )
         self._schedule()
+        return None
+
+    # ------------------------------------------------- direct task plane
+    # Reference analog: `direct_task_transport.cc:135-247` — submitters hold
+    # cached worker leases and push task specs straight to the leased worker
+    # (PushNormalTask), touching the scheduler only for grant/return. Here
+    # the controller additionally stays out of the RESULT path: small
+    # results return inline over the submitter↔worker socket.
+    async def h_request_lease(self, conn, meta, msg):
+        demand = {k: float(v) for k, v in (msg.get("resources") or {}).items()}
+        need_tpu = demand.get("TPU", 0) > 0
+        count = max(1, min(int(msg.get("count", 1)), 16))
+        # PARK until at least one grant or the deadline: a cold pool takes a
+        # spawn round (~0.5s) to produce grantable workers — client-side
+        # retry backoff turned that into multi-second task latency.
+        deadline = time.monotonic() + min(float(msg.get("wait_s", 8.0)), 30.0)
+        bkey = tuple(sorted(demand.items()))
+        first = True
+        while True:
+            grants = self._try_grant_leases(
+                meta, demand, need_tpu, count, spawn=first
+            )
+            first = False
+            if grants or time.monotonic() >= deadline:
+                break
+            # The PARKED demand is autoscaler load — record it now, not
+            # after the park (scale-up is what un-parks a full cluster).
+            self._lease_backlog[bkey] = (demand, count, time.monotonic())
+            try:
+                await asyncio.wait_for(self._worker_arrival.wait(), 0.25)
+            except (asyncio.TimeoutError, TimeoutError):
+                pass
+        # Feed the autoscaler load metrics (drivers re-request while
+        # buffered, refreshing the entry; satisfied requests clear it).
+        if len(grants) < count:
+            self._lease_backlog[bkey] = (demand, count - len(grants), time.monotonic())
+        else:
+            self._lease_backlog.pop(bkey, None)
+        if grants:
+            self._event("lease_granted", n=len(grants), holder=meta.get("conn_id"))
+        return {"leases": grants}
+
+    def _try_grant_leases(self, meta, demand, need_tpu, count, spawn=True):
+        grants = []
+        spawn_hint: Optional[NodeState] = None
+        for _ in range(count):
+            got = None
+            for node in self.nodes.values():
+                if not self._fits_node(node, demand):
+                    continue
+                ws = self._idle_worker(node.node_id, need_tpu)
+                if ws is None:
+                    spawn_hint = spawn_hint or node
+                    continue
+                if not ws.direct_addr:
+                    continue
+                got = (node, ws)
+                break
+            if got is None:
+                break
+            node, ws = got
+            self._acquire(node, demand)
+            ws.assigned = dict(demand)
+            ws.state = LEASED
+            ws.leased_to = meta.get("conn_id")
+            meta.setdefault("leases", set()).add(ws.worker_id)
+            grants.append({"worker_id": ws.worker_id, "addr": ws.direct_addr})
+        if spawn and len(grants) < count and spawn_hint is not None and not need_tpu:
+            # Under-supplied: top the pool up, NET of workers already
+            # booting (unbounded bursts per grow probe were a spawn storm —
+            # each booting interpreter costs ~2s of CPU).
+            want = count - len(grants) - spawn_hint.spawning
+            for _ in range(
+                max(0, min(want, rt_config.get("spawn_burst_cap")))
+            ):
+                self._spawn_worker(node=spawn_hint)
+        return grants
+
+    def _release_lease(self, ws: WorkerState, requeue: bool = True):
+        if ws.state != LEASED:
+            return
+        if ws.blocked:
+            # Capacity already released at block time (h_worker_blocked) —
+            # releasing again would double-credit the node.
+            ws.assigned = {}
+            ws.assigned_pg = None
+            ws.blocked = False
+        else:
+            self._grant_release(ws)
+        ws.state = IDLE
+        ws.leased_to = None
+        ws.revoking = False
+        if requeue:
+            self._schedule()
+
+    async def h_return_lease(self, conn, meta, msg):
+        ws = self.workers.get(msg["worker_id"])
+        leases = meta.get("leases")
+        if leases is not None:
+            leases.discard(msg["worker_id"])
+        if ws is not None and ws.leased_to == meta.get("conn_id"):
+            self._release_lease(ws)
+        return {"ok": True}
+
+    def _revoke_leases_for_backlog(self):
+        """Queued work + zero placement → pull leases back (the holder
+        drains in-flight pushes and returns). Prevents idle-leased workers
+        from starving the queued path."""
+        if not self.ready_queue:
+            return
+        for ws in self.workers.values():
+            if ws.state != LEASED or ws.revoking or ws.leased_to is None:
+                continue
+            holder = self._conns_by_id.get(ws.leased_to)
+            if holder is None:
+                self._release_lease(ws)
+                continue
+            ws.revoking = True
+            asyncio.ensure_future(self._send_revoke(holder, ws))
+
+    async def _send_revoke(self, holder: Connection, ws: WorkerState):
+        try:
+            await holder.send({"type": "revoke_lease", "worker_id": ws.worker_id})
+        except Exception:  # noqa: BLE001 — holder dying; disconnect cleans up
+            pass
+
+    # -------------------------------------------- direct actor call plane
+    # Reference analog: direct actor call transport — after creation, actor
+    # calls flow submitter→actor-worker without the GCS/raylet in the loop.
+    # The handoff FENCE threads through the same controller→worker FIFO as
+    # queued classic calls, so direct mode starts only after every prior
+    # classic call is already in the worker's queue (ordering preserved).
+    async def h_actor_handoff(self, conn, meta, msg):
+        astate = self.actors.get(msg["actor"])
+        if astate is None or astate.state == "dead":
+            return {"ok": False, "reason": "actor not alive"}
+        token = f"{msg['actor']}:{next(self._handoff_counter)}"
+        fut = asyncio.get_running_loop().create_future()
+        self._handoff_waiters[token] = fut
+        # The fence rides the actor's ORDERED send queue (_pump_actor), so
+        # every classic call submitted before it — including calls still
+        # waiting on args or on actor creation — reaches the worker first.
+        astate.send_queue.append(_HandoffFence(token))
+        asyncio.ensure_future(self._pump_actor(astate))
+        try:
+            await asyncio.wait_for(fut, timeout=msg.get("timeout", 30))
+        except Exception:  # noqa: BLE001 — worker busy/dead; caller stays classic
+            return {"ok": False, "reason": "handoff timed out"}
+        finally:
+            self._handoff_waiters.pop(token, None)
+        ws = self.workers.get(astate.worker_id)
+        if astate.state != "alive" or ws is None or not ws.direct_addr:
+            return {"ok": False, "reason": "actor not alive"}
+        return {"ok": True, "addr": ws.direct_addr, "worker_id": ws.worker_id}
+
+    async def h_handoff_ready(self, conn, meta, msg):
+        fut = self._handoff_waiters.get(msg["token"])
+        if fut is not None and not fut.done():
+            fut.set_result(True)
         return None
 
     def _maybe_prefetch(
@@ -2104,8 +2317,63 @@ class Controller:
         for oid in spec.return_ids:
             self._store_error_object(oid.hex(), err)
 
+    async def h_task_events(self, conn, meta, msg):
+        """Batched timeline events from a worker's direct-path executions
+        (reference analog: profile-event batch flushes) — keeps tracing,
+        `api.timeline()`, and the running-task view complete without
+        per-task control traffic."""
+        events = msg.get("events", ())
+        self.timeline.extend(events)
+        if len(self.timeline) > 100_000:
+            del self.timeline[:50_000]
+        names: Dict[str, str] = {}
+        for ev in events:
+            kind = ev.get("event")
+            task = ev.get("task")
+            if kind == "task_submitted":
+                names[task] = ev.get("name", "")
+            elif kind == "task_dispatched":
+                if len(self.direct_running) < 10_000:
+                    self.direct_running[task] = {
+                        "name": names.get(task, ""),
+                        "worker_id": ev.get("worker", ""),
+                    }
+            elif kind == "task_done":
+                self.direct_running.pop(task, None)
+        return None
+
     async def h_task_done(self, conn, meta, msg):
         task_hex = msg["task"]
+        if msg.get("direct"):
+            # Direct-path task on a LEASED worker: the controller's only job
+            # is the object directory (results too big / ref-carrying to ride
+            # the submitter socket inline) — no scheduler state to touch.
+            node_id = (
+                self.workers[meta["worker_id"]].node_id
+                if meta.get("worker_id") in self.workers
+                else HEAD_NODE
+            )
+            for item in msg["results"]:
+                if item.get("inline") is not None:
+                    self._mark_ready(
+                        item["id"], inline=item["inline"],
+                        size=len(item["inline"]), contains=item.get("contains"),
+                    )
+                else:
+                    self._mark_ready(
+                        item["id"], shm_name=item["name"], size=item["size"],
+                        node_id=node_id, contains=item.get("contains"),
+                    )
+            if msg.get("stream_count") is not None:
+                s = self._stream(task_hex)
+                s["produced"] = max(s["produced"], msg["stream_count"])
+                s["done"] = True
+                self._wake_stream(s)
+            if msg.get("spec") is not None:
+                # Registered (arena-resident) results are reconstructible —
+                # remember the creating spec like any scheduled task.
+                self._remember_lineage(spec_from_proto_bytes(msg["spec"]))
+            return None
         entry = self.running.pop(task_hex, None)
         if entry is not None:
             self._unpin_args(entry[1].spec)
@@ -2302,6 +2570,17 @@ class Controller:
                 if not astate.send_queue or astate.send_queue[0] is not spec:
                     continue  # queue drained by a death path while we waited
                 astate.send_queue.popleft()
+                if isinstance(spec, _HandoffFence):
+                    ws = self.workers.get(astate.worker_id)
+                    if astate.state == "alive" and ws is not None and ws.conn is not None:
+                        try:
+                            await ws.conn.send(
+                                {"type": "actor_handoff", "token": spec.token}
+                            )
+                        except Exception:  # noqa: BLE001 — waiter times out
+                            pass
+                    # dead/unreachable: waiter times out → caller stays classic
+                    continue
                 if astate.state == "dead":
                     err = astate.init_error or TaskError(ActorDiedError(), "", spec.name)
                     self._unpin_args(spec)
@@ -2362,6 +2641,7 @@ class Controller:
             return
         prev_state = ws.state
         ws.state = DEAD
+        ws.leased_to = None  # holder sees the direct conn close and recovers
         if ws.assigned:
             if not ws.blocked:
                 self._grant_release(ws)
@@ -2923,6 +3203,14 @@ class Controller:
                 pt.spec.options.scheduling_strategy, PlacementGroupSchedulingStrategy
             )
         ]
+        # Unsatisfied direct-path lease requests are queued demand too —
+        # submitters buffer client-side and retry, so without this the
+        # autoscaler would see an empty queue while work waits for capacity.
+        for key, (demand, unmet, ts) in list(self._lease_backlog.items()):
+            if now - ts > 5.0:
+                self._lease_backlog.pop(key, None)
+                continue
+            pending.extend(dict(demand) for _ in range(min(unmet, 100)))
         pending_pgs = []
         for pg in self.pgs.values():
             if pg["ready"]:
@@ -3050,6 +3338,14 @@ class Controller:
             out.append({"task_id": task_hex, "name": pt.spec.name,
                         "state": "RUNNING", "worker_id": worker_id,
                         "node_id": ws.node_id if ws else "?"})
+        for task_hex, info in list(self.direct_running.items()):
+            ws = self.workers.get(info.get("worker_id", ""))
+            if ws is None or ws.state == DEAD:
+                self.direct_running.pop(task_hex, None)  # lazily reap
+                continue
+            out.append({"task_id": task_hex, "name": info.get("name", ""),
+                        "state": "RUNNING", "worker_id": info["worker_id"],
+                        "node_id": ws.node_id, "direct": True})
         return {"tasks": out}
 
     async def h_list_actors(self, conn, meta, msg):
@@ -3096,7 +3392,8 @@ class Controller:
             "workers": [
                 {"worker_id": w.worker_id, "state": w.state, "pid": w.pid,
                  "node_id": w.node_id, "has_tpu": w.has_tpu,
-                 "current_task": w.current_task, "actor": w.actor_hex}
+                 "current_task": w.current_task, "actor": w.actor_hex,
+                 "direct_addr": w.direct_addr}
                 for w in self.workers.values()
             ]
         }

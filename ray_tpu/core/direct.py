@@ -1,0 +1,1003 @@
+"""Direct call plane — steady-state submissions bypass the controller.
+
+Reference analog: `src/ray/core_worker/transport/direct_task_transport.cc`
+(lines 135-247): submitters cache worker LEASES per scheduling class and
+push task specs straight to the leased worker (`PushNormalTask`), touching
+the scheduler only for lease grant/return; actor calls likewise flow
+submitter→actor-worker once the actor is located (direct actor transport).
+
+Redesign for this runtime: the controller grants leases over its existing
+worker pool and stays out of BOTH directions of the hot path — specs ride a
+submitter↔worker socket, and small results return inline on the same
+socket, so a steady-state task costs the controller nothing. Big or
+ref-carrying results register with the controller's object directory (the
+one source of truth for shared objects) and resolve via the classic path.
+
+Ordering for actor calls is preserved across the classic→direct switch by a
+HANDOFF FENCE: the switch request threads through the same
+controller→worker FIFO as every previously submitted classic call, so the
+direct socket only activates once those calls are already in the actor's
+queue (see `Controller.h_actor_handoff`).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import serialization
+from .exceptions import (
+    ActorDiedError,
+    TaskCancelledError,
+    TaskError,
+    WorkerCrashedError,
+)
+from .rpc import Connection, open_rpc_connection
+from .task_spec import (
+    DefaultSchedulingStrategy,
+    TaskSpec,
+    TaskType,
+    spec_to_proto_bytes,
+)
+
+def _compact_actor_spec(spec: TaskSpec):
+    return (
+        spec.task_id.binary(),
+        spec.actor_id.binary(),
+        spec.method_name,
+        spec.func_payload,
+        spec.num_returns,
+        [oid.binary() for oid in spec.arg_refs],
+        spec.sequence_number,
+        spec.parent_task_id.binary() if spec.parent_task_id else b"",
+    )
+
+
+def _spec_blob(spec_or_bytes) -> bytes:
+    """Resubmission fallback: encode retained TaskSpecs lazily."""
+    if isinstance(spec_or_bytes, (bytes, bytearray)):
+        return spec_or_bytes
+    return spec_to_proto_bytes(spec_or_bytes)
+
+
+# A lease idle longer than this returns to the controller's pool.
+LEASE_IDLE_RETURN_S = 2.0
+# Leases requested per scheduling key when the fast path misses (the
+# controller grants up to available capacity; extras idle-return).
+LEASE_WANT = 4
+
+
+class _Lease:
+    __slots__ = ("worker_id", "addr", "conn", "inflight", "draining", "last_used")
+
+    def __init__(self, worker_id: str, addr: str, conn: Connection):
+        self.worker_id = worker_id
+        self.addr = addr
+        self.conn = conn
+        self.inflight = 0
+        self.draining = False
+        self.last_used = time.monotonic()
+
+
+class _Pending:
+    """One in-flight direct task (normal or actor)."""
+
+    __slots__ = ("spec_bytes", "return_hexes", "event", "retries", "lease",
+                 "actor_hex", "resubmit_kind", "publish", "arg_pins", "discard",
+                 "rebalance", "cancelled")
+
+    def __init__(self, spec_bytes: bytes, return_hexes: List[str],
+                 retries: int, resubmit_kind: str, actor_hex: str = ""):
+        self.spec_bytes = spec_bytes
+        self.return_hexes = return_hexes
+        self.event = threading.Event()
+        self.retries = retries
+        self.lease: Optional[_Lease] = None
+        self.actor_hex = actor_hex
+        self.resubmit_kind = resubmit_kind  # "submit_task" | "submit_actor_task"
+        # A ref to this task's result ESCAPED (arg / nested / put-contained)
+        # before the task resolved — the result must publish into the
+        # controller directory the moment it lands (see ensure_published).
+        self.publish = False
+        # The result's last ref was RELEASED while the task ran
+        # (fire-and-forget): don't retain the frame when it arrives.
+        self.discard = False
+        # A steal is in flight: if the worker drops it (unstarted), it
+        # REASSIGNS to a fresher lease instead of resolving as cancelled.
+        self.rebalance = False
+        # cancel() beat the rebalance: a drop resolves as cancelled.
+        self.cancelled = False
+        # Strong ObjectRefs pinning this call's arguments until completion —
+        # the classic path's _pin_args has no analog here, so the submitter
+        # itself keeps the objects alive (refs die with this entry).
+        self.arg_pins: list = []
+
+
+class _ActorChannel:
+    __slots__ = ("mode", "conn", "addr", "buffer", "pending_hexes", "cooldown",
+                 "out_batch", "out_scheduled")
+
+    def __init__(self):
+        self.mode = "classic"  # classic | handoff | direct
+        self.conn: Optional[Connection] = None
+        self.addr = ""
+        self.buffer: List[TaskSpec] = []  # specs queued during handoff
+        self.pending_hexes: set = set()
+        self.cooldown = 0.0  # monotonic time before retrying a failed handoff
+        # Submission coalescing: compact calls accumulated between io-loop
+        # wake-ups ship as ONE message (the worker's io thread unpickling
+        # one frame per call stole the GIL from its executing main thread).
+        self.out_batch: List[Tuple] = []
+        self.out_scheduled = False
+
+
+class DirectCallManager:
+    """Per-backend manager: leases, actor channels, locally-owned results.
+
+    Thread model: user threads call submit/lookup/wait; the backend's io
+    loop delivers socket events. One lock guards all state; io callbacks
+    hold it only for dict/flag updates (never across awaits).
+    """
+
+    def __init__(self, backend):
+        self.backend = backend  # ClusterBackend
+        self.io = backend.io
+        self._lock = threading.Lock()
+        self._leases: Dict[Tuple, List[_Lease]] = {}
+        self._lease_requesting: set = set()
+        # Specs awaiting a lease grant, per key (reference: the client-side
+        # task queue in the direct transport — tasks wait on the LEASE, they
+        # do not fall back to the scheduler and fight it for capacity).
+        self._send_buffer: Dict[Tuple, List[Tuple[bytes, str]]] = {}
+        # Grow-request verdicts: key → monotonic time until which the
+        # cluster is known FULL for this key (pipelining onto busy leases is
+        # then the best move — there is no idle capacity to wait for).
+        self._full_until: Dict[Tuple, float] = {}
+        # Grow-probe rate limit: a probe per submit would put one
+        # run_coroutine_threadsafe (~0.4ms) on every submission.
+        self._next_grow: Dict[Tuple, float] = {}
+        # Steal-scan rate limit: the scan is O(pending) and lease inflight
+        # hits zero constantly during tiny-task bursts.
+        self._next_steal: Dict[Tuple, float] = {}
+        self._pending: Dict[str, _Pending] = {}  # task_hex → entry
+        # hex → ("frame", bytes) | ("registered",) — resolved direct results.
+        self._table: Dict[str, Tuple] = {}
+        self._hex_to_task: Dict[str, str] = {}  # return hex → task hex
+        self._actors: Dict[str, _ActorChannel] = {}
+        self._closed = False
+        self._idle_timer_started = False
+        self._idle_task_fut = None
+
+    # ------------------------------------------------------------ normal
+    def eligible(self, spec: TaskSpec) -> bool:
+        o = spec.options
+        return (
+            spec.task_type == TaskType.NORMAL_TASK
+            and spec.num_returns >= 1
+            and not spec.arg_refs
+            and not o.runtime_env
+            and (o.scheduling_strategy is None
+                 or isinstance(o.scheduling_strategy, DefaultSchedulingStrategy))
+        )
+
+    def submit(self, spec: TaskSpec) -> bool:
+        """Take ownership of an eligible task: send to an IDLE lease now, or
+        buffer while more leases are requested. Queuing behind a busy lease
+        happens only when the grow-request comes back empty (cluster full) —
+        an eager pile-on would serialize parallel work behind one worker.
+        False → classic."""
+        if self._closed:
+            return False
+        key = (tuple(sorted(spec.resources.items())),
+               spec.resources.get("TPU", 0) > 0)
+        blob = spec_to_proto_bytes(spec)
+        entry = _Pending(
+            blob, [oid.hex() for oid in spec.return_ids],
+            spec.options.max_retries, "submit_task",
+        )
+        task_hex = spec.task_id.hex()
+        with self._lock:
+            lease = self._pick_lease(key)
+            if lease is None:
+                # Cold key: buffer until the spawn-parked request grants.
+                self._pending[task_hex] = entry
+                for h in entry.return_hexes:
+                    self._hex_to_task[h] = task_hex
+                self._send_buffer.setdefault(key, []).append((blob, task_hex))
+                self._maybe_request_leases(key, spec)
+                return True
+            # Pipeline optimistically (throughput) and GROW in the
+            # background when queuing starts; arriving leases steal queued
+            # work back (_steal_for), so a long task can't hold later
+            # submissions hostage the way a committed queue would.
+            now = time.monotonic()
+            if (
+                lease.inflight > 0
+                and now >= self._full_until.get(key, 0.0)
+                and now >= self._next_grow.get(key, 0.0)
+            ):
+                self._next_grow[key] = now + 0.25
+                self._maybe_request_leases(key, spec)
+            entry.lease = lease
+            self._pending[task_hex] = entry
+            for h in entry.return_hexes:
+                self._hex_to_task[h] = task_hex
+            lease.inflight += 1
+            lease.last_used = time.monotonic()
+        self._pipelined(lease.conn, {"type": "direct_task", "spec": blob})
+        return True
+
+    def _pick_lease(self, key) -> Optional[_Lease]:
+        """Under lock: least-loaded usable lease for this key, or None."""
+        lease = None
+        for cand in self._leases.get(key) or ():
+            if cand.draining or cand.conn._closed:
+                continue
+            if lease is None or cand.inflight < lease.inflight:
+                lease = cand
+        return lease
+
+    def _flush_buffer_locked(self, key) -> List[Tuple[bytes, Optional[_Lease]]]:
+        """Under lock: assign every buffered spec to a lease (round-robin by
+        least-loaded). Entries keep their _Pending; only transport changes."""
+        out = []
+        for blob, task_hex in self._send_buffer.pop(key, ()):
+            entry = self._pending.get(task_hex)
+            if entry is None:
+                continue  # cancelled/resolved while buffered
+            lease = self._pick_lease(key)
+            if lease is None:
+                out.append((blob, None, entry))
+                continue
+            entry.lease = lease
+            lease.inflight += 1
+            lease.last_used = time.monotonic()
+            out.append((blob, lease, entry))
+        return out
+
+    def _pipelined(self, conn: Connection, msg: dict):
+        try:
+            conn.post(msg)  # batched, fire-and-forget; close handler recovers
+        except ConnectionError:
+            pass
+
+    def _maybe_request_leases(self, key, spec: TaskSpec):
+        """Called under lock."""
+        if key in self._lease_requesting:
+            return
+        self._lease_requesting.add(key)
+        resources = dict(spec.resources)
+        self.io.call_nowait(self._request_leases(key, resources))
+
+    async def _request_leases(self, key, resources):
+        # The finally-block is load-bearing: if this coroutine dies with the
+        # key still in _lease_requesting, every future submission for the
+        # key buffers forever (no lease, no new request — a deadlock).
+        try:
+            await self._request_leases_inner(key, resources)
+        finally:
+            with self._lock:
+                self._lease_requesting.discard(key)
+                # No new capacity: pipeline the leftovers onto EXISTING
+                # leases (queueing behind busy workers beats the scheduler
+                # round-trip for steady-state bursts)...
+                leftovers = self._flush_buffer_locked(key)
+                stranded = []
+                for blob, lease, entry in leftovers:
+                    if lease is None:
+                        self._pending.pop(
+                            self._hex_to_task.get(entry.return_hexes[0], "")
+                            if entry.return_hexes else "", None,
+                        )
+                        for h in entry.return_hexes:
+                            self._table[h] = ("registered",)
+                        stranded.append((blob, None, entry))
+            for blob, lease, entry in leftovers:
+                if lease is not None:
+                    self._pipelined(lease.conn, {"type": "direct_task", "spec": blob})
+            if stranded:
+                # ...and with no leases at all (exhausted / unreachable /
+                # closed / crashed) they go to the scheduler — safe, they
+                # were never pushed to any worker.
+                self._classic_fallback(stranded, pop=False)
+
+    async def _request_leases_inner(self, key, resources):
+        import asyncio
+
+        # The controller PARKS under-supplied requests until workers
+        # register — so no client backoff. A COLD key (no leases yet) waits
+        # out a spawn round; a GROW request (leases exist, work queuing
+        # behind them) asks briefly and falls back to pipelining.
+        for attempt in range(4):
+            with self._lock:
+                lst = self._leases.get(key, ())
+                existing = bool(lst)
+                oversub = any(l.inflight > 1 for l in lst)
+            try:
+                resp = await self.backend.conn.request(
+                    {"type": "request_lease", "resources": resources,
+                     "count": LEASE_WANT,
+                     # Cold keys wait out a spawn round; OVERSUBSCRIBED keys
+                     # park briefly for freed capacity (arriving grants steal
+                     # queued work back); pure grow probes must not park —
+                     # submissions pipeline meanwhile either way.
+                     "wait_s": 8.0 if not existing else (2.0 if oversub else 0.05)},
+                    timeout=20,
+                )
+            except Exception:  # noqa: BLE001 — controller unreachable
+                resp = None
+                break
+            grants = (resp or {}).get("leases") or []
+            new = []
+            for g in grants:
+                try:
+                    host, port = g["addr"].rsplit(":", 1)
+                    reader, writer = await open_rpc_connection(host, int(port))
+                except OSError:
+                    await self._return_lease_id(g["worker_id"])
+                    continue
+                lease = _Lease(g["worker_id"], g["addr"], Connection(reader, writer))
+                lease.conn.on_push = self._make_on_result(lease)
+                lease.conn.on_close = self._make_on_lease_close(lease)
+                lease.conn.start()
+                new.append(lease)
+            give_back: List[_Lease] = []
+            flush: List[Tuple] = []
+            buffered_left = False
+            with self._lock:
+                if self._closed:
+                    give_back = new
+                else:
+                    if new:
+                        self._leases.setdefault(key, []).extend(new)
+                        if not self._idle_timer_started:
+                            self._idle_timer_started = True
+                            self._idle_task_fut = self.io.call_nowait(
+                                self._idle_return_loop()
+                            )
+                    if self._leases.get(key):
+                        # Only drain the buffer onto REAL leases — flushing
+                        # with none would dump everything to the classic
+                        # path on attempt 1 instead of waiting out a cold
+                        # pool's spawn round.
+                        flush = self._flush_buffer_locked(key)
+                    buffered_left = bool(self._send_buffer.get(key))
+            for lease in give_back:
+                lease.conn.close()
+                await self._return_lease_id(lease.worker_id)
+            if give_back:
+                break
+            overflow: List[Tuple] = []
+            for blob, lease, entry in flush:
+                if lease is None:
+                    overflow.append((blob, None, entry))
+                else:
+                    # post, not await-send: a lease that died this instant
+                    # must not kill the request loop — its pendings recover
+                    # via the conn close handler.
+                    self._pipelined(lease.conn, {"type": "direct_task", "spec": blob})
+            if overflow:
+                # Shouldn't happen (flush only pops what leases absorb), but
+                # never strand work: hand it to the scheduler.
+                self._classic_fallback(overflow)
+            if new:
+                self._steal_for(key)
+            with self._lock:
+                oversub = any(
+                    l.inflight > 1 for l in self._leases.get(key, ())
+                )
+            if existing and not new and not oversub:
+                # Grow attempt found no idle capacity and nothing queues
+                # behind busy leases: the cluster is FULL for this key —
+                # pipeline for a while instead of stalling on doomed probes.
+                with self._lock:
+                    self._full_until[key] = time.monotonic() + 1.0
+                break
+            if not buffered_left and not oversub:
+                break
+            await asyncio.sleep(0.05)
+        else:
+            # Attempts exhausted while oversubscribed: capacity is genuinely
+            # scarce — stop probing for a while.
+            with self._lock:
+                self._full_until[key] = time.monotonic() + 1.0
+
+    def _steal_for(self, key):
+        """New idle leases just arrived: ask deep-queued leases to give
+        unstarted tasks back (client-side analog of the controller's
+        prefetch reclaim). The worker refuses once a task started; a
+        dropped task reassigns in _on_dropped."""
+        steals = []
+        now = time.monotonic()
+        with self._lock:
+            if now < self._next_steal.get(key, 0.0):
+                return
+            self._next_steal[key] = now + 0.05
+            idle = [
+                l for l in self._leases.get(key, ())
+                if l.inflight == 0 and not l.draining and not l.conn._closed
+            ]
+            if not idle:
+                return
+            by_lease: Dict[_Lease, List[Tuple[str, _Pending]]] = {}
+            for task_hex, entry in self._pending.items():
+                l = entry.lease
+                if (
+                    l is not None and l.inflight > 1
+                    and not entry.rebalance and not entry.actor_hex
+                ):
+                    by_lease.setdefault(l, []).append((task_hex, entry))
+            for _ in idle:
+                deep = max(by_lease, key=lambda l: l.inflight, default=None) \
+                    if by_lease else None
+                if deep is None or not by_lease.get(deep):
+                    break
+                task_hex, entry = by_lease[deep].pop()
+                entry.rebalance = True
+                steals.append((deep, task_hex))
+        for lease, task_hex in steals:
+            self._pipelined(lease.conn, {"type": "drop_task", "task": task_hex})
+
+    def _classic_fallback(self, triples, pop: bool = True):
+        """Buffered-but-never-sent specs go to the scheduler (safe: zero
+        execution risk — they were never pushed to any worker)."""
+        for blob, _lease, entry in triples:
+            if pop and entry.return_hexes:
+                with self._lock:
+                    task_hex = self._hex_to_task.get(entry.return_hexes[0])
+                    if task_hex is not None:
+                        self._pending.pop(task_hex, None)
+                    for h in entry.return_hexes:
+                        self._table[h] = ("registered",)
+            try:
+                self.backend._send_pipelined(
+                    {"type": entry.resubmit_kind, "spec": _spec_blob(blob)}
+                )
+            except Exception:  # noqa: BLE001
+                pass
+            self._announce_refs(entry.return_hexes)
+            entry.event.set()
+
+    async def _return_lease_id(self, worker_id: str):
+        try:
+            await self.backend.conn.send(
+                {"type": "return_lease", "worker_id": worker_id}
+            )
+        except Exception:  # noqa: BLE001
+            pass
+
+    # ---------------------------------------------------------- results
+    def _make_on_result(self, lease: Optional[_Lease]):
+        async def on_push(msg: dict):
+            t = msg.get("type")
+            if t == "direct_done":
+                self._on_done(lease, msg)
+            elif t == "direct_done_batch":
+                for item in msg["items"]:
+                    self._on_done(lease, item)
+            elif t == "direct_dropped":
+                self._on_dropped(msg)
+
+        return on_push
+
+    def _on_done(self, lease: Optional[_Lease], msg: dict):
+        registered: List[str] = []
+        publish: List[str] = []
+        with self._lock:
+            entry = self._pending.pop(msg["task"], None)
+            if entry is None:
+                return
+            if lease is not None:
+                lease.inflight -= 1
+                lease.last_used = time.monotonic()
+            if msg.get("registered"):
+                for h in entry.return_hexes:
+                    self._table[h] = ("registered",)
+                registered = entry.return_hexes
+            else:
+                for item in msg.get("results", ()):
+                    h = item["id"]
+                    # Fire-and-forget: the ref already died (release()
+                    # marked the entry) — storing the frame would leak it.
+                    if entry.publish or not entry.discard:
+                        self._table[h] = ("frame", item["inline"])
+                    else:
+                        self._hex_to_task.pop(h, None)
+                if entry.publish:
+                    publish = entry.return_hexes
+            ch = self._actors.get(entry.actor_hex) if entry.actor_hex else None
+            if ch is not None:
+                ch.pending_hexes.discard(msg["task"])
+            drained = (
+                lease is not None and lease.draining and lease.inflight == 0
+            )
+            freed = (
+                lease is not None and not lease.draining and lease.inflight == 0
+            )
+            freed_key = None
+            if freed:
+                for k, lst in self._leases.items():
+                    if lease in lst:
+                        # Only worth a steal scan when real imbalance exists.
+                        if any(l.inflight > 1 for l in lst):
+                            freed_key = k
+                        break
+        if freed_key is not None:
+            # This lease just went idle while others may be deep-queued —
+            # the same steal that runs on new grants (a long task must not
+            # hold later submissions while capacity sits idle).
+            self._steal_for(freed_key)
+        if registered:
+            self._announce_refs(registered)
+        if publish:
+            # The ref escaped while the task was in flight — deliver on the
+            # promise made by ensure_published (consumers long-poll on the
+            # directory entry until this lands).
+            try:
+                self.backend.ensure_published(publish)
+            except Exception:  # noqa: BLE001
+                pass
+        entry.event.set()
+        if drained:
+            self._finish_drain(lease)
+
+    def _announce_refs(self, hexes: List[str]):
+        """A result just became controller-owned: the directory must see our
+        holds (the flusher suppressed them while the object looked local).
+        Dead-already refs go add+release in one batch — the controller
+        processes adds first, so ever_held is still recorded."""
+        from .ref_tracker import TRACKER
+
+        dead = [h for h in hexes if TRACKER.local_count(h) <= 0]
+        try:
+            self.backend._send_nowait(
+                {"type": "update_refs", "add": list(hexes), "release": dead}
+            )
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _on_dropped(self, msg: dict):
+        task_hex = msg["task"]
+        with self._lock:
+            entry = self._pending.get(task_hex)
+            if entry is None:
+                return
+            if entry.lease is not None:
+                entry.lease.inflight -= 1
+            if entry.rebalance and not entry.cancelled:
+                # Steal succeeded: the old worker will skip the spec —
+                # reassign to the least-loaded OTHER lease.
+                entry.rebalance = False
+                old = entry.lease
+                entry.lease = None
+                key = None
+                for k, lst in self._leases.items():
+                    if old in lst:
+                        key = k
+                        break
+                lease = None
+                for cand in self._leases.get(key, ()) if key else ():
+                    if cand is old or cand.draining or cand.conn._closed:
+                        continue
+                    if lease is None or cand.inflight < lease.inflight:
+                        lease = cand
+                if lease is not None:
+                    entry.lease = lease
+                    lease.inflight += 1
+                    lease.last_used = time.monotonic()
+                    blob = _spec_blob(entry.spec_bytes)
+                else:
+                    blob = None  # no other lease — classic below
+            else:
+                self._pending.pop(task_hex, None)
+                err = TaskError(TaskCancelledError(), "", "direct_task")
+                for h in entry.return_hexes:
+                    if entry.publish or not entry.discard:
+                        self._table[h] = ("frame", serialization.pack(err))
+                    else:
+                        self._hex_to_task.pop(h, None)
+                if entry.publish:
+                    try:
+                        self.backend.ensure_published(entry.return_hexes)
+                    except Exception:  # noqa: BLE001
+                        pass
+                entry.event.set()
+                return
+        # Rebalance continuation (outside lock).
+        if entry.lease is not None:
+            self._pipelined(entry.lease.conn, {"type": "direct_task", "spec": blob})
+        else:
+            with self._lock:
+                self._pending.pop(task_hex, None)
+                for h in entry.return_hexes:
+                    self._table[h] = ("registered",)
+            try:
+                self.backend._send_pipelined(
+                    {"type": entry.resubmit_kind, "spec": _spec_blob(entry.spec_bytes)}
+                )
+            except Exception:  # noqa: BLE001
+                pass
+            self._announce_refs(entry.return_hexes)
+            entry.event.set()
+
+    def _make_on_lease_close(self, lease: _Lease):
+        async def on_close():
+            self._recover_lost(lease=lease)
+
+        return on_close
+
+    def _recover_lost(self, lease: Optional[_Lease] = None, actor_hex: str = ""):
+        """A direct socket died (worker crash / kill): resubmit its pending
+        tasks via the classic path when retry policy allows, else resolve
+        them with the matching error locally (reference semantics:
+        max_retries / max_task_retries gate re-execution)."""
+        to_resubmit: List[_Pending] = []
+        to_fail: List[_Pending] = []
+        with self._lock:
+            if lease is not None:
+                for lst in self._leases.values():
+                    if lease in lst:
+                        lst.remove(lease)
+            doomed = [
+                (h, e) for h, e in self._pending.items()
+                if (lease is not None and e.lease is lease)
+                or (actor_hex and e.actor_hex == actor_hex)
+            ]
+            for task_hex, entry in doomed:
+                self._pending.pop(task_hex, None)
+                (to_resubmit if entry.retries > 0 else to_fail).append(entry)
+        for entry in to_fail:
+            exc = (
+                TaskError(ActorDiedError(), "", "direct_actor_task")
+                if entry.actor_hex
+                else TaskError(
+                    WorkerCrashedError("leased worker died mid-task"),
+                    "", "direct_task",
+                )
+            )
+            with self._lock:
+                for h in entry.return_hexes:
+                    self._table[h] = ("frame", serialization.pack(exc))
+            if entry.publish:
+                try:
+                    self.backend.ensure_published(entry.return_hexes)
+                except Exception:  # noqa: BLE001
+                    pass
+            entry.event.set()
+        for entry in to_resubmit:
+            # Controller re-owns the task: results land in the directory.
+            with self._lock:
+                for h in entry.return_hexes:
+                    self._table[h] = ("registered",)
+            try:
+                self.backend._send_pipelined(
+                    {"type": entry.resubmit_kind, "spec": _spec_blob(entry.spec_bytes)}
+                )
+            except Exception:  # noqa: BLE001 — controller gone too
+                pass
+            self._announce_refs(entry.return_hexes)
+            entry.event.set()
+
+    # -------------------------------------------------- lease lifecycle
+    async def _idle_return_loop(self):
+        import asyncio
+
+        while not self._closed:
+            await asyncio.sleep(LEASE_IDLE_RETURN_S / 2)
+            now = time.monotonic()
+            give_back: List[_Lease] = []
+            with self._lock:
+                for key, lst in list(self._leases.items()):
+                    for lease in list(lst):
+                        if (
+                            lease.inflight == 0
+                            and now - lease.last_used > LEASE_IDLE_RETURN_S
+                        ):
+                            lst.remove(lease)
+                            give_back.append(lease)
+                    if not lst:
+                        self._leases.pop(key, None)
+            for lease in give_back:
+                lease.conn.close()
+                await self._return_lease_id(lease.worker_id)
+
+    def on_revoke(self, worker_id: str):
+        """Controller wants the worker back (queued-path backlog)."""
+        drained = None
+        with self._lock:
+            for lst in self._leases.values():
+                for lease in lst:
+                    if lease.worker_id == worker_id:
+                        lease.draining = True
+                        if lease.inflight == 0:
+                            lst.remove(lease)
+                            drained = lease
+                        break
+        if drained is not None:
+            self._finish_drain(drained)
+
+    def _finish_drain(self, lease: _Lease):
+        with self._lock:
+            for lst in self._leases.values():
+                if lease in lst:
+                    lst.remove(lease)
+        lease.conn.close()
+        self.io.call_nowait(self._return_lease_id(lease.worker_id))
+
+    # ------------------------------------------------------ actor calls
+    def actor_eligible(self, spec: TaskSpec) -> bool:
+        # Once a channel is direct, EVERYTHING eligible-by-transport rides
+        # it (ordering); streaming still works (controller stream plane).
+        return spec.task_type == TaskType.ACTOR_TASK and not spec.options.runtime_env
+
+    def submit_actor(self, spec: TaskSpec) -> bool:
+        if self._closed or not self.actor_eligible(spec):
+            return False
+        actor_hex = spec.actor_id.hex()
+        with self._lock:
+            ch = self._actors.get(actor_hex)
+            if ch is None:
+                ch = self._actors[actor_hex] = _ActorChannel()
+            if ch.mode == "classic":
+                if time.monotonic() >= ch.cooldown:
+                    ch.mode = "handoff"
+                    self.io.call_nowait(self._handoff(actor_hex, ch))
+                    # THIS call buffers behind the fence (order preserved:
+                    # it was submitted after every already-sent classic call).
+                    self._buffer_call(ch, spec, actor_hex)
+                    return True
+                return False
+            if ch.mode == "handoff":
+                self._buffer_call(ch, spec, actor_hex)
+                return True
+            # direct
+            if ch.conn is None or ch.conn._closed:
+                ch.mode = "classic"
+                return False
+            compact = self._register_actor_pending(ch, spec, actor_hex)
+            ch.out_batch.append(compact)
+            wake = not ch.out_scheduled
+            ch.out_scheduled = True
+        if wake:
+            try:
+                ch.conn._loop.call_soon_threadsafe(self._flush_actor_batch, ch)
+            except RuntimeError:  # loop closed — close handler recovers
+                pass
+        return True
+
+    def _flush_actor_batch(self, ch: _ActorChannel):
+        """On the io loop: ship everything accumulated since scheduling."""
+        with self._lock:
+            items, ch.out_batch = ch.out_batch, []
+            ch.out_scheduled = False
+            conn = ch.conn
+        if not items or conn is None:
+            return
+        try:
+            if len(items) == 1:
+                conn.post({"type": "direct_actor_task", "c": items[0]})
+            else:
+                conn.post({"type": "direct_actor_batch", "items": items})
+        except ConnectionError:
+            pass  # close handler resubmits pendings
+
+    def _buffer_call(self, ch: _ActorChannel, spec: TaskSpec, actor_hex: str):
+        """Under lock: queue the spec until the fence resolves."""
+        self._register_actor_pending(ch, spec, actor_hex)
+        ch.buffer.append(spec)
+
+    def _register_actor_pending(
+        self, ch: _ActorChannel, spec: TaskSpec, actor_hex: str
+    ):
+        """Under lock. Returns the COMPACT wire form (proto encode/decode
+        showed up as ~25µs/call on the hot actor path; the resubmission
+        fallback re-encodes the retained TaskSpec lazily instead)."""
+        compact = _compact_actor_spec(spec)
+        if spec.num_returns == -1:
+            return compact  # streaming resolves via the controller stream plane
+        task_hex = spec.task_id.hex()
+        entry = _Pending(
+            spec, [oid.hex() for oid in spec.return_ids],
+            spec.options.max_task_retries, "submit_actor_task", actor_hex,
+        )
+        if spec.arg_refs:
+            from .object_ref import ObjectRef
+
+            entry.arg_pins = [ObjectRef(oid) for oid in spec.arg_refs]
+        self._pending[task_hex] = entry
+        for h in entry.return_hexes:
+            self._hex_to_task[h] = task_hex
+        ch.pending_hexes.add(task_hex)
+        return compact
+
+    async def _handoff(self, actor_hex: str, ch: _ActorChannel):
+        ok = False
+        try:
+            resp = await self.backend.conn.request(
+                {"type": "actor_handoff", "actor": actor_hex}, timeout=35
+            )
+            ok = bool(resp and resp.get("ok"))
+        except Exception:  # noqa: BLE001
+            ok = False
+        if ok:
+            try:
+                host, port = resp["addr"].rsplit(":", 1)
+                reader, writer = await open_rpc_connection(host, int(port))
+            except OSError:
+                ok = False
+        if not ok:
+            flush: List[TaskSpec] = []
+            reverted: List[_Pending] = []
+            with self._lock:
+                ch.mode = "classic"
+                ch.cooldown = time.monotonic() + 5.0
+                flush, ch.buffer = ch.buffer, []
+                # Buffered entries revert to controller ownership.
+                for task_hex in list(ch.pending_hexes):
+                    entry = self._pending.pop(task_hex, None)
+                    if entry is not None:
+                        for h in entry.return_hexes:
+                            self._table[h] = ("registered",)
+                        reverted.append(entry)
+                ch.pending_hexes.clear()
+            for spec in flush:
+                try:
+                    self.backend._send_pipelined(
+                        {"type": "submit_actor_task",
+                         "spec": spec_to_proto_bytes(spec)}
+                    )
+                except Exception:  # noqa: BLE001
+                    pass
+            for entry in reverted:
+                self._announce_refs(entry.return_hexes)
+                entry.event.set()
+            return
+        conn = Connection(reader, writer)
+        conn.on_push = self._make_on_result(None)
+        conn.on_close = self._make_on_actor_close(actor_hex)
+        conn.start()
+        with self._lock:
+            ch.conn = conn
+            ch.addr = resp["addr"]
+            ch.mode = "direct"
+            flush, ch.buffer = ch.buffer, []
+        # post (not await send): later batched submissions are posts too, so
+        # FIFO across the fence flush and everything after it is preserved.
+        for spec in flush:
+            conn.post(
+                {"type": "direct_actor_task", "c": _compact_actor_spec(spec)}
+            )
+
+    def _make_on_actor_close(self, actor_hex: str):
+        async def on_close():
+            with self._lock:
+                ch = self._actors.get(actor_hex)
+                if ch is not None:
+                    ch.mode = "classic"
+                    ch.conn = None
+                    ch.cooldown = time.monotonic() + 2.0
+            self._recover_lost(actor_hex=actor_hex)
+
+        return on_close
+
+    # ------------------------------------------------------------ lookup
+    def lookup(self, hex_id: str):
+        """None = not direct-owned; ("frame", bytes) ready; ("registered",)
+        = controller-owned; _Pending = still executing."""
+        with self._lock:
+            got = self._table.get(hex_id)
+            if got is not None:
+                return got
+            task_hex = self._hex_to_task.get(hex_id)
+            if task_hex is None:
+                return None
+            return self._pending.get(task_hex) or self._table.get(hex_id)
+
+    def wait_pending(self, entries: List["_Pending"], timeout: Optional[float]):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for entry in entries:
+            rem = None if deadline is None else deadline - time.monotonic()
+            if rem is not None and rem <= 0:
+                return False
+            if not entry.event.wait(rem):
+                return False
+        return True
+
+    def cancel(self, task_hex: str):
+        """Cancel an in-flight direct task: the ref resolves CANCELLED
+        immediately and deterministically; the drop push is best-effort
+        execution avoidance (reference semantics — a task that already
+        started may still run, but its result is discarded). Resolving
+        locally first closes every race with steals/rebalances: any late
+        direct_done/direct_dropped finds no pending entry and is ignored."""
+        with self._lock:
+            entry = self._pending.pop(task_hex, None)
+            if entry is None:
+                return False
+            entry.cancelled = True
+            if entry.lease is not None:
+                # _on_done/_on_dropped skip popped entries, so this is the
+                # one and only decrement.
+                entry.lease.inflight -= 1
+            conn = entry.lease.conn if entry.lease is not None else None
+            if conn is None and entry.actor_hex:
+                ch = self._actors.get(entry.actor_hex)
+                conn = ch.conn if ch is not None else None
+                if ch is not None:
+                    ch.pending_hexes.discard(task_hex)
+            err = TaskError(TaskCancelledError(), "", "direct_task")
+            frame = serialization.pack(err)
+            for h in entry.return_hexes:
+                self._table[h] = ("frame", frame)
+        if entry.publish:
+            try:
+                self.backend.ensure_published(entry.return_hexes)
+            except Exception:  # noqa: BLE001
+                pass
+        entry.event.set()
+        if conn is not None and not conn._closed:
+            self._pipelined(conn, {"type": "drop_task", "task": task_hex})
+        return True
+
+    def release(self, hex_id: str) -> bool:
+        """GC of a locally-owned result; True if the release is fully
+        handled here (the controller never knew the object)."""
+        with self._lock:
+            got = self._table.pop(hex_id, None)
+            task_hex = self._hex_to_task.pop(hex_id, None)
+            if got is not None:
+                return got[0] == "frame"
+            entry = self._pending.get(task_hex) if task_hex else None
+            if entry is not None:
+                # Fire-and-forget: consume the release now; the arriving
+                # result is dropped instead of retained forever.
+                entry.discard = True
+                return True
+            return False
+
+    def owns(self, hex_id: str) -> bool:
+        with self._lock:
+            if hex_id in self._table:
+                return self._table[hex_id][0] == "frame"
+            return self._hex_to_task.get(hex_id) in self._pending
+
+    def local_frame(self, hex_id: str) -> Optional[bytes]:
+        with self._lock:
+            got = self._table.get(hex_id)
+            return got[1] if got is not None and got[0] == "frame" else None
+
+    def mark_registered(self, hex_id: str):
+        """The object was published to the controller (ensure_published) —
+        future ref transitions must flush there, not stay local."""
+        with self._lock:
+            self._table[hex_id] = ("registered",)
+
+    def flag_publish_on_done(self, hex_id: str) -> bool:
+        """A ref escaped before its direct task resolved: promise to publish
+        the result the moment it lands. True if a pending task claimed it."""
+        with self._lock:
+            task_hex = self._hex_to_task.get(hex_id)
+            entry = self._pending.get(task_hex) if task_hex else None
+            if entry is None:
+                return False
+            entry.publish = True
+            return True
+
+    # ---------------------------------------------------------- shutdown
+    def close(self):
+        self._closed = True
+        if self._idle_task_fut is not None:
+            self._idle_task_fut.cancel()
+        with self._lock:
+            leases = [l for lst in self._leases.values() for l in lst]
+            self._leases.clear()
+            chans = list(self._actors.values())
+            self._actors.clear()
+        for lease in leases:
+            lease.conn.close()
+        for ch in chans:
+            if ch.conn is not None:
+                ch.conn.close()
+
+

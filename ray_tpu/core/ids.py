@@ -16,7 +16,25 @@ Layouts (bytes):
 from __future__ import annotations
 
 import os
+import random
 import threading
+
+# ID randomness needs uniqueness, not unpredictability — a per-process PRNG
+# seeded from the OS is ~20× cheaper than os.urandom per ID (urandom showed
+# up as the #3 submit-path cost at 6k IDs/s). Reseeded after fork so child
+# processes (workers are spawned, but defend anyway) never repeat a stream.
+_rng = random.Random(os.urandom(16))
+_rng_pid = os.getpid()
+_rng_lock = threading.Lock()
+
+
+def _rand_bytes(n: int) -> bytes:
+    global _rng, _rng_pid
+    with _rng_lock:
+        if os.getpid() != _rng_pid:
+            _rng = random.Random(os.urandom(16))
+            _rng_pid = os.getpid()
+        return _rng.randbytes(n)
 
 _JOB_ID_SIZE = 4
 _ACTOR_UNIQUE_SIZE = 12
@@ -44,7 +62,7 @@ class BaseID:
 
     @classmethod
     def from_random(cls) -> "BaseID":
-        return cls(os.urandom(cls.SIZE))
+        return cls(_rand_bytes(cls.SIZE))
 
     @classmethod
     def from_hex(cls, hex_str: str) -> "BaseID":
@@ -111,7 +129,7 @@ class ActorID(BaseID):
 
     @classmethod
     def of(cls, job_id: JobID, unique: bytes | None = None) -> "ActorID":
-        unique = unique if unique is not None else os.urandom(_ACTOR_UNIQUE_SIZE)
+        unique = unique if unique is not None else _rand_bytes(_ACTOR_UNIQUE_SIZE)
         return cls(unique + job_id.binary())
 
     def job_id(self) -> JobID:
@@ -123,7 +141,7 @@ class TaskID(BaseID):
 
     @classmethod
     def of(cls, actor_id: ActorID, unique: bytes | None = None) -> "TaskID":
-        unique = unique if unique is not None else os.urandom(_TASK_UNIQUE_SIZE)
+        unique = unique if unique is not None else _rand_bytes(_TASK_UNIQUE_SIZE)
         return cls(unique + actor_id.binary())
 
     @classmethod

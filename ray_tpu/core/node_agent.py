@@ -161,6 +161,7 @@ class NodeAgent:
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
         env["RAY_TPU_WORKER_ID"] = worker_id
         env["RAY_TPU_ADDRESS"] = self.controller_address
+        env["RAY_TPU_NODE_IP"] = self.node_ip  # workers bind/advertise here
         env["RAY_TPU_SESSION_DIR"] = self.session_dir
         env["RAY_TPU_SESSION_TAG"] = store.SESSION_TAG  # this node's arena
         env["RAY_TPU_NODE_ID"] = self.node_id

@@ -42,6 +42,24 @@ class RemoteFunction:
     def remote(self, *args, **kwargs):
         return self._remote(args, kwargs, self._default_options)
 
+    def _submit_func(self):
+        """The function as a pre-pickled blob, computed once per wrapper
+        (runtime.CachedFuncBlob — executors cache the unpickle by hash)."""
+        cached = self.__dict__.get("_cached_blob")
+        if cached is None:
+            import hashlib
+
+            import cloudpickle
+
+            from .runtime import CachedFuncBlob
+
+            blob = cloudpickle.dumps(self._function)
+            cached = CachedFuncBlob(
+                blob, hashlib.sha1(blob).hexdigest(), self.__name__
+            )
+            self.__dict__["_cached_blob"] = cached
+        return cached
+
     def options(self, **option_kwargs) -> "RemoteFunction":
         new_opts = options_from_kwargs(self._default_options, **option_kwargs)
         return RemoteFunction(self._function, new_opts)
@@ -50,7 +68,7 @@ class RemoteFunction:
         from . import api
 
         runtime = api._global_runtime()
-        refs = runtime.submit_task(self._function, args, kwargs, opts)
+        refs = runtime.submit_task(self._submit_func(), args, kwargs, opts)
         if opts.num_returns == -1:  # streaming/dynamic (canonical sentinel)
             return refs  # an ObjectRefGenerator
         if opts.num_returns == 1:

@@ -39,6 +39,46 @@ class _ArgRefMarker:
         return (_ArgRefMarker, (self.index,))
 
 
+class CachedFuncBlob:
+    """Pre-pickled function: the submitter walks the closure ONCE
+    (cloudpickle.dumps of a function costs ~100µs+, the single largest
+    per-submit cost) and ships the blob; executors cache the unpickled
+    function by content hash. Reference analog: the function table —
+    functions export once, tasks carry only the descriptor."""
+
+    __slots__ = ("blob", "fhash", "name")
+
+    def __init__(self, blob: bytes, fhash: str, name: str = "task"):
+        self.blob = blob
+        self.fhash = fhash
+        self.name = name
+
+    @property
+    def __name__(self) -> str:  # submit paths read func.__name__
+        return self.name
+
+    def __reduce__(self):
+        return (CachedFuncBlob, (self.blob, self.fhash, self.name))
+
+
+_FUNC_CACHE: Dict[str, Any] = {}
+_FUNC_CACHE_ORDER: List[str] = []
+
+
+def resolve_func(obj: Any) -> Any:
+    """Executor side: CachedFuncBlob → function (hash-cached, bounded)."""
+    if not isinstance(obj, CachedFuncBlob):
+        return obj
+    fn = _FUNC_CACHE.get(obj.fhash)
+    if fn is None:
+        fn = cloudpickle.loads(obj.blob)
+        _FUNC_CACHE[obj.fhash] = fn
+        _FUNC_CACHE_ORDER.append(obj.fhash)
+        if len(_FUNC_CACHE_ORDER) > 512:
+            _FUNC_CACHE.pop(_FUNC_CACHE_ORDER.pop(0), None)
+    return fn
+
+
 class TaskContext(threading.local):
     """Per-thread execution context: which task is running here."""
 
@@ -123,7 +163,21 @@ class Runtime:
 
         args2 = tuple(sub(a) for a in args)
         kwargs2 = {k: sub(v) for k, v in kwargs.items()}
-        payload = cloudpickle.dumps((func_or_none, args2, kwargs2))
+        from .serialization import CONTAINED
+
+        CONTAINED.active = nested = []
+        try:
+            payload = cloudpickle.dumps((func_or_none, args2, kwargs2))
+        finally:
+            CONTAINED.active = None
+        # Any ref escaping this process (top-level arg or nested in the
+        # payload) must exist in the shared object directory — publish
+        # locally-owned direct results first (no-op for classic refs).
+        escaping = [r.id.hex() for r in refs] + nested
+        if escaping:
+            publish = getattr(self.backend, "ensure_published", None)
+            if publish is not None:
+                publish(escaping)
         return payload, refs
 
     def submit_task(
@@ -289,6 +343,7 @@ class Runtime:
 def resolve_payload(payload: bytes, resolved_args: List[Any]):
     """Deserialize a task payload, substituting resolved top-level arg values."""
     func, args, kwargs = cloudpickle.loads(payload)
+    func = resolve_func(func)
 
     def sub(x):
         if isinstance(x, _ArgRefMarker):

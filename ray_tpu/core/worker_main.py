@@ -25,8 +25,44 @@ import cloudpickle
 
 from . import serialization, store
 from .exceptions import TaskError
-from .rpc import Connection, EventLoopThread, open_rpc_connection
+from .rpc import Connection, EventLoopThread, auth_token, open_rpc_connection
 from .task_spec import TaskSpec
+
+
+# Shared immutable-by-convention defaults for compact actor specs — the
+# execution path only reads options (runtime_env / max_* untouched here).
+from .task_spec import TaskOptions as _TaskOptions  # noqa: E402
+
+_DEFAULT_ACTOR_OPTIONS = _TaskOptions()
+
+
+def _spec_from_compact(c) -> TaskSpec:
+    """Decode the direct actor-call wire form (direct.py _compact_actor_spec)
+    — a plain tuple instead of the full proto (~25µs/call cheaper)."""
+    from .ids import ActorID, JobID, ObjectID, TaskID
+    from .task_spec import TaskType
+
+    task_bytes, actor_bytes, method, payload, nret, arg_ref_bytes, seq, parent = c
+    task_id = TaskID(task_bytes)
+    return TaskSpec(
+        task_id=task_id,
+        job_id=task_id.job_id(),
+        task_type=TaskType.ACTOR_TASK,
+        func_payload=payload,
+        arg_refs=[ObjectID(b) for b in arg_ref_bytes],
+        num_returns=nret,
+        return_ids=(
+            [] if nret == -1
+            else [ObjectID.of(task_id, i) for i in range(max(nret, 1))]
+        ),
+        resources={},
+        options=_DEFAULT_ACTOR_OPTIONS,
+        name=method,
+        actor_id=ActorID(actor_bytes),
+        method_name=method,
+        sequence_number=seq,
+        parent_task_id=TaskID(parent) if parent else None,
+    )
 
 
 class WorkerProcess:
@@ -58,6 +94,17 @@ class WorkerProcess:
         # moved on — a spurious drop would poison a later re-dispatch of the
         # same task id (retry/reconstruction) on this worker.
         self._done_hexes = collections.deque(maxlen=128)
+        # Per-connection pending direct replies (backlog batching). The
+        # lock covers on_nested_block calls from actor-pool threads.
+        self._reply_lock = threading.Lock()
+        self._reply_batch: Dict[Connection, list] = {}
+        self._reply_batch_t0 = 0.0
+        self._in_batch = False  # inside execute_actor_batch processing
+        # Timeline events for direct tasks (the controller never sees their
+        # dispatch/done) — batched to the controller like the reference's
+        # profile-event flushes, so tracing/state stay complete without a
+        # per-task control-plane message.
+        self._task_events: List[dict] = []
         self._start_orphan_watchdog()
 
     def _start_orphan_watchdog(self):
@@ -84,6 +131,149 @@ class WorkerProcess:
 
         threading.Thread(target=watch, daemon=True, name="orphan-watchdog").start()
 
+    # ------------------------------------------------- direct task plane
+    # Reference analog: the core worker's own gRPC server receiving
+    # PushNormalTask / actor pushes (`direct_task_transport.cc:241`) — the
+    # submitter talks to this worker without the scheduler in the loop.
+    async def _start_direct_server(self):
+        import asyncio
+
+        from . import config as rt_config
+
+        node_ip = rt_config.get("node_ip")
+        bind = rt_config.get("bind_address") or node_ip
+        self._direct_server = await asyncio.start_server(
+            self._on_direct_connection, host=bind, port=0
+        )
+        port = self._direct_server.sockets[0].getsockname()[1]
+        self.direct_addr = f"{node_ip}:{port}"
+
+    async def _on_direct_connection(self, reader, writer):
+        conn = Connection(reader, writer, expected_token=auth_token())
+
+        async def on_push(msg: dict):
+            t = msg.get("type")
+            if t == "direct_task":
+                self.task_queue.put(
+                    {"type": "execute_task", "spec": msg["spec"],
+                     "deps": None, "direct_conn": conn}
+                )
+            elif t == "direct_actor_task":
+                self.task_queue.put(
+                    {"type": "execute_actor_task", "c": msg["c"],
+                     "deps": None, "direct_conn": conn}
+                )
+            elif t == "direct_actor_batch":
+                # One queue item per burst — per-call queue traffic on this
+                # io thread competes with the executing main thread.
+                self.task_queue.put(
+                    {"type": "execute_actor_batch", "items": msg["items"],
+                     "direct_conn": conn}
+                )
+            elif t == "drop_task":
+                with self._task_lock:
+                    dropped = (
+                        msg["task"] != self._current_task_hex
+                        and msg["task"] not in self._done_hexes
+                    )
+                    if dropped:
+                        self._dropped.add(msg["task"])
+                if dropped:
+                    await conn.send({"type": "direct_dropped", "task": msg["task"]})
+
+        conn.on_push = on_push
+        conn.start()
+
+    def _queue_direct_result(
+        self, conn: Connection, spec: TaskSpec, results, spec_blob=None
+    ):
+        """Reply path with backlog batching: while more tasks wait in the
+        queue, inline results accumulate and flush as ONE message per drain
+        (syscall + wakeup per reply dominated the single-actor call rate)."""
+        all_inline = all(
+            r.get("inline") is not None and not r.get("contains") for r in results
+        )
+        if not all_inline:
+            self._flush_direct_replies()
+            self._send_direct_result(conn, spec, results, spec_blob=spec_blob)
+            return
+        with self._reply_lock:
+            if not self._reply_batch:
+                self._reply_batch_t0 = time.monotonic()
+            self._reply_batch.setdefault(conn, []).append(
+                {"task": spec.task_id.hex(), "results": results}
+            )
+            # Flush on: batch full, 2ms elapsed (a long task must never hold
+            # earlier results hostage — submitters may be blocked on them),
+            # or queue drained outside a burst.
+            flush = (
+                len(self._reply_batch[conn]) >= 64
+                or time.monotonic() - self._reply_batch_t0 >= 0.002
+                or (not self._in_batch and self.task_queue.empty())
+            )
+        if flush:
+            self._flush_direct_replies()
+
+    def _flush_task_events(self):
+        with self._reply_lock:
+            if not self._task_events:
+                return
+            events, self._task_events = self._task_events, []
+        self.send({"type": "task_events", "events": events})
+
+    def _flush_direct_replies(self):
+        with self._reply_lock:
+            if not self._reply_batch:
+                return
+            batches, self._reply_batch = self._reply_batch, {}
+        for conn, items in batches.items():
+            try:
+                if len(items) == 1:
+                    conn.post({"type": "direct_done", **items[0]})
+                else:
+                    conn.post({"type": "direct_done_batch", "items": items})
+            except ConnectionError:
+                pass
+
+    def _send_direct_result(
+        self, conn: Connection, spec: TaskSpec, results, spec_blob=None
+    ):
+        """Result routing for a direct task: inline results ride the
+        submitter socket; big / ref-carrying results register with the
+        controller's object directory (the submitter resolves them there)."""
+        task_hex = spec.task_id.hex()
+        all_inline = all(
+            r.get("inline") is not None and not r.get("contains") for r in results
+        )
+        try:
+            if all_inline:
+                conn.post(
+                    {"type": "direct_done", "task": task_hex, "results": results}
+                )
+                return
+            contains = [h for r in results for h in (r.get("contains") or ())]
+            if contains:
+                # A result may embed refs this worker owns only locally —
+                # publish them before the directory learns the container.
+                from . import api
+
+                publish = getattr(
+                    api._global_runtime().backend, "ensure_published", None
+                )
+                if publish is not None:
+                    publish(contains)
+            done = {"type": "task_done", "task": task_hex,
+                    "results": results, "direct": True}
+            if spec_blob is not None:
+                # Registered results live in a node arena — ship the spec so
+                # the controller can reconstruct them after a node death
+                # (inline results live with the submitter; no lineage needed).
+                done["spec"] = spec_blob
+            self.send(done)
+            conn.post({"type": "direct_done", "task": task_hex, "registered": True})
+        except ConnectionError:
+            pass  # submitter gone; objects (if registered) outlive it
+
     # ----------------------------------------------------------------- io
     async def _connect(self):
         import asyncio
@@ -98,6 +288,7 @@ class WorkerProcess:
             "pid": os.getpid(),
             "has_tpu": os.environ.get("RAY_TPU_WORKER_TPU") == "1",
             "node_id": os.environ.get("RAY_TPU_NODE_ID", "node0"),
+            "direct_addr": getattr(self, "direct_addr", ""),
         }
         if self.actor_instance is not None and self._actor_hex:
             payload["actor_hex"] = self._actor_hex  # controller-restart re-adoption
@@ -165,11 +356,18 @@ class WorkerProcess:
 
     def send(self, msg: dict):
         try:
-            self.io.call(self.conn.send(msg))
+            self.conn.post(msg)  # batched fire-and-forget (FIFO per conn)
         except ConnectionError:
             # Mid-outage result delivery is lost; the restarted controller's
             # retry/ref machinery handles it. Don't kill the worker thread.
             pass
+
+    def on_nested_block(self):
+        """User code on the MAIN thread is about to block (nested get):
+        everything batched must go out first — a held-back reply could be
+        exactly what the blocking get (transitively) waits on."""
+        self._flush_direct_replies()
+        self._flush_task_events()
 
     # ------------------------------------------------------------ obj I/O
     def read_location(self, loc: dict) -> Any:
@@ -197,7 +395,20 @@ class WorkerProcess:
         return {"id": object_hex, "name": name, "size": size, "contains": contains}
 
     # -------------------------------------------------------------- tasks
-    def _resolve(self, spec: TaskSpec, deps: Dict[str, dict]) -> List[Any]:
+    def _resolve(self, spec: TaskSpec, deps: Optional[Dict[str, dict]]) -> List[Any]:
+        if deps is None:
+            # Direct-path task: no controller-materialized dep map — fetch
+            # through this worker's own API backend (blocks with the
+            # worker_blocked grant release, like any nested get).
+            if not spec.arg_refs:
+                return []
+            from . import api
+            from .object_ref import ObjectRef
+
+            backend = api._global_runtime().backend
+            return backend.get(
+                [ObjectRef(oid, _weak=True) for oid in spec.arg_refs], None
+            )
         return [self.read_location(deps[oid.hex()]) for oid in spec.arg_refs]
 
     def _end_stream_with_error(self, spec: TaskSpec, err: "TaskError", index: int):
@@ -271,7 +482,13 @@ class WorkerProcess:
 
         return restore
 
-    def _execute(self, spec: TaskSpec, deps: Dict[str, dict], is_actor_method: bool):
+    def _execute(
+        self,
+        spec: TaskSpec,
+        deps: Optional[Dict[str, dict]],
+        is_actor_method: bool,
+        reply=None,
+    ):
         from . import api
         from .runtime import resolve_payload
 
@@ -353,7 +570,36 @@ class WorkerProcess:
             results = [
                 self.store_result(oid.hex(), err) for oid in spec.return_ids
             ]
-        self.send({"type": "task_done", "task": spec.task_id.hex(), "results": results})
+        if reply is not None:
+            reply(results)
+        else:
+            self.send(
+                {"type": "task_done", "task": spec.task_id.hex(), "results": results}
+            )
+
+    def _execute_actor_fast(self, spec: TaskSpec, reply):
+        """Hot path for simple direct actor calls (no arg refs, one return,
+        no runtime_env, no thread pool): skips the generic machinery that
+        profiling showed dominating per-call cost."""
+        import inspect
+
+        runtime = self._runtime
+        ctx = runtime._context
+        ctx.task_id = spec.task_id
+        ctx.actor_id = spec.actor_id
+        try:
+            _, args, kwargs = cloudpickle.loads(spec.func_payload)
+            result = getattr(self.actor_instance, spec.method_name)(*args, **kwargs)
+            if inspect.isgenerator(result):
+                result = list(result)
+            results = [self.store_result(spec.return_ids[0].hex(), result)]
+        except BaseException as e:  # noqa: BLE001
+            err = TaskError(e, traceback.format_exc(), spec.name)
+            results = [self.store_result(spec.return_ids[0].hex(), err)]
+        finally:
+            ctx.task_id = None
+            ctx.actor_id = None
+        reply(results)
 
     def _create_actor(self, spec: TaskSpec, deps: Dict[str, dict]):
         from . import api
@@ -397,9 +643,16 @@ class WorkerProcess:
 
     # --------------------------------------------------------------- loop
     def run(self):
+        self.io.call(self._start_direct_server())
         self.io.call(self._connect())
         self._init_client_api()
         while not self._stop:
+            if self.task_queue.empty():
+                if self._reply_batch:
+                    self._flush_direct_replies()  # never strand a batched reply
+                self._flush_task_events()
+            elif len(self._task_events) >= 512:
+                self._flush_task_events()
             msg = self.task_queue.get()
             mtype = msg["type"]
             if mtype == "exit":
@@ -408,32 +661,113 @@ class WorkerProcess:
                 if not self.io.call(self._reconnect(), timeout=40):
                     break
                 continue
-            from .task_spec import spec_from_proto_bytes
-
-            spec: TaskSpec = spec_from_proto_bytes(msg["spec"])
-            deps = msg.get("deps", {})
-            with self._task_lock:
-                if spec.task_id.hex() in self._dropped:
-                    self._dropped.discard(spec.task_id.hex())
-                    skip = True  # dropped/reclaimed while queued — no task_done
-                else:
-                    skip = False
-                    self._current_task_hex = spec.task_id.hex()
-            if skip:
+            if mtype == "actor_handoff":
+                # Direct actor-call fence: every classic call dispatched
+                # before this marker is already behind us in this queue —
+                # safe for the submitter to switch to the direct socket.
+                self.send({"type": "handoff_ready", "token": msg["token"]})
                 continue
-            if mtype == "execute_task":
-                self._execute(spec, deps, is_actor_method=False)
-                with self._task_lock:
-                    self._done_hexes.append(spec.task_id.hex())
-            elif mtype == "create_actor":
-                self._create_actor(spec, deps)
-            elif mtype == "execute_actor_task":
-                if self.actor_pool is not None:
-                    self.actor_pool.submit(self._execute, spec, deps, True)
-                else:
-                    self._execute(spec, deps, is_actor_method=True)
+            if mtype == "execute_actor_batch":
+                conn = msg["direct_conn"]
+                self._in_batch = True  # one reply flush per burst, not per call
+                try:
+                    for c in msg["items"]:
+                        self._process_task_msg(
+                            "execute_actor_task",
+                            {"c": c, "deps": None, "direct_conn": conn},
+                        )
+                finally:
+                    self._in_batch = False
+                    self._flush_direct_replies()
+                continue
+            self._process_task_msg(mtype, msg)
         self.local_store.close_all()
+        dump = getattr(self, "_profile_dump", None)
+        if dump is not None:
+            dump()
         os._exit(0)
+
+    def _process_task_msg(self, mtype: str, msg: dict):
+        from .task_spec import spec_from_proto_bytes
+
+        compact = msg.get("c")
+        if compact is not None:
+            spec = _spec_from_compact(compact)
+        else:
+            spec = spec_from_proto_bytes(msg["spec"])
+        deps = msg.get("deps", {})
+        direct_conn = msg.get("direct_conn")
+        reply = None
+        if direct_conn is not None:
+            reply = (
+                lambda results, s=spec, c=direct_conn, b=msg.get("spec"):
+                self._queue_direct_result(c, s, results, spec_blob=b)
+            )
+        with self._task_lock:
+            if spec.task_id.hex() in self._dropped:
+                self._dropped.discard(spec.task_id.hex())
+                skip = True  # dropped/reclaimed while queued — no task_done
+            else:
+                skip = False
+                self._current_task_hex = spec.task_id.hex()
+        if skip:
+            return
+        if direct_conn is not None and self.actor_pool is None:
+            task_hex = spec.task_id.hex()
+            now = time.time()
+            self._task_events.append(
+                {"ts": now, "event": "task_submitted", "task": task_hex,
+                 "name": spec.name,
+                 "parent": spec.parent_task_id.hex()
+                 if spec.parent_task_id else None}
+            )
+            self._task_events.append(
+                {"ts": now, "event": "task_dispatched", "task": task_hex,
+                 "worker": self.worker_id}
+            )
+            if not self._in_batch and self.task_queue.empty():
+                # Nothing queued behind: this may be a LONG task — make it
+                # visible as RUNNING before execution starts.
+                self._flush_task_events()
+        if mtype == "execute_task":
+            self._execute(spec, deps, is_actor_method=False, reply=reply)
+            with self._task_lock:
+                self._done_hexes.append(spec.task_id.hex())
+            if direct_conn is not None:
+                self._task_events.append(
+                    {"ts": time.time(), "event": "task_done",
+                     "task": spec.task_id.hex()}
+                )
+        elif mtype == "create_actor":
+            self._create_actor(spec, deps)
+        elif mtype == "execute_actor_task":
+            if self.actor_pool is not None:
+                # Pool threads must not touch the main-thread reply batch.
+                pool_reply = None
+                if direct_conn is not None:
+                    pool_reply = (
+                        lambda results, s=spec, c=direct_conn:
+                        self._send_direct_result(c, s, results)
+                    )
+                self.actor_pool.submit(self._execute, spec, deps, True, pool_reply)
+            elif (
+                reply is not None
+                and spec.num_returns == 1
+                and not spec.arg_refs
+                and spec.options.runtime_env is None
+            ):
+                self._execute_actor_fast(spec, reply)
+                self._task_events.append(
+                    {"ts": time.time(), "event": "task_done",
+                     "task": spec.task_id.hex()}
+                )
+            else:
+                self._execute(spec, deps, is_actor_method=True, reply=reply)
+                if direct_conn is not None:
+                    self._task_events.append(
+                        {"ts": time.time(), "event": "task_done",
+                         "task": spec.task_id.hex()}
+                    )
 
     def _init_client_api(self):
         """Install a Runtime so user code can call the full API from tasks."""
@@ -450,6 +784,7 @@ class WorkerProcess:
         )
         backend.set_runtime(runtime)
         api.set_global_runtime(runtime)
+        self._runtime = runtime  # fast-path handle (no api lookup per call)
 
 
 def main():
@@ -458,6 +793,25 @@ def main():
     session_dir = os.environ.get("RAY_TPU_SESSION_DIR", "/tmp/ray_tpu")
     store.set_session_tag(os.environ.get("RAY_TPU_SESSION_TAG", ""))
     wp = WorkerProcess(address, worker_id, session_dir)
+    profile_dir = os.environ.get("RAY_TPU_WORKER_PROFILE")
+    if profile_dir:
+        # Dev tool (mirrors the controller's profile hook): cProfile the
+        # main execution loop; run() dumps before its os._exit.
+        import cProfile
+        import signal
+
+        prof = cProfile.Profile()
+
+        def _dump():
+            prof.disable()
+            prof.dump_stats(
+                os.path.join(profile_dir, f"worker-{worker_id}.pstats")
+            )
+
+        wp._profile_dump = _dump
+        # Actor workers die by SIGTERM at shutdown — still dump.
+        signal.signal(signal.SIGTERM, lambda *_: (_dump(), os._exit(0)))
+        prof.enable()
     try:
         wp.run()
     except KeyboardInterrupt:

@@ -91,7 +91,8 @@ class WorkerKiller(_KillerBase):
             w["worker_id"]
             for w in workers
             if w["worker_id"] != me
-            and (w["state"] == "busy" or (self.include_actors and w["state"] == "actor"))
+            and (w["state"] in ("busy", "leased")
+                 or (self.include_actors and w["state"] == "actor"))
         ]
         return self._rng.choice(victims) if victims else None
 

@@ -20,8 +20,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 
-def timeit(name: str, fn, n: int, unit: str = "ops/s"):
-    fn()  # warmup
+def timeit(name: str, fn, n: int, unit: str = "ops/s", warmups: int = 1):
+    for _ in range(warmups):  # steady state: pool growth + lease warmup
+        fn()
     t0 = time.perf_counter()
     fn()
     dt = time.perf_counter() - t0
@@ -46,12 +47,12 @@ def main():
     def tiny():
         return b"ok"
 
-    N_TASKS = 1000
+    N_TASKS = 3000  # long enough to measure steady state, not pool ramp
 
     def task_throughput():
         ray_tpu.get([tiny.remote() for _ in range(N_TASKS)])
 
-    timeit("tasks_per_second", task_throughput, N_TASKS)
+    timeit("tasks_per_second", task_throughput, N_TASKS, warmups=3)
 
     # ------------------------------------------------------- actor calls
     @ray_tpu.remote

@@ -54,6 +54,7 @@ def test_tasks_actually_parallel(cluster):
         time.sleep(0.5)
         return os.getpid()
 
+    ray_tpu.get([sleep_id.remote() for _ in range(4)])  # warm the pool
     t0 = time.time()
     pids = ray_tpu.get([sleep_id.remote() for _ in range(4)])
     elapsed = time.time() - t0
@@ -190,6 +191,8 @@ def test_wait_cluster(cluster):
         time.sleep(10)
         return 2
 
+    # Warm the pool: wait() semantics are under test, not cold-start timing.
+    ray_tpu.get([fast.remote() for _ in range(4)], timeout=60)
     f, s = fast.remote(), slow.remote()
     ready, not_ready = ray_tpu.wait([f, s], num_returns=1, timeout=5)
     assert ready == [f] and not_ready == [s]

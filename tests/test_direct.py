@@ -1,0 +1,284 @@
+"""Direct call plane (core/direct.py): leases, worker-push tasks, direct
+actor channels, and their failure paths. Reference analog:
+`direct_task_transport.cc` lease caching + direct actor transport."""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+
+pytestmark = pytest.mark.cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def _manager():
+    from ray_tpu.core import api
+
+    return api._global_runtime().backend.direct
+
+
+def test_steady_state_tasks_use_leases(cluster):
+    @ray_tpu.remote
+    def f(i):
+        return i * 2
+
+    # Warm: first burst acquires leases.
+    assert ray_tpu.get([f.remote(i) for i in range(20)], timeout=60) == [
+        i * 2 for i in range(20)
+    ]
+    m = _manager()
+    assert m is not None
+    # Steady state: leases held, pendings resolved locally.
+    out = ray_tpu.get([f.remote(i) for i in range(200)], timeout=60)
+    assert out == [i * 2 for i in range(200)]
+    with m._lock:
+        assert any(m._leases.values()), "no leases cached after steady state"
+
+
+def test_direct_result_escapes_as_argument(cluster):
+    """A locally-owned direct result must publish into the object directory
+    when passed to another task (top-level AND nested)."""
+
+    @ray_tpu.remote
+    def produce():
+        return 41
+
+    @ray_tpu.remote
+    def add_one(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def add_nested(box):
+        return ray_tpu.get(box["ref"]) + 1
+
+    ref = produce.remote()
+    assert ray_tpu.get(add_one.remote(ref), timeout=60) == 42
+    ref2 = produce.remote()
+    assert ray_tpu.get(add_nested.remote({"ref": ref2}), timeout=60) == 42
+
+
+def test_direct_result_in_put_container(cluster):
+    @ray_tpu.remote
+    def produce():
+        return "inner"
+
+    ref = produce.remote()
+    box = ray_tpu.put([ref])
+
+    @ray_tpu.remote
+    def open_box(b):
+        return ray_tpu.get(b[0])
+
+    assert ray_tpu.get(open_box.remote(box), timeout=60) == "inner"
+
+
+def test_direct_task_error_propagates(cluster):
+    @ray_tpu.remote
+    def boom():
+        raise KeyError("direct")
+
+    # Warm leases so the failing task takes the direct path.
+    @ray_tpu.remote
+    def ok():
+        return 1
+
+    ray_tpu.get([ok.remote() for _ in range(8)], timeout=60)
+    with pytest.raises(KeyError):
+        ray_tpu.get(boom.remote(), timeout=60)
+
+
+def test_direct_task_worker_death_retries(cluster, tmp_path):
+    """Leased-worker death: pending direct tasks resubmit via the scheduler
+    when max_retries allows."""
+    marker = str(tmp_path / "direct_marker")
+
+    @ray_tpu.remote(max_retries=2)
+    def flaky():
+        if not os.path.exists(marker):
+            open(marker, "w").close()
+            os._exit(1)
+        return "recovered"
+
+    # Warm leases with the same resource shape.
+    @ray_tpu.remote
+    def warm():
+        return 1
+
+    ray_tpu.get([warm.remote() for _ in range(8)], timeout=60)
+    assert ray_tpu.get(flaky.remote(), timeout=90) == "recovered"
+
+
+def test_direct_task_worker_death_no_retries_errors(cluster):
+    @ray_tpu.remote(max_retries=0)
+    def die():
+        os._exit(1)
+
+    @ray_tpu.remote
+    def warm():
+        return 1
+
+    ray_tpu.get([warm.remote() for _ in range(8)], timeout=60)
+    with pytest.raises(ray_tpu.RayTpuError):
+        ray_tpu.get(die.remote(), timeout=60)
+
+
+def test_direct_actor_channel_and_ordering(cluster):
+    """Calls before, during, and after the handoff fence must execute in
+    submission order."""
+
+    @ray_tpu.remote
+    class Seq:
+        def __init__(self):
+            self.log = []
+
+        def add(self, v):
+            self.log.append(v)
+            return v
+
+        def get_log(self):
+            return list(self.log)
+
+    s = Seq.remote()
+    refs = [s.add.remote(i) for i in range(50)]  # spans classic→direct switch
+    assert ray_tpu.get(refs, timeout=60) == list(range(50))
+    assert ray_tpu.get(s.get_log.remote(), timeout=60) == list(range(50))
+
+
+def test_direct_actor_with_ref_args(cluster):
+    """Ref-carrying calls ride the direct channel too (worker self-resolves),
+    keeping channel ordering."""
+
+    @ray_tpu.remote
+    class Acc:
+        def __init__(self):
+            self.total = 0
+
+        def add(self, v):
+            self.total += v
+            return self.total
+
+    a = Acc.remote()
+    ray_tpu.get(a.add.remote(1), timeout=60)  # warm + handoff
+    ray_tpu.get(a.add.remote(1), timeout=60)
+    ref = ray_tpu.put(10)
+    assert ray_tpu.get(a.add.remote(ref), timeout=60) == 12
+    assert ray_tpu.get(a.add.remote(3), timeout=60) == 15
+
+
+def test_direct_actor_streaming_method(cluster):
+    @ray_tpu.remote
+    class Gen:
+        def ping(self):
+            return 1
+
+        def stream(self, n):
+            yield from range(n)
+
+    g = Gen.remote()
+    ray_tpu.get(g.ping.remote(), timeout=60)
+    ray_tpu.get(g.ping.remote(), timeout=60)  # direct mode now
+    got = [ray_tpu.get(r, timeout=60) for r in g.stream.options(
+        num_returns="streaming").remote(4)]
+    assert got == [0, 1, 2, 3]
+
+
+def test_direct_actor_death_surfaces(cluster):
+    @ray_tpu.remote(max_restarts=0)
+    class Dying:
+        def ping(self):
+            return 1
+
+        def crash(self):
+            os._exit(1)
+
+    d = Dying.remote()
+    ray_tpu.get(d.ping.remote(), timeout=60)
+    ray_tpu.get(d.ping.remote(), timeout=60)  # direct mode
+    with pytest.raises(ray_tpu.RayTpuError):
+        ray_tpu.get(d.crash.remote(), timeout=60)
+    with pytest.raises(ray_tpu.RayTpuError):
+        ray_tpu.get(d.ping.remote(), timeout=60)
+
+
+def test_cancel_direct_task(cluster):
+    @ray_tpu.remote
+    def warm():
+        return 1
+
+    ray_tpu.get([warm.remote() for _ in range(8)], timeout=60)
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+        return "done"
+
+    # Occupy ALL capacity and wait until every slot is RUNNING, then submit
+    # the victim: it must be queued (cancel of a RUNNING task without force
+    # is best-effort, like the reference — only an unstarted task is
+    # reliably droppable; a cold pool's staggered lease grants could steal
+    # the victim into execution before the cancel lands).
+    refs = [slow.remote() for _ in range(4)]
+    from ray_tpu.core import api
+
+    b = api._global_runtime().backend
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        tasks = b._request({"type": "list_tasks"})["tasks"]
+        if sum(1 for t in tasks if t["state"] == "RUNNING" and t["name"] == "slow") >= 4:
+            break
+        time.sleep(0.1)
+    victim = slow.remote()
+    time.sleep(0.3)
+    ray_tpu.cancel(victim)
+    with pytest.raises(Exception) as ei:
+        ray_tpu.get(victim, timeout=30)
+    assert "ancel" in type(ei.value).__name__ or "ancel" in str(ei.value)
+    assert ray_tpu.get(refs[0], timeout=30) == "done"
+
+
+def test_lease_revoked_for_queued_backlog(cluster):
+    """Leased-idle workers must come back when the queued path needs the
+    capacity (controller h_request_lease ↔ _revoke_leases_for_backlog)."""
+
+    @ray_tpu.remote
+    def warm():
+        return 1
+
+    from ray_tpu.core.task_spec import SpreadSchedulingStrategy
+
+    ray_tpu.get([warm.remote() for _ in range(12)], timeout=60)  # leases held
+
+    # An ineligible task (spread strategy → classic path) needing capacity.
+    @ray_tpu.remote(scheduling_strategy=SpreadSchedulingStrategy())
+    def classic():
+        return "ran"
+
+    assert ray_tpu.get([classic.remote() for _ in range(6)], timeout=90) == [
+        "ran"
+    ] * 6
+
+
+def test_big_direct_result_registers(cluster):
+    import numpy as np
+
+    @ray_tpu.remote
+    def warm():
+        return 1
+
+    ray_tpu.get([warm.remote() for _ in range(8)], timeout=60)
+
+    @ray_tpu.remote
+    def big():
+        return np.ones(300_000, dtype=np.float32)  # > inline threshold
+
+    out = ray_tpu.get(big.remote(), timeout=60)
+    assert float(out.sum()) == 300_000.0

@@ -4,12 +4,33 @@ Reference analog: `python/ray/tests/test_tracing.py` (span parent/child
 links around remote calls).
 """
 
+import time
+
 import pytest
 
 import ray_tpu
 from ray_tpu.util import tracing
 
 pytestmark = pytest.mark.cluster
+
+
+def _wait_spans(names, deadline_s=8.0):
+    """Timeline events for direct-path tasks are worker-batched and
+    eventually consistent — poll until the expected spans land COMPLETE
+    (their task_done flushes in a later batch than the dispatch)."""
+    end = time.monotonic() + deadline_s
+    while True:
+        spans = tracing.build_trace(ray_tpu.timeline())
+        by_name = {}
+        for s in spans.values():
+            by_name.setdefault(s.name, []).append(s)
+        done = all(
+            n in by_name and all(s.done_at is not None for s in by_name[n])
+            for n in names
+        )
+        if done or time.monotonic() >= end:
+            return spans, by_name
+        time.sleep(0.2)
 
 
 def test_nested_task_parentage(cluster_runtime):
@@ -23,10 +44,7 @@ def test_nested_task_parentage(cluster_runtime):
 
     assert ray_tpu.get(parent.remote()) == 2
 
-    spans = tracing.build_trace(ray_tpu.timeline())
-    by_name = {}
-    for s in spans.values():
-        by_name.setdefault(s.name, []).append(s)
+    spans, by_name = _wait_spans(["parent", "child"])
     assert "parent" in by_name and "child" in by_name
     child_span = by_name["child"][0]
     parent_span = by_name["parent"][0]
@@ -46,8 +64,17 @@ def test_task_tree_and_flows(cluster_runtime):
         return ray_tpu.get([leaf.remote(i) for i in range(3)])
 
     assert ray_tpu.get(fan.remote()) == [0, 1, 2]
-    tree = tracing.get_task_tree()
-    fan_nodes = [t for t in tree if t["name"] == "fan"]
+    # All three leaves flush from (possibly) different workers — poll until
+    # the whole fan-out is visible.
+    end = time.monotonic() + 8.0
+    while True:
+        tree = tracing.get_task_tree()
+        fan_nodes = [t for t in tree if t["name"] == "fan"]
+        if (fan_nodes and len(fan_nodes[0]["children"]) == 3) or (
+            time.monotonic() >= end
+        ):
+            break
+        time.sleep(0.2)
     assert fan_nodes and len(fan_nodes[0]["children"]) == 3
 
     flows = tracing.chrome_trace_with_flows(ray_tpu.timeline())
