@@ -91,7 +91,20 @@ define("spawn_burst_cap", 4, doc="Max workers spawned per node per pass")
 define("snapshot_interval_s", 1.0, doc="Controller state snapshot period")
 define("gcs_storage", "file",
        doc="Metadata backend url: file[://dir] (durable) | memory (volatile)")
-define("pull_timeout_s", 120.0, doc="Cross-node object pull timeout")
+define("pull_timeout_s", 120.0, doc="Cross-node object pull base timeout")
+# Chunked transfer plane (reference: object_manager chunked push/pull,
+# `object_manager.h` default chunk 5 MiB; admission `pull_manager.h:52`).
+define("transfer_chunk_bytes", 16 * 1024 * 1024,
+       doc="Cross-node transfers stream in chunks of this size")
+define("transfer_chunk_parallel", 4,
+       doc="In-flight chunks per object pull")
+define("transfer_chunk_timeout_s", 60.0,
+       doc="Per-chunk progress deadline (replaces whole-object timeouts)")
+define("transfer_max_pulls", 4,
+       doc="Concurrent object pulls a node admits (admission control)")
+define("transfer_pulls_per_source", 2,
+       doc="Concurrent pulls served per source copy before fan-out waits "
+           "for new copies (yields tree-shaped broadcast)")
 # Networking (reference: `node_ip_address` plumbed through every process,
 # `services.py:295-305`). node_ip is what THIS machine advertises to the
 # cluster; bind_address is the listen interface (empty = node_ip).

@@ -275,6 +275,10 @@ class Controller:
         self.nodes: Dict[str, NodeState] = {HEAD_NODE: self.head}
         # In-flight cross-node pulls, deduped: (node_id, object_hex) -> Future.
         self._pulls: Dict[Tuple[str, str], asyncio.Future] = {}
+        # Broadcast shaping: active pulls served per source node + waiters
+        # parked until a pull completes (new copies appear).
+        self._src_active: Dict[str, int] = {}
+        self._transfer_waiters: List[asyncio.Future] = []
         # Controller -> agent fetch-server connections (for pulls INTO node0).
         self._fetch_conns: Dict[str, Connection] = {}
         self._spread_rr = 0
@@ -973,13 +977,26 @@ class Controller:
 
     # ------------------------------------------------- cross-node transfer
     def _source_for(self, obj: ObjectState) -> Optional[dict]:
-        """Pick a fetch source: any live shm copy, else the spill file."""
+        """Pick the LEAST-LOADED live copy (each completed pull mints a new
+        copy, so concurrent fan-out self-organizes into a broadcast tree —
+        reference analog: `PushManager` chunked push + location-aware pulls);
+        falls back to the spill file."""
+        best = None
+        best_load = None
         for nid, name in obj.locations.items():
             node = self.nodes.get(nid)
             if node is None or not node.alive:
                 continue
-            addr = f"{self.node_ip}:{self.port}" if nid == HEAD_NODE else node.fetch_addr
-            return {"addr": addr, "name": name, "node": nid}
+            load = self._src_active.get(nid, 0)
+            if best is None or load < best_load:
+                addr = (
+                    f"{self.node_ip}:{self.port}" if nid == HEAD_NODE
+                    else node.fetch_addr
+                )
+                best = {"addr": addr, "name": name, "node": nid}
+                best_load = load
+        if best is not None:
+            return best
         if obj.spilled_path is not None:
             nid = obj.spilled_node
             node = self.nodes.get(nid)
@@ -1003,26 +1020,58 @@ class Controller:
             return
         fut = asyncio.get_running_loop().create_future()
         self._pulls[key] = fut
+        src = None
         try:
-            src = self._source_for(obj)
-            if src is None:
-                raise RuntimeError(f"object {hex_id[:12]} has no live copy")
-            if node_id == HEAD_NODE:
-                data = await self._fetch_from(src)
-                name, size = self.local_store.create_raw(hex_id, data)
-                self.store_bytes_used += size
-                self._maybe_spill()  # pulls also count against the memory cap
-            else:
-                node = self.nodes[node_id]
-                req = {"type": "pull_object", "id": hex_id, "addr": src["addr"]}
-                if "name" in src:
-                    req["name"] = src["name"]
+            # Broadcast shaping: wait while every source is already serving
+            # its quota of pulls — each completed pull adds a copy, so
+            # waiters fan out over fresh sources (binomial-tree growth)
+            # instead of hammering the origin N-wide.
+            per_src = rt_config.get("transfer_pulls_per_source")
+            while True:
+                src = self._source_for(obj)
+                if src is None:
+                    raise RuntimeError(f"object {hex_id[:12]} has no live copy")
+                if self._src_active.get(src["node"], 0) < per_src:
+                    break
+                waiter = asyncio.get_running_loop().create_future()
+                self._transfer_waiters.append(waiter)
+                await waiter
+                if node_id in obj.locations:  # a racer materialized it here
+                    fut.set_result(None)
+                    return
+            self._src_active[src["node"]] = self._src_active.get(src["node"], 0) + 1
+            try:
+                # Deadline scales with size AND with possible queueing behind
+                # the destination's pull-admission quota (the per-chunk
+                # progress deadline lives agent-side; this is a backstop).
+                timeout = rt_config.get("pull_timeout_s") + (
+                    obj.size * (1 + rt_config.get("transfer_max_pulls"))
+                    / (16 * 1024 * 1024) if obj.size else 0.0
+                )
+                if node_id == HEAD_NODE:
+                    name, size = await self._fetch_into_head(
+                        dict(src, id=hex_id), obj.size
+                    )
+                    self.store_bytes_used += size
+                    self._maybe_spill()  # pulls count against the memory cap
                 else:
-                    req["path"] = src["path"]
-                resp = await node.conn.request(req, timeout=rt_config.get("pull_timeout_s"))
-                if not resp.get("ok"):
-                    raise RuntimeError(f"pull failed: {resp.get('error')}")
-                name = resp["name"]
+                    node = self.nodes[node_id]
+                    req = {"type": "pull_object", "id": hex_id,
+                           "addr": src["addr"], "size": obj.size or 0}
+                    if "name" in src:
+                        req["name"] = src["name"]
+                    else:
+                        req["path"] = src["path"]
+                    resp = await node.conn.request(req, timeout=timeout)
+                    if not resp.get("ok"):
+                        raise RuntimeError(f"pull failed: {resp.get('error')}")
+                    name = resp["name"]
+            finally:
+                self._src_active[src["node"]] -= 1
+                waiters, self._transfer_waiters = self._transfer_waiters, []
+                for w in waiters:
+                    if not w.done():
+                        w.set_result(None)
             obj.locations[node_id] = name
             self._event("object_transferred", object=hex_id, to=node_id, src=src["node"])
             fut.set_result(None)
@@ -1034,13 +1083,19 @@ class Controller:
         finally:
             self._pulls.pop(key, None)
 
-    async def _fetch_from(self, src: dict) -> bytes:
-        """Fetch object bytes into the controller (head-node pulls)."""
+    async def _fetch_into_head(self, src: dict, size_hint: int = 0):
+        """Materialize a remote object in the HEAD store — the same chunked
+        pull client the agents use (streams into shm; no heap staging).
+        Returns (name, size)."""
+        from .node_agent import pull_chunked
+
+        hex_id = src.get("id", "")
         if src["node"] == HEAD_NODE:
             if "name" in src:
-                return self.local_store.read_raw(src["name"])
+                return src["name"], self.local_store.raw_size(src["name"])
             with open(src["path"], "rb") as f:
-                return f.read()
+                data = f.read()
+            return self.local_store.create_raw(hex_id, data)
         conn = self._fetch_conns.get(src["node"])
         if conn is None or conn._closed:
             host, port = src["addr"].rsplit(":", 1)
@@ -1048,15 +1103,26 @@ class Controller:
             conn = Connection(reader, writer)
             conn.start()
             self._fetch_conns[src["node"]] = conn
-        fetch = {"type": "fetch_object"}
-        if "name" in src:
-            fetch["name"] = src["name"]
-        else:
-            fetch["path"] = src["path"]
-        resp = await conn.request(fetch, timeout=60)
-        if resp.get("error"):
-            raise RuntimeError(resp["error"])
-        return resp["data"]
+        where = {"name": src["name"]} if "name" in src else {"path": src["path"]}
+        return await pull_chunked(
+            conn, where, self.local_store, hex_id, size_hint=size_hint
+        )
+
+    async def h_stat_object(self, conn, meta, msg):
+        from .node_agent import serve_fetch
+
+        try:
+            return serve_fetch(self.local_store, dict(msg, type="stat_object"))
+        except Exception as e:  # noqa: BLE001
+            return {"error": repr(e)}
+
+    async def h_fetch_chunk(self, conn, meta, msg):
+        from .node_agent import serve_fetch
+
+        try:
+            return serve_fetch(self.local_store, dict(msg, type="fetch_chunk"))
+        except Exception as e:  # noqa: BLE001
+            return {"error": repr(e)}
 
     async def h_fetch_object(self, conn, meta, msg):
         """Serve head-node object bytes to a pulling agent."""

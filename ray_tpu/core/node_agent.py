@@ -36,6 +36,79 @@ from . import store
 from .rpc import Connection, auth_token, open_rpc_connection
 
 
+def serve_fetch(local_store, msg: dict):
+    """Shared fetch-plane request handling (agents AND the controller serve
+    the same three verbs): fetch_object (whole), stat_object (size),
+    fetch_chunk (slice). Returns the response payload or None to ignore."""
+    mtype = msg.get("type")
+    if mtype == "fetch_object":
+        if msg.get("name"):
+            return {"data": local_store.read_raw(msg["name"])}
+        with open(msg["path"], "rb") as f:
+            return {"data": f.read()}
+    if mtype == "stat_object":
+        if msg.get("name"):
+            return {"size": local_store.raw_size(msg["name"])}
+        return {"size": os.path.getsize(msg["path"])}
+    if mtype == "fetch_chunk":
+        if msg.get("name"):
+            return {"data": local_store.read_raw_slice(
+                msg["name"], msg["offset"], msg["length"]
+            )}
+        with open(msg["path"], "rb") as f:
+            f.seek(msg["offset"])
+            return {"data": f.read(msg["length"])}
+    return None
+
+
+async def pull_chunked(peer, where: dict, local_store, hex_id: str,
+                       size_hint: int = 0):
+    """Shared chunked-pull client (agents AND the controller's head pulls):
+    stat (skipped when the size is already known) → whole-object fast path
+    for small objects → bounded-parallel chunk fetches streamed straight
+    into the destination store (create_begin → write → commit; no full-
+    object staging in heap). Returns (name, size)."""
+    import asyncio
+
+    chunk = rt_config.get("transfer_chunk_bytes")
+    tmo = rt_config.get("transfer_chunk_timeout_s")
+    size = size_hint
+    if not size:
+        stat = await peer.request({"type": "stat_object", **where}, timeout=tmo)
+        if stat.get("error"):
+            raise RuntimeError(stat["error"])
+        size = stat["size"]
+    if size <= chunk:
+        resp = await peer.request({"type": "fetch_object", **where}, timeout=tmo)
+        if resp.get("error"):
+            raise RuntimeError(resp["error"])
+        return local_store.create_raw(hex_id, resp["data"])
+    name, writer = local_store.create_begin(hex_id, size)
+    if writer is None:
+        return name, size  # completed earlier pull / locally produced
+    try:
+        sem = asyncio.Semaphore(rt_config.get("transfer_chunk_parallel"))
+
+        async def get_chunk(off: int):
+            length = min(chunk, size - off)
+            async with sem:
+                resp = await peer.request(
+                    {"type": "fetch_chunk", **where,
+                     "offset": off, "length": length},
+                    timeout=tmo,
+                )
+            if resp.get("error"):
+                raise RuntimeError(resp["error"])
+            writer.write(off, resp["data"])
+
+        await asyncio.gather(*(get_chunk(o) for o in range(0, size, chunk)))
+        writer.commit()
+    except BaseException:
+        writer.abort()
+        raise
+    return name, size
+
+
 def _set_pdeathsig():
     """Linux: kill this process when the parent (agent) dies."""
     try:
@@ -73,6 +146,11 @@ class NodeAgent:
         self._server: Optional[asyncio.base_events.Server] = None
         self._worker_procs: Dict[str, subprocess.Popen] = {}
         self._peer_conns: Dict[str, Connection] = {}
+        # Pull admission control (reference: pull_manager.h quota): bounds
+        # concurrent inbound object materializations; same-object requests
+        # join the in-flight pull.
+        self._pull_sem = asyncio.Semaphore(rt_config.get("transfer_max_pulls"))
+        self._pulls_inflight: Dict[str, asyncio.Future] = {}
         self._shutdown = asyncio.Event()
 
     # ------------------------------------------------------------ lifecycle
@@ -214,24 +292,48 @@ class NodeAgent:
         return conn
 
     async def _handle_pull(self, msg: dict):
-        """Fetch object bytes from a peer node into the local arena.
-        Reference analog: `PullManager` bundle fetch (`pull_manager.h:52`)."""
+        """Fetch an object from a peer node into the local arena, streamed
+        in bounded-parallel CHUNKS with per-chunk progress deadlines and
+        node-level admission control. Reference analog: `PullManager`
+        (`pull_manager.h:52`) + the object manager's chunked transfer
+        (`object_manager.h`, default 5 MiB chunks). Same-object pulls JOIN
+        the in-flight transfer instead of racing its partial writes (a
+        controller-side timeout retry must never observe half-written
+        bytes through create_begin's already-exists fast path)."""
+        import asyncio
+
         req_id = msg.get("req_id")
         hex_id = msg["id"]
+        inflight = self._pulls_inflight.get(hex_id)
+        if inflight is not None:
+            try:
+                result = dict(await inflight)
+            except Exception as e:  # noqa: BLE001
+                result = {"ok": False, "error": repr(e)}
+            if req_id is not None:
+                await self.conn.respond(req_id, result)
+            return
+        fut = asyncio.get_running_loop().create_future()
+        self._pulls_inflight[hex_id] = fut
         try:
-            peer = await self._peer(msg["addr"])
-            fetch = {"type": "fetch_object"}
-            if msg.get("name"):
-                fetch["name"] = msg["name"]
-            else:
-                fetch["path"] = msg["path"]
-            resp = await peer.request(fetch, timeout=60)
-            if resp.get("error"):
-                raise RuntimeError(resp["error"])
-            name, size = self.local_store.create_raw(hex_id, resp["data"])
-            result = {"ok": True, "name": name, "size": size}
+            async with self._pull_sem:
+                peer = await self._peer(msg["addr"])
+                where = (
+                    {"name": msg["name"]} if msg.get("name")
+                    else {"path": msg["path"]}
+                )
+                name, size = await pull_chunked(
+                    peer, where, self.local_store, hex_id,
+                    size_hint=msg.get("size", 0),
+                )
+                result = {"ok": True, "name": name, "size": size}
+            fut.set_result(result)
         except Exception as e:  # noqa: BLE001
             result = {"ok": False, "error": repr(e)}
+            fut.set_exception(e)
+            fut.exception()  # consumed here even with no joiners
+        finally:
+            self._pulls_inflight.pop(hex_id, None)
         if req_id is not None:
             await self.conn.respond(req_id, result)
 
@@ -240,15 +342,13 @@ class NodeAgent:
         conn = Connection(reader, writer, expected_token=auth_token())
 
         async def on_push(msg: dict):
-            if msg.get("type") != "fetch_object" or msg.get("req_id") is None:
+            if msg.get("req_id") is None:
                 return
             try:
-                if msg.get("name"):
-                    data = self.local_store.read_raw(msg["name"])
-                else:
-                    with open(msg["path"], "rb") as f:
-                        data = f.read()
-                await conn.respond(msg["req_id"], {"data": data})
+                payload = serve_fetch(self.local_store, msg)
+                if payload is None:
+                    return
+                await conn.respond(msg["req_id"], payload)
             except Exception as e:  # noqa: BLE001
                 await conn.respond(msg["req_id"], {"error": repr(e)})
 

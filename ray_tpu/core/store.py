@@ -134,6 +134,41 @@ class LocalStore:
                 self._open[shm_name] = seg
         return bytes(seg.buf)
 
+    # --------------------------------------- chunked transfer (pull plane)
+    def raw_size(self, shm_name: str) -> int:
+        with self._lock:
+            seg = self._open.get(shm_name)
+            if seg is None:
+                seg = shared_memory.SharedMemory(name=shm_name)
+                _untrack(seg)
+                self._open[shm_name] = seg
+        return seg.size
+
+    def read_raw_slice(self, shm_name: str, offset: int, length: int) -> bytes:
+        with self._lock:
+            seg = self._open.get(shm_name)
+            if seg is None:
+                seg = shared_memory.SharedMemory(name=shm_name)
+                _untrack(seg)
+                self._open[shm_name] = seg
+        return bytes(seg.buf[offset:offset + length])
+
+    def create_begin(self, object_hex: str, size: int):
+        """Begin an incremental (chunked) write of a pulled object. Returns
+        (name, writer) — writer is None if the object already exists (pulls
+        are deduped per node upstream, so an existing segment is a COMPLETED
+        copy; failed writers abort-unlink, and a crash mid-write is a node
+        death — the controller drops this node's locations entirely)."""
+        name = shm_name_for(object_hex)
+        try:
+            seg = shared_memory.SharedMemory(name=name, create=True, size=max(size, 1))
+        except FileExistsError:
+            return name, None
+        _untrack(seg)
+        with self._lock:
+            self._open[name] = seg
+        return name, _ShmWriter(self, name, seg)
+
     # -------------------------------------------------------------- reading
     def read(self, shm_name: str) -> Any:
         """Attach and deserialize. Numpy arrays are zero-copy views over the
@@ -282,6 +317,57 @@ def arena_segment_name() -> str:
     return f"/{_SHM_PREFIX}{SESSION_TAG}-arena"
 
 
+class _ShmWriter:
+    """Incremental writer for a chunked pull into a plain shm segment."""
+
+    __slots__ = ("_store", "_name", "_seg")
+
+    def __init__(self, store, name, seg):
+        self._store = store
+        self._name = name
+        self._seg = seg
+
+    def write(self, offset: int, data: bytes):
+        self._seg.buf[offset:offset + len(data)] = data
+
+    def commit(self):
+        pass  # plain shm has no seal step
+
+    def abort(self):
+        try:
+            with self._store._lock:
+                self._store._open.pop(self._name, None)
+            self._seg.close()
+            self._seg.unlink()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+class _ArenaWriter:
+    """Incremental writer into the native arena (create → write → seal)."""
+
+    __slots__ = ("_store", "_hex", "_view")
+
+    def __init__(self, store, object_hex, view):
+        self._store = store
+        self._hex = object_hex
+        self._view = view
+
+    def write(self, offset: int, data: bytes):
+        self._view[offset:offset + len(data)] = data
+
+    def commit(self):
+        self._view.release()
+        self._store.arena.seal(self._hex)
+
+    def abort(self):
+        try:
+            self._view.release()
+            self._store.arena.delete(self._hex)
+        except Exception:  # noqa: BLE001
+            pass
+
+
 class ArenaStore:
     """LocalStore-compatible store over the native shm arena."""
 
@@ -371,6 +457,57 @@ class ArenaStore:
                 self.arena.release(hex_id)
             except BufferError:
                 pass
+
+    # --------------------------------------- chunked transfer (pull plane)
+    def raw_size(self, name: str) -> int:
+        if not name.startswith(ARENA_PREFIX):
+            return self.fallback.raw_size(name)
+        hex_id = name[len(ARENA_PREFIX):]
+        view = self.arena.get(hex_id)
+        if view is None:
+            raise FileNotFoundError(f"object {hex_id} not in arena")
+        try:
+            return view.nbytes
+        finally:
+            try:
+                view.release()
+                self.arena.release(hex_id)
+            except BufferError:
+                pass
+
+    def read_raw_slice(self, name: str, offset: int, length: int) -> bytes:
+        if not name.startswith(ARENA_PREFIX):
+            return self.fallback.read_raw_slice(name, offset, length)
+        hex_id = name[len(ARENA_PREFIX):]
+        view = self.arena.get(hex_id)
+        if view is None:
+            raise FileNotFoundError(f"object {hex_id} not in arena")
+        try:
+            return bytes(view[offset:offset + length])
+        finally:
+            try:
+                view.release()
+                self.arena.release(hex_id)
+            except BufferError:
+                pass
+
+    def create_begin(self, object_hex: str, size: int):
+        try:
+            existing = self.arena.get(object_hex)
+        except BlockingIOError:
+            # Unsealed entry: a LOCAL producer is mid-write (pulls for the
+            # same hex are deduped upstream — node_agent._pulls_inflight).
+            # Report present; the producer's seal completes the object.
+            return ARENA_PREFIX + object_hex, None
+        if existing is not None:
+            existing.release()
+            self.arena.release(object_hex)
+            return ARENA_PREFIX + object_hex, None
+        try:
+            view = self.arena.create(object_hex, size)
+        except MemoryError:
+            return self.fallback.create_begin(object_hex, size)
+        return ARENA_PREFIX + object_hex, _ArenaWriter(self, object_hex, view)
 
     # ------------------------------------------------------------- lifetime
     def spill(self, name: str, spill_dir: str) -> str:

@@ -105,6 +105,60 @@ def main():
 
     ray_tpu.shutdown()
 
+    # ---- cross-node transfer envelope (ref: 1 GiB×50 nodes broadcast +
+    # 100 GiB+ single objects; chunked pull plane past the old 4 GiB frame
+    # cap) ----
+    from ray_tpu.cluster_utils import Cluster
+
+    xfer_gib = 8 if big else 1
+    bcast_nodes = 4 if big else 2
+    store_bytes = (xfer_gib + 3) << 30
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    for i in range(bcast_nodes + 1):  # w1 produces; w2..w{n+1} consume
+        cluster.add_node(
+            num_cpus=2, resources={f"w{i + 1}": 1},
+            object_store_memory=store_bytes,
+        )
+    ray_tpu.init(address=cluster.address)
+
+    @ray_tpu.remote(resources={"w1": 1})
+    def produce(gib):
+        return np.ones((gib << 27,), np.float64)
+
+    @ray_tpu.remote
+    def reduce_sum(a):
+        return float(a[0]) + float(a[-1])
+
+    ref = produce.remote(xfer_gib)
+    ray_tpu.wait([ref], num_returns=1, timeout=600)
+    t0 = time.perf_counter()
+    got = ray_tpu.get(
+        reduce_sum.options(resources={"w2": 1}).remote(ref), timeout=3600
+    )
+    dt = time.perf_counter() - t0
+    assert got == 2.0
+    report("cross_node_object_pull", xfer_gib, "GiB",
+           {"seconds": round(dt, 2), "gib_per_s": round(xfer_gib / dt, 2)})
+    del ref
+
+    bref = produce.remote(1)
+    ray_tpu.wait([bref], num_returns=1, timeout=600)
+    t0 = time.perf_counter()
+    outs = ray_tpu.get(
+        [
+            reduce_sum.options(resources={f"w{i + 1}": 1}).remote(bref)
+            for i in range(1, bcast_nodes + 1)
+        ],
+        timeout=3600,
+    )
+    dt = time.perf_counter() - t0
+    assert all(v == 2.0 for v in outs)
+    report("broadcast_1gib", bcast_nodes, "nodes",
+           {"seconds": round(dt, 2),
+            "aggregate_gib_per_s": round(bcast_nodes / dt, 2)})
+    ray_tpu.shutdown()
+    cluster.shutdown()
+
 
 if __name__ == "__main__":
     main()
