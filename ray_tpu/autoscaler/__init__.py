@@ -17,6 +17,7 @@ STRICT_SPREAD placement-group bundles onto `tpu_node` types.
 from .autoscaler import Monitor, StandardAutoscaler
 from .load_metrics import LoadMetrics
 from .node_provider import FakeMultiNodeProvider, NodeProvider
+from .tpu_vm_provider import InMemoryTPUAPI, TPUVMProvider
 from .resource_demand_scheduler import get_nodes_to_launch
 from . import sdk
 
@@ -26,6 +27,8 @@ __all__ = [
     "LoadMetrics",
     "NodeProvider",
     "FakeMultiNodeProvider",
+    "TPUVMProvider",
+    "InMemoryTPUAPI",
     "get_nodes_to_launch",
     "sdk",
 ]

@@ -174,12 +174,14 @@ class ClusterBackend(RuntimeBackend):
             payload = {"type": register_as, "node_id": os.environ.get("RAY_TPU_NODE_ID", "node0")}
             if register_as == "register_worker" and self.worker is not None:
                 payload["worker_id"] = self.worker.worker_id
-            out = await conn.request(payload, timeout=15)
+            # Generous: worker boot storms (many interpreters importing
+            # concurrently) legitimately delay controller responses.
+            out = await conn.request(payload, timeout=60)
             phases["register"] = round(_t.monotonic() - t0, 2)
             return out
 
         try:
-            result = self.io.call(go(), timeout=20)
+            result = self.io.call(go(), timeout=70)
         except ConnectionError as e:
             raise RayTpuError(
                 "controller closed the connection during registration — "

@@ -87,6 +87,9 @@ define("scheduler_scan_window", 64,
 define("max_workers_per_cpu", 4, doc="Worker pool cap = cpus × this")
 define("worker_prestart_cap", 6, doc="Max head workers prestarted per pass")
 define("spawn_burst_cap", 4, doc="Max workers spawned per node per pass")
+define("worker_boot_concurrency", 16,
+       doc="Cluster-wide cap on simultaneously BOOTING workers — interpreter "
+           "start is ~2s of CPU; unbounded bursts thrash the machine")
 # Persistence.
 define("snapshot_interval_s", 1.0, doc="Controller state snapshot period")
 define("gcs_storage", "file",
@@ -116,6 +119,14 @@ define("bind_address", "",
 # Observability.
 define("dashboard", True, doc="Serve the HTTP dashboard from the controller")
 define("dashboard_port", 0, doc="Dashboard port (0 = ephemeral)")
+# Memory monitor (reference: `memory_monitor.h:52` + worker-killing policy).
+define("memory_monitor_interval_s", 1.0,
+       doc="Node memory-pressure sampling period (0 disables)")
+define("memory_usage_threshold", 0.95,
+       doc="Fraction of node memory that triggers worker killing")
+define("memory_limit_bytes", 0,
+       doc="Absolute node memory budget (0 = threshold x total); tests use "
+           "this to trigger the policy without exhausting the machine")
 # Failure detection (reference: `gcs_health_check_manager.h:55`).
 define("health_check_period_s", 5.0, doc="Node agent liveness probe period")
 define("health_check_timeout_s", 2.0, doc="Per-probe response deadline")

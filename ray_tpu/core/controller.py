@@ -95,6 +95,8 @@ class WorkerState:
     leased_to: Optional[int] = None
     # A revoke push is in flight to the lease holder.
     revoking: bool = False
+    # Killed by the memory monitor — labels the death error as OOM.
+    oom_killed: bool = False
 
 
 @dataclass
@@ -120,6 +122,8 @@ class NodeState:
     # autoscaler's idle-node detection (reference: `LoadMetrics`
     # `load_metrics.py:63` last_used_time_by_ip).
     last_active: float = field(default_factory=time.monotonic)
+    # Latest cpu/mem/disk/TPU sample (reference: reporter_agent node stats).
+    sys_metrics: Dict[str, float] = field(default_factory=dict)
 
     def utilization(self) -> float:
         fracs = [
@@ -279,6 +283,9 @@ class Controller:
         # parked until a pull completes (new copies appear).
         self._src_active: Dict[str, int] = {}
         self._transfer_waiters: List[asyncio.Future] = []
+        # (node_id, started_at, tpu) per in-flight spawn — boot-budget
+        # expiry for spawns that die before registering.
+        self._spawn_ledger: List[tuple] = []
         # Controller -> agent fetch-server connections (for pulls INTO node0).
         self._fetch_conns: Dict[str, Connection] = {}
         self._spread_rr = 0
@@ -403,6 +410,7 @@ class Controller:
         asyncio.ensure_future(self._gc_loop())
         asyncio.ensure_future(self._snapshot_loop())
         asyncio.ensure_future(self._health_check_loop())
+        asyncio.ensure_future(self._head_memory_monitor_loop())
 
     # --------------------------------------------------- persistence (GCS FT)
     # Reference analog: GCS tables behind `RedisStoreClient`
@@ -641,6 +649,17 @@ class Controller:
         cap that bounds task-worker prestarting must not deadlock actor
         creation."""
         node = node or self.head
+        # Boot-rate limit (ALL spawn kinds, incl. forced actor spawns): each
+        # booting interpreter costs ~2s of CPU; an unbounded burst (observed:
+        # 500+ booting during a 2000-actor envelope probe) thrashes the
+        # machine until registrations time out. Deferral is safe — every
+        # registration fires _schedule, which re-flushes pending spawn
+        # demand until it drains.
+        booting = sum(
+            1 for w in self.workers.values() if w.state == STARTING
+        ) + sum(n.spawning for n in self.nodes.values())
+        if booting >= rt_config.get("worker_boot_concurrency"):
+            return
         if tpu:
             if node.spawning_tpu > 0:
                 return
@@ -657,6 +676,7 @@ class Controller:
             if not force and node.spawning + live_count >= self._max_workers:
                 return
         node.spawning += 1
+        self._spawn_ledger.append((node.node_id, time.monotonic(), tpu))
         worker_id = f"w{next(self._worker_counter)}"
         if node.conn is not None:
             asyncio.ensure_future(
@@ -842,6 +862,10 @@ class Controller:
             node.spawning = max(0, node.spawning - 1)
             if ws.has_tpu:
                 node.spawning_tpu = max(0, node.spawning_tpu - 1)
+            for i, entry in enumerate(self._spawn_ledger):
+                if entry[0] == node_id and entry[2] == ws.has_tpu:
+                    del self._spawn_ledger[i]
+                    break
         self._worker_arrival.set()
         self._worker_arrival.clear()
         self._schedule()
@@ -2740,8 +2764,14 @@ class Controller:
                     self._event("task_retry", task=task_hex)
                     self._enqueue(pt)
                 else:
+                    cause = (
+                        f"Worker {worker_id} was killed by the memory "
+                        f"monitor (node out of memory) while executing task"
+                        if ws.oom_killed
+                        else f"Worker {worker_id} died executing task"
+                    )
                     err = TaskError(
-                        WorkerCrashedError(f"Worker {worker_id} died executing task"),
+                        WorkerCrashedError(cause),
                         "",
                         pt.spec.name,
                     )
@@ -2815,6 +2845,8 @@ class Controller:
             try:
                 resp = await node.conn.request({"type": "ping"}, timeout=timeout)
                 ok = bool((resp or {}).get("ok"))
+                if ok and resp.get("sys"):
+                    node.sys_metrics = resp["sys"]
             except Exception:  # noqa: BLE001
                 ok = False
             if ok:
@@ -2830,6 +2862,9 @@ class Controller:
                     pass
                 await self._on_node_death(node.node_id)
 
+        from ..util.system_metrics import SystemMetricsSampler
+
+        head_sampler = SystemMetricsSampler()
         while not self._shutdown_event.is_set():
             await asyncio.sleep(period)
             # Concurrent probes: one wedged node must not delay (or inflate
@@ -2839,6 +2874,36 @@ class Controller:
             ]
             if targets:
                 await asyncio.gather(*(probe(n) for n in targets))
+            try:
+                self.head.sys_metrics = head_sampler.sample()
+            except Exception:  # noqa: BLE001
+                pass
+            self._expire_spawn_ledger()
+
+    def _expire_spawn_ledger(self):
+        """Spawns that never registered (interpreter died / wedged) must
+        give their boot budget back — a leaked `spawning` count would
+        eventually starve the global worker_boot_concurrency cap."""
+        now = time.monotonic()
+        keep = []
+        expired = False
+        for entry in self._spawn_ledger:
+            node_id, t0, tpu = entry
+            if now - t0 < 180.0:
+                keep.append(entry)
+                continue
+            expired = True
+            node = self.nodes.get(node_id)
+            if node is not None:
+                node.spawning = max(0, node.spawning - 1)
+                if tpu:
+                    node.spawning_tpu = max(0, node.spawning_tpu - 1)
+            self._event("spawn_expired", node=node_id)
+        self._spawn_ledger = keep
+        if expired:
+            # Freed boot budget must re-fire deferred spawn demand — with a
+            # blocked client and no other events, nothing else schedules.
+            self._schedule()
 
     async def _on_node_death(self, node_id: str):
         """A node agent's connection dropped (reference analog: GCS node
@@ -2892,6 +2957,78 @@ class Controller:
         self._schedule()
 
     # ------------------------------------------------------------ blocking
+    # ------------------------------------------------------ memory monitor
+    # Reference analog: `memory_monitor.h:52` sampling + the raylet's
+    # worker-killing policy (`worker_killing_policy_group_by_owner.cc`).
+    # Agents report candidates; the controller picks with global knowledge.
+    async def h_memory_pressure(self, conn, meta, msg):
+        node_id = msg.get("node_id", HEAD_NODE)
+        victim = self._pick_oom_victim(node_id, msg.get("candidates") or [])
+        if victim is None:
+            return None
+        victim.oom_killed = True
+        self._event(
+            "oom_kill", worker=victim.worker_id, node=node_id,
+            used=msg.get("used"), limit=msg.get("limit"),
+        )
+        node = self.nodes.get(node_id)
+        if node is not None and node.conn is not None:
+            await node.conn.send(
+                {"type": "kill_worker", "worker_id": victim.worker_id}
+            )
+        else:
+            self._terminate_worker(victim)
+        return None
+
+    def _pick_oom_victim(self, node_id: str, candidates) -> Optional["WorkerState"]:
+        """Largest-RSS TASK worker first; an actor host only when no task
+        worker remains (the reference's policy spares actors the same way —
+        killing one loses state, not just one retryable task)."""
+        task_pick = actor_pick = None
+        for worker_id, _rss in candidates:  # already sorted largest-first
+            ws = self.workers.get(worker_id)
+            if ws is None or ws.state == DEAD or ws.node_id != node_id:
+                continue
+            if ws.state == ACTOR:
+                actor_pick = actor_pick or ws
+            else:
+                task_pick = task_pick or ws
+                break
+        return task_pick or actor_pick
+
+    async def _head_memory_monitor_loop(self):
+        """The head node has no agent — the controller samples its own
+        spawned workers with the same policy."""
+        from ..util.memory_monitor import MemoryPressureSampler
+
+        interval = rt_config.get("memory_monitor_interval_s")
+        if not interval:
+            return
+        sampler = MemoryPressureSampler(
+            rt_config.get("memory_limit_bytes"),
+            rt_config.get("memory_usage_threshold"),
+        )
+        while not self._shutdown_event.is_set():
+            await asyncio.sleep(interval)
+            try:
+                over = sampler.over_threshold()
+                if over is None:
+                    continue
+                pids = {
+                    wid: p.pid for wid, p in self._worker_procs.items()
+                    if p.poll() is None
+                }
+                if not pids:
+                    continue
+                await self.h_memory_pressure(
+                    None, {},
+                    {"node_id": HEAD_NODE,
+                     "candidates": sampler.candidates(pids), **over},
+                )
+                await asyncio.sleep(interval)
+            except Exception:  # noqa: BLE001
+                traceback.print_exc()
+
     async def h_worker_blocked(self, conn, meta, msg):
         ws = self.workers.get(msg["worker_id"])
         if ws is not None and not ws.blocked:
@@ -3360,6 +3497,7 @@ class Controller:
                     "NodeManagerAddress": (self.node_ip if n.node_id == HEAD_NODE else n.fetch_addr.rsplit(":", 1)[0] if n.fetch_addr else ""),
                     "object_store_memory": n.object_store_memory
                     or self.object_store_memory,
+                    "SystemMetrics": dict(n.sys_metrics),
                 }
                 for n in self.nodes.values()
             ]
@@ -3555,6 +3693,12 @@ class Controller:
                 lines.append(
                     f'ray_tpu_node_resource_available{{node="{esc(n.node_id)}",'
                     f'resource="{esc(k)}"}} {v}'
+                )
+            for k, v in n.sys_metrics.items():
+                if k == "ts":
+                    continue
+                lines.append(
+                    f'ray_tpu_node_{k}{{node="{esc(n.node_id)}"}} {v}'
                 )
         for (name, tags), (value, kind) in self.user_metrics.items():
             name = re.sub(r"[^a-zA-Z0-9_:]", "_", name)

@@ -151,6 +151,9 @@ class NodeAgent:
         # join the in-flight pull.
         self._pull_sem = asyncio.Semaphore(rt_config.get("transfer_max_pulls"))
         self._pulls_inflight: Dict[str, asyncio.Future] = {}
+        from ..util.system_metrics import SystemMetricsSampler
+
+        self._sys_sampler = SystemMetricsSampler()
         self._shutdown = asyncio.Event()
 
     # ------------------------------------------------------------ lifecycle
@@ -187,7 +190,43 @@ class NodeAgent:
         if not (resp or {}).get("ok"):
             raise RuntimeError(f"node registration rejected: {resp}")
 
+    async def _memory_monitor_loop(self):
+        """Sample node memory pressure; over the limit, report worker RSS
+        candidates — the controller picks and kills the victim (it knows
+        which workers host actors). Reference: `memory_monitor.h:52`."""
+        from ..util.memory_monitor import MemoryPressureSampler
+
+        interval = rt_config.get("memory_monitor_interval_s")
+        if not interval:
+            return
+        sampler = MemoryPressureSampler(
+            rt_config.get("memory_limit_bytes"),
+            rt_config.get("memory_usage_threshold"),
+        )
+        while not self._shutdown.is_set():
+            await asyncio.sleep(interval)
+            try:
+                over = sampler.over_threshold()
+                if over is None:
+                    continue
+                pids = {
+                    wid: p.pid for wid, p in self._worker_procs.items()
+                    if p.poll() is None
+                }
+                if not pids:
+                    continue
+                await self.conn.send({
+                    "type": "memory_pressure",
+                    "node_id": self.node_id,
+                    "candidates": sampler.candidates(pids),
+                    **over,
+                })
+                await asyncio.sleep(interval)  # give the kill time to land
+            except Exception:  # noqa: BLE001
+                traceback.print_exc()
+
     async def serve_forever(self):
+        asyncio.ensure_future(self._memory_monitor_loop())
         await self._shutdown.wait()
         self._kill_workers()
         if self._server:
@@ -211,8 +250,12 @@ class NodeAgent:
         try:
             mtype = msg["type"]
             if mtype == "ping" and msg.get("req_id") is not None:
-                # Liveness probe (controller `_health_check_loop`).
-                await self.conn.respond(msg["req_id"], {"ok": True})
+                # Liveness probe (controller `_health_check_loop`); the
+                # response doubles as the node's system-metrics report
+                # (reference: `reporter_agent.py:277` node reporter).
+                await self.conn.respond(
+                    msg["req_id"], {"ok": True, "sys": self._sys_sampler.sample()}
+                )
             elif mtype == "spawn_worker":
                 self._spawn_worker(msg["worker_id"], tpu=bool(msg.get("tpu")))
             elif mtype == "pull_object":

@@ -106,6 +106,10 @@ def gpt2_large(**kw):
     return GPTConfig(**{**dict(n_layers=36, d_model=1280, n_heads=20, d_mlp=5120), **kw})
 
 
+def gpt2_xl(**kw):
+    return GPTConfig(**{**dict(n_layers=48, d_model=1600, n_heads=25, d_mlp=6400), **kw})
+
+
 def gptj_6b(**kw):
     return GPTConfig(
         **{

@@ -70,6 +70,7 @@ class Algorithm:
                 if cfg.module_to_env_connector is not None else None
             ),
         )
+        self._runner_kwargs = runner_kwargs  # eval runners reuse the recipe
         if cfg.num_env_runners > 0:
             import ray_tpu
 
@@ -98,10 +99,16 @@ class Algorithm:
             probe_env.close()
             out = self.config.env_to_module_connector()(probe_obs)
             obs_dim = int(np.prod(np.asarray(out).shape[1:]))
+        model = dict(self.config.model)
         if isinstance(self.action_space, Discrete):
-            return DiscretePolicyModule(obs_dim, self.action_space.n, hidden)
+            return DiscretePolicyModule(
+                obs_dim, self.action_space.n, hidden, model=model
+            )
         if isinstance(self.action_space, Box):
-            return GaussianPolicyModule(obs_dim, int(np.prod(self.action_space.shape)), hidden)
+            return GaussianPolicyModule(
+                obs_dim, int(np.prod(self.action_space.shape)), hidden,
+                model=model,
+            )
         raise TypeError(f"Unsupported action space {self.action_space}")
 
     def _make_learner(self) -> Learner:
@@ -130,6 +137,11 @@ class Algorithm:
             time_this_iter_s=dt,
             env_steps_per_sec=steps_this_iter / dt if dt > 0 else 0.0,
         )
+        # Periodic evaluation on DEDICATED runners (reference:
+        # evaluation_interval + evaluation workers).
+        interval = self.config.evaluation_interval
+        if interval and self.iteration % interval == 0:
+            result["evaluation"] = self.evaluate()
         return result
 
     def training_step(self) -> Dict:
@@ -162,15 +174,64 @@ class Algorithm:
         return out
 
     # ---------------------------------------------------------- evaluation
+    # Reference analog: the evaluation-WORKER plane — greedy rollouts on
+    # runners SEPARATE from the training stream (training envs keep their
+    # auto-reset state; eval never perturbs the sampling distribution).
+    def _ensure_eval_runners(self):
+        if getattr(self, "_eval_runners", None) is not None:
+            return
+        cfg = self.config
+        kwargs = dict(self._runner_kwargs)
+        if cfg.evaluation_num_env_runners > 0:
+            import ray_tpu
+
+            from ..env.env_runner import EnvRunner
+
+            RemoteRunner = ray_tpu.remote(num_cpus=1)(EnvRunner)
+            self._eval_runners = [
+                RemoteRunner.remote(seed=cfg.seed + 10_000 + i, **kwargs)
+                for i in range(cfg.evaluation_num_env_runners)
+            ]
+            ray_tpu.get([r.ping.remote() for r in self._eval_runners])
+        else:
+            from ..env.env_runner import EnvRunner
+
+            self._eval_runners = [
+                EnvRunner(seed=cfg.seed + 10_000, **kwargs)
+            ]
+
     def evaluate(self) -> Dict:
-        runner = self._local_runner
-        if runner is None:
-            return self._ray.get(
-                self._remote_runners[0].evaluate.remote(
-                    self._weights, self.config.evaluation_num_episodes
-                )
+        self._ensure_eval_runners()
+        n = self.config.evaluation_num_episodes
+        runners = self._eval_runners
+        if self.config.evaluation_num_env_runners > 0:
+            import ray_tpu
+
+            # Exact split: base episodes everywhere + the remainder spread
+            # over the first runners (a flat max(1, n//k) under- or
+            # over-shoots the configured duration).
+            base, rem = divmod(n, len(runners))
+            shares = [
+                base + (1 if i < rem else 0) for i in range(len(runners))
+            ]
+            outs = ray_tpu.get(
+                [
+                    r.evaluate.remote(self._weights, share)
+                    for r, share in zip(runners, shares) if share > 0
+                ]
             )
-        return runner.evaluate(self._weights, self.config.evaluation_num_episodes)
+        else:
+            outs = [runners[0].evaluate(self._weights, n)]
+        total = sum(o.get("episodes", 0) for o in outs)
+        means = [
+            o["episode_reward_mean"] * o.get("episodes", 0)
+            for o in outs if o.get("episodes", 0)
+        ]
+        return {
+            "episode_reward_mean": (sum(means) / total) if total else float("nan"),
+            "episodes": total,
+            "num_eval_runners": len(runners),
+        }
 
     # --------------------------------------------------------- checkpoints
     def save(self, checkpoint_dir: str) -> str:
@@ -211,6 +272,17 @@ class Algorithm:
                 except Exception:  # noqa: BLE001
                     pass
             self._remote_runners = []
+        # Dedicated eval runners die with the algorithm too (leaking one
+        # pair per Tune trial would eat the cluster's CPUs).
+        if self.config.evaluation_num_env_runners > 0:
+            import ray_tpu
+
+            for r in getattr(self, "_eval_runners", None) or []:
+                try:
+                    ray_tpu.kill(r)
+                except Exception:  # noqa: BLE001
+                    pass
+        self._eval_runners = None
         self.learner_group.shutdown()
 
     # Tune function-trainable adapter

@@ -39,8 +39,12 @@ class AlgorithmConfig:
         self.remote_learner: bool = False
         # debugging
         self.seed: int = 0
-        # evaluation
+        # evaluation (reference: the evaluation-worker config in
+        # `algorithm_config.py` — evaluation_interval /
+        # evaluation_num_env_runners / evaluation_duration)
         self.evaluation_num_episodes: int = 10
+        self.evaluation_interval: Optional[int] = None  # iterations; None=off
+        self.evaluation_num_env_runners: int = 0  # 0 = dedicated local runner
 
     # ------------------------------------------------------- builder API
     def environment(self, env: Optional[str] = None, *, env_config: Optional[dict] = None) -> "AlgorithmConfig":
@@ -95,7 +99,21 @@ class AlgorithmConfig:
             self.seed = seed
         return self
 
-    def evaluation(self, *, evaluation_num_episodes: Optional[int] = None, **_c) -> "AlgorithmConfig":
+    def evaluation(
+        self,
+        *,
+        evaluation_num_episodes: Optional[int] = None,
+        evaluation_duration: Optional[int] = None,  # reference alias
+        evaluation_interval: Optional[int] = None,
+        evaluation_num_env_runners: Optional[int] = None,
+        **_c,
+    ) -> "AlgorithmConfig":
+        if evaluation_duration is not None:
+            self.evaluation_num_episodes = evaluation_duration
+        if evaluation_interval is not None:
+            self.evaluation_interval = evaluation_interval
+        if evaluation_num_env_runners is not None:
+            self.evaluation_num_env_runners = evaluation_num_env_runners
         if evaluation_num_episodes is not None:
             self.evaluation_num_episodes = evaluation_num_episodes
         return self

@@ -42,8 +42,8 @@ class QPolicyModule(RLModule):
     """Adapts QModule to the EnvRunner interface: params carry
     {online, target, eps}; `sample` is epsilon-greedy over online Q."""
 
-    def __init__(self, obs_dim: int, n_actions: int, hidden=(64, 64)):
-        self.q = QModule(obs_dim, n_actions, hidden)
+    def __init__(self, obs_dim: int, n_actions: int, hidden=(64, 64), model=None):
+        self.q = QModule(obs_dim, n_actions, hidden, model=model)
         self.n_actions = n_actions
 
     def init(self, rng):
@@ -142,7 +142,9 @@ class DQN(Algorithm):
             raise TypeError("DQN requires a discrete action space")
         hidden = tuple(self.config.model.get("hidden", (64, 64)))
         obs_dim = int(np.prod(self.observation_space.shape))
-        return QPolicyModule(obs_dim, self.action_space.n, hidden)
+        return QPolicyModule(
+            obs_dim, self.action_space.n, hidden, model=dict(self.config.model)
+        )
 
     def _make_learner(self) -> Learner:
         from ..utils.optim import make_optimizer

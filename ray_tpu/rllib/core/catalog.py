@@ -1,0 +1,199 @@
+"""Model catalog — pluggable encoder factories consumed by every policy
+module (reference analog: `rllib/models/catalog.py` — `ModelCatalog`
+mapping model-config dicts to network classes, with `register_custom_model`).
+
+TPU-native shape: an encoder is a pure-function pair `(init, apply)` over a
+params pytree plus its output width — jittable and shardable like the rest
+of the RLModule stack. Selection rides the algorithm's `model` config:
+
+    config.training(model={"encoder": "cnn", "obs_shape": (84, 84, 4),
+                           "conv_filters": [(16, 4, 2), (32, 4, 2)]})
+
+Built-ins: "mlp" (default), "cnn" (NHWC conv stack over flattened image
+observations), "lstm" (scan-based recurrent encoder; stepwise `step` for
+carried-state inference). Custom encoders register via
+`register_encoder(name, factory)`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_ACTIVATIONS = {
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "gelu": jax.nn.gelu,
+    "silu": jax.nn.silu,
+}
+
+
+@dataclasses.dataclass
+class Encoder:
+    init: Callable  # rng -> params
+    apply: Callable  # (params, obs[B, D]) -> features [B, out_dim]
+    out_dim: int
+    # Recurrent encoders also provide stepwise application + initial state.
+    initial_state: Optional[Callable] = None  # batch -> state pytree
+    step: Optional[Callable] = None  # (params, obs[B,D], state) -> (feat, state)
+
+
+_REGISTRY: Dict[str, Callable[[Dict[str, Any], int], Encoder]] = {}
+
+
+def register_encoder(name: str, factory: Callable[[Dict[str, Any], int], Encoder]):
+    """Reference analog: `ModelCatalog.register_custom_model`."""
+    _REGISTRY[name] = factory
+
+
+def build_encoder(model_config: Dict[str, Any], obs_dim: int) -> Encoder:
+    name = model_config.get("encoder", "mlp")
+    factory = _REGISTRY.get(name)
+    if factory is None:
+        raise KeyError(
+            f"unknown encoder {name!r}; registered: {sorted(_REGISTRY)}"
+        )
+    return factory(model_config, obs_dim)
+
+
+# --------------------------------------------------------------------- MLP
+def _dense_init(rng, d_in, d_out, scale=np.sqrt(2)):
+    w = jax.nn.initializers.orthogonal(scale)(rng, (d_in, d_out), jnp.float32)
+    return {"w": w, "b": jnp.zeros((d_out,), jnp.float32)}
+
+
+def _mlp_encoder(model_config: Dict[str, Any], obs_dim: int) -> Encoder:
+    hidden = tuple(model_config.get("hidden", (64, 64)))
+    act = _ACTIVATIONS[model_config.get("activation", "tanh")]
+    sizes = (obs_dim, *hidden)
+
+    def init(rng):
+        keys = jax.random.split(rng, len(sizes) - 1)
+        return [
+            _dense_init(k, a, b)
+            for k, a, b in zip(keys, sizes[:-1], sizes[1:])
+        ]
+
+    def apply(params, x):
+        for layer in params:
+            x = act(x @ layer["w"] + layer["b"])
+        return x
+
+    return Encoder(init=init, apply=apply, out_dim=hidden[-1] if hidden else obs_dim)
+
+
+# --------------------------------------------------------------------- CNN
+def _cnn_encoder(model_config: Dict[str, Any], obs_dim: int) -> Encoder:
+    """NHWC conv stack (MXU-friendly feature dims) over image observations.
+    Observations arrive FLATTENED [B, H*W*C] (the runner flattens all obs);
+    the encoder reshapes from `obs_shape`."""
+    obs_shape = tuple(model_config["obs_shape"])  # (H, W, C)
+    if int(np.prod(obs_shape)) != obs_dim:
+        raise ValueError(
+            f"model.obs_shape {obs_shape} does not match obs_dim {obs_dim}"
+        )
+    filters: Sequence[Tuple[int, int, int]] = model_config.get(
+        "conv_filters", [(16, 4, 2), (32, 4, 2)]
+    )  # (out_channels, kernel, stride)
+    out_dim = int(model_config.get("encoder_out", 256))
+    act = _ACTIVATIONS[model_config.get("activation", "relu")]
+
+    def conv_shapes():
+        h, w, c = obs_shape
+        specs = []
+        for oc, k, s in filters:
+            specs.append((c, oc, k, s))
+            h = (h - k) // s + 1
+            w = (w - k) // s + 1
+            c = oc
+        return specs, h * w * c
+
+    specs, flat_dim = conv_shapes()
+
+    def init(rng):
+        keys = jax.random.split(rng, len(specs) + 1)
+        params = {"conv": [], "head": _dense_init(keys[-1], flat_dim, out_dim)}
+        for key, (ic, oc, k, _s) in zip(keys, specs):
+            w = jax.nn.initializers.orthogonal(np.sqrt(2))(
+                key, (k, k, ic, oc), jnp.float32
+            )
+            params["conv"].append(
+                {"w": w, "b": jnp.zeros((oc,), jnp.float32)}
+            )
+        return params
+
+    def apply(params, x):
+        b = x.shape[0]
+        x = x.reshape((b, *obs_shape))
+        for layer, (_ic, _oc, _k, s) in zip(params["conv"], specs):
+            x = jax.lax.conv_general_dilated(
+                x, layer["w"], window_strides=(s, s), padding="VALID",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            ) + layer["b"]
+            x = act(x)
+        x = x.reshape((b, -1))
+        return act(x @ params["head"]["w"] + params["head"]["b"])
+
+    return Encoder(init=init, apply=apply, out_dim=out_dim)
+
+
+# -------------------------------------------------------------------- LSTM
+def _lstm_encoder(model_config: Dict[str, Any], obs_dim: int) -> Encoder:
+    """Single-layer LSTM (reference analog: `use_lstm` wrappers in
+    `models/catalog.py`). `apply` consumes [B, T, D] sequences via lax.scan
+    (training/BPTT); `step` carries (h, c) for per-step inference."""
+    units = int(model_config.get("lstm_cell_size", 64))
+
+    def init(rng):
+        k1, k2 = jax.random.split(rng)
+        scale = 1.0 / np.sqrt(units)
+        return {
+            "wx": jax.random.uniform(
+                k1, (obs_dim, 4 * units), jnp.float32, -scale, scale
+            ),
+            "wh": jax.random.uniform(
+                k2, (units, 4 * units), jnp.float32, -scale, scale
+            ),
+            "b": jnp.zeros((4 * units,), jnp.float32),
+        }
+
+    def cell(params, x_t, state):
+        h, c = state
+        z = x_t @ params["wx"] + h @ params["wh"] + params["b"]
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        c = jax.nn.sigmoid(f + 1.0) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return h, (h, c)
+
+    def initial_state(batch: int):
+        return (
+            jnp.zeros((batch, units), jnp.float32),
+            jnp.zeros((batch, units), jnp.float32),
+        )
+
+    def apply(params, x):
+        # [B, T, D] -> final hidden state [B, units].
+        def scan_fn(state, x_t):
+            _, state = cell(params, x_t, state)
+            return state, state[0]
+
+        state0 = initial_state(x.shape[0])
+        _, hs = jax.lax.scan(scan_fn, state0, jnp.swapaxes(x, 0, 1))
+        return hs[-1]
+
+    def step(params, x_t, state):
+        return cell(params, x_t, state)
+
+    return Encoder(
+        init=init, apply=apply, out_dim=units,
+        initial_state=initial_state, step=step,
+    )
+
+
+register_encoder("mlp", _mlp_encoder)
+register_encoder("cnn", _cnn_encoder)
+register_encoder("lstm", _lstm_encoder)

@@ -49,25 +49,60 @@ class RLModule:
         raise NotImplementedError
 
 
+def _build_encoder(model: dict, obs_dim: int):
+    """Catalog hookup for the feedforward modules: None/mlp keeps the
+    classic separate-tower layout; other encoders (cnn / custom-registered)
+    feed shared features into linear heads. Recurrent encoders need
+    sequence plumbing the feedforward runner doesn't provide."""
+    from .catalog import build_encoder
+
+    name = (model or {}).get("encoder", "mlp")
+    if name == "mlp":
+        return None  # classic towers
+    if name == "lstm":
+        raise ValueError(
+            "the lstm encoder needs recurrent rollout plumbing; use it via "
+            "the catalog's step/apply API, not the feedforward modules"
+        )
+    return build_encoder(model, obs_dim)
+
+
 class DiscretePolicyModule(RLModule):
-    """Separate policy/value MLP towers; categorical action distribution.
+    """Separate policy/value MLP towers (default), or a catalog encoder
+    (e.g. cnn) with linear pi/v heads; categorical action distribution.
 
     forward -> (logits [B, n_actions], value [B]).
     """
 
-    def __init__(self, obs_dim: int, n_actions: int, hidden: Sequence[int] = (64, 64)):
+    def __init__(self, obs_dim: int, n_actions: int, hidden: Sequence[int] = (64, 64),
+                 model: dict = None):
         self.obs_dim = obs_dim
         self.n_actions = n_actions
         self.hidden = tuple(hidden)
+        self.encoder = _build_encoder(model, obs_dim)
 
     def init(self, rng):
         k_pi, k_v = jax.random.split(rng)
+        if self.encoder is not None:
+            k_enc, k_pi = jax.random.split(k_pi)
+            d = self.encoder.out_dim
+            return {
+                "enc": self.encoder.init(k_enc),
+                "pi": _mlp_init(k_pi, (d, self.n_actions), scale_last=0.01),
+                "v": _mlp_init(k_v, (d, 1), scale_last=1.0),
+            }
         return {
             "pi": _mlp_init(k_pi, (self.obs_dim, *self.hidden, self.n_actions), scale_last=0.01),
             "v": _mlp_init(k_v, (self.obs_dim, *self.hidden, 1), scale_last=1.0),
         }
 
     def forward(self, params, obs) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        if self.encoder is not None:
+            feat = self.encoder.apply(params["enc"], obs)
+            return (
+                _mlp_apply(params["pi"], feat),
+                _mlp_apply(params["v"], feat)[..., 0],
+            )
         logits = _mlp_apply(params["pi"], obs)
         value = _mlp_apply(params["v"], obs)[..., 0]
         return logits, value
@@ -96,13 +131,24 @@ class GaussianPolicyModule(RLModule):
     """Diagonal-Gaussian policy for continuous actions (tanh-free, clipped by
     the env). forward -> ((mean [B, act_dim], log_std [act_dim]), value [B])."""
 
-    def __init__(self, obs_dim: int, act_dim: int, hidden: Sequence[int] = (64, 64)):
+    def __init__(self, obs_dim: int, act_dim: int, hidden: Sequence[int] = (64, 64),
+                 model: dict = None):
         self.obs_dim = obs_dim
         self.act_dim = act_dim
         self.hidden = tuple(hidden)
+        self.encoder = _build_encoder(model, obs_dim)
 
     def init(self, rng):
         k_pi, k_v = jax.random.split(rng)
+        if self.encoder is not None:
+            k_enc, k_pi = jax.random.split(k_pi)
+            d = self.encoder.out_dim
+            return {
+                "enc": self.encoder.init(k_enc),
+                "pi": _mlp_init(k_pi, (d, self.act_dim), scale_last=0.01),
+                "v": _mlp_init(k_v, (d, 1), scale_last=1.0),
+                "log_std": jnp.zeros((self.act_dim,), jnp.float32),
+            }
         return {
             "pi": _mlp_init(k_pi, (self.obs_dim, *self.hidden, self.act_dim), scale_last=0.01),
             "v": _mlp_init(k_v, (self.obs_dim, *self.hidden, 1), scale_last=1.0),
@@ -110,6 +156,11 @@ class GaussianPolicyModule(RLModule):
         }
 
     def forward(self, params, obs):
+        if self.encoder is not None:
+            feat = self.encoder.apply(params["enc"], obs)
+            mean = _mlp_apply(params["pi"], feat)
+            value = _mlp_apply(params["v"], feat)[..., 0]
+            return (mean, params["log_std"]), value
         mean = _mlp_apply(params["pi"], obs)
         value = _mlp_apply(params["v"], obs)[..., 0]
         return (mean, params["log_std"]), value
@@ -141,13 +192,26 @@ class GaussianPolicyModule(RLModule):
 class QModule(RLModule):
     """Q-network for DQN: forward -> q_values [B, n_actions]."""
 
-    def __init__(self, obs_dim: int, n_actions: int, hidden: Sequence[int] = (64, 64)):
+    def __init__(self, obs_dim: int, n_actions: int, hidden: Sequence[int] = (64, 64),
+                 model: dict = None):
         self.obs_dim = obs_dim
         self.n_actions = n_actions
         self.hidden = tuple(hidden)
+        self.encoder = _build_encoder(model, obs_dim)
 
     def init(self, rng):
+        if self.encoder is not None:
+            k_enc, k_q = jax.random.split(rng)
+            return {
+                "enc": self.encoder.init(k_enc),
+                "q": _mlp_init(
+                    k_q, (self.encoder.out_dim, self.n_actions), scale_last=1.0
+                ),
+            }
         return {"q": _mlp_init(rng, (self.obs_dim, *self.hidden, self.n_actions), scale_last=1.0)}
 
     def forward(self, params, obs):
+        if self.encoder is not None:
+            feat = self.encoder.apply(params["enc"], obs)
+            return _mlp_apply(params["q"], feat)
         return _mlp_apply(params["q"], obs, activation=jax.nn.relu)

@@ -13,7 +13,8 @@ from .schedulers import (
     PopulationBasedTraining,
     TrialScheduler,
 )
-from .search import BasicVariantGenerator, OptunaSearch, Searcher
+from .search import (BOHBSearch, BasicVariantGenerator, ConcurrencyLimiter,
+                     OptunaSearch, Searcher, TPESearch)
 from .search_space import (
     choice,
     grid_search,
@@ -64,5 +65,8 @@ __all__ = [
     "PopulationBasedTraining",
     "Searcher",
     "BasicVariantGenerator",
+    "BOHBSearch",
+    "ConcurrencyLimiter",
     "OptunaSearch",
+    "TPESearch",
 ]

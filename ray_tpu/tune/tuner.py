@@ -20,7 +20,7 @@ from ..train.checkpoint import Checkpoint
 from ..train.config import RunConfig
 from ..train.result import Result
 from .schedulers import CONTINUE, STOP, FIFOScheduler, PopulationBasedTraining, TrialScheduler
-from .search import BasicVariantGenerator, Searcher
+from .search import BUSY, BasicVariantGenerator, Searcher
 
 
 @dataclasses.dataclass
@@ -57,6 +57,7 @@ class TuneController:
         tune_config: TuneConfig,
         run_config: RunConfig,
         param_space: Dict[str, Any],
+        restore_state: Optional[dict] = None,
     ):
         self.trainable = trainable
         self.tune_config = tune_config
@@ -73,13 +74,77 @@ class TuneController:
         self.trials: List[Trial] = []
         self._trial_counter = itertools.count()
         self._exhausted = False
+        if restore_state is not None:
+            # Experiment-level resume (reference:
+            # `tune/execution/experiment_state.py` + `Tuner.restore`):
+            # terminal trials keep their results; interrupted ones re-run
+            # from their latest checkpoint with their original config.
+            self.searcher = restore_state["searcher"]
+            self._exhausted = restore_state["exhausted"]
+            for td in restore_state["trials"]:
+                trial = Trial(td["trial_id"], td["config"])
+                trial.results = td["results"]
+                trial.latest_checkpoint = td["latest_checkpoint"]
+                trial.error = td["error"]
+                trial.iteration = td["iteration"]
+                trial.state = (
+                    td["state"] if td["state"] in ("TERMINATED", "ERROR")
+                    else "RESTORE_PENDING"
+                )
+                self.trials.append(trial)
+                if trial.state == "RESTORE_PENDING":
+                    self.scheduler.on_trial_add(trial)
+
+    # ---------------------------------------------------- experiment state
+    def _state_path(self) -> str:
+        import os
+
+        exp_dir = self.run_config.resolve_storage()  # already .../<name>
+        os.makedirs(exp_dir, exist_ok=True)
+        return os.path.join(exp_dir, "experiment_state.pkl")
+
+    def _save_experiment_state(self):
+        import os
+
+        import cloudpickle
+
+        state = {
+            "searcher": self.searcher,
+            "exhausted": self._exhausted,
+            "metric": self.metric,
+            "mode": self.mode,
+            "trials": [
+                {
+                    "trial_id": t.trial_id,
+                    "config": t.config,
+                    "state": t.state,
+                    "results": t.results,
+                    "latest_checkpoint": t.latest_checkpoint,
+                    "error": t.error,
+                    "iteration": t.iteration,
+                }
+                for t in self.trials
+            ],
+        }
+        path = self._state_path()
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            cloudpickle.dump(state, f)
+        os.replace(tmp, path)
 
     # ------------------------------------------------------------- lifecycle
-    def _next_trial(self) -> Optional[Trial]:
+    def _next_trial(self):
+        # Interrupted-then-restored trials launch before new suggestions.
+        for t in self.trials:
+            if t.state == "RESTORE_PENDING":
+                t.state = "PENDING"
+                return t
         if self._exhausted:
             return None
         trial_id = f"trial_{next(self._trial_counter):05d}_{uuid.uuid4().hex[:6]}"
         config = self.searcher.suggest(trial_id)
+        if config is BUSY:
+            return BUSY  # throttled (ConcurrencyLimiter) — retry next tick
         if config is None:
             self._exhausted = True
             # Synchronous schedulers (HyperBand) resolve partially-filled
@@ -139,12 +204,12 @@ class TuneController:
             # Launch up to the concurrency cap.
             while len(running) < max_conc:
                 trial = self._next_trial()
-                if trial is None:
+                if trial is None or trial is BUSY:
                     break
-                self._start_trial(trial)
+                self._start_trial(trial, checkpoint=trial.latest_checkpoint)
                 running.append(trial)
             if not running:
-                break
+                break  # launch loop above already probed _next_trial
 
             for trial in running:
                 try:
@@ -164,6 +229,9 @@ class TuneController:
                     trial.results.append(metrics)
                     if entry.get("checkpoint") is not None:
                         trial.latest_checkpoint = entry["checkpoint"]
+                    hook = getattr(self.searcher, "on_trial_result", None)
+                    if hook is not None:  # BOHB: rung results feed the model
+                        hook(trial.trial_id, metrics)
                     d = self.scheduler.on_trial_result(trial, metrics)
                     if d == STOP:
                         decision = STOP
@@ -185,11 +253,14 @@ class TuneController:
                     trial.error = err
                     self._stop_trial(trial, "ERROR")
                     self.searcher.on_trial_complete(trial.trial_id, None)
+                    self._save_experiment_state()
                 elif decision == STOP or finished:
                     self._stop_trial(trial)
                     self.scheduler.on_trial_complete(trial, trial.last_result)
                     self.searcher.on_trial_complete(trial.trial_id, trial.last_result)
+                    self._save_experiment_state()
             time.sleep(0.02)
+        self._save_experiment_state()
         return self.trials
 
     def _hit_stop_criteria(self, metrics: Dict[str, Any], stop: Dict[str, Any]) -> bool:
@@ -313,10 +384,44 @@ class Tuner:
 
     def fit(self) -> ResultGrid:
         controller = TuneController(
-            self.trainable, self.tune_config, self.run_config, self.param_space
+            self.trainable, self.tune_config, self.run_config,
+            self.param_space, restore_state=getattr(self, "_restore_state", None),
         )
         trials = controller.run()
         return ResultGrid(trials, self.tune_config.metric, self.tune_config.mode)
+
+    @classmethod
+    def restore(
+        cls,
+        path: str,
+        trainable: Callable,
+        *,
+        run_config: Optional[RunConfig] = None,
+    ) -> "Tuner":
+        """Resume an interrupted experiment from its directory (reference:
+        `Tuner.restore` + `tune/execution/experiment_state.py`). Terminal
+        trials keep their results; interrupted trials re-run from their
+        latest checkpoint."""
+        import os
+
+        import cloudpickle
+
+        state_file = (
+            path if path.endswith(".pkl")
+            else os.path.join(path, "experiment_state.pkl")
+        )
+        with open(state_file, "rb") as f:
+            state = cloudpickle.load(f)
+        name = os.path.basename(os.path.dirname(os.path.abspath(state_file)))
+        rc = run_config or RunConfig(name=name)
+        rc.name = rc.name or name
+        tuner = cls(
+            trainable,
+            tune_config=TuneConfig(metric=state["metric"], mode=state["mode"]),
+            run_config=rc,
+        )
+        tuner._restore_state = state
+        return tuner
 
 
 def run(

@@ -1,0 +1,86 @@
+"""Per-node system metrics (reference analog:
+`dashboard/modules/reporter/reporter_agent.py:277` — the psutil-based node
+reporter feeding the dashboard and Prometheus).
+
+No psutil dependency: cpu from /proc/stat deltas, memory from
+/proc/meminfo, disk from statvfs, TPU duty cycle from the JAX runtime when
+a chip is attached (best-effort — 0.0 when unavailable, matching nodes
+without accelerators)."""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, Optional, Tuple
+
+
+def _cpu_jiffies() -> Tuple[int, int]:
+    """(busy, total) jiffies across all cpus."""
+    with open("/proc/stat") as f:
+        parts = f.readline().split()[1:]
+    vals = [int(p) for p in parts]
+    idle = vals[3] + (vals[4] if len(vals) > 4 else 0)  # idle + iowait
+    total = sum(vals)
+    return total - idle, total
+
+
+class SystemMetricsSampler:
+    """Stateful sampler: cpu_percent needs a jiffies delta between calls."""
+
+    def __init__(self, disk_path: str = "/"):
+        self.disk_path = disk_path
+        self._last: Optional[Tuple[int, int]] = None
+
+    def sample(self) -> Dict[str, float]:
+        from .memory_monitor import node_memory
+
+        busy, total = _cpu_jiffies()
+        cpu_percent = 0.0
+        if self._last is not None:
+            db = busy - self._last[0]
+            dt = total - self._last[1]
+            if dt > 0:
+                cpu_percent = 100.0 * db / dt
+        self._last = (busy, total)
+        mem_total, mem_avail = node_memory()
+        try:
+            st = os.statvfs(self.disk_path)
+            disk_total = st.f_frsize * st.f_blocks
+            disk_free = st.f_frsize * st.f_bavail
+        except OSError:
+            disk_total = disk_free = 0
+        return {
+            "cpu_percent": round(cpu_percent, 1),
+            "mem_total_bytes": mem_total,
+            "mem_used_bytes": mem_total - mem_avail,
+            "disk_total_bytes": disk_total,
+            "disk_used_bytes": disk_total - disk_free,
+            "tpu_duty_cycle": tpu_duty_cycle(),
+            "ts": time.time(),
+        }
+
+
+def tpu_duty_cycle() -> float:
+    """Best-effort TPU utilization: reported ONLY from processes that have
+    already initialized JAX (never import it here — a metrics sampler that
+    triggers the ~2s jax import + chip attach inside an agent's ping
+    handler would blow the health-probe deadline AND steal the chip from
+    the workers that need it)."""
+    import sys
+
+    if "jax" not in sys.modules:
+        return 0.0
+    try:
+        jax = sys.modules["jax"]
+        devs = jax.devices()
+        if not devs or devs[0].platform not in ("tpu", "axon"):
+            return 0.0
+        # jax.local_devices memory stats as a utilization proxy when the
+        # runtime exposes them (duty-cycle counters need libtpu monitoring,
+        # absent from this environment).
+        stats = devs[0].memory_stats() or {}
+        limit = stats.get("bytes_limit") or 0
+        used = stats.get("bytes_in_use") or 0
+        return round(100.0 * used / limit, 1) if limit else 0.0
+    except Exception:  # noqa: BLE001
+        return 0.0

@@ -1,0 +1,149 @@
+"""Round-3 bench sweeps: gpt2-xl (1.5B) single-chip training and
+long-sequence flash attention (VERDICT item 6: bigger model + 8k-16k
+sequence coverage; the headline bench.py number stays gpt2-large).
+
+One JSON line per probe. gpt2-xl uses adafactor (factored second moments):
+adamw's 2x fp32 moments for 1.56B params (~12.5 GiB) + fp32 params do not
+fit a 16G chip — adafactor is the standard big-model-on-small-chip
+optimizer and keeps the MFU math honest. Long-sequence probes run the
+flash-attention kernel fwd+bwd standalone at S=8k/16k (what ring attention
+executes per shard on every chip of an SP mesh; the ring collectives
+themselves need multiple chips — see tests/test_parallel.py for the 8-way
+CPU-mesh equivalence checks).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import _check_device_reachable, peak_flops_per_chip  # noqa: E402
+
+
+def report(**kw):
+    print(json.dumps(kw), flush=True)
+
+
+def bench_xl():
+    import jax
+    import optax
+
+    from ray_tpu.models import gpt2_xl, init_params, make_train_step
+
+    B, S = 8, 1024
+    cfg = gpt2_xl(max_seq=S, attn_impl="flash", remat=True)
+    params = jax.jit(lambda key: init_params(key, cfg))(jax.random.PRNGKey(0))
+    opt = optax.adafactor(3e-4)
+    opt_state = jax.jit(opt.init)(params)
+    step = jax.jit(make_train_step(cfg, opt), donate_argnums=(0,))
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (B, S + 1), 0, cfg.vocab_size
+    )
+    batch = {"tokens": tokens}
+    state = (params, opt_state)
+    for _ in range(2):
+        state, metrics = step(state, batch)
+    _ = float(metrics["loss"])
+    n = 8
+    t0 = time.perf_counter()
+    for _ in range(n):
+        state, metrics = step(state, batch)
+    _ = float(metrics["loss"])
+    dt = (time.perf_counter() - t0) / n
+    tok_s = B * S / dt
+    mfu = cfg.flops_per_token(S) * tok_s / peak_flops_per_chip()
+    report(
+        metric="gpt2_xl_train_tokens_per_sec_per_chip",
+        value=round(tok_s, 1), unit="tokens/s/chip",
+        extra={"mfu": round(mfu, 4), "params_b": round(cfg.n_params / 1e9, 2),
+               "batch": B, "seq": S, "optimizer": "adafactor",
+               "step_ms": round(dt * 1000, 1)},
+    )
+
+
+def bench_long_seq_attention(seq: int):
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.ops.attention import flash_attention
+
+    B, H, D = 1, 16, 64
+
+    def fwd_loss(q, k, v):
+        return flash_attention(q, k, v, causal=True).astype(jnp.float32).sum()
+
+    grad = jax.jit(jax.grad(fwd_loss, argnums=(0, 1, 2)))
+    key = jax.random.PRNGKey(0)
+    shape = (B, H, seq, D)  # flash_attention layout: [B, H, S, D]
+    q = jax.random.normal(key, shape, jnp.bfloat16)
+    k = jax.random.normal(key, shape, jnp.bfloat16)
+    v = jax.random.normal(key, shape, jnp.bfloat16)
+    out = grad(q, k, v)
+    jax.block_until_ready(out)
+    n = 6
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = grad(q, k, v)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / n
+    # Causal attention fwd+bwd ≈ 3.5 × (4 · B·H·S²·D / 2) MACs→FLOPs.
+    flops = 3.5 * 4 * B * H * seq * seq * D / 2
+    report(
+        metric=f"flash_attention_s{seq}_fwd_bwd",
+        value=round(flops / dt / 1e12, 2), unit="TFLOP/s",
+        extra={"seq": seq, "heads": H, "d_head": D,
+               "ms": round(dt * 1000, 2),
+               "pct_peak": round(100 * flops / dt / peak_flops_per_chip(), 1)},
+    )
+
+
+def bench_long_ctx_train():
+    """Full gpt2-large training step at 4k context (remat + flash)."""
+    import jax
+    import optax
+
+    from ray_tpu.models import gpt2_large, init_params, make_train_step
+
+    B, S = 2, 4096
+    cfg = gpt2_large(max_seq=S, attn_impl="flash", remat=True)
+    params = jax.jit(lambda key: init_params(key, cfg))(jax.random.PRNGKey(0))
+    opt = optax.adamw(3e-4, weight_decay=0.1)
+    opt_state = jax.jit(opt.init)(params)
+    step = jax.jit(make_train_step(cfg, opt), donate_argnums=(0,))
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (B, S + 1), 0, cfg.vocab_size
+    )
+    state = (params, opt_state)
+    for _ in range(2):
+        state, metrics = step(state, {"tokens": tokens})
+    _ = float(metrics["loss"])
+    n = 6
+    t0 = time.perf_counter()
+    for _ in range(n):
+        state, metrics = step(state, {"tokens": tokens})
+    _ = float(metrics["loss"])
+    dt = (time.perf_counter() - t0) / n
+    tok_s = B * S / dt
+    mfu = cfg.flops_per_token(S) * tok_s / peak_flops_per_chip()
+    report(
+        metric="gpt2_large_s4096_train_tokens_per_sec_per_chip",
+        value=round(tok_s, 1), unit="tokens/s/chip",
+        extra={"mfu": round(mfu, 4), "batch": B, "seq": S,
+               "step_ms": round(dt * 1000, 1)},
+    )
+
+
+def main():
+    _check_device_reachable()
+    bench_xl()
+    bench_long_ctx_train()
+    for seq in (8192, 16384):
+        bench_long_seq_attention(seq)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

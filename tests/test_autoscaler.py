@@ -267,3 +267,75 @@ def test_autoscaler_satisfies_pending_placement_group(head_only_cluster):
         assert pg.wait(30)
     finally:
         provider.shutdown()
+
+
+# ----------------------------------------------- TPU-VM provider (hermetic)
+class TestTPUVMProvider:
+    """Slice-granular scale-up against a mocked Cloud TPU API (reference
+    analog: `autoscaler/_private/kuberay/node_provider.py` — REST-managed
+    nodes; here one node == one TPU slice)."""
+
+    def _provider(self, delay=0.0):
+        from ray_tpu.autoscaler import InMemoryTPUAPI, TPUVMProvider
+
+        api = InMemoryTPUAPI(provision_delay_s=delay)
+        provider = TPUVMProvider(
+            {
+                "project": "proj-x",
+                "zone": "us-central2-b",
+                "accelerator_type": "v5litepod-16",
+                "runtime_version": "v2-alpha-tpuv5-lite",
+                "transport": api.transport,
+            },
+            cluster_name="testclu",
+        )
+        return api, provider
+
+    def test_create_list_terminate_lifecycle(self):
+        api, provider = self._provider()
+        ids = provider.create_node(
+            {"accelerator_type": "v5litepod-16"},
+            {"ray_tpu-user-node-type": "tpu16"},
+            count=2,
+        )
+        assert len(ids) == 2
+        # Each CREATE is one slice-granular API call.
+        assert sum(1 for m, _u in api.calls if m == "POST") == 2
+        assert api.nodes[ids[0]]["acceleratorType"] == "v5litepod-16"
+        live = provider.non_terminated_nodes({"ray_tpu-user-node-type": "tpu16"})
+        assert sorted(live) == sorted(ids)
+        assert provider.is_running(ids[0])  # provision delay 0 → READY
+        provider.terminate_node(ids[0])
+        live = provider.non_terminated_nodes({"ray_tpu-user-node-type": "tpu16"})
+        assert live == [ids[1]]
+
+    def test_tag_filtering_and_pending_state(self):
+        api, provider = self._provider(delay=3600.0)  # stays CREATING
+        ids = provider.create_node({}, {"ray_tpu-user-node-type": "tpu16"}, 1)
+        # CREATING nodes are non-terminated (counted as pending by the
+        # autoscaler) but not yet running.
+        assert provider.non_terminated_nodes({}) == ids
+        assert not provider.is_running(ids[0])
+        assert provider.node_tags(ids[0])["ray_tpu-user-node-type"] == "tpu16"
+
+    def test_demand_scheduler_launches_one_slice_for_gang(self):
+        """A 16-chip TPU gang demand maps to ONE v5litepod-16 slice."""
+        from ray_tpu.autoscaler.resource_demand_scheduler import (
+            get_nodes_to_launch,
+        )
+
+        node_types = {
+            "tpu16": {
+                "resources": {"TPU": 16.0, "TPU-v5litepod-16-head": 1.0},
+                "min_workers": 0,
+                "max_workers": 4,
+            }
+        }
+        out = get_nodes_to_launch(
+            node_types,
+            counts_by_type={},
+            existing_avail=[],
+            demands=[{"TPU-v5litepod-16-head": 1.0}] + [{"TPU": 4.0}] * 4,
+            explicit_demands=[],
+        )
+        assert out == {"tpu16": 1}

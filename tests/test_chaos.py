@@ -85,3 +85,84 @@ def test_node_killer_node_death_recovery():
     finally:
         ray_tpu.shutdown()
         cluster.shutdown()
+
+
+def test_memory_monitor_kills_runaway_worker(monkeypatch):
+    """A worker allocating past the node's memory budget is killed by the
+    memory monitor and its task fails with an OOM-labelled error; the rest
+    of the cluster keeps working (reference: `memory_monitor.h:52` +
+    `worker_killing_policy_group_by_owner.cc`)."""
+    from ray_tpu.util.memory_monitor import node_memory
+
+    total, avail = node_memory()
+    # Budget = current usage + 1.5 GiB: the hog breaches it quickly without
+    # stressing the machine.
+    limit = (total - avail) + (1536 << 20)
+    ray_tpu.shutdown()
+    monkeypatch.setenv("RAY_TPU_MEMORY_LIMIT_BYTES", str(limit))
+    monkeypatch.setenv("RAY_TPU_MEMORY_MONITOR_INTERVAL_S", "0.5")
+    rt_config._reset_cache_for_tests()
+    ray_tpu.init(num_cpus=4)
+    try:
+        @ray_tpu.remote(max_retries=0)
+        def hog():
+            blocks = []
+            while True:  # ~100 MB/step until the monitor fires
+                blocks.append(bytearray(100 << 20))
+                for i in range(0, len(blocks[-1]), 4096):
+                    blocks[-1][i] = 1  # touch pages so RSS grows
+                time.sleep(0.05)
+
+        with pytest.raises(ray_tpu.RayTpuError) as ei:
+            ray_tpu.get(hog.remote(), timeout=120)
+        msg = str(ei.value).lower()
+        assert "memory" in msg or "died" in msg or "crash" in msg
+
+        # The node survived: normal work proceeds.
+        @ray_tpu.remote
+        def ok():
+            return 42
+
+        assert ray_tpu.get(ok.remote(), timeout=60) == 42
+    finally:
+        ray_tpu.shutdown()
+        rt_config._reset_cache_for_tests()
+
+
+def test_memory_monitor_retries_then_succeeds(monkeypatch):
+    """An OOM-killed task with retries left is retried (and can succeed if
+    the pressure was transient — modelled by a marker file)."""
+    import os as _os
+    import tempfile
+
+    from ray_tpu.util.memory_monitor import node_memory
+
+    total, avail = node_memory()
+    limit = (total - avail) + (1536 << 20)
+    marker = tempfile.mktemp(prefix="oom_marker_")
+    ray_tpu.shutdown()
+    monkeypatch.setenv("RAY_TPU_MEMORY_LIMIT_BYTES", str(limit))
+    monkeypatch.setenv("RAY_TPU_MEMORY_MONITOR_INTERVAL_S", "0.5")
+    rt_config._reset_cache_for_tests()
+    ray_tpu.init(num_cpus=4)
+    try:
+        @ray_tpu.remote(max_retries=3)
+        def sometimes_hog():
+            if not _os.path.exists(marker):
+                open(marker, "w").close()
+                blocks = []
+                while True:
+                    blocks.append(bytearray(100 << 20))
+                    for i in range(0, len(blocks[-1]), 4096):
+                        blocks[-1][i] = 1
+                    time.sleep(0.05)
+            return "second attempt fits"
+
+        assert ray_tpu.get(sometimes_hog.remote(), timeout=180) == "second attempt fits"
+    finally:
+        ray_tpu.shutdown()
+        rt_config._reset_cache_for_tests()
+        try:
+            _os.remove(marker)
+        except OSError:
+            pass

@@ -149,3 +149,46 @@ def test_tail_logs_from_remote_node():
     finally:
         ray_tpu.shutdown()
         cluster.shutdown()
+
+
+def test_node_system_metrics_reported():
+    """Per-node cpu/mem/disk samples surface in the nodes API and the
+    Prometheus exposition (reference: `reporter_agent.py:277`)."""
+    import time as _t
+    import urllib.request
+
+    import ray_tpu
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2)
+    try:
+        from ray_tpu.core import api
+
+        b = api._global_runtime().backend
+        deadline = _t.monotonic() + 30
+        sys_metrics = {}
+        while _t.monotonic() < deadline:
+            nodes = b._request({"type": "nodes"})["nodes"]
+            sys_metrics = next(
+                (n.get("SystemMetrics") or {} for n in nodes
+                 if n["NodeID"] == "node0"),
+                {},
+            )
+            if sys_metrics.get("mem_total_bytes"):
+                break
+            _t.sleep(0.5)
+        assert sys_metrics.get("mem_total_bytes", 0) > 0
+        assert sys_metrics.get("disk_total_bytes", 0) > 0
+        assert "cpu_percent" in sys_metrics
+
+        info = b._request({"type": "cluster_info"}) if False else None
+        import json
+        import os
+
+        with open("/tmp/ray_tpu/session_latest/address.json") as f:
+            metrics_url = json.load(f)["metrics_url"]
+        text = urllib.request.urlopen(metrics_url, timeout=10).read().decode()
+        assert "ray_tpu_node_mem_used_bytes" in text
+        assert 'ray_tpu_node_cpu_percent{node="node0"}' in text
+    finally:
+        ray_tpu.shutdown()

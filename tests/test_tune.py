@@ -123,3 +123,131 @@ def test_pbt_exploits_checkpoints():
     ).fit()
     assert len(results) == 2
     assert not results.errors
+
+
+def test_tpe_search_converges_better_than_worst():
+    """Native TPE: later suggestions should concentrate near good regions."""
+    from ray_tpu.tune import TPESearch
+
+    def objective(config):
+        # Max at x = 3.
+        tune.report({"score": -(config["x"] - 3.0) ** 2})
+
+    results = Tuner(
+        objective,
+        param_space={"x": tune.uniform(-10.0, 10.0)},
+        tune_config=TuneConfig(
+            metric="score", mode="max",
+            search_alg=TPESearch(
+                {"x": tune.uniform(-10.0, 10.0)}, num_samples=20, seed=7,
+                min_observations=5,
+            ),
+            max_concurrent_trials=1,  # sequential: the model sees history
+        ),
+    ).fit()
+    best = results.get_best_result().metrics["score"]
+    assert len(results) == 20 and not results.errors
+    assert best > -4.0, f"TPE best {best} — no better than random corners"
+
+
+def test_bohb_with_hyperband_scheduler():
+    from ray_tpu.tune import BOHBSearch
+    from ray_tpu.tune.schedulers import AsyncHyperBandScheduler
+
+    def objective(config):
+        for i in range(1, 9):
+            tune.report({"score": config["lr"] * i, "training_iteration": i})
+
+    results = Tuner(
+        objective,
+        param_space={"lr": tune.uniform(0.1, 1.0)},
+        tune_config=TuneConfig(
+            metric="score", mode="max",
+            search_alg=BOHBSearch(
+                {"lr": tune.uniform(0.1, 1.0)}, num_samples=8, seed=3
+            ),
+            scheduler=AsyncHyperBandScheduler(max_t=8, grace_period=2),
+            max_concurrent_trials=2,
+        ),
+    ).fit()
+    assert len(results) == 8
+    assert results.get_best_result().metrics["score"] > 0
+
+
+def test_concurrency_limiter_caps_in_flight():
+    from ray_tpu.tune import ConcurrencyLimiter
+    from ray_tpu.tune.search import BasicVariantGenerator
+
+    def objective(config):
+        import time as _t
+
+        start = _t.time()
+        _t.sleep(0.25)
+        tune.report({"score": config["x"], "start": start, "end": _t.time()})
+
+    space = {"x": tune.uniform(0, 1)}
+    results = Tuner(
+        objective,
+        param_space=space,
+        tune_config=TuneConfig(
+            metric="score", mode="max",
+            search_alg=ConcurrencyLimiter(
+                BasicVariantGenerator(space, num_samples=6), max_concurrent=2
+            ),
+            max_concurrent_trials=4,  # the LIMITER must be the binding cap
+        ),
+    ).fit()
+    assert len(results) == 6 and not results.errors
+    # Peak overlap of [start, end] windows must respect the limiter.
+    spans = [(r.metrics["start"], r.metrics["end"]) for r in results]
+    events = sorted(
+        [(s, 1) for s, _ in spans] + [(e, -1) for _, e in spans]
+    )
+    live = peak = 0
+    for _, delta in events:
+        live += delta
+        peak = max(peak, live)
+    assert peak <= 2, f"limiter allowed {peak} concurrent trials"
+
+
+def test_tuner_restore_resumes_incomplete(tmp_path):
+    """Experiment snapshot/resume: terminal trials keep results; an
+    interrupted trial re-runs from its checkpoint."""
+    import cloudpickle
+
+    from ray_tpu.train.config import RunConfig
+
+    def objective(config):
+        ckpt = tune.get_checkpoint()
+        start = ckpt.to_dict()["step"] if ckpt else 0
+        for i in range(start + 1, 6):
+            tune.report(
+                {"score": config["x"] * i, "training_iteration": i},
+                checkpoint=tune.Checkpoint.from_dict({"step": i}),
+            )
+
+    rc = RunConfig(name="restore_exp", storage_path=str(tmp_path))
+    results = Tuner(
+        objective,
+        param_space={"x": tune.grid_search([1.0, 2.0])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=rc,
+    ).fit()
+    assert len(results) == 2 and not results.errors
+
+    # Forge an interruption: mark one trial RUNNING-at-snapshot with a
+    # mid-run checkpoint, as a crashed controller would have left it.
+    state_file = tmp_path / "restore_exp" / "experiment_state.pkl"
+    state = cloudpickle.loads(state_file.read_bytes())
+    assert len(state["trials"]) == 2
+    state["trials"][1]["state"] = "RUNNING"
+    state["trials"][1]["results"] = state["trials"][1]["results"][:2]
+    state["trials"][1]["latest_checkpoint"] = tune.Checkpoint.from_dict({"step": 2})
+    state_file.write_bytes(cloudpickle.dumps(state))
+
+    restored = Tuner.restore(str(tmp_path / "restore_exp"), objective,
+                             run_config=rc).fit()
+    assert len(restored) == 2 and not restored.errors
+    # The interrupted trial resumed from step 2 and finished through step 5.
+    resumed = [r for r in restored if r.metrics.get("training_iteration") == 5]
+    assert resumed, "interrupted trial did not resume to completion"
