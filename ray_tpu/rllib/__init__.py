@@ -24,6 +24,8 @@ from .algorithms.appo import APPO, APPOConfig
 from .algorithms.bc import BC, BCConfig
 from .algorithms.marwil import MARWIL, MARWILConfig
 from .algorithms.td3 import TD3, TD3Config
+from .algorithms.ddpg import DDPG, DDPGConfig
+from .algorithms.a2c import A2C, A2CConfig
 from . import offline
 from .env import register_env, make_env
 from .env.env_runner import EnvRunner
@@ -49,6 +51,10 @@ __all__ = [
     "MARWILConfig",
     "TD3",
     "TD3Config",
+    "DDPG",
+    "DDPGConfig",
+    "A2C",
+    "A2CConfig",
     "offline",
     "register_env",
     "make_env",

@@ -83,38 +83,15 @@ def make_ppo_update(module, opt, cfg: PPOConfig):
         return total, aux
 
     def update(state, batch, rng):
+        from ..utils.gae import compute_gae, flatten_time_major
+
         params, opt_state = state
-        rewards, dones, values = batch["rewards"], batch["dones"], batch["values"]
-        T, B = rewards.shape
-
-        _, last_val = module.forward(params, batch["last_obs"])
-
-        def gae_step(carry, x):
-            adv_next, v_next = carry
-            r, d, v = x
-            delta = r + gamma * v_next * (1.0 - d) - v
-            adv = delta + gamma * lam * (1.0 - d) * adv_next
-            return (adv, v), adv
-
-        (_, _), advs = lax.scan(
-            gae_step,
-            (jnp.zeros(B, values.dtype), last_val),
-            (rewards, dones, values),
-            reverse=True,
-        )
-        returns = advs + values
-
+        T, B = batch["rewards"].shape
+        advs, returns = compute_gae(module, params, batch, gamma, lam)
         N = T * B
         mb_size = min(cfg.minibatch_size, N)
         num_minibatches = max(N // mb_size, 1)
-        flat = {
-            "obs": batch["obs"].reshape(N, -1),
-            "actions": batch["actions"].reshape((N,) + batch["actions"].shape[2:]),
-            "logp": batch["logp"].reshape(N),
-            "values": values.reshape(N),
-            "adv": advs.reshape(N),
-            "returns": returns.reshape(N),
-        }
+        flat = flatten_time_major(batch, advs, returns)
 
         def epoch_step(carry, key):
             def mb_step(carry, idx):

@@ -40,6 +40,7 @@ class TD3Config(AlgorithmConfig):
         self.target_noise: float = 0.2        # smoothing sigma
         self.noise_clip: float = 0.5
         self.policy_delay: int = 2            # actor updates every N critic steps
+        self.use_twin_q: bool = True          # False → plain DDPG critic
         self.grad_clip = None
 
 
@@ -113,15 +114,23 @@ def make_td3_update(module: TD3Module, actor_opt, critic_opt, cfg: TD3Config):
         next_a = jnp.clip(
             module.act(params["actor_t"], mb["next_obs"]) + noise, -1.0, 1.0
         )
-        y = mb["rewards"] + gamma * (1.0 - mb["dones"]) * jnp.minimum(
-            module.q_value(params["q1_t"], mb["next_obs"], next_a),
-            module.q_value(params["q2_t"], mb["next_obs"], next_a),
-        )
+        if cfg.use_twin_q:
+            target_q = jnp.minimum(
+                module.q_value(params["q1_t"], mb["next_obs"], next_a),
+                module.q_value(params["q2_t"], mb["next_obs"], next_a),
+            )
+        else:  # plain DDPG: single critic, no clipped-double trick
+            target_q = module.q_value(params["q1_t"], mb["next_obs"], next_a)
+        y = mb["rewards"] + gamma * (1.0 - mb["dones"]) * target_q
         y = lax.stop_gradient(y)
         unit_a = mb["actions"] / module.action_scale
         q1 = module.q_value(qs["q1"], mb["obs"], unit_a)
-        q2 = module.q_value(qs["q2"], mb["obs"], unit_a)
-        return ((q1 - y) ** 2 + (q2 - y) ** 2).mean(), q1.mean()
+        if cfg.use_twin_q:
+            q2 = module.q_value(qs["q2"], mb["obs"], unit_a)
+            return ((q1 - y) ** 2 + (q2 - y) ** 2).mean(), q1.mean()
+        return ((q1 - y) ** 2).mean(), q1.mean()
+
+    critic_keys = ("q1", "q2") if cfg.use_twin_q else ("q1",)
 
     def actor_loss(actor, params, mb):
         a = module.act(actor, mb["obs"])
@@ -133,16 +142,12 @@ def make_td3_update(module: TD3Module, actor_opt, critic_opt, cfg: TD3Config):
         def grad_step(carry, inp):
             params, (a_opt, c_opt), step = carry
             mb, key = inp
+            qs_in = {k: params[k] for k in critic_keys}
             (c_loss, q_mean), c_grads = jax.value_and_grad(
                 critic_loss, has_aux=True
-            )({"q1": params["q1"], "q2": params["q2"]}, params, mb, key)
-            c_updates, c_opt = critic_opt.update(
-                c_grads, c_opt, {"q1": params["q1"], "q2": params["q2"]}
-            )
-            new_qs = optax.apply_updates(
-                {"q1": params["q1"], "q2": params["q2"]}, c_updates
-            )
-            params = {**params, **new_qs}
+            )(qs_in, params, mb, key)
+            c_updates, c_opt = critic_opt.update(c_grads, c_opt, qs_in)
+            params = {**params, **optax.apply_updates(qs_in, c_updates)}
 
             def do_actor(operand):
                 params, a_opt = operand
@@ -156,21 +161,14 @@ def make_td3_update(module: TD3Module, actor_opt, critic_opt, cfg: TD3Config):
                 }
                 # Delayed Polyak of actor AND critic targets (TD3 couples
                 # target updates to the policy cadence).
-                params = {
-                    **params,
-                    "actor_t": jax.tree.map(
+                polyak = {
+                    f"{k}_t": jax.tree.map(
                         lambda t, o: (1 - tau) * t + tau * o,
-                        params["actor_t"], params["actor"],
-                    ),
-                    "q1_t": jax.tree.map(
-                        lambda t, o: (1 - tau) * t + tau * o,
-                        params["q1_t"], params["q1"],
-                    ),
-                    "q2_t": jax.tree.map(
-                        lambda t, o: (1 - tau) * t + tau * o,
-                        params["q2_t"], params["q2"],
-                    ),
+                        params[f"{k}_t"], params[k],
+                    )
+                    for k in ("actor", *critic_keys)
                 }
+                params = {**params, **polyak}
                 return params, a_opt, a_loss
 
             def skip_actor(operand):
@@ -230,11 +228,10 @@ class TD3(Algorithm):
             make_td3_update(self.module, actor_opt, critic_opt, cfg),
             seed=cfg.seed,
         )
+        critic_keys = ("q1", "q2") if cfg.use_twin_q else ("q1",)
         learner.opt_state = (
             actor_opt.init(learner.params["actor"]),
-            critic_opt.init(
-                {"q1": learner.params["q1"], "q2": learner.params["q2"]}
-            ),
+            critic_opt.init({k: learner.params[k] for k in critic_keys}),
         )
         return learner
 

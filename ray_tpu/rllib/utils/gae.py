@@ -1,0 +1,44 @@
+"""Generalized advantage estimation — shared by the on-policy learners
+(PPO, A2C; reference analog: `rllib/evaluation/postprocessing.py`
+compute_gae_for_sample_batch, as one in-jit scan)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def compute_gae(module, params, batch, gamma: float, lam: float):
+    """Time-major batch → (advantages, returns), both [T, B]."""
+    rewards, dones, values = batch["rewards"], batch["dones"], batch["values"]
+    _, last_val = module.forward(params, batch["last_obs"])
+
+    def gae_step(carry, x):
+        adv_next, v_next = carry
+        r, d, v = x
+        delta = r + gamma * v_next * (1.0 - d) - v
+        adv = delta + gamma * lam * (1.0 - d) * adv_next
+        return (adv, v), adv
+
+    B = rewards.shape[1]
+    (_, _), advs = lax.scan(
+        gae_step,
+        (jnp.zeros(B, values.dtype), last_val),
+        (rewards, dones, values),
+        reverse=True,
+    )
+    return advs, advs + values
+
+
+def flatten_time_major(batch, advs, returns):
+    """[T, B, ...] rollouts → flat per-sample dict for minibatching."""
+    T, B = batch["rewards"].shape
+    N = T * B
+    return {
+        "obs": batch["obs"].reshape(N, -1),
+        "actions": batch["actions"].reshape((N,) + batch["actions"].shape[2:]),
+        "logp": batch["logp"].reshape(N),
+        "values": batch["values"].reshape(N),
+        "adv": advs.reshape(N),
+        "returns": returns.reshape(N),
+    }

@@ -185,3 +185,38 @@ class TestTD3:
         )
         best = _train_until(algo, -350, 200)
         assert best >= -350, f"TD3 failed to learn Pendulum: best={best}"
+
+
+class TestA2C:
+    def test_a2c_cartpole_learning(self):
+        from ray_tpu.rllib import A2CConfig
+
+        algo = (
+            A2CConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=0, num_envs_per_env_runner=16)
+            .training(train_batch_size=1024, lr=7e-4,
+                      model={"hidden": (64, 64)})
+            .debugging(seed=0)
+            .build()
+        )
+        best = _train_until(algo, 120, 150)
+        assert best >= 120, f"A2C failed to learn CartPole: best={best}"
+
+
+class TestDDPG:
+    def test_ddpg_pendulum_learning(self):
+        from ray_tpu.rllib import DDPGConfig
+
+        algo = (
+            DDPGConfig()
+            .environment("Pendulum-v1")
+            .env_runners(num_env_runners=0, num_envs_per_env_runner=8)
+            .training(train_batch_size=256, learning_starts=512,
+                      num_grad_steps=256, minibatch_size=128,
+                      model={"hidden": (64, 64)}, lr=1e-3)
+            .debugging(seed=0)
+            .build()
+        )
+        best = _train_until(algo, -400, 200)
+        assert best >= -400, f"DDPG failed to learn Pendulum: best={best}"
