@@ -105,6 +105,15 @@ define("transfer_chunk_timeout_s", 60.0,
        doc="Per-chunk progress deadline (replaces whole-object timeouts)")
 define("transfer_max_pulls", 4,
        doc="Concurrent object pulls a node admits (admission control)")
+# Bulk plane (bulk.py): sendfile/recv_into raw-socket transfers; the pickle
+# chunk plane above remains the fallback when no bulk endpoint is known.
+define("bulk_streams", 4,
+       doc="Parallel connections (contiguous spans) per bulk object pull")
+define("bulk_min_bytes", 1 << 20,
+       doc="Use the sendfile bulk plane for objects at least this large")
+define("bulk_same_host_map", True,
+       doc="Same-host pulls pread the source shm file directly (plasma "
+           "fd-passing by name) instead of looping through TCP")
 define("transfer_pulls_per_source", 2,
        doc="Concurrent pulls served per source copy before fan-out waits "
            "for new copies (yields tree-shaped broadcast)")

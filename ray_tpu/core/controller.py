@@ -109,6 +109,7 @@ class NodeState:
     node_id: str
     conn: Optional[Connection] = None
     fetch_addr: str = ""
+    bulk_addr: str = ""
     total: Dict[str, float] = field(default_factory=dict)
     available: Dict[str, float] = field(default_factory=dict)
     session_tag: str = ""
@@ -382,6 +383,12 @@ class Controller:
             self._on_connection, host=bind, port=self.port
         )
         self.port = self._server.sockets[0].getsockname()[1]
+        # Head-store bulk plane (bulk.py): serves the controller's objects to
+        # pulling agents the same way agents serve each other.
+        from .bulk import BulkServer
+
+        self._bulk_server = BulkServer(self.local_store, bind_host=bind)
+        self._bulk_addr = f"{self.node_ip}:{self._bulk_server.start()}"
         # Prometheus exposition (reference: `metrics_agent.py:83-95`).
         self._metrics_server = await asyncio.start_server(
             self._on_metrics_connection, host=bind, port=0
@@ -631,6 +638,8 @@ class Controller:
             store.mark_restorable(store.SESSION_TAG, False)
         if self._server:
             self._server.close()
+        if getattr(self, "_bulk_server", None) is not None:
+            self._bulk_server.stop()
 
     # ------------------------------------------------------------- workers
     def _spawn_worker(
@@ -884,6 +893,7 @@ class Controller:
             node_id=node_id,
             conn=conn,
             fetch_addr=msg.get("fetch_addr", ""),
+            bulk_addr=msg.get("bulk_addr", ""),
             total=dict(total),
             available=dict(total),
             session_tag=msg.get("session_tag", ""),
@@ -1017,7 +1027,8 @@ class Controller:
                     f"{self.node_ip}:{self.port}" if nid == HEAD_NODE
                     else node.fetch_addr
                 )
-                best = {"addr": addr, "name": name, "node": nid}
+                bulk = self._bulk_addr if nid == HEAD_NODE else node.bulk_addr
+                best = {"addr": addr, "name": name, "node": nid, "bulk": bulk}
                 best_load = load
         if best is not None:
             return best
@@ -1026,7 +1037,9 @@ class Controller:
             node = self.nodes.get(nid)
             if node is not None and (nid == HEAD_NODE or node.alive):
                 addr = f"{self.node_ip}:{self.port}" if nid == HEAD_NODE else node.fetch_addr
-                return {"addr": addr, "path": obj.spilled_path, "node": nid}
+                bulk = self._bulk_addr if nid == HEAD_NODE else node.bulk_addr
+                return {"addr": addr, "path": obj.spilled_path, "node": nid,
+                        "bulk": bulk}
         return None
 
     async def _ensure_local(self, node_id: str, hex_id: str):
@@ -1081,7 +1094,8 @@ class Controller:
                 else:
                     node = self.nodes[node_id]
                     req = {"type": "pull_object", "id": hex_id,
-                           "addr": src["addr"], "size": obj.size or 0}
+                           "addr": src["addr"], "size": obj.size or 0,
+                           "bulk": src.get("bulk", "")}
                     if "name" in src:
                         req["name"] = src["name"]
                     else:
@@ -1128,6 +1142,8 @@ class Controller:
             conn.start()
             self._fetch_conns[src["node"]] = conn
         where = {"name": src["name"]} if "name" in src else {"path": src["path"]}
+        if src.get("bulk"):
+            where["bulk"] = src["bulk"]
         return await pull_chunked(
             conn, where, self.local_store, hex_id, size_hint=size_hint
         )

@@ -86,6 +86,25 @@ async def pull_chunked(peer, where: dict, local_store, hex_id: str,
     name, writer = local_store.create_begin(hex_id, size)
     if writer is None:
         return name, size  # completed earlier pull / locally produced
+    # Bulk plane first: sendfile → recv_into straight between arena mappings
+    # (bulk.py). Any failure falls back to the RPC chunk plane below, which
+    # rewrites every offset, so a half-written bulk span is harmless.
+    if where.get("bulk") and size >= rt_config.get("bulk_min_bytes"):
+        from . import bulk as bulk_mod
+
+        pulled = False
+        try:
+            await asyncio.get_running_loop().run_in_executor(
+                None, bulk_mod.bulk_pull_into, where["bulk"], where, size, writer
+            )
+            pulled = True
+        except Exception:  # noqa: BLE001
+            traceback.print_exc()
+        if pulled:
+            # Outside the fallback-swallowing try: a commit failure must
+            # surface, not send released-writer writes down the chunk plane.
+            writer.commit()
+            return name, size
     try:
         sem = asyncio.Semaphore(rt_config.get("transfer_chunk_parallel"))
 
@@ -167,6 +186,10 @@ class NodeAgent:
             self._on_peer_connection, host=bind, port=0
         )
         self.fetch_port = self._server.sockets[0].getsockname()[1]
+        from .bulk import BulkServer
+
+        self._bulk_server = BulkServer(self.local_store, bind_host=bind)
+        bulk_port = self._bulk_server.start()
 
         host, port = self.controller_address.rsplit(":", 1)
         reader, writer = await open_rpc_connection(host, int(port))
@@ -180,6 +203,7 @@ class NodeAgent:
                 "node_id": self.node_id,
                 "resources": self.resources,
                 "fetch_addr": f"{self.node_ip}:{self.fetch_port}",
+                "bulk_addr": f"{self.node_ip}:{bulk_port}",
                 "session_tag": store.SESSION_TAG,
                 "object_store_memory": self.object_store_memory,
                 "labels": self.labels,
@@ -231,6 +255,8 @@ class NodeAgent:
         self._kill_workers()
         if self._server:
             self._server.close()
+        if getattr(self, "_bulk_server", None) is not None:
+            self._bulk_server.stop()
         arena = getattr(self.local_store, "arena", None)
         self.local_store.close_all(unlink=False)
         if arena is not None:
@@ -365,6 +391,8 @@ class NodeAgent:
                     {"name": msg["name"]} if msg.get("name")
                     else {"path": msg["path"]}
                 )
+                if msg.get("bulk"):
+                    where["bulk"] = msg["bulk"]
                 name, size = await pull_chunked(
                     peer, where, self.local_store, hex_id,
                     size_hint=msg.get("size", 0),

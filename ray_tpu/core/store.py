@@ -12,6 +12,7 @@ this module is the mechanical mmap layer used by every process.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
 from multiprocessing import shared_memory
@@ -152,6 +153,23 @@ class LocalStore:
                 _untrack(seg)
                 self._open[shm_name] = seg
         return bytes(seg.buf[offset:offset + length])
+
+    @contextlib.contextmanager
+    def bulk_source(self, shm_name: str):
+        """(fd, base_offset, size) of the file backing `shm_name` — the bulk
+        server (`bulk.py`) sendfiles spans straight from the page cache."""
+        fd = os.open(f"/dev/shm/{shm_name}", os.O_RDONLY)
+        try:
+            yield fd, 0, os.fstat(fd).st_size
+        finally:
+            os.close(fd)
+
+    @contextlib.contextmanager
+    def bulk_map_source(self, shm_name: str):
+        """(path, offset, size) for SAME-HOST handover — the puller opens the
+        backing file itself and preads (plasma fd-passing, by name)."""
+        path = f"/dev/shm/{shm_name}"
+        yield path, 0, os.stat(path).st_size
 
     def create_begin(self, object_hex: str, size: int):
         """Begin an incremental (chunked) write of a pulled object. Returns
@@ -330,6 +348,10 @@ class _ShmWriter:
     def write(self, offset: int, data: bytes):
         self._seg.buf[offset:offset + len(data)] = data
 
+    def raw_view(self, offset: int, length: int) -> memoryview:
+        """Writable window for the bulk plane's recv_into (no staging)."""
+        return memoryview(self._seg.buf)[offset:offset + length]
+
     def commit(self):
         pass  # plain shm has no seal step
 
@@ -355,6 +377,10 @@ class _ArenaWriter:
 
     def write(self, offset: int, data: bytes):
         self._view[offset:offset + len(data)] = data
+
+    def raw_view(self, offset: int, length: int) -> memoryview:
+        """Writable window for the bulk plane's recv_into (no staging)."""
+        return self._view[offset:offset + length]
 
     def commit(self):
         self._view.release()
@@ -490,6 +516,44 @@ class ArenaStore:
                 self.arena.release(hex_id)
             except BufferError:
                 pass
+
+    @contextlib.contextmanager
+    def bulk_source(self, name: str):
+        """(fd, base_offset, size) for sendfile — the object's span INSIDE
+        the arena's backing file, pinned for the duration of the serve."""
+        if not name.startswith(ARENA_PREFIX):
+            with self.fallback.bulk_source(name) as src:
+                yield src
+            return
+        hex_id = name[len(ARENA_PREFIX):]
+        loc = self.arena.locate(hex_id)
+        if loc is None:
+            raise FileNotFoundError(f"object {hex_id} not in arena")
+        offset, size = loc
+        fd = os.open(f"/dev/shm/{self.arena.name.lstrip('/')}", os.O_RDONLY)
+        try:
+            yield fd, offset, size
+        finally:
+            os.close(fd)
+            self.arena.release(hex_id)
+
+    @contextlib.contextmanager
+    def bulk_map_source(self, name: str):
+        """(path, offset, size) for SAME-HOST handover, pinned while the
+        puller preads the span (plasma fd-passing, by name)."""
+        if not name.startswith(ARENA_PREFIX):
+            with self.fallback.bulk_map_source(name) as src:
+                yield src
+            return
+        hex_id = name[len(ARENA_PREFIX):]
+        loc = self.arena.locate(hex_id)
+        if loc is None:
+            raise FileNotFoundError(f"object {hex_id} not in arena")
+        offset, size = loc
+        try:
+            yield f"/dev/shm/{self.arena.name.lstrip('/')}", offset, size
+        finally:
+            self.arena.release(hex_id)
 
     def create_begin(self, object_hex: str, size: int):
         try:

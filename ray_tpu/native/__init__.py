@@ -102,7 +102,8 @@ def load_arena_lib() -> Optional[ctypes.CDLL]:
         ]
         lib.rt_arena_base.restype = ctypes.c_void_p
         lib.rt_arena_base.argtypes = [ctypes.c_void_p]
-        for fn in ("rt_arena_capacity", "rt_arena_used", "rt_arena_num_objects"):
+        for fn in ("rt_arena_capacity", "rt_arena_used", "rt_arena_num_objects",
+                   "rt_arena_data_offset"):
             getattr(lib, fn).restype = ctypes.c_uint64
             getattr(lib, fn).argtypes = [ctypes.c_void_p]
         lib.rt_arena_detach.restype = ctypes.c_int
@@ -221,6 +222,19 @@ class Arena:
         if off == -2:
             raise BlockingIOError(f"object {object_id} not sealed yet")
         return self._view(off, size.value)
+
+    def locate(self, object_id: str):
+        """Pin + return (file_offset, size) of a sealed object within the
+        arena's backing file (object offsets are payload-relative; adding
+        data_offset makes them file offsets — bulk.py sendfiles from them).
+        None if absent. Balance every successful locate with release()."""
+        size = ctypes.c_uint64()
+        off = self._lib.rt_arena_get(self._h, object_id.encode(), ctypes.byref(size))
+        if off == -1:
+            return None
+        if off == -2:
+            raise BlockingIOError(f"object {object_id} not sealed yet")
+        return off + self._lib.rt_arena_data_offset(self._h), size.value
 
     def release(self, object_id: str):
         self._lib.rt_arena_release(self._h, object_id.encode())

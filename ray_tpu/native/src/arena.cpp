@@ -385,6 +385,9 @@ uint8_t* rt_arena_base(void* hv) {
 }
 
 uint64_t rt_arena_capacity(void* hv) { return static_cast<Handle*>(hv)->hdr->capacity; }
+// Payload base as a FILE offset: object offsets from rt_arena_get/alloc are
+// relative to this (the bulk plane sendfiles spans of the backing file).
+uint64_t rt_arena_data_offset(void* hv) { return static_cast<Handle*>(hv)->hdr->data_offset; }
 uint64_t rt_arena_used(void* hv) { return static_cast<Handle*>(hv)->hdr->used_bytes; }
 uint64_t rt_arena_num_objects(void* hv) { return static_cast<Handle*>(hv)->hdr->num_objects; }
 
