@@ -98,9 +98,10 @@ def main():
     else:
         # gpt2_large B=12 is the single-chip sweet spot (scripts/
         # bench_sweep2.py r2): 0.438 MFU vs medium's 0.409@24; larger
-        # d_model (1280) fills the MXU better. Blocks 512/512 beat
-        # 256/512, 512/1024, 1024/512 (scripts block sweep). B=16/S=2048
-        # fail to compile on the 16G chip.
+        # d_model (1280) fills the MXU better. Flash blocks (1024,1024)
+        # via the r4 sweeps (scripts/bench_flash.py): single-KV-step fwd
+        # at S=1024 halves the kernel's VPU cost vs the r3 (512,512).
+        # B=16/S=1024 and remat_policy="attn"@B=12 exceed the 16G HBM.
         B, S = 12, 1024
         cfg = gpt2_large(max_seq=S, attn_impl="flash", remat=True)
 

@@ -296,8 +296,16 @@ def _pull_span(addr: str, where: dict, writer, offset: int, length: int,
         _recv_exact_into(sock, writer.raw_view(offset, length), tmo)
 
 
+_local_addrs_cache: Optional[set] = None
+
+
 def _local_addrs() -> set:
-    """Addresses that mean 'this host' for the same-host map handover."""
+    """Addresses that mean 'this host' for the same-host map handover.
+    Cached: the gethostbyname_ex resolver round trip is static for the
+    process lifetime and must not tax every pull."""
+    global _local_addrs_cache
+    if _local_addrs_cache is not None:
+        return _local_addrs_cache
     out = {"127.0.0.1", "localhost", "::1"}
     node_ip = rt_config.get("node_ip")
     if node_ip:
@@ -307,6 +315,7 @@ def _local_addrs() -> set:
         out.update(socket.gethostbyname_ex(socket.gethostname())[2])
     except OSError:
         pass
+    _local_addrs_cache = out
     return out
 
 

@@ -114,6 +114,18 @@ define("bulk_min_bytes", 1 << 20,
 define("bulk_same_host_map", True,
        doc="Same-host pulls pread the source shm file directly (plasma "
            "fd-passing by name) instead of looping through TCP")
+define("worker_forkserver", True,
+       doc="Per-node pre-imported template process; CPU workers fork from "
+           "it in ~10ms instead of booting an interpreter (~2s)")
+# Two-level scheduling (reference: ClusterTaskManager/LocalTaskManager split).
+define("local_dispatch", True,
+       doc="Hand queued plain tasks to node agents' LocalDispatchers; the "
+           "agent leases local workers and dispatches without the head")
+define("local_dispatch_depth", 4,
+       doc="Handoff queue depth per node, in multiples of its CPU count")
+define("local_dispatch_spill_s", 10.0,
+       doc="Agent-queued tasks with no obtainable lease for this long "
+           "spill back to central scheduling")
 define("transfer_pulls_per_source", 2,
        doc="Concurrent pulls served per source copy before fan-out waits "
            "for new copies (yields tree-shaped broadcast)")

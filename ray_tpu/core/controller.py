@@ -44,6 +44,7 @@ from .ids import ObjectID
 from .task_spec import (
     spec_from_proto_bytes,
     spec_to_proto_bytes,
+    DefaultSchedulingStrategy,
     NodeAffinitySchedulingStrategy,
     NodeLabelSchedulingStrategy,
     PlacementGroupSchedulingStrategy,
@@ -110,6 +111,13 @@ class NodeState:
     conn: Optional[Connection] = None
     fetch_addr: str = ""
     bulk_addr: str = ""
+    # Two-level scheduling (reference: ClusterTaskManager picks the node,
+    # LocalTaskManager owns the local queue + worker grant —
+    # `scheduling/cluster_task_manager.h:42` / `local_task_manager.cc:1`):
+    # agents that run a LocalDispatcher accept queued-task handoffs and keep
+    # dispatching them to leased local workers with no head involvement.
+    dispatch: bool = False
+    handoff_inflight: int = 0
     total: Dict[str, float] = field(default_factory=dict)
     available: Dict[str, float] = field(default_factory=dict)
     session_tag: str = ""
@@ -344,6 +352,7 @@ class Controller:
         self._schedule_again = False
         self._shutdown_event = asyncio.Event()
         self._worker_procs: Dict[str, subprocess.Popen] = {}
+        self._forkserver = None  # set in start()
 
     # ------------------------------------------------------------ lifecycle
     _SNAPSHOT_KEY = "controller_state"
@@ -389,6 +398,13 @@ class Controller:
 
         self._bulk_server = BulkServer(self.local_store, bind_host=bind)
         self._bulk_addr = f"{self.node_ip}:{self._bulk_server.start()}"
+        # Warm-worker template (forkserver.py): pays the interpreter+import
+        # cost once; CPU workers fork from it in ~10 ms once it is ready.
+        from .forkserver import ForkServerClient
+
+        self._forkserver = ForkServerClient(self.session_dir, "head")
+        if rt_config.get("worker_forkserver"):
+            self._forkserver.start()
         # Prometheus exposition (reference: `metrics_agent.py:83-95`).
         self._metrics_server = await asyncio.start_server(
             self._on_metrics_connection, host=bind, port=0
@@ -640,6 +656,8 @@ class Controller:
             self._server.close()
         if getattr(self, "_bulk_server", None) is not None:
             self._bulk_server.stop()
+        if getattr(self, "_forkserver", None) is not None:
+            self._forkserver.stop()
 
     # ------------------------------------------------------------- workers
     def _spawn_worker(
@@ -667,7 +685,12 @@ class Controller:
         booting = sum(
             1 for w in self.workers.values() if w.state == STARTING
         ) + sum(n.spawning for n in self.nodes.values())
-        if booting >= rt_config.get("worker_boot_concurrency"):
+        boot_cap = rt_config.get("worker_boot_concurrency")
+        if self._forkserver is not None and self._forkserver.ready:
+            # Forked workers skip the ~2s interpreter boot the cap was sized
+            # for; registration (the remaining cost) tolerates a deeper queue.
+            boot_cap *= 4
+        if booting >= boot_cap:
             return
         if tpu:
             if node.spawning_tpu > 0:
@@ -714,6 +737,17 @@ class Controller:
             if env.get("JAX_PLATFORMS", "").lower() in ("", "axon", "tpu"):
                 env["JAX_PLATFORMS"] = "cpu"
         log_path = os.path.join(self.session_dir, f"worker-{worker_id}.log")
+        if not tpu and self._forkserver is not None and self._forkserver.ready:
+            # Warm path: ~10 ms fork from the pre-imported template. Fork
+            # preserves the no-pdeathsig property (the template, not the
+            # controller, is the parent — and it ignores SIGCHLD).
+            try:
+                self._worker_procs[worker_id] = self._forkserver.spawn(
+                    worker_id, env, log_path
+                )
+                return
+            except Exception:  # noqa: BLE001 — template died; spawn cold
+                traceback.print_exc()
         log_f = open(log_path, "ab")
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.core.worker_main"],
@@ -894,6 +928,7 @@ class Controller:
             conn=conn,
             fetch_addr=msg.get("fetch_addr", ""),
             bulk_addr=msg.get("bulk_addr", ""),
+            dispatch=bool(msg.get("local_dispatch")),
             total=dict(total),
             available=dict(total),
             session_tag=msg.get("session_tag", ""),
@@ -1819,6 +1854,184 @@ class Controller:
         self._event("task_dispatched", task=task_hex, worker=ws.worker_id,
                      node=node.node_id)
 
+    # ----------------------------------------------- two-level scheduling
+    def _handoff_cap(self, node: NodeState) -> int:
+        return max(
+            int(node.total.get("CPU", 0)), 1
+        ) * rt_config.get("local_dispatch_depth")
+
+    def _try_handoff(self, pt: PendingTask, preferred: Optional[NodeState]) -> bool:
+        """Hand a queued plain task to a node agent's LocalDispatcher
+        instead of keeping it head-resident (reference: ClusterTaskManager
+        node pick + spillback of the QUEUE, not just of running tasks).
+
+        Only the overflow path takes this: tasks that found an idle worker
+        were dispatched centrally already, so agents receive exactly the
+        backlog — the population whose dispatch otherwise serializes
+        through this loop."""
+        spec = pt.spec
+        if not rt_config.get("local_dispatch"):
+            return False
+        if spec.task_type != TaskType.NORMAL_TASK:
+            return False
+        demand = spec.resources
+        # The dispatcher executes on generic CPU:1 leases — only tasks whose
+        # demand a CPU:1 lease actually covers may ride the plane. Custom
+        # resources / multi-CPU shapes keep central accounting (which debits
+        # node.available per task).
+        if any(k != "CPU" for k in demand) or demand.get("CPU", 0) > 1:
+            return False
+        strat = spec.options.scheduling_strategy
+        if not isinstance(
+            strat,
+            (DefaultSchedulingStrategy, SpreadSchedulingStrategy,
+             NodeAffinitySchedulingStrategy),
+        ):
+            return False
+        if isinstance(strat, NodeAffinitySchedulingStrategy):
+            node = self.nodes.get(strat.node_id)
+            candidates = [node] if node is not None else []
+        elif pt.pinned_node is not None:
+            node = self.nodes.get(pt.pinned_node)
+            candidates = [node] if node is not None else []
+        elif preferred is not None:
+            candidates = [preferred] + [
+                n for n in self.nodes.values() if n is not preferred
+            ]
+        else:
+            candidates = list(self.nodes.values())
+        best = None
+        for node in candidates:
+            if (
+                node is None or not node.alive or node.conn is None
+                or not node.dispatch
+                or node.handoff_inflight >= self._handoff_cap(node)
+                or not all(node.total.get(k, 0) >= v for k, v in demand.items())
+            ):
+                continue
+            if best is None or node.handoff_inflight < best.handoff_inflight:
+                best = node
+            if node is preferred or pt.pinned_node is not None:
+                break  # placement-constrained: first viable wins
+        if best is None:
+            return False
+        task_hex = spec.task_id.hex()
+        self.running[task_hex] = (f"@{best.node_id}", pt)
+        best.handoff_inflight += 1
+        self._event("task_handoff", task=task_hex, node=best.node_id)
+        if not spec.arg_refs:
+            best.conn.post({
+                "type": "enqueue_task", "task": task_hex,
+                "spec": spec_to_proto_bytes(spec), "deps": {},
+            })
+        else:
+            asyncio.ensure_future(self._handoff_send(best, pt))
+        return True
+
+    async def _handoff_send(self, node: NodeState, pt: PendingTask):
+        """Materialize args on the target node, then ship spec+deps — the
+        agent dispatches with zero further head involvement."""
+        spec = pt.spec
+        task_hex = spec.task_id.hex()
+        try:
+            await asyncio.gather(
+                *(self._ensure_local(node.node_id, oid.hex())
+                  for oid in spec.arg_refs)
+            )
+            node.conn.post({
+                "type": "enqueue_task", "task": task_hex,
+                "spec": spec_to_proto_bytes(spec),
+                "deps": self._deps_payload(spec, node.node_id),
+            })
+        except Exception as e:  # noqa: BLE001 — dep transfer / conn failure
+            self.running.pop(task_hex, None)
+            node.handoff_inflight = max(0, node.handoff_inflight - 1)
+            lost = [
+                oid.hex()
+                for oid in spec.arg_refs
+                if (o := self.objects.get(oid.hex())) is not None and o.is_lost()
+            ]
+            if lost and all(self._reconstruct_object(h) for h in lost):
+                self._event("task_requeued_for_reconstruction", task=task_hex)
+                self._enqueue(pt)
+            else:
+                err = TaskError(
+                    RuntimeError(f"dependency transfer failed: {e}"), "",
+                    spec.name,
+                )
+                self._unpin_args(spec)
+                if spec.num_returns == -1:
+                    self._fail_stream(spec, err)
+                for oid in spec.return_ids:
+                    self._store_error_object(oid.hex(), err)
+            self._schedule()
+
+    def _retry_or_fail(self, pt: PendingTask, task_hex: str, cause: str):
+        """Shared worker-loss policy: consume a retry and requeue, else fail
+        the returns (used by _on_worker_death and agent-reported losses)."""
+        if task_hex in self.cancelled:
+            self._finish_cancelled(pt)
+            return
+        if pt.retries_left > 0:
+            pt.retries_left -= 1
+            pt.spec.attempt_number += 1
+            pt.pinned_node = None
+            self._event("task_retry", task=task_hex)
+            self._enqueue(pt)
+            return
+        err = TaskError(WorkerCrashedError(cause), "", pt.spec.name)
+        self._unpin_args(pt.spec)
+        if pt.spec.num_returns == -1:
+            self._fail_stream(pt.spec, err)
+        for oid in pt.spec.return_ids:
+            self._store_error_object(oid.hex(), err)
+
+    async def h_agent_task_lost(self, conn, meta, msg):
+        """Agent-side dispatch saw the executing worker die (local worker
+        loss is AGENT-observed for handed-off tasks — the head never granted
+        that worker)."""
+        entry = self.running.pop(msg["task"], None)
+        if entry is None:
+            return None
+        node = self.nodes.get(meta.get("node_id", ""))
+        if node is not None:
+            node.handoff_inflight = max(0, node.handoff_inflight - 1)
+        self._retry_or_fail(
+            entry[1], msg["task"],
+            f"Worker {msg.get('worker_id', '?')} died executing task",
+        )
+        self._schedule()
+        return None
+
+    async def h_agent_spillback(self, conn, meta, msg):
+        """Agent could not serve queued tasks (no leases obtainable) — they
+        come home for central placement (reference: spillback,
+        `cluster_task_manager.h` ScheduleOnNode fallback)."""
+        node = self.nodes.get(meta.get("node_id", ""))
+        for task_hex in msg.get("tasks", []):
+            entry = self.running.pop(task_hex, None)
+            if entry is None:
+                continue
+            if node is not None:
+                node.handoff_inflight = max(0, node.handoff_inflight - 1)
+            pt = entry[1]
+            pt.pinned_node = None
+            if task_hex in self.cancelled:
+                self._finish_cancelled(pt)
+            else:
+                self._enqueue(pt)
+        self._schedule()
+        return None
+
+    async def h_agent_task_cancelled(self, conn, meta, msg):
+        entry = self.running.pop(msg["task"], None)
+        node = self.nodes.get(meta.get("node_id", ""))
+        if node is not None:
+            node.handoff_inflight = max(0, node.handoff_inflight - 1)
+        if entry is not None:
+            self._finish_cancelled(entry[1])
+        return None
+
     def _schedule(self):
         """Dispatch as many ready tasks as resources + workers allow.
 
@@ -1961,6 +2174,16 @@ class Controller:
                     )
                     sig = pt.sched_sig(need_tpu)
                     if sig is not None and sig in no_capacity:
+                        # Same demand already found no central capacity this
+                        # pass — the agent handoff plane is exactly for this
+                        # backlog population.
+                        hint_node = (
+                            self.nodes.get(no_capacity[sig])
+                            if no_capacity[sig] is not None else None
+                        )
+                        if self._try_handoff(pt, hint_node):
+                            made_progress = True
+                            continue
                         self.ready_queue.append(pt)
                         hint = no_capacity[sig]
                         if hint is not None and not need_tpu:
@@ -1992,6 +2215,9 @@ class Controller:
                         chosen = (node, ws)
                         break
                     if chosen is None:
+                        if self._try_handoff(pt, spawn_on):
+                            made_progress = True
+                            continue
                         self.ready_queue.append(pt)
                         if sig is not None:
                             no_capacity[sig] = (
@@ -2183,9 +2409,13 @@ class Controller:
         deadline = time.monotonic() + min(float(msg.get("wait_s", 8.0)), 30.0)
         bkey = tuple(sorted(demand.items()))
         first = True
+        # LocalDispatchers lease only their OWN node's workers (the point of
+        # the handoff is node-local dispatch); submitters lease anywhere.
+        node_filter = msg.get("node_id")
         while True:
             grants = self._try_grant_leases(
-                meta, demand, need_tpu, count, spawn=first
+                meta, demand, need_tpu, count, spawn=first,
+                node_filter=node_filter,
             )
             first = False
             if grants or time.monotonic() >= deadline:
@@ -2207,12 +2437,15 @@ class Controller:
             self._event("lease_granted", n=len(grants), holder=meta.get("conn_id"))
         return {"leases": grants}
 
-    def _try_grant_leases(self, meta, demand, need_tpu, count, spawn=True):
+    def _try_grant_leases(self, meta, demand, need_tpu, count, spawn=True,
+                          node_filter=None):
         grants = []
         spawn_hint: Optional[NodeState] = None
         for _ in range(count):
             got = None
             for node in self.nodes.values():
+                if node_filter is not None and node.node_id != node_filter:
+                    continue
                 if not self._fits_node(node, demand):
                     continue
                 ws = self._idle_worker(node.node_id, need_tpu)
@@ -2483,6 +2716,10 @@ class Controller:
         entry = self.running.pop(task_hex, None)
         if entry is not None:
             self._unpin_args(entry[1].spec)
+            if entry[0].startswith("@"):  # agent-dispatched (handoff plane)
+                hnode = self.nodes.get(entry[0][1:])
+                if hnode is not None:
+                    hnode.handoff_inflight = max(0, hnode.handoff_inflight - 1)
         ws = self.workers.get(meta["worker_id"]) if meta["worker_id"] else None
         node_id = ws.node_id if ws is not None else HEAD_NODE
         if ws is not None and ws.reclaiming_task == task_hex:
@@ -2773,12 +3010,6 @@ class Controller:
                     # without prefetch).
                     pt.pinned_node = None
                     self._enqueue(pt)
-                elif pt.retries_left > 0:
-                    pt.retries_left -= 1
-                    pt.spec.attempt_number += 1
-                    pt.pinned_node = None  # re-pick; the node may be gone
-                    self._event("task_retry", task=task_hex)
-                    self._enqueue(pt)
                 else:
                     cause = (
                         f"Worker {worker_id} was killed by the memory "
@@ -2786,16 +3017,7 @@ class Controller:
                         if ws.oom_killed
                         else f"Worker {worker_id} died executing task"
                     )
-                    err = TaskError(
-                        WorkerCrashedError(cause),
-                        "",
-                        pt.spec.name,
-                    )
-                    self._unpin_args(pt.spec)
-                    if pt.spec.num_returns == -1:
-                        self._fail_stream(pt.spec, err)
-                    for oid in pt.spec.return_ids:
-                        self._store_error_object(oid.hex(), err)
+                    self._retry_or_fail(pt, task_hex, cause)
         if prev_state == ACTOR and ws.actor_hex:
             await self._on_actor_worker_death(ws.actor_hex)
         # Keep the pool topped up.
@@ -2930,6 +3152,16 @@ class Controller:
         node.alive = False
         self._fetch_conns.pop(node_id, None)
         self._event("node_died", node=node_id)
+        # Tasks handed to its LocalDispatcher die with it — same retry
+        # policy as worker death.
+        marker = f"@{node_id}"
+        for task_hex, (wid, pt) in list(self.running.items()):
+            if wid == marker:
+                self.running.pop(task_hex, None)
+                self._retry_or_fail(
+                    pt, task_hex, f"Node {node_id} died with task queued"
+                )
+        node.handoff_inflight = 0
         # Its workers are dying with it (PDEATHSIG); process them now so
         # running tasks retry immediately rather than on socket timeout.
         for ws in list(self.workers.values()):
@@ -3067,6 +3299,16 @@ class Controller:
         entry = self.running.get(task_hex)
         if entry is not None:
             worker_id, pt = entry
+            if worker_id.startswith("@"):
+                # Queued/running at a node agent: drop there; force also
+                # kills the executing worker (the agent knows which one —
+                # h_agent_task_cancelled / h_agent_task_lost finish the
+                # bookkeeping).
+                node = self.nodes.get(worker_id[1:])
+                if node is not None and node.conn is not None and node.alive:
+                    node.conn.post({"type": "cancel_task", "task": task_hex,
+                                    "force": bool(msg.get("force"))})
+                return {"ok": True}
             ws = self.workers.get(worker_id)
             if ws is not None and ws.prefetch_task == task_hex:
                 # Prefetched but not yet executing: drop it on the worker —

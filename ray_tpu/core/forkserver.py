@@ -1,0 +1,267 @@
+"""Warm-worker forkserver: fork pre-imported worker processes in ~10 ms.
+
+Reference analog: `WorkerPool::PrestartWorkers` + startup tokens
+(`src/ray/raylet/worker_pool.h:354`, `:455`). The reference amortizes worker
+boot by pre-forking on backlog hints; here the amortization is structural —
+a per-node TEMPLATE process pays the interpreter+import cost once (python +
+numpy + the worker module + jax-on-CPU, ~2 s of CPU on the bench host),
+then `fork()`s a ready worker per request in ~10 ms. This is what turns the
+2,000-actor envelope from boot-bound (ENVELOPE_r3: 1,943 s) into
+fork-bound.
+
+Design constraints:
+  * The template is strictly SINGLE-THREADED and runs no asyncio loop —
+    fork() of a multithreaded process can deadlock the child on locks held
+    by threads that do not survive the fork. jax is imported (that is the
+    expensive part) but its backend is never initialized here (backend init
+    spins up threadpools).
+  * TPU workers do NOT fork from the template: the JAX platform is pinned
+    at interpreter start (sitecustomize), and the template is pinned to
+    CPU. TPU workers keep the cold Popen path — at most one per node.
+  * Children are auto-reaped (SIGCHLD ignored in the template); callers
+    track liveness by pid via PidHandle, which quacks like Popen.
+
+Wire: one unix-domain request per connection on the session-dir socket —
+[u32 len][json {worker_id, env, log_path}] → [u32 len][json {pid}].
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import time
+from typing import Dict, Optional
+
+_LEN = struct.Struct("<I")
+READY_LINE = "RAY_TPU_FORKSERVER_READY"
+
+
+def _send_msg(sock: socket.socket, obj: dict):
+    body = json.dumps(obj).encode()
+    sock.sendall(_LEN.pack(len(body)) + body)
+
+
+def _recv_msg(sock: socket.socket) -> dict:
+    buf = b""
+    while len(buf) < 4:
+        chunk = sock.recv(4 - len(buf))
+        if not chunk:
+            raise ConnectionError("forkserver peer closed")
+        buf += chunk
+    (n,) = _LEN.unpack(buf)
+    body = b""
+    while len(body) < n:
+        chunk = sock.recv(n - len(body))
+        if not chunk:
+            raise ConnectionError("forkserver peer closed")
+        body += chunk
+    return json.loads(body)
+
+
+class PidHandle:
+    """Popen-shaped handle over a bare pid (forked workers have no Popen).
+
+    SIGCHLD is ignored in the forking TEMPLATE (children reparent nowhere —
+    the template auto-reaps), so liveness here is signal-0 probing."""
+
+    def __init__(self, pid: int):
+        self.pid = pid
+        self._rc: Optional[int] = None
+
+    def poll(self) -> Optional[int]:
+        if self._rc is not None:
+            return self._rc
+        try:
+            os.kill(self.pid, 0)
+            return None
+        except (ProcessLookupError, PermissionError):
+            self._rc = -1
+            return self._rc
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self.poll() is None:
+            if deadline is not None and time.monotonic() > deadline:
+                raise subprocess.TimeoutExpired("forked-worker", timeout)
+            time.sleep(0.02)
+        return self._rc
+
+    def _signal(self, sig):
+        try:
+            os.kill(self.pid, sig)
+        except ProcessLookupError:
+            self._rc = -1
+
+    def terminate(self):
+        self._signal(signal.SIGTERM)
+
+    def kill(self):
+        self._signal(signal.SIGKILL)
+
+    def send_signal(self, sig):
+        self._signal(sig)
+
+
+class ForkServerClient:
+    """Owns one template process and hands out forked workers."""
+
+    def __init__(self, session_dir: str, name: str):
+        self.session_dir = session_dir
+        self.sock_path = os.path.join(session_dir, f"forkserver-{name}.sock")
+        self.log_path = os.path.join(session_dir, f"forkserver-{name}.log")
+        self.proc: Optional[subprocess.Popen] = None
+        self._ready = False
+
+    def start(self, pdeathsig: bool = False):
+        """Launch the template (non-blocking: readiness is polled later).
+
+        pdeathsig=True chains process lineage to the caller: caller death
+        kills the template, which kills its forked workers — the node-agent
+        semantics ("workers die with the agent"). Head-side templates leave
+        it off so workers survive a controller crash (controller FT)."""
+        env = dict(os.environ)
+        pkg_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        env["RAY_TPU_FORK_SOCK"] = self.sock_path
+        env["RAY_TPU_FORK_PDEATHSIG"] = "1" if pdeathsig else "0"
+        env["PYTHONUNBUFFERED"] = "1"
+        # CPU pin — same dance as cold CPU-worker spawns: the template must
+        # never touch the TPU plugin (workers that need it spawn cold).
+        env["RAY_TPU_WORKER_TPU"] = "0"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        if env.get("JAX_PLATFORMS", "").lower() in ("", "axon", "tpu"):
+            env["JAX_PLATFORMS"] = "cpu"
+        try:
+            os.unlink(self.sock_path)
+        except OSError:
+            pass
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.forkserver"],
+            env=env,
+            stdout=open(self.log_path, "ab"),
+            stderr=subprocess.STDOUT,
+            cwd=pkg_root,
+        )
+
+    @property
+    def ready(self) -> bool:
+        """True once the template is accepting fork requests."""
+        if self._ready:
+            return True
+        if self.proc is None or self.proc.poll() is not None:
+            return False
+        self._ready = os.path.exists(self.sock_path)
+        return self._ready
+
+    def spawn(self, worker_id: str, env: Dict[str, str], log_path: str) -> PidHandle:
+        """Fork a worker (blocking, ~10 ms). Raises if the template is gone."""
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(10.0)
+        try:
+            sock.connect(self.sock_path)
+            _send_msg(sock, {"worker_id": worker_id, "env": env,
+                             "log_path": log_path})
+            resp = _recv_msg(sock)
+        finally:
+            sock.close()
+        if "pid" not in resp:
+            raise RuntimeError(f"forkserver error: {resp.get('error')}")
+        return PidHandle(resp["pid"])
+
+    def stop(self):
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()
+        try:
+            os.unlink(self.sock_path)
+        except OSError:
+            pass
+
+
+# ------------------------------------------------------------------ template
+def _set_pdeathsig():
+    try:
+        import ctypes
+
+        libc = ctypes.CDLL("libc.so.6", use_errno=True)
+        libc.prctl(1, signal.SIGKILL)  # PR_SET_PDEATHSIG
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def _child_exec(req: dict):
+    """Forked child → worker. Never returns."""
+    if os.environ.get("RAY_TPU_FORK_PDEATHSIG") == "1":
+        _set_pdeathsig()  # die with the TEMPLATE (which dies with the agent)
+    os.setsid()
+    fd = os.open(req["log_path"], os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    os.dup2(fd, 1)
+    os.dup2(fd, 2)
+    os.close(fd)
+    signal.signal(signal.SIGCHLD, signal.SIG_DFL)
+    os.environ.update(req["env"])
+    from . import worker_main
+
+    worker_main.main()
+    os._exit(0)
+
+
+def template_main():
+    sock_path = os.environ["RAY_TPU_FORK_SOCK"]
+    if os.environ.get("RAY_TPU_FORK_PDEATHSIG") == "1":
+        _set_pdeathsig()  # die with the node agent
+
+    # The expensive part, paid exactly once per node: interpreter + imports.
+    import numpy  # noqa: F401
+    from . import worker_main  # noqa: F401  (pulls rpc/store/serialization)
+    try:
+        import jax  # noqa: F401  — import only; backend stays uninitialized
+    except Exception:  # noqa: BLE001 — workers degrade to import-at-use
+        pass
+
+    signal.signal(signal.SIGCHLD, signal.SIG_IGN)  # auto-reap forked workers
+    srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    tmp = sock_path + ".tmp"
+    try:
+        os.unlink(tmp)
+    except OSError:
+        pass
+    srv.bind(tmp)
+    os.chmod(tmp, 0o600)
+    srv.listen(64)
+    os.rename(tmp, sock_path)  # atomic: socket existence signals readiness
+    print(READY_LINE, flush=True)
+
+    while True:
+        try:
+            conn, _ = srv.accept()
+        except OSError:
+            return
+        try:
+            req = _recv_msg(conn)
+            pid = os.fork()
+            if pid == 0:
+                srv.close()
+                conn.close()
+                try:
+                    _child_exec(req)
+                finally:
+                    os._exit(1)
+            _send_msg(conn, {"pid": pid})
+        except Exception as e:  # noqa: BLE001 — report; keep serving
+            try:
+                _send_msg(conn, {"error": repr(e)})
+            except OSError:
+                pass
+        finally:
+            conn.close()
+
+
+if __name__ == "__main__":
+    template_main()

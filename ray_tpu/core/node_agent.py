@@ -174,6 +174,8 @@ class NodeAgent:
 
         self._sys_sampler = SystemMetricsSampler()
         self._shutdown = asyncio.Event()
+        # Two-level scheduling: set in start() when local_dispatch is on.
+        self.dispatcher = None
 
     # ------------------------------------------------------------ lifecycle
     async def start(self):
@@ -190,6 +192,11 @@ class NodeAgent:
 
         self._bulk_server = BulkServer(self.local_store, bind_host=bind)
         bulk_port = self._bulk_server.start()
+        from .forkserver import ForkServerClient
+
+        self._forkserver = ForkServerClient(self.session_dir, self.node_id)
+        if rt_config.get("worker_forkserver"):
+            self._forkserver.start(pdeathsig=True)
 
         host, port = self.controller_address.rsplit(":", 1)
         reader, writer = await open_rpc_connection(host, int(port))
@@ -197,6 +204,11 @@ class NodeAgent:
             reader, writer, on_push=self._on_controller_push, on_close=self._on_controller_close
         )
         self.conn.start()
+        if rt_config.get("local_dispatch"):
+            from .local_dispatch import LocalDispatcher
+
+            self.dispatcher = LocalDispatcher(self)
+            self.dispatcher.start()
         resp = await self.conn.request(
             {
                 "type": "register_node",
@@ -204,6 +216,7 @@ class NodeAgent:
                 "resources": self.resources,
                 "fetch_addr": f"{self.node_ip}:{self.fetch_port}",
                 "bulk_addr": f"{self.node_ip}:{bulk_port}",
+                "local_dispatch": self.dispatcher is not None,
                 "session_tag": store.SESSION_TAG,
                 "object_store_memory": self.object_store_memory,
                 "labels": self.labels,
@@ -257,6 +270,10 @@ class NodeAgent:
             self._server.close()
         if getattr(self, "_bulk_server", None) is not None:
             self._bulk_server.stop()
+        if getattr(self, "_forkserver", None) is not None:
+            self._forkserver.stop()
+        if self.dispatcher is not None:
+            self.dispatcher.stop()
         arena = getattr(self.local_store, "arena", None)
         self.local_store.close_all(unlink=False)
         if arena is not None:
@@ -282,6 +299,24 @@ class NodeAgent:
                 await self.conn.respond(
                     msg["req_id"], {"ok": True, "sys": self._sys_sampler.sample()}
                 )
+            elif mtype == "enqueue_task":
+                if self.dispatcher is not None:
+                    self.dispatcher.enqueue(
+                        msg["task"], msg["spec"], msg.get("deps") or {}
+                    )
+                else:  # dispatch disabled after registration — send home
+                    await self.conn.send(
+                        {"type": "agent_spillback", "tasks": [msg["task"]]}
+                    )
+            elif mtype == "cancel_task":
+                if self.dispatcher is not None:
+                    self.dispatcher.cancel(
+                        msg["task"], force=bool(msg.get("force")),
+                        worker_procs=self._worker_procs,
+                    )
+            elif mtype == "revoke_lease":
+                if self.dispatcher is not None:
+                    self.dispatcher.on_revoke(msg["worker_id"])
             elif mtype == "spawn_worker":
                 self._spawn_worker(msg["worker_id"], tpu=bool(msg.get("tpu")))
             elif mtype == "pull_object":
@@ -321,6 +356,13 @@ class NodeAgent:
             if env.get("JAX_PLATFORMS", "").lower() in ("", "axon", "tpu"):
                 env["JAX_PLATFORMS"] = "cpu"
         log_path = os.path.join(self.session_dir, f"worker-{worker_id}.log")
+        fs = getattr(self, "_forkserver", None)
+        if not tpu and fs is not None and fs.ready:
+            try:
+                self._worker_procs[worker_id] = fs.spawn(worker_id, env, log_path)
+                return
+            except Exception:  # noqa: BLE001 — template died; spawn cold
+                traceback.print_exc()
         log_f = open(log_path, "ab")
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.core.worker_main"],

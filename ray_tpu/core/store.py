@@ -526,16 +526,21 @@ class ArenaStore:
                 yield src
             return
         hex_id = name[len(ARENA_PREFIX):]
-        loc = self.arena.locate(hex_id)
-        if loc is None:
-            raise FileNotFoundError(f"object {hex_id} not in arena")
-        offset, size = loc
+        # fd first: once locate() pins the object, every exit path must
+        # reach the release() below (an os.open failure between the two
+        # would leak the pin and block eviction of that span forever).
         fd = os.open(f"/dev/shm/{self.arena.name.lstrip('/')}", os.O_RDONLY)
         try:
-            yield fd, offset, size
+            loc = self.arena.locate(hex_id)
+            if loc is None:
+                raise FileNotFoundError(f"object {hex_id} not in arena")
+            offset, size = loc
+            try:
+                yield fd, offset, size
+            finally:
+                self.arena.release(hex_id)
         finally:
             os.close(fd)
-            self.arena.release(hex_id)
 
     @contextlib.contextmanager
     def bulk_map_source(self, name: str):

@@ -158,6 +158,14 @@ class WorkerProcess:
                     {"type": "execute_task", "spec": msg["spec"],
                      "deps": None, "direct_conn": conn}
                 )
+            elif t == "agent_task":
+                # LocalDispatcher push (local_dispatch.py): CLASSIC result
+                # semantics (task_done → controller) — only the done PING
+                # returns on this conn so the agent can dispatch the next.
+                self.task_queue.put(
+                    {"type": "execute_task", "spec": msg["spec"],
+                     "deps": msg.get("deps") or {}, "agent_conn": conn}
+                )
             elif t == "direct_actor_task":
                 self.task_queue.put(
                     {"type": "execute_actor_task", "c": msg["c"],
@@ -733,6 +741,14 @@ class WorkerProcess:
             self._execute(spec, deps, is_actor_method=False, reply=reply)
             with self._task_lock:
                 self._done_hexes.append(spec.task_id.hex())
+            agent_conn = msg.get("agent_conn")
+            if agent_conn is not None:
+                try:
+                    agent_conn.post(
+                        {"type": "agent_task_done", "task": spec.task_id.hex()}
+                    )
+                except ConnectionError:
+                    pass  # agent gone; controller owns the result anyway
             if direct_conn is not None:
                 self._task_events.append(
                     {"ts": time.time(), "event": "task_done",

@@ -55,7 +55,10 @@ class GPTConfig:
     dtype: Any = jnp.bfloat16
     attn_impl: str = "flash"         # flash | ring | ulysses | ref
     remat: bool = True
-    remat_policy: Optional[str] = None  # None (save nothing) | "dots"
+    # None (save nothing) | "dots" | "attn" (save flash attention's out+lse
+    # so backward never re-runs the VPU-bound forward kernel — the costliest
+    # recompute per the r4 profile; +~32 MB/layer at B=12,S=1024).
+    remat_policy: Optional[str] = None
     sp_axis: str = "sp"
 
     @property
@@ -283,6 +286,14 @@ def _attention(cfg: GPTConfig, q, k, v, mesh=None):
         return fn(q, k, v)
     if cfg.attn_impl == "ref":
         return attention_reference(q, k, v, causal=True)
+    if cfg.remat and cfg.remat_policy == "attn":
+        from ..ops.attention import flash_attention_with_stats
+
+        # The stats variant's vjp names its residuals ("attn_out"/"attn_lse")
+        # so the "attn" remat policy saves them instead of re-running the
+        # forward kernel; lse exists only for that purpose.
+        o, _ = flash_attention_with_stats(q, k, v, causal=True)
+        return o
     return flash_attention(q, k, v, causal=True)
 
 
@@ -400,8 +411,12 @@ def forward(params, tokens, cfg: GPTConfig, positions=None, mesh=None, return_au
 
 
 def _remat_policy(cfg: GPTConfig):
-    if cfg.remat_policy not in (None, "dots"):
+    if cfg.remat_policy not in (None, "dots", "attn"):
         raise ValueError(f"unknown remat_policy: {cfg.remat_policy!r}")
+    if cfg.remat_policy == "attn":
+        return jax.checkpoint_policies.save_only_these_names(
+            "attn_out", "attn_lse"
+        )
     return (
         jax.checkpoint_policies.dots_with_no_batch_dims_saveable
         if cfg.remat_policy == "dots"

@@ -98,16 +98,18 @@ def _flash_fwd_kernel(
     qi = pl.program_id(1)
     j = pl.program_id(2)
     nk = pl.num_programs(2)
+    nk_static = nk if isinstance(nk, int) else 0  # grid is static in practice
     block_q = q_ref.shape[1]
     # When S != Skv (decode over a cached prefix) queries are END-aligned
     # with keys, matching attention_reference's (Skv - S) offset.
     row_offset = seq_kv - seq_q
 
-    @pl.when(j == 0)
-    def _init():
-        acc_ref[...] = jnp.zeros_like(acc_ref)
-        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
-        l_ref[...] = jnp.zeros_like(l_ref)
+    if nk_static != 1:
+        @pl.when(j == 0)
+        def _init():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+            m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+            l_ref[...] = jnp.zeros_like(l_ref)
 
     block_k_pad = k_ref.shape[1]
     # Masking is pure VPU cost (2 iotas + 2 compares + where per element) and
@@ -155,6 +157,36 @@ def _flash_fwd_kernel(
         on_edge = jnp.logical_and(kv_ragged, j == last_kv_block) if kv_ragged else False
         in_range = True
 
+    def _masked_logits():
+        s = _logits()
+        cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        valid = cols < seq_kv  # mask the zero-padded tail
+        if causal:
+            rows = (
+                row_offset + qi * block_q
+                + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            )
+            valid = jnp.logical_and(valid, rows >= cols)
+        return jnp.where(valid, s, _NEG_INF)
+
+    if nk_static == 1:
+        # Whole K/V fits one grid step (short sequences): skip the online-
+        # softmax scratch round-trips entirely — plain softmax in registers.
+        s = _masked_logits() if (causal or kv_ragged) else _logits()
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        acc = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        if lse_ref is not None:
+            lse_ref[0, 0, pl.ds(qi * block_q, block_q)] = (
+                m + jnp.log(jnp.maximum(l, 1e-30))
+            )[:, 0]
+        return
+
     if causal or kv_ragged:
         @pl.when(jnp.logical_and(in_range, jnp.logical_not(on_edge)))
         def _fast():
@@ -162,16 +194,7 @@ def _flash_fwd_kernel(
 
         @pl.when(jnp.logical_and(in_range, on_edge))
         def _masked():
-            s = _logits()
-            cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            valid = cols < seq_kv  # mask the zero-padded tail
-            if causal:
-                rows = (
-                    row_offset + qi * block_q
-                    + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-                )
-                valid = jnp.logical_and(valid, rows >= cols)
-            _softmax_update(jnp.where(valid, s, _NEG_INF), v_ref[0])
+            _softmax_update(_masked_logits(), v_ref[0])
     else:
         _softmax_update(_logits(), v_ref[0])
 
@@ -539,19 +562,104 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_stats(q, k, v, causal, sm_scale, block_q, block_k):
+    return _flash_fwd_pallas(
+        q, k, v, causal, sm_scale, block_q, block_k, return_lse=True
+    )
+
+
+def _flash_stats_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    from jax.ad_checkpoint import checkpoint_name
+
+    out, lse = _flash_fwd_pallas(
+        q, k, v, causal, sm_scale, block_q, block_k, return_lse=True
+    )
+    # Name the values HERE so the residual vars themselves carry the names:
+    # under jax.checkpoint with save_only_these_names("attn_out","attn_lse")
+    # the saved copies satisfy both the downstream primal use and the
+    # backward's residual needs, and the rematerialized forward's pallas
+    # call DCEs away — attention forward runs exactly once per step.
+    out = checkpoint_name(out, "attn_out")
+    lse = checkpoint_name(lse, "attn_lse")
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_stats_bwd(causal, sm_scale, block_q, block_k, res, g):
+    q, k, v, o, lse = res
+    g_o, _ = g  # lse cotangent is structurally zero (stats are not a loss path)
+    return _flash_bwd_pallas(q, k, v, o, lse, g_o, causal, sm_scale, block_q, block_k)
+
+
+_flash_stats.defvjp(_flash_stats_fwd, _flash_stats_bwd)
+
+
+def flash_attention_with_stats(
+    q,
+    k,
+    v,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
+):
+    """`flash_attention` that also returns the per-row logsumexp.
+
+    Exists for remat integration: the VPU-bound forward kernel is the most
+    expensive recompute in a rematerialized transformer block, and saving
+    (out, lse) — named inside the vjp forward rule — lets a
+    `save_only_these_names` policy skip exactly that rerun
+    (models/gpt.py `remat_policy="attn"`).
+
+    The returned lse is STOP-GRADIENTED on every backend: the flash
+    backward implements only d(out); declaring lse non-differentiable here
+    keeps TPU and the off-TPU reference path consistent instead of silently
+    dropping a cotangent on one of them. Use it for logging/remat, not as
+    a loss term."""
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    if not _on_tpu():
+        out = attention_reference(q, k, v, causal, scale)
+        *_, S, D = q.shape
+        Skv = k.shape[-2]
+        logits = jnp.einsum(
+            "bhsd,bhtd->bhst", q, k, preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            qpos = jnp.arange(S)[:, None] + (Skv - S)
+            logits = jnp.where(qpos >= jnp.arange(Skv)[None, :], logits, _NEG_INF)
+        B, H = q.shape[0], q.shape[1]
+        lse = jax.nn.logsumexp(logits, axis=-1).reshape(B * H, 1, S)
+        return out, jax.lax.stop_gradient(lse)
+    if block_q is None:
+        block_q = 1024
+    if block_k is None:
+        block_k = 1024
+    out, lse = _flash_stats(q, k, v, causal, scale, block_q, block_k)
+    return out, jax.lax.stop_gradient(lse)
+
+
 def flash_attention(
     q,
     k,
     v,
     causal: bool = True,
     sm_scale: Optional[float] = None,
-    block_q: int = 512,
-    block_k: int = 512,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
 ):
-    """Blockwise attention. Pallas on TPU; XLA reference elsewhere."""
+    """Blockwise attention. Pallas on TPU; XLA reference elsewhere.
+
+    Default blocks (1024, 1024) come from the v5e sweeps in
+    scripts/bench_flash.py and the per-kernel runs at gpt2-large shape:
+    53/49 TFLOP/s fwd+bwd at 8k/16k (25-27% of peak), and at S=1024 the
+    single-KV-block forward runs 2x faster than block_k=512."""
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
     if not _on_tpu():
         return attention_reference(q, k, v, causal, scale)
+    if block_q is None:
+        block_q = 1024
+    if block_k is None:
+        block_k = 1024
     return _flash(q, k, v, causal, scale, block_q, block_k)
 
 

@@ -77,38 +77,18 @@ def bench_xl():
 
 
 def bench_long_seq_attention(seq: int):
-    import jax
-    import jax.numpy as jnp
+    # Chained-fori_loop protocol (see scripts/bench_flash.py docstring):
+    # per-dispatch timing under the axon tunnel measures RTT, not device
+    # time — round 3's numbers from the old loop here were unreliable.
+    from scripts.bench_flash import bench_flash_grad
 
-    from ray_tpu.ops.attention import flash_attention
-
-    B, H, D = 1, 16, 64
-
-    def fwd_loss(q, k, v):
-        return flash_attention(q, k, v, causal=True).astype(jnp.float32).sum()
-
-    grad = jax.jit(jax.grad(fwd_loss, argnums=(0, 1, 2)))
-    key = jax.random.PRNGKey(0)
-    shape = (B, H, seq, D)  # flash_attention layout: [B, H, S, D]
-    q = jax.random.normal(key, shape, jnp.bfloat16)
-    k = jax.random.normal(key, shape, jnp.bfloat16)
-    v = jax.random.normal(key, shape, jnp.bfloat16)
-    out = grad(q, k, v)
-    jax.block_until_ready(out)
-    n = 6
-    t0 = time.perf_counter()
-    for _ in range(n):
-        out = grad(q, k, v)
-    jax.block_until_ready(out)
-    dt = (time.perf_counter() - t0) / n
-    # Causal attention fwd+bwd ≈ 3.5 × (4 · B·H·S²·D / 2) MACs→FLOPs.
-    flops = 3.5 * 4 * B * H * seq * seq * D / 2
+    ms, tf, pct = bench_flash_grad(seq, 1024, 1024)
     report(
         metric=f"flash_attention_s{seq}_fwd_bwd",
-        value=round(flops / dt / 1e12, 2), unit="TFLOP/s",
-        extra={"seq": seq, "heads": H, "d_head": D,
-               "ms": round(dt * 1000, 2),
-               "pct_peak": round(100 * flops / dt / peak_flops_per_chip(), 1)},
+        value=round(tf, 2), unit="TFLOP/s",
+        extra={"seq": seq, "heads": 16, "d_head": 64,
+               "ms": round(ms, 2), "pct_peak": round(pct, 1),
+               "block_q": 1024, "block_k": 1024},
     )
 
 
@@ -119,8 +99,12 @@ def bench_long_ctx_train():
 
     from ray_tpu.models import gpt2_large, init_params, make_train_step
 
+    # remat_policy="attn" saves flash's (out, lse) so backward skips the
+    # VPU-bound forward rerun — at 4k attention dominates, worth ~14% MFU
+    # (0.408 -> 0.465 measured r4); fits comfortably at B=2.
     B, S = 2, 4096
-    cfg = gpt2_large(max_seq=S, attn_impl="flash", remat=True)
+    cfg = gpt2_large(max_seq=S, attn_impl="flash", remat=True,
+                     remat_policy="attn")
     params = jax.jit(lambda key: init_params(key, cfg))(jax.random.PRNGKey(0))
     opt = optax.adamw(3e-4, weight_decay=0.1)
     opt_state = jax.jit(opt.init)(params)
@@ -210,10 +194,10 @@ def main():
     _check_device_reachable()
     bench_xl()
     bench_long_ctx_train()
-    # Single-chip flash attention tops out at 8k: the kernel holds K/V for
-    # the whole (padded) sequence in VMEM per q-block — 16k crosses the 16M
-    # scoped-vmem limit. Longer contexts are SP's job (ring probe below).
+    # The r4 streamed-KV kernel holds O(block) in VMEM, so single-chip
+    # full attention runs at 16k+ (the r3 whole-KV layout capped at 8k).
     bench_long_seq_attention(8192)
+    bench_long_seq_attention(16384)
     bench_ring_16k_functional()
 
 

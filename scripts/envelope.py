@@ -33,10 +33,11 @@ def main():
     import ray_tpu
 
     big = "--big" in sys.argv
-    ray_tpu.init(num_cpus=8, object_store_memory=4 << 30)
+    GIB = 16 if big else 1  # large-object probe size (ref: 100 GiB+)
+    ray_tpu.init(num_cpus=8, object_store_memory=(GIB + 4) << 30)
 
     # ---- many queued tasks on one node (ref: 1,000,000+ queued) ----
-    N_QUEUE = 100_000 if big else 10_000
+    N_QUEUE = 500_000 if big else 10_000
 
     @ray_tpu.remote
     def nop(i):
@@ -47,9 +48,11 @@ def main():
     submit_s = time.perf_counter() - t0
     report("tasks_queued", N_QUEUE, "tasks", {"submit_s": round(submit_s, 2)})
     t0 = time.perf_counter()
-    out = ray_tpu.get(refs, timeout=3600)
+    out = ray_tpu.get(refs, timeout=7200)
+    drain_s = time.perf_counter() - t0
     assert out[-1] == N_QUEUE - 1
-    report("queued_tasks_drained_s", round(time.perf_counter() - t0, 1), "s")
+    report("queued_tasks_drained_s", round(drain_s, 1), "s",
+           {"tasks_per_s": round(N_QUEUE / max(drain_s, submit_s), 1)})
 
     # ---- many actors (ref: 40,000+ cluster-wide) ----
     N_ACTORS = 2000 if big else 200
@@ -80,7 +83,6 @@ def main():
         remove_placement_group(pg)
 
     # ---- large object put/get (ref: 100 GiB+; scaled) ----
-    GIB = (8 if big else 1)
     arr = np.ones((GIB << 27,), np.float64)  # GIB GiB
     t0 = time.perf_counter()
     ref = ray_tpu.put(arr)
@@ -138,8 +140,10 @@ def main():
     dt = time.perf_counter() - t0
     assert got == 2.0
     report("cross_node_object_pull", xfer_gib, "GiB",
-           {"seconds": round(dt, 2), "gib_per_s": round(xfer_gib / dt, 2)})
+           {"seconds": round(dt, 2), "gib_per_s": round(xfer_gib / dt, 2),
+            "plane": "bulk+same-host-map"})
     del ref
+
 
     bref = produce.remote(1)
     ray_tpu.wait([bref], num_returns=1, timeout=600)
@@ -158,6 +162,45 @@ def main():
             "aggregate_gib_per_s": round(bcast_nodes / dt, 2)})
     ray_tpu.shutdown()
     cluster.shutdown()
+
+    # ---- TCP-forced cross-node pull (fresh cluster, map handover off) ----
+    # Measures the sendfile/recv_into socket path that real cross-MACHINE
+    # pulls take; the same-host map handover above is the intra-host plane
+    # (plasma fd-passing analog) and does not exist between machines.
+    os.environ["RAY_TPU_BULK_SAME_HOST_MAP"] = "0"
+    from ray_tpu.core import config as rt_config
+
+    rt_config._reset_cache_for_tests()
+    try:
+        cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+        for i in range(2):
+            cluster.add_node(
+                num_cpus=2, resources={f"w{i + 1}": 1},
+                object_store_memory=store_bytes,
+            )
+        ray_tpu.init(address=cluster.address)
+
+        @ray_tpu.remote(resources={"w1": 1})
+        def produce_tcp(gib):
+            return np.ones((gib << 27,), np.float64)
+
+        @ray_tpu.remote(resources={"w2": 1})
+        def reduce_tcp(a):
+            return float(a[0]) + float(a[-1])
+
+        ref = produce_tcp.remote(xfer_gib)
+        ray_tpu.wait([ref], num_returns=1, timeout=600)
+        t0 = time.perf_counter()
+        assert ray_tpu.get(reduce_tcp.remote(ref), timeout=3600) == 2.0
+        dt = time.perf_counter() - t0
+        report("cross_node_object_pull_tcp", xfer_gib, "GiB",
+               {"seconds": round(dt, 2),
+                "gib_per_s": round(xfer_gib / dt, 2), "plane": "bulk-tcp"})
+        ray_tpu.shutdown()
+        cluster.shutdown()
+    finally:
+        del os.environ["RAY_TPU_BULK_SAME_HOST_MAP"]
+        rt_config._reset_cache_for_tests()
 
 
 if __name__ == "__main__":

@@ -20,16 +20,25 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 
-def timeit(name: str, fn, n: int, unit: str = "ops/s", warmups: int = 1):
+def timeit(name: str, fn, n: int, unit: str = "ops/s", warmups: int = 1,
+           rounds: int = 3):
+    """Pinned protocol (scripts/bench_protocol.md): warmups to steady state,
+    then MEDIAN of `rounds` measured rounds, spread reported alongside —
+    a single-round number on this 1-vCPU box swings up to 40%."""
     for _ in range(warmups):  # steady state: pool growth + lease warmup
         fn()
-    t0 = time.perf_counter()
-    fn()
-    dt = time.perf_counter() - t0
-    value = n / dt
+    values = []
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        fn()
+        values.append(n / (time.perf_counter() - t0))
+    values.sort()
+    value = values[len(values) // 2]
+    spread = (values[-1] - values[0]) / value if value else 0.0
     print(
         json.dumps(
-            {"perf_metric_name": name, "value": round(value, 1), "unit": unit}
+            {"perf_metric_name": name, "value": round(value, 1), "unit": unit,
+             "spread_pct": round(100 * spread, 1), "rounds": rounds}
         ),
         flush=True,
     )

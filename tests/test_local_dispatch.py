@@ -1,0 +1,147 @@
+"""Two-level scheduling: controller hands backlog to node agents'
+LocalDispatchers (reference: ClusterTaskManager node pick +
+LocalTaskManager local queue/grant — `scheduling/cluster_task_manager.h:42`,
+`local_task_manager.cc:1`)."""
+
+import os
+import signal
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.core import config as rt_config
+
+pytestmark = pytest.mark.cluster
+
+
+@pytest.fixture
+def dispatch_cluster():
+    ray_tpu.shutdown()
+    rt_config._reset_cache_for_tests()
+    # Head contributes no CPUs: every plain task must land on the agent node.
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 0})
+    cluster.add_node(num_cpus=2, resources={"worker1": 1})
+    ray_tpu.init(address=cluster.address)
+    try:
+        yield cluster
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+        rt_config._reset_cache_for_tests()
+
+
+def test_backlog_flows_through_agent(dispatch_cluster):
+    """More tasks than workers: the overflow rides the handoff plane and
+    every result still resolves through the classic object path."""
+
+    @ray_tpu.remote
+    def bump(x):
+        return x + 1
+
+    refs = [bump.remote(i) for i in range(40)]
+    assert ray_tpu.get(refs, timeout=180) == [i + 1 for i in range(40)]
+
+
+def test_dispatch_continues_while_head_stalled(dispatch_cluster, tmp_path):
+    """The VERDICT r3 item-4 bar: with the controller SIGSTOPped, the agent
+    keeps dispatching queued tasks to local workers. Tasks drop marker
+    files so progress is observable without the (stalled) driver API."""
+    marker_dir = str(tmp_path)
+
+    @ray_tpu.remote
+    def slow_mark(i, d):
+        import os
+        import time as _t
+
+        _t.sleep(0.5)
+        open(os.path.join(d, f"done-{i}"), "w").close()
+        return i
+
+    # 12 tasks on 2 workers: ~2 execute at a time, the rest queue at the
+    # agent (head has no CPUs; handoff engages for the whole backlog).
+    refs = [slow_mark.remote(i, marker_dir) for i in range(12)]
+    # Wait until the first completions prove dispatch started.
+    deadline = time.monotonic() + 60
+    while len(os.listdir(marker_dir)) < 2 and time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert len(os.listdir(marker_dir)) >= 2
+
+    controller_pid = dispatch_cluster.head_proc.pid
+    os.kill(controller_pid, signal.SIGSTOP)
+    try:
+        before = len(os.listdir(marker_dir))
+        deadline = time.monotonic() + 30
+        # Progress bar: at least 4 MORE tasks must start+finish while the
+        # head is frozen — impossible unless dispatch is agent-local.
+        while (
+            len(os.listdir(marker_dir)) < before + 4
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.2)
+        progressed = len(os.listdir(marker_dir)) - before
+    finally:
+        os.kill(controller_pid, signal.SIGCONT)
+    assert progressed >= 4, (
+        f"only {progressed} tasks dispatched during the head stall"
+    )
+    # After the thaw, everything resolves.
+    assert sorted(ray_tpu.get(refs, timeout=180)) == list(range(12))
+
+
+def test_agent_worker_death_retries(dispatch_cluster):
+    """A worker dying mid-agent-task consumes a retry and the task
+    completes on another worker."""
+
+    @ray_tpu.remote(max_retries=2)
+    def die_once(path):
+        import os
+
+        if not os.path.exists(path):
+            open(path, "w").close()
+            os._exit(1)
+        return "survived"
+
+    import tempfile
+
+    path = os.path.join(tempfile.mkdtemp(), "died-once")
+    # Saturate the two workers so this task rides the handoff plane.
+    @ray_tpu.remote
+    def filler():
+        time.sleep(1.0)
+
+    fillers = [filler.remote() for _ in range(4)]
+    ref = die_once.remote(path)
+    assert ray_tpu.get(ref, timeout=180) == "survived"
+    ray_tpu.get(fillers, timeout=60)
+
+
+def test_spillback_when_node_cannot_serve():
+    """Tasks handed to a node whose dispatcher can obtain no lease spill
+    back and run elsewhere (here: the head)."""
+    ray_tpu.shutdown()
+    rt_config._reset_cache_for_tests()
+    os.environ["RAY_TPU_LOCAL_DISPATCH_SPILL_S"] = "2.0"
+    rt_config._reset_cache_for_tests()
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    # Node advertises CPUs for placement but a TPU-only demand shape the
+    # lease plane cannot satisfy would be artificial; instead exercise the
+    # spill path by killing the node's workers' source: zero-CPU node.
+    cluster.add_node(num_cpus=0, resources={"worker1": 1})
+    ray_tpu.init(address=cluster.address)
+    try:
+        @ray_tpu.remote
+        def f():
+            return "ok"
+
+        # Plain tasks: head serves them; the zero-CPU agent can never get a
+        # lease, so anything handed there must come home. Saturation pushes
+        # some tasks through the handoff path.
+        refs = [f.remote() for _ in range(30)]
+        assert ray_tpu.get(refs, timeout=180) == ["ok"] * 30
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+        del os.environ["RAY_TPU_LOCAL_DISPATCH_SPILL_S"]
+        rt_config._reset_cache_for_tests()

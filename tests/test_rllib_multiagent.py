@@ -1,0 +1,101 @@
+"""Multi-agent TRAINING (policy mapping → per-policy batches → N modules
+updated). Reference analog: `rllib/policy/policy_map.py:1` +
+`rllib/env/multi_agent_env.py:1`. VERDICT r3 item 5's bar: a learning-gated
+two-policy run where BOTH policies clear a reward threshold."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib.algorithms.multi_agent_ppo import MultiAgentPPOConfig
+from ray_tpu.rllib.env.ma_runner import MultiAgentEnvRunner
+from ray_tpu.rllib.env.multi_agent import make_multi_agent
+from ray_tpu.rllib.env import make_env
+
+
+def _ma_cartpole(num_agents=2):
+    ctor = make_multi_agent(
+        lambda n, **kw: make_env("CartPole-v1", n, **kw), num_agents
+    )
+    return ctor
+
+
+def test_runner_splits_batches_per_policy():
+    cfg = MultiAgentPPOConfig()
+    ctor = _ma_cartpole(3)
+    probe = ctor()
+    from ray_tpu.rllib.core.rl_module import DiscretePolicyModule
+
+    obs_dim = int(np.prod(probe.observation_space.shape))
+    mods = {
+        "even": DiscretePolicyModule(obs_dim, probe.action_space.n, (16,)),
+        "odd": DiscretePolicyModule(obs_dim, probe.action_space.n, (16,)),
+    }
+    runner = MultiAgentEnvRunner(
+        make_env=ctor,
+        modules=mods,
+        policy_mapping_fn=lambda a: "even" if int(a[-1]) % 2 == 0 else "odd",
+        num_instances=2,
+        rollout_len=8,
+        seed=0,
+    )
+    params = {pid: m.init(__import__("jax").random.PRNGKey(0))
+              for pid, m in mods.items()}
+    out = runner.sample(params)
+    stats = out.pop("__stats__")
+    assert set(out) == {"even", "odd"}
+    # 3 agents: agent_0/agent_2 -> even (2 slots/instance), agent_1 -> odd.
+    assert out["even"]["obs"].shape[:2] == (8, 4)
+    assert out["odd"]["obs"].shape[:2] == (8, 2)
+    for b in out.values():
+        for key in ("obs", "actions", "logp", "values", "rewards", "dones"):
+            assert np.isfinite(np.asarray(b[key])).all(), key
+    assert "policy_episode_returns" in stats
+
+
+def test_two_policy_cartpole_both_learn():
+    """Two independent policies, one per CartPole agent — both must clear
+    the bar (reference stop criterion style: tuned_examples cartpole)."""
+    cfg = (
+        MultiAgentPPOConfig()
+        .environment(ma_env_maker=_ma_cartpole(2))
+        .training(train_batch_size=1024, minibatch_size=128, lr=3e-4,
+                  num_epochs=6, entropy_coeff=0.01)
+        .debugging(seed=0)
+        .multi_agent(
+            policies=["p0", "p1"],
+            policy_mapping_fn=lambda a: "p0" if a == "agent_0" else "p1",
+        )
+    )
+    cfg.num_instances = 8
+    cfg.num_envs_per_env_runner = 8
+    algo = cfg.build()
+    bar = 120.0
+    best = {"p0": -np.inf, "p1": -np.inf}
+    for _ in range(120):
+        result = algo.train()
+        for pid, m in result["policy_reward_mean"].items():
+            if np.isfinite(m):
+                best[pid] = max(best[pid], m)
+        if all(v >= bar for v in best.values()):
+            break
+    assert all(v >= bar for v in best.values()), (
+        f"multi-agent PPO failed the two-policy bar: {best}"
+    )
+
+
+def test_self_play_weight_sharing():
+    """shared_policy=True: every agent maps to ONE policy/parameter set."""
+    cfg = (
+        MultiAgentPPOConfig()
+        .environment(ma_env_maker=_ma_cartpole(2))
+        .training(train_batch_size=512, minibatch_size=128)
+        .debugging(seed=0)
+        .multi_agent(shared_policy=True)
+    )
+    cfg.num_instances = 4
+    algo = cfg.build()
+    assert list(algo.modules) == ["shared"]
+    result = algo.train()
+    assert np.isfinite(result["info"]["learner"]["shared"]["total_loss"])
+    # Both agents ride the same batch: slots = instances × 2 agents.
+    assert algo._runner.slots["shared"] == ["agent_0", "agent_1"]
