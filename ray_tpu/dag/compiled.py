@@ -10,12 +10,20 @@ for a single mesh lives in `ray_tpu.parallel.pipeline`).
 
 from __future__ import annotations
 
+import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
 
 from ..experimental.channel import Channel, ChannelClosed
+from ..experimental.tcp_channel import TcpChannel
 from . import ActorMethodNode, ClassNode, DAGNode, InputNode, MultiOutputNode
+
+
+def _advertise_host() -> str:
+    from ..core import config
+
+    return config.get("node_ip") or "127.0.0.1"
 
 
 class _StageHost:
@@ -33,6 +41,30 @@ class _StageHost:
 
     def ping(self) -> str:
         return "ok"
+
+    def node_id(self) -> str:
+        from ..core.runtime_context import get_runtime_context
+
+        return get_runtime_context().get_node_id()
+
+    def bind_tcp_channel(self, name: str, num_readers: int) -> Tuple[str, int]:
+        """Bind the writer end of a cross-host edge in this process and
+        return the address readers should dial (reference analog: the
+        producer registers the channel with its local raylet,
+        `python/ray/experimental/channel.py:49`)."""
+        ch = TcpChannel.bind(name, num_readers, advertise_host=_advertise_host())
+        return ch.addr
+
+    def create_shm_channel(self, buffer_size: int, num_readers: int) -> str:
+        """Create a shm channel ON THIS NODE for an edge whose producer and
+        consumers all live here but the driver doesn't — the driver can't
+        create the segment remotely, so it asks the producer to (and keeps
+        only a no-mapping descriptor)."""
+        ch = Channel(buffer_size, num_readers=num_readers)
+        if not hasattr(self, "_owned_channels"):
+            self._owned_channels = []
+        self._owned_channels.append(ch)  # keep tracker registration alive
+        return ch.name
 
     def run_loop(self, stages: List[Tuple[str, List[Tuple[str, Any]], Channel]]) -> int:
         """One loop task per actor, executing ALL of this actor's stages in
@@ -136,25 +168,12 @@ class CompiledDAG:
         for pid in driver_reads:
             num_readers.setdefault(pid, 1)
 
-        # One channel per producing node; one for the DAG input.
-        self._input_channel: Optional[Channel] = (
-            Channel(self._buffer_size, num_readers=len(input_consumer_stages))
-            if input_consumer_stages
-            else None
-        )
-        self._channels: Dict[int, Channel] = {
-            id(node): Channel(self._buffer_size, num_readers=num_readers[id(node)])
-            for node in order
-            if id(node) in num_readers
-        }
-        self._all_channels = list(self._channels.values()) + (
-            [self._input_channel] if self._input_channel else []
-        )
-        self._next_slot: Dict[str, int] = {}  # channel name -> next reader slot
-
-        # Create one _StageHost per distinct ClassNode.
+        # Create one _StageHost per distinct ClassNode, carrying the user's
+        # actor options (resources / scheduling strategy) so stages land
+        # where the DAG author placed them.
         self._ray = ray_tpu
-        StageActor = ray_tpu.remote(_StageHost)
+        from ..core.actor import ActorClass
+
         self._actors: Dict[int, Any] = {}
         for node in order:
             cn: ClassNode = node._target
@@ -165,11 +184,78 @@ class CompiledDAG:
                     raise ValueError(
                         "Compiled DAG actor constructors take constants only"
                     )
+                StageActor = ActorClass(_StageHost, cn._actor_cls._default_options)
                 self._actors[id(cn)] = StageActor.remote(
                     cloudpickle.dumps(cn._actor_cls.cls),
                     cloudpickle.dumps((cn._bound_args, cn._bound_kwargs)),
                 )
         ray_tpu.get([a.ping.remote() for a in self._actors.values()])
+
+        # Channel type is chosen per edge: shm seqlock when the producer and
+        # every consumer share a node, persistent TCP otherwise (the
+        # cross-host pipeline path — SURVEY §7 "compiled multi-host
+        # pipelines"; reference substrate `experimental/channel.py:49`).
+        from ..core.runtime_context import get_runtime_context
+
+        driver_node = get_runtime_context().get_node_id()
+        actor_nodes: Dict[int, str] = dict(
+            zip(
+                self._actors.keys(),
+                ray_tpu.get([a.node_id.remote() for a in self._actors.values()]),
+            )
+        )
+        stage_node = {id(n): actor_nodes[id(n._target)] for n in order}
+
+        from ..experimental.channel import RemoteShmChannel
+
+        def make_channel(producer_node, consumer_nodes, n_readers, bind_actor):
+            if all(c == producer_node for c in consumer_nodes):
+                if producer_node == driver_node or bind_actor is None:
+                    return Channel(self._buffer_size, num_readers=n_readers)
+                # Edge entirely on a remote node: the segment must be
+                # created THERE; the driver keeps a no-mapping descriptor.
+                name = ray_tpu.get(
+                    bind_actor.create_shm_channel.remote(
+                        self._buffer_size, n_readers
+                    )
+                )
+                return RemoteShmChannel(name, n_readers)
+            name = f"rtpuch-{uuid.uuid4().hex[:12]}"
+            if bind_actor is None:  # producer is the driver (input channel)
+                return TcpChannel.bind(
+                    name, n_readers, advertise_host=_advertise_host()
+                )
+            addr = ray_tpu.get(bind_actor.bind_tcp_channel.remote(name, n_readers))
+            return TcpChannel(name, tuple(addr), n_readers)
+
+        self._input_channel: Optional[Channel] = None
+        if input_consumer_stages:
+            in_consumer_nodes = [
+                stage_node[sid] for sid in input_consumer_stages
+            ]
+            self._input_channel = make_channel(
+                driver_node, in_consumer_nodes, len(input_consumer_stages), None
+            )
+        self._channels: Dict[int, Channel] = {}
+        for node in order:
+            pid = id(node)
+            if pid not in num_readers:
+                continue
+            consumer_nodes = [
+                stage_node[sid] for sid in consumer_stages.get(pid, ())
+            ]
+            if pid in driver_reads:
+                consumer_nodes.append(driver_node)
+            self._channels[pid] = make_channel(
+                stage_node[pid],
+                consumer_nodes,
+                num_readers[pid],
+                self._actors[id(node._target)],
+            )
+        self._all_channels = list(self._channels.values()) + (
+            [self._input_channel] if self._input_channel else []
+        )
+        self._next_slot: Dict[str, int] = {}  # channel name -> next reader slot
 
         # One exec-loop task per actor, covering all its stages in topo order.
         def take_slot(ch: Channel) -> Channel:

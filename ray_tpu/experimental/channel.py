@@ -226,6 +226,34 @@ def _attach_channel(name: str, num_readers: int, reader_slot: int) -> "Channel":
     )
 
 
+class RemoteShmChannel:
+    """Driver-side DESCRIPTOR for a shm channel that lives on another host
+    (both endpoints of the edge are there; the driver never touches the
+    bytes). Holds no mapping — it exists to be pickled into stage arg plans,
+    where it unpickles as a real attached `Channel`. The segment itself is
+    created by the producer actor (`_StageHost.create_shm_channel`) and
+    unlinked by that process's resource tracker at exit."""
+
+    def __init__(self, name: str, num_readers: int, reader_slot: int = 0):
+        self.name = name
+        self.num_readers = num_readers
+        self.reader_slot = reader_slot
+
+    def with_reader_slot(self, slot: int) -> "RemoteShmChannel":
+        if not 0 <= slot < self.num_readers:
+            raise ValueError(f"reader slot {slot} out of range [0, {self.num_readers})")
+        return RemoteShmChannel(self.name, self.num_readers, slot)
+
+    def close_writer(self):
+        pass  # stop sentinels for remote-interior edges ride actor teardown
+
+    def destroy(self):
+        pass  # owning process's resource tracker unlinks at exit
+
+    def __reduce__(self):
+        return (_attach_channel, (self.name, self.num_readers, self.reader_slot))
+
+
 def _attach_untracked(name: str) -> shared_memory.SharedMemory:
     from multiprocessing import resource_tracker
 

@@ -413,6 +413,14 @@ def forward(params, tokens, cfg: GPTConfig, positions=None, mesh=None, return_au
 def _remat_policy(cfg: GPTConfig):
     if cfg.remat_policy not in (None, "dots", "attn"):
         raise ValueError(f"unknown remat_policy: {cfg.remat_policy!r}")
+    if cfg.remat_policy == "attn" and cfg.attn_impl != "flash":
+        # Only the flash path checkpoint_name's (attn_out, attn_lse);
+        # elsewhere save_only_these_names would match nothing and silently
+        # rematerialize everything — fail loudly instead.
+        raise ValueError(
+            "remat_policy='attn' saves flash-attention residuals; it requires "
+            f"attn_impl='flash' (got {cfg.attn_impl!r})"
+        )
     if cfg.remat_policy == "attn":
         return jax.checkpoint_policies.save_only_these_names(
             "attn_out", "attn_lse"
@@ -505,6 +513,80 @@ def merge_stage_params(params, cfg: GPTConfig):
         else:
             out[k] = v
     return out
+
+
+def extract_stage_params(params, cfg: GPTConfig, stage: int, num_stages: int):
+    """The parameter subset stage `stage` of a cross-host pipeline actually
+    needs: its layer slice, plus embeddings on the first stage and the final
+    norm + LM head on the last. This is the per-host weight set for
+    compiled-DAG pipelines where each stage lives on its own host/mesh
+    (in-mesh GPipe keeps the full stacked params instead —
+    `split_stage_params`)."""
+    if cfg.n_layers % num_stages != 0:
+        raise ValueError(f"{cfg.n_layers} layers not divisible by {num_stages} stages")
+    per = cfg.n_layers // num_stages
+    out = {
+        k: v[stage * per : (stage + 1) * per]
+        for k, v in params.items()
+        if k in _LAYER_KEYS
+    }
+    first, last = stage == 0, stage == num_stages - 1
+    if first or (last and cfg.tie_embeddings):
+        out["tok_embed"] = params["tok_embed"]
+    if first and cfg.pos == "learned":
+        out["pos_embed"] = params["pos_embed"]
+    if last:
+        out["ln_f_w"] = params["ln_f_w"]
+        out["ln_f_b"] = params["ln_f_b"]
+        if not cfg.tie_embeddings:
+            out["lm_head"] = params["lm_head"]
+    return out
+
+
+def stage_forward(
+    stage_params, inp, cfg: GPTConfig, *, first: bool, last: bool,
+    positions=None, mesh=None,
+):
+    """One pipeline stage of `forward`: embed if `first`, this stage's layer
+    slice, final norm + head if `last`. `inp` is tokens [B, S] on the first
+    stage, activations [B, S, E] (cfg.dtype — what the compiled-DAG edge
+    ships between hosts) otherwise. Returns (output, moe_aux_sum)."""
+    if first:
+        _, S = inp.shape
+        if positions is None:
+            positions = jnp.arange(S) if mesh is not None else global_positions(cfg, S)
+        x = stage_params["tok_embed"][inp].astype(cfg.dtype)
+        if cfg.pos == "learned":
+            x = x + stage_params["pos_embed"][positions].astype(cfg.dtype)
+    else:
+        x = inp.astype(cfg.dtype)
+        S = x.shape[1]
+        if positions is None:
+            positions = jnp.arange(S) if mesh is not None else global_positions(cfg, S)
+
+    rope_tables = None
+    if cfg.pos == "rotary":
+        rd = min(cfg.rotary_dim, cfg.d_head)
+        rope_tables = rope_frequencies(rd, cfg.max_seq, dtype=jnp.float32)
+
+    layer_stack = {k: stage_params[k] for k in _LAYER_KEYS if k in stage_params}
+    block = functools.partial(_block, cfg, rope_tables, mesh)
+    if cfg.remat:
+        block = jax.checkpoint(block, policy=_remat_policy(cfg))
+
+    def scan_body(x, layer_params):
+        x, aux = block(x, layer_params, positions)
+        return x, aux
+
+    x, aux_stack = jax.lax.scan(scan_body, x, layer_stack)
+    if not last:
+        return x, aux_stack.sum()
+    x = _norm(x, stage_params["ln_f_w"], stage_params["ln_f_b"], cfg.norm)
+    head = (
+        stage_params["tok_embed"].T if cfg.tie_embeddings else stage_params["lm_head"]
+    )
+    logits = jnp.einsum("bse,ev->bsv", x, head.astype(cfg.dtype))
+    return logits, aux_stack.sum()
 
 
 def pipeline_stage_shardings(cfg: GPTConfig, mesh, rules: Optional[ShardingRules] = None):
