@@ -109,6 +109,21 @@ class KubeRayProvider(NodeProvider):
                 return g
         return None
 
+    def _groups_with(self, cr: dict, group: str, **changes) -> List[dict]:
+        """The COMPLETE workerGroupSpecs array with one group modified.
+        RFC 7386 merge-patch replaces arrays wholesale — patching a
+        one-element list would delete every other worker group and strip
+        the patched group's template, so every patch ships the full
+        read-modify-write array (the reference provider does the same:
+        `kuberay/node_provider.py` patches the whole workerGroupSpecs)."""
+        import copy
+
+        groups = copy.deepcopy(cr["spec"].get("workerGroupSpecs", []))
+        for g in groups:
+            if g["groupName"] == group:
+                g.update(copy.deepcopy(changes))
+        return groups
+
     # ------------------------------------------------------- NodeProvider
     def non_terminated_nodes(self, tag_filters: Dict[str, str]) -> List[str]:
         out = []
@@ -156,12 +171,9 @@ class KubeRayProvider(NodeProvider):
             )
         self._patch_cr({
             "spec": {
-                "workerGroupSpecs": [
-                    {
-                        "groupName": group,
-                        "replicas": int(spec.get("replicas", 0)) + count,
-                    }
-                ]
+                "workerGroupSpecs": self._groups_with(
+                    cr, group, replicas=int(spec.get("replicas", 0)) + count
+                )
             }
         })
         return []
@@ -179,18 +191,16 @@ class KubeRayProvider(NodeProvider):
             return
         self._patch_cr({
             "spec": {
-                "workerGroupSpecs": [
-                    {
-                        "groupName": group,
-                        "replicas": max(0, int(spec.get("replicas", 0)) - 1),
-                        "scaleStrategy": {
-                            "workersToDelete":
-                                spec.get("scaleStrategy", {}).get(
-                                    "workersToDelete", []
-                                ) + [node_id],
-                        },
-                    }
-                ]
+                "workerGroupSpecs": self._groups_with(
+                    cr, group,
+                    replicas=max(0, int(spec.get("replicas", 0)) - 1),
+                    scaleStrategy={
+                        "workersToDelete":
+                            spec.get("scaleStrategy", {}).get(
+                                "workersToDelete", []
+                            ) + [node_id],
+                    },
+                )
             }
         })
 
@@ -236,12 +246,22 @@ class InMemoryK8sAPI:
         return copy.deepcopy(self.cr)
 
     def _merge_patch(self, patch: dict):
-        for g_patch in patch.get("spec", {}).get("workerGroupSpecs", []):
-            spec = next(
-                g for g in self.cr["spec"]["workerGroupSpecs"]
-                if g["groupName"] == g_patch["groupName"]
-            )
-            spec.update({k: v for k, v in g_patch.items() if k != "groupName"})
+        """RFC 7386 semantics — dicts merge recursively, arrays and scalars
+        REPLACE wholesale, null deletes. Faithful to a real apiserver so the
+        provider can't pass tests with patches that would destroy sibling
+        worker groups in production."""
+        import copy
+
+        def merge(target: dict, p: dict):
+            for k, v in p.items():
+                if v is None:
+                    target.pop(k, None)
+                elif isinstance(v, dict) and isinstance(target.get(k), dict):
+                    merge(target[k], v)
+                else:
+                    target[k] = copy.deepcopy(v)
+
+        merge(self.cr, patch)
 
     # ---------------------------------------------------- operator double
     def _reconcile(self):

@@ -1906,6 +1906,10 @@ class Controller:
                 node is None or not node.alive or node.conn is None
                 or not node.dispatch
                 or node.handoff_inflight >= self._handoff_cap(node)
+                # The dispatcher executes on CPU:1 leases — a node that can
+                # never grant one (e.g. TPU-only, CPU:0) would strand even
+                # num_cpus=0 tasks in 10s spill-back bounces.
+                or node.total.get("CPU", 0) < 1
                 or not all(node.total.get(k, 0) >= v for k, v in demand.items())
             ):
                 continue
@@ -1920,10 +1924,15 @@ class Controller:
         best.handoff_inflight += 1
         self._event("task_handoff", task=task_hex, node=best.node_id)
         if not spec.arg_refs:
-            best.conn.post({
-                "type": "enqueue_task", "task": task_hex,
-                "spec": spec_to_proto_bytes(spec), "deps": {},
-            })
+            try:
+                best.conn.post({
+                    "type": "enqueue_task", "task": task_hex,
+                    "spec": spec_to_proto_bytes(spec), "deps": {},
+                })
+            except Exception:  # noqa: BLE001 — conn died before alive flipped
+                self.running.pop(task_hex, None)
+                best.handoff_inflight = max(0, best.handoff_inflight - 1)
+                return False
         else:
             asyncio.ensure_future(self._handoff_send(best, pt))
         return True
@@ -1938,13 +1947,27 @@ class Controller:
                 *(self._ensure_local(node.node_id, oid.hex())
                   for oid in spec.arg_refs)
             )
+            if task_hex in self.cancelled:
+                # ray.cancel() landed while deps were in flight: h_cancel's
+                # cancel_task post found nothing at the agent (the enqueue
+                # hadn't shipped), so suppress the enqueue here or the task
+                # would run uncancellably.
+                self.running.pop(task_hex, None)
+                node.handoff_inflight = max(0, node.handoff_inflight - 1)
+                self._finish_cancelled(pt)
+                self._schedule()
+                return
             node.conn.post({
                 "type": "enqueue_task", "task": task_hex,
                 "spec": spec_to_proto_bytes(spec),
                 "deps": self._deps_payload(spec, node.node_id),
             })
         except Exception as e:  # noqa: BLE001 — dep transfer / conn failure
-            self.running.pop(task_hex, None)
+            if self.running.pop(task_hex, None) is None:
+                # Ownership already taken (node death requeued/retried the
+                # task, or cancel finished it) — failing the returns here
+                # would poison a retry that may yet succeed.
+                return
             node.handoff_inflight = max(0, node.handoff_inflight - 1)
             lost = [
                 oid.hex()

@@ -131,6 +131,11 @@ class ForkServerClient:
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
         env["RAY_TPU_FORK_SOCK"] = self.sock_path
         env["RAY_TPU_FORK_PDEATHSIG"] = "1" if pdeathsig else "0"
+        # Forked workers must see the SAME cwd as cold-spawned ones (the
+        # spawner's), not the template's pkg_root — tasks with relative
+        # paths would otherwise behave differently depending on which spawn
+        # path won the readiness race.
+        env["RAY_TPU_FORK_CWD"] = os.getcwd()
         env["PYTHONUNBUFFERED"] = "1"
         # CPU pin — same dance as cold CPU-worker spawns: the template must
         # never touch the TPU plugin (workers that need it spawn cold).
@@ -206,6 +211,10 @@ def _child_exec(req: dict):
     os.close(fd)
     signal.signal(signal.SIGCHLD, signal.SIG_DFL)
     os.environ.update(req["env"])
+    try:
+        os.chdir(os.environ.get("RAY_TPU_FORK_CWD", os.getcwd()))
+    except OSError:
+        pass  # spawner's cwd vanished; keep the template's
     from . import worker_main
 
     worker_main.main()

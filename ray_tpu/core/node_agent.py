@@ -479,6 +479,13 @@ async def run_agent(args: dict):
         labels=args.get("labels"),
         node_ip=args.get("node_ip"),
     )
+    # Graceful stop on SIGTERM (cluster_utils.remove_node(allow_graceful=True),
+    # `kill <pid>` by an operator): run the serve_forever teardown — killing
+    # workers and unlinking this node's arena — instead of leaking the shm
+    # segment (reference: raylet's SIGTERM handler drains + shuts down
+    # plasma, `src/ray/raylet/main.cc` shutdown_raylet_gracefully).
+    loop = asyncio.get_running_loop()
+    loop.add_signal_handler(signal.SIGTERM, agent._shutdown.set)
     await agent.start()
     print(f"RAY_TPU_NODE_READY={agent.node_id}", flush=True)
     await agent.serve_forever()

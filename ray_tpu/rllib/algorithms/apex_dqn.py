@@ -97,8 +97,16 @@ class ApexDQN(DQN):
         # always-on sampling actors, collapsed to one outstanding round).
         w_ref = ray.put(self._weights)
         launched = [r.sample.remote(w_ref) for r in self._remote_runners]
-        sample_refs = self._inflight_samples or launched
-        self._inflight_samples = launched if self._inflight_samples else []
+        if self._inflight_samples:
+            sample_refs, self._inflight_samples = self._inflight_samples, launched
+        else:
+            # First round: consume what we just launched and prime the
+            # pipeline with a second in-flight round so every later step
+            # overlaps sampling with the learner update.
+            sample_refs = launched
+            self._inflight_samples = [
+                r.sample.remote(w_ref) for r in self._remote_runners
+            ]
 
         env_steps = 0
         push_acks = []
@@ -116,17 +124,25 @@ class ApexDQN(DQN):
             shard = self._shards[self._next_rr % len(self._shards)]
             self._next_rr += 1
             push_acks.append(shard.add_fragment.remote(b))
-        sizes = ray.get(push_acks)
+        ray.get(push_acks)
+        # Gate on ACTUAL shard occupancy, not this step's push acks: round-
+        # robin fills shards unevenly early on, and sampling an empty shard
+        # is a 0/0 priority normalization.
+        shard_sizes = ray.get([s.size.remote() for s in self._shards])
+        ready = [
+            s for s, sz in zip(self._shards, shard_sizes)
+            if sz >= cfg.minibatch_size
+        ]
 
         metrics: Dict = {"td_loss": float("nan"), "q_mean": float("nan")}
-        if sum(sizes) >= cfg.learning_starts and max(sizes) >= cfg.minibatch_size:
-            per_shard = max(1, cfg.num_grad_steps // len(self._shards))
+        if sum(shard_sizes) >= cfg.learning_starts and ready:
+            per_shard = max(1, cfg.num_grad_steps // len(ready))
             sample_out = ray.get([
                 s.sample.remote(per_shard, cfg.minibatch_size)
-                for s in self._shards
+                for s in ready
             ])
             prio_acks = []
-            for shard, (mbs, indices) in zip(self._shards, sample_out):
+            for shard, (mbs, indices) in zip(ready, sample_out):
                 metrics = self.learner_group.update(mbs)
                 self._weights = self.learner_group.get_weights()
                 # New priorities: |TD error| recomputed from the fresh net.

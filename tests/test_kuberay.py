@@ -141,3 +141,25 @@ def test_autoscaler_scales_tpu_group_up_and_down():
         autoscaler.update()
     assert provider.non_terminated_nodes({TAG_NODE_TYPE: "tpu-v5e-16"}) == []
     assert api.cr["spec"]["workerGroupSpecs"][1]["replicas"] == 0
+
+
+def test_patch_preserves_sibling_groups_and_template():
+    """RFC 7386 merge-patch replaces arrays wholesale — the provider must
+    ship the COMPLETE workerGroupSpecs on every patch or a real apiserver
+    would delete sibling groups and strip the patched group's fields (the
+    in-memory double now implements faithful RFC 7386 array replacement)."""
+    api, provider = _provider(hosts=2)
+    # Seed extra fields a real CR carries; they must survive patches.
+    api.cr["spec"]["workerGroupSpecs"][1]["template"] = {"spec": {"x": 1}}
+    provider.create_node({"group": "cpu-workers"}, {}, count=2)
+    groups = api.cr["spec"]["workerGroupSpecs"]
+    assert [g["groupName"] for g in groups] == ["cpu-workers", "tpu-v5e-16"]
+    assert groups[1]["template"] == {"spec": {"x": 1}}
+    assert groups[1]["numOfHosts"] == 2
+    # Terminate from the TPU group: the CPU group's replicas must survive.
+    provider.create_node({"group": "tpu-v5e-16"}, {}, count=1)
+    pod = provider.non_terminated_nodes({TAG_NODE_TYPE: "tpu-v5e-16"})[0]
+    provider.terminate_node(pod)
+    groups = api.cr["spec"]["workerGroupSpecs"]
+    assert groups[0]["replicas"] == 2
+    assert groups[1]["template"] == {"spec": {"x": 1}}
