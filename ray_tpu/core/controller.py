@@ -67,6 +67,16 @@ LEASED = "leased"
 HEAD_NODE = "node0"
 
 
+def _task_env_key(spec) -> str:
+    """Isolation hash of the task's runtime_env ("" = plain pool)."""
+    renv = spec.options.runtime_env
+    if not renv:
+        return ""
+    from ..runtime_env.isolation import isolation_key
+
+    return isolation_key(renv)
+
+
 @dataclass
 class WorkerState:
     worker_id: str
@@ -89,6 +99,9 @@ class WorkerState:
     blocked: bool = False
     node_id: str = HEAD_NODE
     has_tpu: bool = False
+    # Isolation hash (runtime_env conda/container — `isolation_key`): tasks
+    # only dispatch onto workers whose env_key matches; "" = plain pool.
+    env_key: str = ""
     # Direct task plane: the worker's own listener for submitter→worker
     # pushes (reference: core-worker gRPC server for PushNormalTask).
     direct_addr: str = ""
@@ -345,6 +358,15 @@ class Controller:
         self.timeline: List[dict] = []
         self.drivers: Set[Connection] = set()
         self._worker_counter = itertools.count()
+        # Isolated-worker bookkeeping (runtime_env conda/container):
+        # worker_id -> env_key applied at registration; (node, key) ->
+        # last spawn time (a monotonic gate so one isolated worker boots
+        # per key per node at a time, self-healing if the spawn dies).
+        self._worker_env_keys: Dict[str, str] = {}
+        self._iso_booting: Dict[Tuple[str, str], float] = {}
+        # (node_id, env_key) -> error: the isolation binary is missing on
+        # that node (sticky; a node gaining conda mid-session must rejoin).
+        self._iso_unavailable: Dict[Tuple[str, str], str] = {}
         self._max_workers = max(int(num_cpus) * rt_config.get("max_workers_per_cpu"), 8)
         self._min_workers = 2
         self._server: Optional[asyncio.base_events.Server] = None
@@ -666,6 +688,7 @@ class Controller:
         node: Optional[NodeState] = None,
         live_count: Optional[int] = None,
         force: bool = False,
+        isolation: Optional[dict] = None,
     ):
         """Spawn a worker on `node` (default head). Remote nodes spawn via
         their agent (reference: raylet `WorkerPool::StartWorkerProcess`).
@@ -704,15 +727,24 @@ class Controller:
                 live_count = sum(
                     1 for w in self.workers.values()
                     if w.state not in (DEAD, ACTOR) and w.node_id == node.node_id
+                    and not w.env_key  # isolated workers are outside the pool
                 )
             if not force and node.spawning + live_count >= self._max_workers:
                 return
         node.spawning += 1
         self._spawn_ledger.append((node.node_id, time.monotonic(), tpu))
         worker_id = f"w{next(self._worker_counter)}"
+        if isolation is not None:
+            # Registration looks the env_key up by worker_id (the worker
+            # itself doesn't need to know its isolation hash).
+            self._worker_env_keys[worker_id] = isolation["key"]
+            self._iso_booting[(node.node_id, isolation["key"])] = time.monotonic()
         if node.conn is not None:
             asyncio.ensure_future(
-                node.conn.send({"type": "spawn_worker", "worker_id": worker_id, "tpu": tpu})
+                node.conn.send({
+                    "type": "spawn_worker", "worker_id": worker_id,
+                    "tpu": tpu, "isolation": isolation,
+                })
             )
             return
         env = dict(os.environ)
@@ -737,7 +769,23 @@ class Controller:
             if env.get("JAX_PLATFORMS", "").lower() in ("", "axon", "tpu"):
                 env["JAX_PLATFORMS"] = "cpu"
         log_path = os.path.join(self.session_dir, f"worker-{worker_id}.log")
-        if not tpu and self._forkserver is not None and self._forkserver.ready:
+        argv = [sys.executable, "-m", "ray_tpu.core.worker_main"]
+        if isolation is not None:
+            # conda/container wrap — never forkserver-able (the whole point
+            # is a different interpreter/filesystem).
+            from ..runtime_env.isolation import build_argv
+
+            env["RAY_TPU_ENV_KEY"] = isolation["key"]
+            try:
+                argv = build_argv(isolation, argv, env, self.session_dir)
+            except Exception as e:  # noqa: BLE001 — binary missing on node
+                self._iso_spawn_failed(node, worker_id, isolation, repr(e), tpu=tpu)
+                self._schedule()
+                return
+        if (
+            not tpu and isolation is None
+            and self._forkserver is not None and self._forkserver.ready
+        ):
             # Warm path: ~10 ms fork from the pre-imported template. Fork
             # preserves the no-pdeathsig property (the template, not the
             # controller, is the parent — and it ignores SIGCHLD).
@@ -750,7 +798,7 @@ class Controller:
                 traceback.print_exc()
         log_f = open(log_path, "ab")
         proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.core.worker_main"],
+            argv,
             env=env,
             stdout=log_f,
             stderr=subprocess.STDOUT,
@@ -761,6 +809,92 @@ class Controller:
             # grace timeout, not process lineage.
         )
         self._worker_procs[worker_id] = proc
+
+    def _spawn_isolated(self, node: "NodeState", spec, tpu: bool = False):
+        """Spawn a worker wrapped in the task's conda/container isolation
+        (reference: raylet starting runtime-env workers through the agent's
+        env setup, `worker_pool.cc` PopWorker w/ runtime_env_hash). One boot
+        per (node, key) at a time, with a grace window so a dead spawn
+        doesn't wedge the key forever."""
+        from ..runtime_env.isolation import resolve
+
+        isolation = resolve(spec.options.runtime_env)
+        if isolation is None:
+            return
+        key = isolation["key"]
+        if (node.node_id, key) in self._iso_unavailable:
+            # The binary is missing on THIS node; another may serve the env.
+            alt = self._iso_candidate(spec, key)
+            if alt is None:
+                self._fail_iso_tasks_without_candidates(key)
+                return
+            node = alt
+        last = self._iso_booting.get((node.node_id, key))
+        if last is not None and time.monotonic() - last < 15.0:
+            return  # a worker for this env is already booting there
+        self._spawn_worker(tpu=tpu, node=node, force=True, isolation=isolation)
+
+    def _iso_candidate(self, spec, key: str) -> Optional["NodeState"]:
+        """An alive node not yet marked binary-less for this env whose
+        TOTAL resources could host the task."""
+        for node in self.nodes.values():
+            if (
+                node.alive
+                and (node.node_id, key) not in self._iso_unavailable
+                and all(
+                    node.total.get(k, 0) >= v
+                    for k, v in spec.resources.items()
+                )
+            ):
+                return node
+        return None
+
+    def _fail_iso_tasks_without_candidates(self, key: str):
+        """Fail queued tasks for this env ONLY once no alive node can host
+        it (reference: RUNTIME_ENV_SETUP_FAILED) — a missing binary is a
+        per-node property, not a cluster verdict."""
+        from ..runtime_env import RuntimeEnvSetupError
+
+        doomed = [
+            pt for pt in self.ready_queue
+            if _task_env_key(pt.spec) == key
+            and self._iso_candidate(pt.spec, key) is None
+        ]
+        if not doomed:
+            return
+        why = "; ".join(sorted({
+            v for (n, k), v in self._iso_unavailable.items() if k == key
+        }))
+        for pt in doomed:
+            self.ready_queue.remove(pt)
+            self._fail_task(
+                pt,
+                TaskError(
+                    RuntimeEnvSetupError(
+                        f"no node can host this environment: {why}"
+                    ),
+                    "", pt.spec.name,
+                ),
+            )
+
+    def _iso_spawn_failed(self, node, worker_id: str, isolation: dict,
+                          why: str, tpu: bool = False):
+        """Isolated spawn couldn't even exec (missing conda/podman on this
+        node): give back the FULL spawn bookkeeping (counter + ledger, like
+        registration does), mark the node unavailable for the env, and fail
+        only tasks no other node can serve."""
+        node.spawning = max(0, node.spawning - 1)
+        if tpu:
+            node.spawning_tpu = max(0, node.spawning_tpu - 1)
+        for i, entry in enumerate(self._spawn_ledger):
+            if entry[0] == node.node_id and entry[2] == tpu:
+                del self._spawn_ledger[i]
+                break
+        self._worker_env_keys.pop(worker_id, None)
+        key = isolation["key"]
+        self._iso_booting.pop((node.node_id, key), None)
+        self._iso_unavailable[(node.node_id, key)] = why
+        self._fail_iso_tasks_without_candidates(key)
 
     # ---------------------------------------------------------- connection
     async def _on_connection(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
@@ -857,6 +991,12 @@ class Controller:
         node_id = msg.get("node_id", HEAD_NODE)
         meta["kind"] = "worker"
         meta["worker_id"] = worker_id
+        # Prefer the worker's self-report (survives controller restarts —
+        # the in-memory map doesn't); fall back to the spawn-time record.
+        env_key = msg.get("env_key") or self._worker_env_keys.pop(worker_id, "")
+        self._worker_env_keys.pop(worker_id, None)
+        if env_key:
+            self._iso_booting.pop((node_id, env_key), None)
         ws = WorkerState(
             worker_id=worker_id,
             conn=conn,
@@ -865,6 +1005,7 @@ class Controller:
             has_tpu=bool(msg.get("has_tpu")),
             node_id=node_id,
             direct_addr=msg.get("direct_addr", ""),
+            env_key=env_key,
         )
         self.workers[worker_id] = ws
         # Re-adoption after a controller restart: a surviving actor worker
@@ -1674,7 +1815,8 @@ class Controller:
         return None
 
     def _idle_worker(
-        self, node_id: str, need_tpu: bool = False, cache: Optional[dict] = None
+        self, node_id: str, need_tpu: bool = False, cache: Optional[dict] = None,
+        env_key: str = "",
     ) -> Optional[WorkerState]:
         if cache is not None:
             # Per-pass index (built once in _schedule): O(1) per lookup
@@ -1685,7 +1827,9 @@ class Controller:
                 for ws in self.workers.values():
                     if ws.state == IDLE:
                         kind = "tpu" if ws.has_tpu else "cpu"
-                        idx[kind].setdefault(ws.node_id, []).append(ws)
+                        idx[kind].setdefault(
+                            (ws.node_id, ws.env_key), []
+                        ).append(ws)
             def take(lst):
                 # Validate against live state — entries can go stale if any
                 # path mutates workers outside _cache_remove_idle.
@@ -1693,16 +1837,17 @@ class Controller:
                     lst.pop()
                 return lst[-1] if lst else None
 
+            slot = (node_id, env_key)
             if need_tpu:
-                return take(idx["tpu"].get(node_id) or [])
-            got = take(idx["cpu"].get(node_id) or [])
+                return take(idx["tpu"].get(slot) or [])
+            got = take(idx["cpu"].get(slot) or [])
             if got is not None:
                 return got
-            # Fallback: TPU worker takes CPU task.
-            return take(idx["tpu"].get(node_id) or [])
+            # Fallback: TPU worker takes CPU task (same isolation only).
+            return take(idx["tpu"].get(slot) or [])
         fallback = None
         for ws in self.workers.values():
-            if ws.state != IDLE or ws.node_id != node_id:
+            if ws.state != IDLE or ws.node_id != node_id or ws.env_key != env_key:
                 continue
             if need_tpu:
                 if ws.has_tpu:
@@ -1722,7 +1867,7 @@ class Controller:
         if idx is None:
             return
         kind = "tpu" if ws.has_tpu else "cpu"
-        lst = idx[kind].get(ws.node_id)
+        lst = idx[kind].get((ws.node_id, ws.env_key))
         if lst and ws in lst:
             lst.remove(ws)
 
@@ -1874,6 +2019,8 @@ class Controller:
             return False
         if spec.task_type != TaskType.NORMAL_TASK:
             return False
+        if _task_env_key(spec):
+            return False  # isolated tasks need env-keyed workers, not leases
         demand = spec.resources
         # The dispatcher executes on generic CPU:1 leases — only tasks whose
         # demand a CPU:1 lease actually covers may ride the plane. Custom
@@ -2009,6 +2156,20 @@ class Controller:
         for oid in pt.spec.return_ids:
             self._store_error_object(oid.hex(), err)
 
+    async def h_worker_spawn_failed(self, conn, meta, msg):
+        """Agent couldn't even exec the isolated worker command (missing
+        conda/podman) — fail the tasks waiting on that env."""
+        worker_id = msg["worker_id"]
+        key = self._worker_env_keys.get(worker_id, "")
+        node = self.nodes.get(meta.get("node_id", ""))
+        if node is not None and key:
+            self._iso_spawn_failed(
+                node, worker_id, {"key": key},
+                msg.get("error", "spawn failed"), tpu=bool(msg.get("tpu")),
+            )
+            self._schedule()
+        return None
+
     async def h_agent_task_lost(self, conn, meta, msg):
         """Agent-side dispatch saw the executing worker die (local worker
         loss is AGENT-observed for handed-off tasks — the head never granted
@@ -2118,6 +2279,7 @@ class Controller:
                     continue
                 demand = spec.resources
                 need_tpu = demand.get("TPU", 0) > 0
+                env_key = _task_env_key(spec)
                 chosen: Optional[Tuple[NodeState, WorkerState]] = None
                 spawn_on: Optional[NodeState] = None
                 pg_grant: Optional[Tuple[str, int]] = None
@@ -2167,10 +2329,12 @@ class Controller:
                         self.ready_queue.append(pt)  # bundle busy / placing
                         continue
                     pg_hex, bidx, node = fit
-                    ws = self._idle_worker(node.node_id, need_tpu, cache)
+                    ws = self._idle_worker(node.node_id, need_tpu, cache, env_key)
                     if ws is None:
                         self.ready_queue.append(pt)
-                        if need_tpu:
+                        if env_key:
+                            self._spawn_isolated(node, spec, tpu=need_tpu)
+                        elif need_tpu:
                             self._spawn_worker(tpu=True, node=node)
                         else:
                             target = (
@@ -2209,7 +2373,11 @@ class Controller:
                             continue
                         self.ready_queue.append(pt)
                         hint = no_capacity[sig]
-                        if hint is not None and not need_tpu:
+                        if hint is not None and env_key:
+                            hn = self.nodes.get(hint)
+                            if hn is not None:
+                                self._spawn_isolated(hn, spec, tpu=need_tpu)
+                        elif hint is not None and not need_tpu:
                             target = (
                                 spawn_wanted_actors
                                 if spec.task_type == TaskType.ACTOR_CREATION_TASK
@@ -2228,7 +2396,7 @@ class Controller:
                     for node in candidates:
                         if not self._fits_node(node, demand):
                             continue
-                        ws = self._idle_worker(node.node_id, need_tpu, cache)
+                        ws = self._idle_worker(node.node_id, need_tpu, cache, env_key)
                         if ws is None:
                             spawn_on = spawn_on or node
                             if commit_first_fit:
@@ -2247,7 +2415,9 @@ class Controller:
                                 spawn_on.node_id if spawn_on is not None else None
                             )
                         if spawn_on is not None:
-                            if need_tpu:
+                            if env_key:
+                                self._spawn_isolated(spawn_on, spec, tpu=need_tpu)
+                            elif need_tpu:
                                 self._spawn_worker(tpu=True, node=spawn_on)
                             else:
                                 target = (
@@ -2624,6 +2794,7 @@ class Controller:
             or hspec.arg_refs
             or hspec.task_id.hex() in self.cancelled
             or head.sched_sig(hspec.resources.get("TPU", 0) > 0) != sig
+            or _task_env_key(hspec) != _task_env_key(spec)
         ):
             return
         self.ready_queue.popleft()

@@ -318,7 +318,10 @@ class NodeAgent:
                 if self.dispatcher is not None:
                     self.dispatcher.on_revoke(msg["worker_id"])
             elif mtype == "spawn_worker":
-                self._spawn_worker(msg["worker_id"], tpu=bool(msg.get("tpu")))
+                self._spawn_worker(
+                    msg["worker_id"], tpu=bool(msg.get("tpu")),
+                    isolation=msg.get("isolation"),
+                )
             elif mtype == "pull_object":
                 # Long transfer — detach so other commands keep flowing.
                 asyncio.ensure_future(self._handle_pull(msg))
@@ -335,7 +338,8 @@ class NodeAgent:
         except Exception:  # noqa: BLE001
             traceback.print_exc()
 
-    def _spawn_worker(self, worker_id: str, tpu: bool = False):
+    def _spawn_worker(self, worker_id: str, tpu: bool = False,
+                      isolation: Optional[dict] = None):
         env = dict(os.environ)
         pkg_root = os.path.dirname(
             os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -356,8 +360,26 @@ class NodeAgent:
             if env.get("JAX_PLATFORMS", "").lower() in ("", "axon", "tpu"):
                 env["JAX_PLATFORMS"] = "cpu"
         log_path = os.path.join(self.session_dir, f"worker-{worker_id}.log")
+        argv = [sys.executable, "-m", "ray_tpu.core.worker_main"]
+        if isolation is not None:
+            # conda/container wrap (reference: runtime-env workers start
+            # through the agent's env setup) — forkserver can't serve these.
+            from ..runtime_env.isolation import build_argv
+
+            env["RAY_TPU_ENV_KEY"] = isolation["key"]
+            try:
+                argv = build_argv(isolation, argv, env, self.session_dir)
+            except Exception as e:  # noqa: BLE001 — binary missing here
+                try:
+                    self.conn.post({
+                        "type": "worker_spawn_failed", "worker_id": worker_id,
+                        "error": repr(e), "tpu": tpu,
+                    })
+                except Exception:  # noqa: BLE001
+                    pass
+                return
         fs = getattr(self, "_forkserver", None)
-        if not tpu and fs is not None and fs.ready:
+        if not tpu and isolation is None and fs is not None and fs.ready:
             try:
                 self._worker_procs[worker_id] = fs.spawn(worker_id, env, log_path)
                 return
@@ -365,7 +387,7 @@ class NodeAgent:
                 traceback.print_exc()
         log_f = open(log_path, "ab")
         proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.core.worker_main"],
+            argv,
             env=env,
             stdout=log_f,
             stderr=subprocess.STDOUT,

@@ -297,6 +297,10 @@ class WorkerProcess:
             "has_tpu": os.environ.get("RAY_TPU_WORKER_TPU") == "1",
             "node_id": os.environ.get("RAY_TPU_NODE_ID", "node0"),
             "direct_addr": getattr(self, "direct_addr", ""),
+            # Isolation hash (conda/container) — self-reported so a
+            # restarted controller re-adopts this worker into the RIGHT
+            # env-keyed pool, not the plain one.
+            "env_key": os.environ.get("RAY_TPU_ENV_KEY", ""),
         }
         if self.actor_instance is not None and self._actor_hex:
             payload["actor_hex"] = self._actor_hex  # controller-restart re-adoption

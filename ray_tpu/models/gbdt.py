@@ -1,0 +1,262 @@
+"""Gradient-boosted decision trees, JAX-native.
+
+Reference analog: `python/ray/train/gbdt_trainer.py` + the xgboost/lightgbm
+trainers built on it — the reference delegates the math to external C++
+boosters. TPU redesign: a histogram booster written directly in JAX so the
+whole training round is one jitted program of dense, fixed-shape ops
+(XLA-friendly): features are quantile-binned to uint8 once on the host;
+each round computes gradients, builds [node, feature, bin] histograms with
+`segment_sum`, picks splits by vectorized gain, and routes samples — no
+per-node Python, no dynamic shapes. Trees are complete binary trees in
+array form (feature/threshold/leaf-value per node), so prediction is D
+vectorized gathers.
+
+Supports squared-error regression and binary logistic classification —
+the two objectives the reference's release tests gate
+(`release/train_tests/xgboost_lightgbm`).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass
+class GBDTParams:
+    objective: str = "squared_error"   # squared_error | binary_logistic
+    num_boost_round: int = 50
+    max_depth: int = 4
+    learning_rate: float = 0.1
+    reg_lambda: float = 1.0            # L2 on leaf values
+    gamma: float = 0.0                 # min split gain
+    min_child_weight: float = 1.0      # min hessian sum per child
+    max_bins: int = 256                # uint8 binning
+    base_score: float = 0.0
+
+
+def quantile_bins(X: np.ndarray, max_bins: int = 256) -> np.ndarray:
+    """Per-feature quantile cut points [F, max_bins-1] (host-side, once)."""
+    qs = np.linspace(0, 1, max_bins + 1)[1:-1]
+    return np.quantile(X, qs, axis=0).T.astype(np.float32)  # [F, B-1]
+
+
+def apply_bins(X: np.ndarray, edges: np.ndarray) -> np.ndarray:
+    """float features -> uint8 bin indices via the stored cut points."""
+    out = np.empty(X.shape, np.uint8)
+    for f in range(X.shape[1]):
+        out[:, f] = np.searchsorted(edges[f], X[:, f], side="left")
+    return out
+
+
+def _grad_hess(objective: str, pred, y):
+    if objective == "squared_error":
+        return pred - y, jnp.ones_like(pred)
+    if objective in ("binary_logistic", "binary:logistic"):
+        p = jax.nn.sigmoid(pred)
+        return p - y, p * (1.0 - p)
+    raise ValueError(f"unknown objective {objective!r}")
+
+
+@functools.partial(jax.jit, static_argnames=("depth", "n_bins"))
+def _grow_tree(bins, g, h, depth: int, n_bins: int, reg_lambda, gamma,
+               min_child_weight):
+    """One tree on binned features. bins [N, F] uint8; g,h [N] f32.
+    Returns (feature, threshold, leaf_value, is_leaf) arrays sized for the
+    complete binary tree of `depth` (2^(depth+1)-1 nodes)."""
+    N, F = bins.shape
+    n_nodes_total = 2 ** (depth + 1) - 1
+    feat = jnp.zeros((n_nodes_total,), jnp.int32)
+    thresh = jnp.zeros((n_nodes_total,), jnp.int32)
+    is_leaf = jnp.ones((n_nodes_total,), bool)
+    node_g = jnp.zeros((n_nodes_total,), jnp.float32)
+    node_h = jnp.zeros((n_nodes_total,), jnp.float32)
+    node_g = node_g.at[0].set(g.sum())
+    node_h = node_h.at[0].set(h.sum())
+
+    assign = jnp.zeros((N,), jnp.int32)  # tree-node index per sample
+    f_idx = jnp.arange(F, dtype=jnp.int32)[None, :]
+
+    for d in range(depth):
+        first, n_level = 2 ** d - 1, 2 ** d
+        # Histograms for this level: local node id × feature × bin.
+        local = assign - first  # [-] samples not at this level get clamped
+        at_level = (assign >= first) & (assign < first + n_level)
+        local = jnp.clip(local, 0, n_level - 1)
+        seg = (
+            local[:, None] * (F * n_bins)
+            + f_idx * n_bins
+            + bins.astype(jnp.int32)
+        )  # [N, F]
+        w = at_level.astype(jnp.float32)[:, None]
+        num_seg = n_level * F * n_bins
+        hist_g = jax.ops.segment_sum(
+            jnp.broadcast_to(g[:, None] * w, (N, F)).ravel(),
+            seg.ravel(), num_segments=num_seg,
+        ).reshape(n_level, F, n_bins)
+        hist_h = jax.ops.segment_sum(
+            jnp.broadcast_to(h[:, None] * w, (N, F)).ravel(),
+            seg.ravel(), num_segments=num_seg,
+        ).reshape(n_level, F, n_bins)
+
+        # Split gain for "left = bin <= b": cumulative stats over bins.
+        GL = jnp.cumsum(hist_g, axis=-1)
+        HL = jnp.cumsum(hist_h, axis=-1)
+        G = GL[..., -1:]
+        H = HL[..., -1:]
+        GR, HR = G - GL, H - HL
+        gain = 0.5 * (
+            GL**2 / (HL + reg_lambda)
+            + GR**2 / (HR + reg_lambda)
+            - G**2 / (H + reg_lambda)
+        ) - gamma
+        valid = (HL >= min_child_weight) & (HR >= min_child_weight)
+        gain = jnp.where(valid, gain, -jnp.inf)
+        flat = gain.reshape(n_level, F * n_bins)
+        best = flat.argmax(axis=-1)
+        best_gain = jnp.take_along_axis(flat, best[:, None], -1)[:, 0]
+        best_f = (best // n_bins).astype(jnp.int32)
+        best_b = (best % n_bins).astype(jnp.int32)
+        do_split = best_gain > 0.0
+
+        node_ids = first + jnp.arange(n_level)
+        feat = feat.at[node_ids].set(jnp.where(do_split, best_f, 0))
+        thresh = thresh.at[node_ids].set(jnp.where(do_split, best_b, 0))
+        is_leaf = is_leaf.at[node_ids].set(~do_split)
+
+        # Child aggregates (for leaf values at the last level).
+        lg = jnp.take_along_axis(
+            GL.reshape(n_level, -1), (best_f * n_bins + best_b)[:, None], -1
+        )[:, 0]
+        lh = jnp.take_along_axis(
+            HL.reshape(n_level, -1), (best_f * n_bins + best_b)[:, None], -1
+        )[:, 0]
+        left_ids, right_ids = 2 * node_ids + 1, 2 * node_ids + 2
+        node_g = node_g.at[left_ids].set(lg).at[right_ids].set(
+            node_g[node_ids] - lg
+        )
+        node_h = node_h.at[left_ids].set(lh).at[right_ids].set(
+            node_h[node_ids] - lh
+        )
+
+        # Route samples whose node split.
+        nf = feat[assign]
+        nb = thresh[assign]
+        sample_bin = jnp.take_along_axis(
+            bins.astype(jnp.int32), nf[:, None], axis=1
+        )[:, 0]
+        split_here = at_level & ~is_leaf[assign]
+        assign = jnp.where(
+            split_here,
+            jnp.where(sample_bin <= nb, 2 * assign + 1, 2 * assign + 2),
+            assign,
+        )
+
+    leaf_value = -node_g / (node_h + reg_lambda)
+    return feat, thresh, leaf_value.astype(jnp.float32), is_leaf
+
+
+@functools.partial(jax.jit, static_argnames=("depth",))
+def _predict_tree(bins, feat, thresh, leaf_value, is_leaf, depth: int):
+    N = bins.shape[0]
+    idx = jnp.zeros((N,), jnp.int32)
+    for _ in range(depth):
+        nf = feat[idx]
+        nb = thresh[idx]
+        sample_bin = jnp.take_along_axis(
+            bins.astype(jnp.int32), nf[:, None], axis=1
+        )[:, 0]
+        nxt = jnp.where(sample_bin <= nb, 2 * idx + 1, 2 * idx + 2)
+        idx = jnp.where(is_leaf[idx], idx, nxt)
+    return leaf_value[idx]
+
+
+@dataclass
+class GradientBoostedTrees:
+    """Fitted ensemble. `trees` holds stacked per-tree arrays."""
+
+    params: GBDTParams
+    edges: np.ndarray = None               # [F, max_bins-1] bin cut points
+    trees: Dict[str, np.ndarray] = field(default_factory=dict)
+    train_history: List[float] = field(default_factory=list)
+
+    def fit(self, X: np.ndarray, y: np.ndarray,
+            eval_every: int = 10) -> "GradientBoostedTrees":
+        p = self.params
+        if not 2 <= p.max_bins <= 256:
+            # Bin indices live in uint8 — beyond 256 they'd silently wrap.
+            raise ValueError(f"max_bins must be in [2, 256], got {p.max_bins}")
+        X = np.asarray(X, np.float32)
+        y = jnp.asarray(np.asarray(y, np.float32))
+        self.edges = quantile_bins(X, p.max_bins)
+        bins = jnp.asarray(apply_bins(X, self.edges))
+        pred = jnp.full((X.shape[0],), p.base_score, jnp.float32)
+        feats, threshs, leaves, leafmask = [], [], [], []
+        for r in range(p.num_boost_round):
+            g, h = _grad_hess(p.objective, pred, y)
+            t = _grow_tree(
+                bins, g, h, p.max_depth, p.max_bins,
+                p.reg_lambda, p.gamma, p.min_child_weight,
+            )
+            pred = pred + p.learning_rate * _predict_tree(
+                bins, *t, p.max_depth
+            )
+            feats.append(t[0]); threshs.append(t[1])
+            leaves.append(t[2]); leafmask.append(t[3])
+            if r % eval_every == 0 or r == p.num_boost_round - 1:
+                self.train_history.append(float(self._loss(pred, y)))
+        self.trees = {
+            "feat": np.stack([np.asarray(a) for a in feats]),
+            "thresh": np.stack([np.asarray(a) for a in threshs]),
+            "leaf": np.stack([np.asarray(a) for a in leaves]),
+            "is_leaf": np.stack([np.asarray(a) for a in leafmask]),
+        }
+        return self
+
+    def _loss(self, pred, y):
+        if self.params.objective == "squared_error":
+            return jnp.mean((pred - y) ** 2)
+        ll = jax.nn.log_sigmoid(pred) * y + jax.nn.log_sigmoid(-pred) * (1 - y)
+        return -ll.mean()
+
+    def predict_raw(self, X: np.ndarray) -> np.ndarray:
+        bins = jnp.asarray(apply_bins(np.asarray(X, np.float32), self.edges))
+        pred = jnp.full((X.shape[0],), self.params.base_score, jnp.float32)
+        for i in range(self.trees["feat"].shape[0]):
+            pred = pred + self.params.learning_rate * _predict_tree(
+                bins,
+                jnp.asarray(self.trees["feat"][i]),
+                jnp.asarray(self.trees["thresh"][i]),
+                jnp.asarray(self.trees["leaf"][i]),
+                jnp.asarray(self.trees["is_leaf"][i]),
+                self.params.max_depth,
+            )
+        return np.asarray(pred)
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        raw = self.predict_raw(X)
+        if self.params.objective == "squared_error":
+            return raw
+        return 1.0 / (1.0 + np.exp(-raw))  # probabilities
+
+    # ------------------------------------------------------- serialization
+    def to_dict(self) -> dict:
+        return {
+            "params": self.params.__dict__,
+            "edges": self.edges,
+            "trees": self.trees,
+            "train_history": self.train_history,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "GradientBoostedTrees":
+        m = cls(GBDTParams(**d["params"]))
+        m.edges = d["edges"]
+        m.trees = d["trees"]
+        m.train_history = list(d.get("train_history", []))
+        return m

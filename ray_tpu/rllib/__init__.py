@@ -26,6 +26,10 @@ from .algorithms.marwil import MARWIL, MARWILConfig
 from .algorithms.td3 import TD3, TD3Config
 from .algorithms.ddpg import DDPG, DDPGConfig
 from .algorithms.a2c import A2C, A2CConfig
+from .algorithms.apex_dqn import ApexDQN, ApexDQNConfig
+from .algorithms.cql import CQL, CQLConfig
+from .algorithms.dt import DT, DTConfig
+from .algorithms.multi_agent_ppo import MultiAgentPPO, MultiAgentPPOConfig
 from . import offline
 from .env import register_env, make_env
 from .env.env_runner import EnvRunner
@@ -55,6 +59,14 @@ __all__ = [
     "DDPGConfig",
     "A2C",
     "A2CConfig",
+    "ApexDQN",
+    "ApexDQNConfig",
+    "CQL",
+    "CQLConfig",
+    "DT",
+    "DTConfig",
+    "MultiAgentPPO",
+    "MultiAgentPPOConfig",
     "offline",
     "register_env",
     "make_env",

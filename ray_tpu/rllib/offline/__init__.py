@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
@@ -127,3 +127,93 @@ def collect_dataset(
         return np.concatenate(list(xs), axis=0)[:n_steps]
 
     return OfflineDataset(flat(all_obs), flat(all_act), flat(ret))
+
+
+class EpisodeDataset:
+    """Episodic offline data for trajectory methods (Decision Transformer).
+
+    Reference analog: `rllib/algorithms/dt/` consumes SampleBatches grouped
+    by episode; here episodes are explicit: each is
+    {"obs": [T, D], "actions": [T], "rewards": [T]}.
+    """
+
+    def __init__(self, episodes: List[Dict[str, np.ndarray]]):
+        if not episodes:
+            raise ValueError("EpisodeDataset needs at least one episode")
+        self.episodes = [
+            {
+                "obs": np.asarray(e["obs"], np.float32),
+                "actions": np.asarray(e["actions"]),
+                "rewards": np.asarray(e["rewards"], np.float32),
+            }
+            for e in episodes
+        ]
+        # Undiscounted returns-to-go per step (the DT conditioning signal).
+        self._rtg = [
+            np.cumsum(e["rewards"][::-1])[::-1].astype(np.float32)
+            for e in self.episodes
+        ]
+        self.returns = np.array([r[0] for r in self._rtg], np.float32)
+
+    def __len__(self) -> int:
+        return len(self.episodes)
+
+    def sample_subsequences(
+        self, rng: np.random.Generator, batch_size: int, K: int
+    ) -> Dict[str, np.ndarray]:
+        """[B, K] windows ending at random timesteps, front-padded (mask=0
+        on pad): obs, actions, rtg, timesteps, mask."""
+        obs_dim = self.episodes[0]["obs"].shape[1]
+        act_dtype = self.episodes[0]["actions"].dtype
+        out = {
+            "obs": np.zeros((batch_size, K, obs_dim), np.float32),
+            "actions": np.zeros((batch_size, K), act_dtype),
+            "rtg": np.zeros((batch_size, K), np.float32),
+            "timesteps": np.zeros((batch_size, K), np.int32),
+            "mask": np.zeros((batch_size, K), np.float32),
+        }
+        # Sample episodes weighted by length (uniform over TIMESTEPS).
+        lengths = np.array([len(e["actions"]) for e in self.episodes])
+        probs = lengths / lengths.sum()
+        eps = rng.choice(len(self.episodes), size=batch_size, p=probs)
+        for b, ei in enumerate(eps):
+            ep, rtg = self.episodes[ei], self._rtg[ei]
+            T = len(ep["actions"])
+            end = int(rng.integers(1, T + 1))
+            start = max(0, end - K)
+            n = end - start
+            out["obs"][b, K - n:] = ep["obs"][start:end]
+            out["actions"][b, K - n:] = ep["actions"][start:end]
+            out["rtg"][b, K - n:] = rtg[start:end]
+            out["timesteps"][b, K - n:] = np.arange(start, end)
+            out["mask"][b, K - n:] = 1.0
+        return out
+
+
+def collect_episodes(
+    env_name: str,
+    policy_fn: Callable[[np.ndarray], np.ndarray],
+    n_episodes: int,
+    *,
+    seed: int = 0,
+    max_steps: int = 500,
+    env_kwargs: Optional[dict] = None,
+) -> EpisodeDataset:
+    """Roll `policy_fn` one env at a time and keep whole episodes (the
+    trajectory-structured sibling of `collect_dataset`)."""
+    env = make_env(env_name, 1, **(env_kwargs or {}))
+    episodes = []
+    for i in range(n_episodes):
+        obs, _ = env.reset(seed=seed + i)
+        rows = {"obs": [], "actions": [], "rewards": []}
+        for _ in range(max_steps):
+            a = np.asarray(policy_fn(obs))
+            rows["obs"].append(obs[0].copy())
+            rows["actions"].append(a[0])
+            obs, rew, term, trunc, _ = env.step(a)
+            rows["rewards"].append(float(rew[0]))
+            if bool(term[0] or trunc[0]):
+                break
+        episodes.append({k: np.asarray(v) for k, v in rows.items()})
+    env.close()
+    return EpisodeDataset(episodes)

@@ -18,7 +18,12 @@ Redesign (TPU-first, zero-egress aware):
     missing requirements into the (shared, non-isolated) worker interpreter
     — a bootstrap escape hatch for images with an index, not per-task
     isolation.
-  * `conda` — declared non-goal (no conda in the image); raises.
+  * `conda` / `container` — WORKER-LEVEL isolation (these can't be applied
+    inside a running interpreter): the scheduler keys workers by isolation
+    hash and the node agent spawns them through `conda run` / `podman run`
+    (see `isolation.py`; reference: `_private/runtime_env/conda.py`,
+    `container.py`). Gated on the binary existing on the node; conda env
+    CREATION from spec dicts stays rejected (zero-egress image).
   * custom plugins — `register_plugin(name, plugin)` with driver-side
     `prepare` and worker-side `apply` hooks.
 """
@@ -39,6 +44,7 @@ KNOWN_FIELDS = {
     "py_modules",
     "pip",
     "conda",
+    "container",
     "config",
     # Internal (driver-prepared) fields:
     "_working_dir_pkg",
@@ -105,11 +111,10 @@ def validate(renv: dict):
                 value = value.get("packages", [])
             if not isinstance(value, (list, tuple)):
                 raise ValueError("runtime_env pip must be a list of requirements")
-        if key == "conda":
-            raise ValueError(
-                "runtime_env conda is a non-goal of this build (no conda in "
-                "the TPU image); use pip or py_modules"
-            )
+        if key in ("conda", "container"):
+            from .isolation import validate_spec
+
+            validate_spec(key, value)
 
 
 # ------------------------------------------------------------- driver side

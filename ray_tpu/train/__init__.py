@@ -25,7 +25,9 @@ from .session import get_checkpoint, get_context, get_dataset_shard, report
 from .result import Result
 from .base_trainer import BaseTrainer
 from .data_parallel_trainer import DataParallelTrainer
+from .gbdt_trainer import GBDTTrainer, XGBoostTrainer
 from .jax_trainer import JaxTrainer
+from . import huggingface  # noqa: F401 — HF checkpoint interop (GPT-2 family)
 from . import torch_trainer as torch  # ray_tpu.train.torch.prepare_model(...)
 from .torch_trainer import TorchTrainer
 
@@ -43,6 +45,8 @@ __all__ = [
     "DataConfig",
     "BaseTrainer",
     "DataParallelTrainer",
+    "GBDTTrainer",
+    "XGBoostTrainer",
     "JaxTrainer",
     "TorchTrainer",
     "torch",

@@ -1,0 +1,122 @@
+"""HF Transformers interop: weight conversion parity + finetune path.
+
+Reference analog: `python/ray/train/huggingface/` (TransformersTrainer) and
+`python/ray/train/tests/test_transformers_*` — here the gate is stronger:
+converted weights must reproduce the torch model's LOGITS, not just train.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+import ray_tpu.data
+from ray_tpu import train
+from ray_tpu.train import RunConfig, ScalingConfig
+from ray_tpu.train.huggingface import (
+    TransformersTrainer,
+    config_from_hf,
+    params_from_hf,
+    params_to_hf_state_dict,
+)
+
+transformers = pytest.importorskip("transformers")
+
+
+def _tiny_hf_model(seed=0):
+    import torch
+
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    torch.manual_seed(seed)
+    hf_cfg = GPT2Config(
+        vocab_size=100, n_positions=64, n_embd=32, n_layer=2, n_head=2,
+        n_inner=64, resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+    )
+    return GPT2LMHeadModel(hf_cfg).eval()
+
+
+def _torch_logits(model, tokens):
+    import torch
+
+    with torch.no_grad():
+        return model(torch.from_numpy(tokens)).logits.numpy()
+
+
+class TestWeightConversion:
+    def test_config_mapping(self):
+        model = _tiny_hf_model()
+        cfg = config_from_hf(model.config)
+        assert cfg.vocab_size == 128  # 100 padded to a multiple of 128
+        assert (cfg.n_layers, cfg.d_model, cfg.n_heads, cfg.d_head) == (2, 32, 2, 16)
+        assert cfg.d_mlp == 64 and cfg.max_seq == 64 and cfg.tie_embeddings
+
+    def test_logit_parity_with_torch(self):
+        """The converted params must reproduce the torch forward — the
+        strongest possible check that every weight landed in the right
+        slot with the right layout."""
+        from ray_tpu.models import gpt
+
+        model = _tiny_hf_model()
+        params, cfg = params_from_hf(
+            model, config_from_hf(model.config, attn_impl="ref", remat=False,
+                                  dtype=np.float32)
+        )
+        tokens = np.random.default_rng(0).integers(0, 100, (2, 16))
+        expected = _torch_logits(model, tokens)
+        ours = np.asarray(gpt.forward(params, tokens, cfg))[:, :, :100]
+        np.testing.assert_allclose(ours, expected, rtol=1e-3, atol=2e-4)
+
+    def test_export_roundtrip(self):
+        """params -> HF state dict -> fresh torch model reproduces the
+        original logits (serving-ecosystem compatibility)."""
+        model = _tiny_hf_model()
+        params, cfg = params_from_hf(model)
+        sd = params_to_hf_state_dict(params, cfg, hf_vocab_size=100)
+        fresh = _tiny_hf_model(seed=123)  # different init, then overwrite
+        missing, unexpected = fresh.load_state_dict(sd, strict=False)
+        assert not unexpected
+        assert all("attn.bias" in k or "masked_bias" in k for k in missing)
+        tokens = np.random.default_rng(1).integers(0, 100, (2, 12))
+        np.testing.assert_allclose(
+            _torch_logits(fresh, tokens), _torch_logits(model, tokens),
+            rtol=1e-4, atol=1e-5,
+        )
+
+
+class TestTransformersTrainer:
+    def test_finetune_reduces_loss_and_exports(self, local_runtime, tmp_path):
+        """HF model -> TPU-native finetune via Ray Data -> checkpoint whose
+        params convert back to a working HF state dict."""
+        model = _tiny_hf_model()
+        # A learnable synthetic corpus: token i is always followed by
+        # (i + 1) % 50, so next-token loss can drop fast.
+        rng = np.random.default_rng(0)
+        starts = rng.integers(0, 50, (128, 1))
+        rows = (starts + np.arange(17)) % 50
+        ds = ray_tpu.data.from_numpy(rows.astype(np.int32), column="tokens")
+
+        trainer = TransformersTrainer(
+            model=model,
+            datasets={"train": ds},
+            train_loop_config={"steps": 100, "batch_size": 16, "lr": 3e-3},
+            gpt_config=config_from_hf(model.config, attn_impl="ref",
+                                      remat=False, dtype=np.float32),
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(storage_path=str(tmp_path)),
+        )
+        result = trainer.fit()
+        assert result.error is None
+        history = [m["loss"] for m in result.metrics_history if "loss" in m]
+        assert history[-1] < history[0] - 0.5, history
+        ckpt = result.checkpoint.to_dict()
+        sd = params_to_hf_state_dict(
+            ckpt["params"], config_from_hf(model.config), hf_vocab_size=100
+        )
+        fresh = _tiny_hf_model(seed=7)
+        fresh.load_state_dict(sd, strict=False)
+        tokens = np.arange(10)[None, :] % 50
+        logits = _torch_logits(fresh, tokens.astype(np.int64))
+        # The finetuned model should actually have learned the successor
+        # pattern: argmax of the last position predicts (t+1) % 50.
+        pred = logits[0, -1].argmax()
+        assert pred == (tokens[0, -1] + 1) % 50

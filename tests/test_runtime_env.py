@@ -29,8 +29,11 @@ pytestmark = pytest.mark.cluster
 def test_validate_rejects_unknown_fields():
     with pytest.raises(ValueError, match="Unknown runtime_env field"):
         validate({"working_dirs": "/tmp"})
-    with pytest.raises(ValueError, match="non-goal"):
+    # Conda env CREATION from spec dicts stays rejected (zero-egress image);
+    # existing envs by name are worker-isolation (test_runtime_env_isolation).
+    with pytest.raises(ValueError, match="zero-egress"):
         validate({"conda": {"dependencies": []}})
+    validate({"conda": "existing-env"})
     validate({"env_vars": {"A": "1"}, "pip": ["numpy"]})
     assert RuntimeEnv(env_vars={"A": "1"})["env_vars"] == {"A": "1"}
 

@@ -1,0 +1,173 @@
+"""runtime_env conda/container worker isolation.
+
+Reference analog: `python/ray/_private/runtime_env/conda.py`, `container.py`
++ `python/ray/tests/test_runtime_env_conda_and_pip.py` — workers for
+isolated envs start through a wrapper command and tasks only dispatch onto
+matching workers. The conda/podman binaries are faked with recording shims
+(the image has neither), which exercises every seam of OUR plumbing:
+validation, env-keyed scheduling, agent spawn wrapping, and the
+missing-binary failure path.
+"""
+
+import os
+import stat
+import textwrap
+
+import pytest
+
+import ray_tpu
+from ray_tpu.runtime_env import RuntimeEnvSetupError, validate
+from ray_tpu.runtime_env.isolation import build_argv, isolation_key, resolve
+
+
+class TestValidation:
+    def test_conda_name_ok_dict_rejected(self):
+        validate({"conda": "myenv"})
+        with pytest.raises(ValueError, match="zero-egress"):
+            validate({"conda": {"dependencies": ["pip"]}})
+
+    def test_container_shape(self):
+        validate({"container": {"image": "python:3.12"}})
+        with pytest.raises(ValueError, match="image"):
+            validate({"container": {"run_options": ["--gpus=all"]}})
+
+    def test_isolation_keys(self):
+        k1 = isolation_key({"conda": "a"})
+        k2 = isolation_key({"conda": "b"})
+        k3 = isolation_key({"container": {"image": "x"}})
+        assert k1 != k2 != k3 and k1.startswith("conda:")
+        assert k3.startswith("container:")
+        assert isolation_key({"env_vars": {"A": "1"}}) == ""
+        assert isolation_key(None) == ""
+
+
+class TestArgvBuilding:
+    def test_conda_wrap(self, monkeypatch, tmp_path):
+        fake = tmp_path / "conda"
+        fake.write_text("#!/bin/sh\n")
+        fake.chmod(0o755)
+        monkeypatch.setenv("CONDA_EXE", str(fake))
+        argv = build_argv(
+            resolve({"conda": "myenv"}), ["python", "-m", "w"], {}, "/tmp/s"
+        )
+        assert argv == [str(fake), "run", "-n", "myenv",
+                        "--no-capture-output", "python", "-m", "w"]
+        # Prefix paths use -p.
+        argv = build_argv(
+            resolve({"conda": "/envs/foo"}), ["python"], {}, "/tmp/s"
+        )
+        assert argv[2:4] == ["-p", "/envs/foo"]
+
+    def test_conda_missing_binary(self, monkeypatch):
+        monkeypatch.delenv("CONDA_EXE", raising=False)
+        monkeypatch.setenv("PATH", "/nonexistent")
+        with pytest.raises(RuntimeError, match="conda"):
+            build_argv(resolve({"conda": "x"}), ["python"], {}, "/tmp/s")
+
+    def test_container_wrap_forwards_env(self, monkeypatch, tmp_path):
+        fake = tmp_path / "podman"
+        fake.write_text("#!/bin/sh\n")
+        fake.chmod(0o755)
+        monkeypatch.setenv("PATH", str(tmp_path), prepend=os.pathsep)
+        monkeypatch.delenv("RAY_TPU_CONTAINER_ENGINE", raising=False)
+        iso = resolve({"container": {"image": "python:3.12",
+                                     "run_options": ["--cpus=2"]}})
+        env = {"RAY_TPU_WORKER_ID": "w7", "PYTHONPATH": "/x", "HOME": "/root"}
+        argv = build_argv(iso, ["python", "-m", "w"], env, "/tmp/sess")
+        assert argv[0].endswith("podman") and argv[1] == "run"
+        assert "--network=host" in argv and "--ipc=host" in argv
+        assert "-e" in argv and "RAY_TPU_WORKER_ID=w7" in argv
+        assert "PYTHONPATH=/x" in argv
+        assert not any(a.startswith("HOME=") for a in argv)  # not forwarded
+        img = argv.index("python:3.12")
+        assert argv[img - 1] == "--cpus=2"  # run_options precede the image
+        assert argv[img + 1:] == ["python", "-m", "w"]
+
+    def test_container_missing_engine(self, monkeypatch):
+        monkeypatch.setenv("PATH", "/nonexistent")
+        monkeypatch.delenv("RAY_TPU_CONTAINER_ENGINE", raising=False)
+        with pytest.raises(RuntimeError, match="podman nor docker"):
+            build_argv(
+                resolve({"container": {"image": "x"}}), ["python"], {}, "/t"
+            )
+
+
+_FAKE_CONDA = textwrap.dedent("""\
+    #!/bin/sh
+    # fake `conda run -n NAME --no-capture-output CMD...`: exec CMD with the
+    # activation marker set, like a real activated env would have.
+    shift           # run
+    shift           # -n / -p
+    envname=$1; shift
+    shift           # --no-capture-output
+    CONDA_DEFAULT_ENV=$envname exec "$@"
+    """)
+
+
+@pytest.mark.cluster
+class TestIsolatedWorkers:
+    @pytest.fixture
+    def fake_conda_path(self, tmp_path, monkeypatch):
+        bind = tmp_path / "bin"
+        bind.mkdir()
+        shim = bind / "conda"
+        shim.write_text(_FAKE_CONDA)
+        shim.chmod(shim.stat().st_mode | stat.S_IEXEC)
+        monkeypatch.delenv("CONDA_EXE", raising=False)
+        monkeypatch.setenv("PATH", f"{bind}{os.pathsep}{os.environ['PATH']}")
+        yield str(bind)
+
+    def test_conda_tasks_run_in_env_keyed_workers(self, fake_conda_path):
+        ray_tpu.init(num_cpus=4)
+        try:
+            @ray_tpu.remote
+            def probe():
+                import os
+                return (os.environ.get("CONDA_DEFAULT_ENV"), os.getpid())
+
+            # Plain task: no activation marker.
+            env0, pid0 = ray_tpu.get(probe.remote())
+            assert env0 is None
+
+            iso = probe.options(runtime_env={"conda": "envA"})
+            env1, pid1 = ray_tpu.get(iso.remote(), timeout=60)
+            assert env1 == "envA"
+            assert pid1 != pid0  # isolated worker, not the pooled one
+            # Same env -> SAME worker (env-keyed reuse, like the
+            # reference's runtime_env_hash worker cache).
+            env2, pid2 = ray_tpu.get(iso.remote(), timeout=60)
+            assert (env2, pid2) == ("envA", pid1)
+            # Different env -> different worker.
+            env3, pid3 = ray_tpu.get(
+                probe.options(runtime_env={"conda": "envB"}).remote(),
+                timeout=60,
+            )
+            assert env3 == "envB" and pid3 not in (pid0, pid1)
+        finally:
+            ray_tpu.shutdown()
+
+    def test_conda_actor_runs_isolated(self, fake_conda_path):
+        ray_tpu.init(num_cpus=2)
+        try:
+            @ray_tpu.remote(runtime_env={"conda": "actorenv"})
+            class A:
+                def env(self):
+                    import os
+                    return os.environ.get("CONDA_DEFAULT_ENV")
+
+            a = A.remote()
+            assert ray_tpu.get(a.env.remote(), timeout=60) == "actorenv"
+        finally:
+            ray_tpu.shutdown()
+
+    def test_missing_engine_fails_task_cleanly(self):
+        ray_tpu.init(num_cpus=2)
+        try:
+            @ray_tpu.remote(runtime_env={"container": {"image": "python:3.12"}})
+            def f():
+                return 1
+
+            with pytest.raises(Exception, match="podman|docker|container"):
+                ray_tpu.get(f.remote(), timeout=60)
+        finally:
+            ray_tpu.shutdown()
