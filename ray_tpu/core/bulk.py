@@ -50,6 +50,7 @@ from typing import Optional
 
 from . import config as rt_config
 from .rpc import _AUTH_MAGIC, _LEN, auth_token
+from .serialization import _pwrite_all
 
 _HDR = struct.Struct("<BQ")
 _SENDFILE_SPAN = 32 << 20  # max bytes per sendfile syscall (keeps EINTR cheap)
@@ -277,6 +278,28 @@ def _open_bulk_conn(addr: str, timeout_s: float) -> socket.socket:
     return sock
 
 
+def _recv_to_sink(sock: socket.socket, sink, offset: int, length: int,
+                  deadline_s: float):
+    """Land a span via recv into a reusable anon buffer + pwrite to the
+    destination's backing file — the write()-path allocates cold tmpfs pages
+    ~7× faster than recv_into a fresh mapping would fault them (mem.py)."""
+    dst_path, dst_base = sink
+    fd = os.open(dst_path, os.O_WRONLY)
+    try:
+        buf = bytearray(_RECV_SPAN)
+        mv = memoryview(buf)
+        sock.settimeout(deadline_s)
+        got = 0
+        while got < length:
+            r = sock.recv_into(mv[: min(_RECV_SPAN, length - got)])
+            if r == 0:
+                raise ConnectionError("bulk peer closed mid-span")
+            _pwrite_all(fd, mv[:r], dst_base + offset + got)
+            got += r
+    finally:
+        os.close(fd)
+
+
 def _pull_span(addr: str, where: dict, writer, offset: int, length: int,
                tmo: float):
     sock = _open_bulk_conn(addr, tmo)
@@ -293,7 +316,13 @@ def _pull_span(addr: str, where: dict, writer, offset: int, length: int,
             )
         if n != length:
             raise RuntimeError(f"bulk length mismatch: asked {length}, got {n}")
-        _recv_exact_into(sock, writer.raw_view(offset, length), tmo)
+        sink = getattr(writer, "sink", lambda: None)()
+        if sink is not None:
+            _recv_to_sink(sock, sink, offset, length, tmo)
+        else:
+            if hasattr(writer, "ensure_populated"):
+                writer.ensure_populated()
+            _recv_exact_into(sock, writer.raw_view(offset, length), tmo)
 
 
 _local_addrs_cache: Optional[set] = None
@@ -319,9 +348,54 @@ def _local_addrs() -> set:
     return out
 
 
+def _copy_span_from_file(src_fd: int, src_base: int, size: int, writer):
+    """Land `size` bytes of an open file into the writer, fastest path first:
+
+    1. file→file `sendfile` into the writer's backing-file span (`sink()`):
+       zero userspace copies AND no mmap faults — the write()-side tmpfs
+       allocation path is ~25× faster than faulting pages through a fresh
+       mapping on lazily-backed guest kernels (see mem.py).
+    2. Fallback: batch the destination faults (`ensure_populated`) and
+       preadv straight into the writer's mapping.
+    """
+    sink = getattr(writer, "sink", lambda: None)()
+    if sink is not None:
+        dst_path, dst_base = sink
+        dfd = os.open(dst_path, os.O_WRONLY)
+        try:
+            os.lseek(dfd, dst_base, os.SEEK_SET)
+            done = 0
+            while done < size:
+                try:
+                    n = os.sendfile(dfd, src_fd, src_base + done,
+                                    min(_SENDFILE_SPAN, size - done))
+                except InterruptedError:
+                    continue
+                except OSError as e:
+                    if e.errno in (errno.EINVAL, errno.ENOSYS) and done == 0:
+                        break  # no file→file sendfile here; fall through
+                    raise
+                if n <= 0:
+                    raise ConnectionError("bulk map sendfile hit EOF")
+                done += n
+            else:
+                return
+        finally:
+            os.close(dfd)
+    if hasattr(writer, "ensure_populated"):
+        writer.ensure_populated()
+    done = 0
+    while done < size:
+        span = min(_SENDFILE_SPAN, size - done)
+        got = os.preadv(src_fd, [writer.raw_view(done, span)], src_base + done)
+        if got <= 0:
+            raise ConnectionError("bulk map pread hit EOF")
+        done += got
+
+
 def _pull_map(addr: str, where: dict, size: int, writer, tmo: float) -> bool:
-    """Same-host handover: ask for (path, offset), pread the span straight
-    into the writer's mapping. Returns False if the server declined."""
+    """Same-host handover: ask for (path, offset), copy the span file→file
+    (or pread it) — never over TCP. Returns False if the server declined."""
     sock = _open_bulk_conn(addr, tmo)
     with contextlib.closing(sock):
         req = json.dumps({
@@ -348,13 +422,7 @@ def _pull_map(addr: str, where: dict, size: int, writer, tmo: float) -> bool:
             )
         fd = os.open(path, os.O_RDONLY)
         try:
-            done = 0
-            while done < size:
-                span = min(_SENDFILE_SPAN, size - done)
-                got = os.preadv(fd, [writer.raw_view(done, span)], base + done)
-                if got <= 0:
-                    raise ConnectionError("bulk map pread hit EOF")
-                done += got
+            _copy_span_from_file(fd, base, size, writer)
         finally:
             os.close(fd)
         sock.sendall(b"\x01")  # release the server-side pin

@@ -114,6 +114,10 @@ define("bulk_min_bytes", 1 << 20,
 define("bulk_same_host_map", True,
        doc="Same-host pulls pread the source shm file directly (plasma "
            "fd-passing by name) instead of looping through TCP")
+define("arena_prefault", True,
+       doc="Fault the arena mapping in once at creation (background): tmpfs "
+           "pages stay guest-resident for the file's life, so every later "
+           "object write runs at warm-page speed (see core/mem.py)")
 define("worker_forkserver", True,
        doc="Per-node pre-imported template process; CPU workers fork from "
            "it in ~10ms instead of booting an interpreter (~2s)")

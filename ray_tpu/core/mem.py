@@ -1,0 +1,112 @@
+"""Page-population helpers for shm-backed object writes.
+
+Why this exists: on this class of host (Firecracker/virtualized kernels with
+lazily-backed guest memory), a first-touch page fault through an mmap of a
+tmpfs file costs ~40-50us/page — memcpy into a fresh shm mapping crawls at
+~0.1 GiB/s while the plain `write()` syscall path to the SAME tmpfs file
+runs at ~3 GiB/s (measured in-repo; see bench in the round-4 notes). The
+reference sidesteps this class of problem by writing objects through the
+plasma store process which owns long-lived, already-faulted arenas
+(`src/ray/object_manager/plasma/store.h:55`); our per-session arena mapping
+is long-lived too, but every NEW allocation's pages still fault on first
+touch.
+
+`populate_write(buf)` batches those faults into one
+`madvise(MADV_POPULATE_WRITE)` syscall (~2.6 GiB/s), after which memcpy /
+`recv_into` / `preadv` land at warm-page speed. On kernels without
+MADV_POPULATE_WRITE (<5.14) the call fails with EINVAL and we fall back to
+doing nothing — the write path still works, just slower.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import mmap
+
+_MADV_POPULATE_WRITE = 23  # linux uapi mman-common.h (kernel >= 5.14)
+_PAGE = mmap.PAGESIZE
+_POPULATE_MIN = 1 << 20  # below 1 MiB the fault cost doesn't matter
+
+_libc = None
+_unavailable = False
+
+
+def _get_libc():
+    global _libc, _unavailable
+    if _libc is None and not _unavailable:
+        try:
+            _libc = ctypes.CDLL(None, use_errno=True)
+            _libc.madvise.argtypes = [
+                ctypes.c_void_p, ctypes.c_size_t, ctypes.c_int,
+            ]
+            _libc.madvise.restype = ctypes.c_int
+        except Exception:  # noqa: BLE001
+            _unavailable = True
+    return _libc
+
+
+def populate_range_async(addr: int, length: int, chunk: int = 64 << 20,
+                         name: str = "rtpu-arena-prefault"):
+    """Fault in `[addr, addr+length)` from a background daemon thread, in
+    strides (content-preserving madvise — safe concurrent with writers).
+
+    Used once per session on the arena mapping: tmpfs pages, once faulted
+    into the guest, stay resident for the life of the arena FILE (frees
+    return blocks to the allocator, not pages to the host), so this one-time
+    warmup moves every later object write from the ~0.1-0.7 GiB/s cold-page
+    path to the 1-3 GiB/s warm path. Analog: plasma's optional up-front pool
+    preallocation (`src/ray/object_manager/plasma/plasma_allocator.cc`).
+    """
+    libc = _get_libc()
+    if libc is None or length <= 0:
+        return
+
+    def run():
+        try:
+            # Linux: threads are schedulable tasks — demote THIS thread so
+            # the warmup never competes with foreground work for the CPU
+            # (the fault work is charged to the caller of madvise).
+            os.setpriority(os.PRIO_PROCESS, 0, 19)
+        except OSError:
+            pass
+        end = addr + length
+        start = addr & ~(_PAGE - 1)
+        while start < end:
+            n = min(chunk, end - start)
+            try:
+                if libc.madvise(start, n + _PAGE - 1 & ~(_PAGE - 1),
+                                _MADV_POPULATE_WRITE) != 0:
+                    return  # unsupported kernel — nothing to warm
+            except Exception:  # noqa: BLE001
+                return
+            start += n
+
+    import threading
+
+    threading.Thread(target=run, name=name, daemon=True).start()
+
+
+def populate_write(buf) -> bool:
+    """Pre-fault the pages backing a writable buffer (best effort).
+
+    Returns True if the madvise succeeded. Safe to call repeatedly (an
+    already-populated range is a fast no-op walk) and on any size (small
+    buffers are skipped).
+    """
+    libc = _get_libc()
+    if libc is None:
+        return False
+    try:
+        view = memoryview(buf)
+        n = view.nbytes
+        if n < _POPULATE_MIN or view.readonly:
+            return False
+        addr = ctypes.addressof(ctypes.c_char.from_buffer(view))
+    except (TypeError, ValueError, BufferError):
+        return False
+    start = addr & ~(_PAGE - 1)
+    length = (addr + n + _PAGE - 1 & ~(_PAGE - 1)) - start
+    # Partial neighbor pages at the edges get populated too — harmless (they
+    # belong to the same mapping, and populating a resident page is a no-op).
+    return libc.madvise(start, length, _MADV_POPULATE_WRITE) == 0

@@ -18,7 +18,7 @@ import threading
 from multiprocessing import shared_memory
 from typing import Any, Dict, Optional, Tuple
 
-from . import serialization
+from . import mem, serialization
 
 # Objects smaller than this ride the control plane inline instead of shm
 # (reference: small objects go to the in-process memory store, big to plasma).
@@ -27,6 +27,12 @@ from . import serialization
 from . import config as _rt_config  # noqa: E402
 
 INLINE_THRESHOLD = _rt_config.get("inline_threshold_bytes")
+
+# Creates at or above this size go through the destination's backing FILE
+# (pwritev / pack_into_fd) instead of memcpy into a fresh mapping: on
+# lazily-backed guest kernels the write() path allocates tmpfs pages ~7×
+# faster than first-touch faults through an mmap (see core/mem.py).
+FD_WRITE_MIN = 1 << 20
 
 _SHM_PREFIX = "rtpu-"
 
@@ -89,7 +95,14 @@ class LocalStore:
             seg = shared_memory.SharedMemory(name=name, create=True, size=max(size, 1))
         _untrack(seg)
         try:
-            serialization.pack_into(payload, buffers, seg.buf)
+            if size >= FD_WRITE_MIN:
+                fd = os.open(f"/dev/shm/{name}", os.O_WRONLY)
+                try:
+                    serialization.pack_into_fd(payload, buffers, fd, 0)
+                finally:
+                    os.close(fd)
+            else:
+                serialization.pack_into(payload, buffers, seg.buf)
         except BaseException:
             seg.close()
             seg.unlink()
@@ -120,7 +133,14 @@ class LocalStore:
         except FileExistsError:
             return name, size  # a concurrent pull already materialized it
         _untrack(seg)
-        seg.buf[:size] = data
+        if size >= FD_WRITE_MIN:
+            fd = os.open(f"/dev/shm/{name}", os.O_WRONLY)
+            try:
+                serialization._pwrite_all(fd, data, 0)
+            finally:
+                os.close(fd)
+        else:
+            seg.buf[:size] = data
         with self._lock:
             self._open[name] = seg
         return name, size
@@ -338,24 +358,52 @@ def arena_segment_name() -> str:
 class _ShmWriter:
     """Incremental writer for a chunked pull into a plain shm segment."""
 
-    __slots__ = ("_store", "_name", "_seg")
+    __slots__ = ("_store", "_name", "_seg", "_populated", "_wfd")
 
     def __init__(self, store, name, seg):
         self._store = store
         self._name = name
         self._seg = seg
+        self._populated = False
+        self._wfd = None
+
+    def ensure_populated(self):
+        """Batch the destination's first-touch page faults (mem.py) before a
+        memcpy-style landing (recv_into/preadv). Idempotent."""
+        if not self._populated:
+            self._populated = True
+            mem.populate_write(self._seg.buf)
+
+    def sink(self):
+        """(path, base_offset) of the backing file — bulk landings go through
+        it via write()-path syscalls, no mmap faults at all (mem.py)."""
+        return f"/dev/shm/{self._name}", 0
+
+    def _fd(self) -> int:
+        if self._wfd is None:
+            self._wfd = os.open(f"/dev/shm/{self._name}", os.O_WRONLY)
+        return self._wfd
 
     def write(self, offset: int, data: bytes):
-        self._seg.buf[offset:offset + len(data)] = data
+        serialization._pwrite_all(self._fd(), data, offset)
 
     def raw_view(self, offset: int, length: int) -> memoryview:
         """Writable window for the bulk plane's recv_into (no staging)."""
         return memoryview(self._seg.buf)[offset:offset + length]
 
+    def _close_fd(self):
+        if self._wfd is not None:
+            try:
+                os.close(self._wfd)
+            except OSError:
+                pass
+            self._wfd = None
+
     def commit(self):
-        pass  # plain shm has no seal step
+        self._close_fd()  # plain shm has no seal step
 
     def abort(self):
+        self._close_fd()
         try:
             with self._store._lock:
                 self._store._open.pop(self._name, None)
@@ -368,15 +416,38 @@ class _ShmWriter:
 class _ArenaWriter:
     """Incremental writer into the native arena (create → write → seal)."""
 
-    __slots__ = ("_store", "_hex", "_view")
+    __slots__ = ("_store", "_hex", "_view", "_file_off", "_populated")
 
-    def __init__(self, store, object_hex, view):
+    def __init__(self, store, object_hex, view, file_off=None):
         self._store = store
         self._hex = object_hex
         self._view = view
+        self._file_off = file_off
+        self._populated = False
+
+    def ensure_populated(self):
+        """Batch the destination's first-touch page faults (mem.py) before a
+        memcpy-style landing (recv_into/preadv). Idempotent."""
+        if not self._populated:
+            self._populated = True
+            mem.populate_write(self._view)
+
+    def sink(self):
+        """(path, base_offset) of the object's span in the arena's backing
+        file, or None — bulk landings go through it via write()-path
+        syscalls, no mmap faults at all (mem.py)."""
+        if self._file_off is None:
+            return None
+        name = self._store.arena.name.lstrip("/")
+        return f"/dev/shm/{name}", self._file_off
 
     def write(self, offset: int, data: bytes):
-        self._view[offset:offset + len(data)] = data
+        if self._file_off is not None and len(data) >= FD_WRITE_MIN:
+            serialization._pwrite_all(
+                self._store._write_fd(), data, self._file_off + offset
+            )
+        else:
+            self._view[offset:offset + len(data)] = data
 
     def raw_view(self, offset: int, length: int) -> memoryview:
         """Writable window for the bulk plane's recv_into (no staging)."""
@@ -402,17 +473,34 @@ class ArenaStore:
         self.fallback = fallback or LocalStore()
         self._pinned: Dict[str, Any] = {}  # hex -> root memoryview (1 pin each)
         self._lock = threading.Lock()
+        self._wfd: Optional[int] = None  # cached write fd on the backing file
+
+    def _write_fd(self) -> int:
+        """Write fd on the arena's backing file, for large creates via the
+        write() syscall path (pwritev with explicit offsets — safe to share
+        across threads). See FD_WRITE_MIN."""
+        with self._lock:
+            if self._wfd is None:
+                self._wfd = os.open(
+                    f"/dev/shm/{self.arena.name.lstrip('/')}", os.O_WRONLY
+                )
+            return self._wfd
 
     # ------------------------------------------------------------- creation
     def create_packed(self, object_hex: str, payload: bytes, buffers) -> Tuple[str, int]:
         size = serialization.packed_size(payload, buffers)
         try:
-            view = self.arena.create(object_hex, size)
+            view, file_off = self.arena.create(object_hex, size, with_offset=True)
         except MemoryError:
             # Arena full → classic per-object segment keeps progress.
             return self.fallback.create_packed(object_hex, payload, buffers)
         try:
-            serialization.pack_into(payload, buffers, view)
+            if size >= FD_WRITE_MIN:
+                serialization.pack_into_fd(
+                    payload, buffers, self._write_fd(), file_off
+                )
+            else:
+                serialization.pack_into(payload, buffers, view)
         except BaseException:
             view.release()
             self.arena.delete(object_hex)
@@ -460,10 +548,13 @@ class ArenaStore:
             self.arena.release(object_hex)
             return ARENA_PREFIX + object_hex, size
         try:
-            view = self.arena.create(object_hex, size)
+            view, file_off = self.arena.create(object_hex, size, with_offset=True)
         except MemoryError:
             return self.fallback.create_raw(object_hex, data)
-        view[:size] = data
+        if size >= FD_WRITE_MIN:
+            serialization._pwrite_all(self._write_fd(), data, file_off)
+        else:
+            view[:size] = data
         view.release()
         self.arena.seal(object_hex)
         return ARENA_PREFIX + object_hex, size
@@ -573,10 +664,12 @@ class ArenaStore:
             self.arena.release(object_hex)
             return ARENA_PREFIX + object_hex, None
         try:
-            view = self.arena.create(object_hex, size)
+            view, file_off = self.arena.create(object_hex, size, with_offset=True)
         except MemoryError:
             return self.fallback.create_begin(object_hex, size)
-        return ARENA_PREFIX + object_hex, _ArenaWriter(self, object_hex, view)
+        return ARENA_PREFIX + object_hex, _ArenaWriter(
+            self, object_hex, view, file_off
+        )
 
     # ------------------------------------------------------------- lifetime
     def spill(self, name: str, spill_dir: str) -> str:
@@ -624,6 +717,12 @@ class ArenaStore:
         with self._lock:
             pinned = dict(self._pinned)
             self._pinned.clear()
+            if self._wfd is not None:
+                try:
+                    os.close(self._wfd)
+                except OSError:
+                    pass
+                self._wfd = None
         for hex_id, view in pinned.items():
             try:
                 view.release()
@@ -659,6 +758,10 @@ def make_store(
             except OSError:
                 pass
             arena = Arena(name, capacity=capacity, create=True)
+            if _rt_config.get("arena_prefault"):
+                # One-time background warmup of the whole mapping — later
+                # object writes hit warm pages (core/mem.py rationale).
+                mem.populate_range_async(arena._base, arena.capacity)
         else:
             arena = Arena(name, create=False)
     except Exception:  # noqa: BLE001  (native build failed / arena absent)

@@ -201,12 +201,18 @@ class Arena:
         self._owner = create
 
     # -------------------------------------------------------------- objects
-    def create(self, object_id: str, size: int) -> memoryview:
-        """Allocate an unsealed object; returns a writable view of it."""
+    def create(self, object_id: str, size: int, with_offset: bool = False):
+        """Allocate an unsealed object; returns a writable view of it — or
+        (view, file_offset) with ``with_offset`` (the bulk plane's same-host
+        map handover sendfiles into that span of the backing file; the offset
+        is only knowable here because `locate()` requires a sealed object)."""
         off = self._lib.rt_arena_alloc(self._h, object_id.encode(), size)
         if off < 0:
             raise MemoryError(f"arena alloc failed for {object_id} ({size}B)")
-        return self._view(off, size)
+        view = self._view(off, size)
+        if with_offset:
+            return view, off + self._lib.rt_arena_data_offset(self._h)
+        return view
 
     def seal(self, object_id: str):
         if self._lib.rt_arena_seal(self._h, object_id.encode()) != 0:
