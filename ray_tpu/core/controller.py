@@ -363,7 +363,12 @@ class Controller:
         # last spawn time (a monotonic gate so one isolated worker boots
         # per key per node at a time, self-healing if the spawn dies).
         self._worker_env_keys: Dict[str, str] = {}
-        self._iso_booting: Dict[Tuple[str, str], float] = {}
+        # (node, key) -> (last spawn time, worker_id of that attempt)
+        self._iso_booting: Dict[Tuple[str, str], Tuple[float, str]] = {}
+        # (node, key) -> consecutive spawns that died before registering
+        # (wrapper exec'd fine but the env is broken: bad conda env name,
+        # unpullable image, ...). Capped — see _spawn_isolated.
+        self._iso_attempts: Dict[Tuple[str, str], int] = {}
         # (node_id, env_key) -> error: the isolation binary is missing on
         # that node (sticky; a node gaining conda mid-session must rejoin).
         self._iso_unavailable: Dict[Tuple[str, str], str] = {}
@@ -738,7 +743,9 @@ class Controller:
             # Registration looks the env_key up by worker_id (the worker
             # itself doesn't need to know its isolation hash).
             self._worker_env_keys[worker_id] = isolation["key"]
-            self._iso_booting[(node.node_id, isolation["key"])] = time.monotonic()
+            self._iso_booting[(node.node_id, isolation["key"])] = (
+                time.monotonic(), worker_id,
+            )
         if node.conn is not None:
             asyncio.ensure_future(
                 node.conn.send({
@@ -829,9 +836,42 @@ class Controller:
                 self._fail_iso_tasks_without_candidates(key)
                 return
             node = alt
-        last = self._iso_booting.get((node.node_id, key))
-        if last is not None and time.monotonic() - last < 15.0:
-            return  # a worker for this env is already booting there
+        booting = self._iso_booting.get((node.node_id, key))
+        if booting is not None:
+            last, prev_worker = booting
+            if time.monotonic() - last < rt_config.get("iso_boot_grace_s"):
+                return  # a worker for this env is already booting there
+            proc = self._worker_procs.get(prev_worker)
+            if proc is not None and hasattr(proc, "poll") and proc.poll() is None:
+                # Still ALIVE past the grace — a slow boot (first image
+                # pull, heavy conda activate), not a dead one. Extend the
+                # window rather than double-spawning or counting a failure.
+                self._iso_booting[(node.node_id, key)] = (
+                    time.monotonic(), prev_worker,
+                )
+                return
+            # Dead (or agent-spawned and unobservable) without registering:
+            # bad conda env name, unpullable image, ... Count it exactly
+            # once — the entry is POPPED here and only re-armed by
+            # _spawn_worker when a new spawn actually launches, so a
+            # boot-cap deferral can never inflate the counter. After a few
+            # dead attempts the node stops being a candidate, which
+            # surfaces RuntimeEnvSetupError to the queued tasks — the
+            # reference's RUNTIME_ENV_SETUP_FAILED contract
+            # (`python/ray/_private/runtime_env/container.py`).
+            self._iso_booting.pop((node.node_id, key), None)
+            self._worker_env_keys.pop(prev_worker, None)
+            if proc is not None:
+                self._worker_procs.pop(prev_worker, None)
+            attempts = self._iso_attempts.get((node.node_id, key), 0) + 1
+            self._iso_attempts[(node.node_id, key)] = attempts
+            if attempts >= 3:
+                self._iso_unavailable[(node.node_id, key)] = (
+                    f"isolated worker died before registering "
+                    f"{attempts} times (broken env?)"
+                )
+                self._fail_iso_tasks_without_candidates(key)
+                return
         self._spawn_worker(tpu=tpu, node=node, force=True, isolation=isolation)
 
     def _iso_candidate(self, spec, key: str) -> Optional["NodeState"]:
@@ -997,6 +1037,10 @@ class Controller:
         self._worker_env_keys.pop(worker_id, None)
         if env_key:
             self._iso_booting.pop((node_id, env_key), None)
+            self._iso_attempts.pop((node_id, env_key), None)
+            # A registered worker PROVES the env works here — undo any
+            # unavailable verdict a slow earlier boot may have left.
+            self._iso_unavailable.pop((node_id, env_key), None)
         ws = WorkerState(
             worker_id=worker_id,
             conn=conn,
@@ -3311,6 +3355,13 @@ class Controller:
             except Exception:  # noqa: BLE001
                 pass
             self._expire_spawn_ledger()
+            if self.ready_queue and self._iso_booting:
+                # Scheduling is event-driven; an isolated spawn that died
+                # before registering produces NO event. This tick is what
+                # advances the dead-attempt counter (_spawn_isolated) so a
+                # broken env converges to RuntimeEnvSetupError instead of
+                # hanging its tasks forever.
+                self._schedule()
 
     def _expire_spawn_ledger(self):
         """Spawns that never registered (interpreter died / wedged) must

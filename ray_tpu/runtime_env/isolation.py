@@ -85,6 +85,20 @@ _FORWARD_PREFIXES = ("RAY_TPU_", "JAX_", "XLA_")
 _FORWARD_EXACT = ("PYTHONPATH", "PYTHONUNBUFFERED", "TPU_SKIP_MDS_QUERY")
 
 
+def _relocated(base_argv: List[str]) -> List[str]:
+    """The wrapped command runs in a DIFFERENT interpreter world; the
+    spawner's absolute `sys.executable` would escape it (`conda run` would
+    exec the HOST interpreter with host site-packages; a container image
+    likely has no python at that host path at all). Swap an absolute
+    interpreter path for PATH-resolved `python3` (the PEP 394 guaranteed
+    name; Debian-family images often ship no bare `python`), which the
+    wrapper environment resolves to ITS interpreter — the entire point of the
+    feature."""
+    if base_argv and os.path.isabs(base_argv[0]):
+        return ["python3"] + base_argv[1:]
+    return list(base_argv)
+
+
 def build_argv(
     isolation: Dict[str, Any], base_argv: List[str], env: Dict[str, str],
     session_dir: str,
@@ -93,6 +107,7 @@ def build_argv(
     Raises RuntimeError when the needed binary is absent on this node."""
     kind, spec = isolation["kind"], isolation["spec"]
     validate_spec(kind, spec)
+    base_argv = _relocated(base_argv)
     if kind == "conda":
         conda = os.environ.get("CONDA_EXE") or shutil.which("conda")
         if conda is None:

@@ -42,6 +42,21 @@ class TestValidation:
 
 
 class TestArgvBuilding:
+    def test_absolute_interpreter_is_relocated(self, monkeypatch, tmp_path):
+        # Production passes [sys.executable, "-m", ...]; the wrapper must
+        # NOT carry the host-absolute interpreter into the other world
+        # (conda run would exec the host python; the image may not even
+        # have that path). PATH-resolved `python` binds inside the env.
+        fake = tmp_path / "conda"
+        fake.write_text("#!/bin/sh\n")
+        fake.chmod(0o755)
+        monkeypatch.setenv("CONDA_EXE", str(fake))
+        argv = build_argv(
+            resolve({"conda": "myenv"}),
+            ["/usr/local/bin/python3.12", "-m", "w"], {}, "/tmp/s",
+        )
+        assert argv[-3:] == ["python3", "-m", "w"]
+
     def test_conda_wrap(self, monkeypatch, tmp_path):
         fake = tmp_path / "conda"
         fake.write_text("#!/bin/sh\n")
@@ -157,6 +172,34 @@ class TestIsolatedWorkers:
 
             a = A.remote()
             assert ray_tpu.get(a.env.remote(), timeout=60) == "actorenv"
+        finally:
+            ray_tpu.shutdown()
+
+    def test_dead_env_fails_after_capped_attempts(self, tmp_path, monkeypatch):
+        # A wrapper that execs fine but whose env is broken (here: exits 1
+        # before the worker can register) must NOT respawn forever — after
+        # 3 dead attempts the (node, env) is marked unavailable and the
+        # task fails with RuntimeEnvSetupError (reference:
+        # RUNTIME_ENV_SETUP_FAILED on env setup failure).
+        bind = tmp_path / "bin"
+        bind.mkdir()
+        shim = bind / "conda"
+        shim.write_text("#!/bin/sh\nexit 1\n")
+        shim.chmod(shim.stat().st_mode | stat.S_IEXEC)
+        monkeypatch.delenv("CONDA_EXE", raising=False)
+        monkeypatch.setenv("PATH", f"{bind}{os.pathsep}{os.environ['PATH']}")
+        monkeypatch.setenv("RAY_TPU_ISO_BOOT_GRACE_S", "1.0")
+        from ray_tpu.core import config as rt_config
+
+        rt_config._reset_cache_for_tests()  # flag may be cached pre-override
+        ray_tpu.init(num_cpus=2)
+        try:
+            @ray_tpu.remote(runtime_env={"conda": "brokenenv"})
+            def f():
+                return 1
+
+            with pytest.raises(Exception, match="RuntimeEnvSetupError|environment"):
+                ray_tpu.get(f.remote(), timeout=90)
         finally:
             ray_tpu.shutdown()
 
