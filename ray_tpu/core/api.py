@@ -25,7 +25,18 @@ from .task_spec import TaskOptions
 
 _runtime: Optional[Runtime] = None
 _runtime_lock = threading.RLock()
+_runtime_factory = None
 _job_counter = 0
+
+
+def set_runtime_factory(factory) -> None:
+    """Deferred worker bootstrap: `factory()` builds and installs this
+    process's Runtime (via set_global_runtime) on FIRST API use. Workers
+    set this instead of connecting a full client backend at boot — actors
+    and tasks that never call the API back into the runtime skip that cost
+    entirely (it dominated fork-to-ready time on the bench host)."""
+    global _runtime_factory
+    _runtime_factory = factory
 
 
 def _global_runtime() -> Runtime:
@@ -33,7 +44,10 @@ def _global_runtime() -> Runtime:
     if _runtime is None:
         with _runtime_lock:
             if _runtime is None:
-                init()
+                if _runtime_factory is not None:
+                    _runtime_factory()
+                else:
+                    init()
     return _runtime
 
 
@@ -54,7 +68,9 @@ def set_global_runtime(runtime: Optional[Runtime]):
 
 
 def is_initialized() -> bool:
-    return _runtime is not None
+    # A worker with a pending runtime factory IS part of an initialized
+    # session — the runtime just hasn't been forced yet.
+    return _runtime is not None or _runtime_factory is not None
 
 
 def init(
@@ -87,6 +103,8 @@ def init(
         address = address[len("ray://"):]
         remote_client = True
     with _runtime_lock:
+        if _runtime is None and _runtime_factory is not None:
+            _runtime_factory()  # worker: force the deferred bootstrap
         if _runtime is not None:
             if ignore_reinit_error:
                 return RuntimeContextInfo(_runtime)
@@ -159,8 +177,9 @@ def _atexit_shutdown():
 
 
 def shutdown():
-    global _runtime
+    global _runtime, _runtime_factory
     with _runtime_lock:
+        _runtime_factory = None
         if _runtime is not None:
             _runtime.shutdown()
             _runtime = None

@@ -487,17 +487,27 @@ class ClusterBackend(RuntimeBackend):
         from .actor import ActorHandle
 
         handle = ActorHandle(spec.actor_id, spec.name, dict(spec.method_meta))
-        resp = self._request(
-            {
-                "type": "create_actor",
-                "spec": spec_to_proto_bytes(spec),
-                "name": name,
-                "namespace": namespace or "default",
-                "handle": cloudpickle.dumps(handle),
-            }
-        )
-        if resp and resp.get("error"):
-            raise ValueError(resp["error"])
+        msg = {
+            "type": "create_actor",
+            "spec": spec_to_proto_bytes(spec),
+            "name": name,
+            "namespace": namespace or "default",
+            "handle": cloudpickle.dumps(handle),
+        }
+        if name:
+            # Named creation stays a round trip: the name-taken conflict is
+            # a synchronous ValueError by API contract.
+            resp = self._request(msg)
+            if resp and resp.get("error"):
+                raise ValueError(resp["error"])
+            return
+        # Anonymous creation is fire-and-forget (reference semantics: actor
+        # creation is async; errors — infeasibility, init failure — surface
+        # on the first method call via the actor's error state). FIFO with
+        # the subsequent submit_actor_task posts on this connection. This
+        # keeps a creation burst pipelined instead of paying one controller
+        # round trip per actor while the controller is busy booting workers.
+        self._send_pipelined(msg)
 
     def submit_actor_task(self, spec: TaskSpec) -> None:
         from .task_spec import spec_to_proto_bytes

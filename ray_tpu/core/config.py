@@ -114,10 +114,13 @@ define("bulk_min_bytes", 1 << 20,
 define("bulk_same_host_map", True,
        doc="Same-host pulls pread the source shm file directly (plasma "
            "fd-passing by name) instead of looping through TCP")
-define("iso_boot_grace_s", 15.0,
+define("iso_boot_grace_s", 30.0,
        doc="Seconds an isolated (conda/container) worker spawn may take to "
-           "register before it counts as a dead attempt; 3 dead attempts "
-           "mark the (node, env) unavailable")
+           "register before it counts as a dead attempt (the window widens "
+           "per attempt: x1, x2, x3 -> 3 min total by default — REMOTE "
+           "agent spawns are unobservable from the head, so slow image "
+           "pulls must not be misread as dead); 3 dead attempts mark the "
+           "(node, env) unavailable")
 define("arena_prefault", True,
        doc="Fault the arena mapping in once at creation (background): tmpfs "
            "pages stay guest-resident for the file's life, so every later "

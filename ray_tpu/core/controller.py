@@ -667,7 +667,9 @@ class Controller:
                 except Exception:  # noqa: BLE001
                     pass
         await asyncio.sleep(0.05)
-        for proc in self._worker_procs.values():
+        # list(): the fork-flusher thread may still be registering
+        # PidHandles mid-burst; a live dict would raise mid-iteration.
+        for proc in list(self._worker_procs.values()):
             if proc.poll() is None:
                 proc.terminate()
         for obj in self.objects.values():
@@ -795,27 +797,32 @@ class Controller:
         ):
             # Warm path: ~10 ms fork from the pre-imported template. Fork
             # preserves the no-pdeathsig property (the template, not the
-            # controller, is the parent — and it ignores SIGCHLD).
-            try:
-                self._worker_procs[worker_id] = self._forkserver.spawn(
-                    worker_id, env, log_path
-                )
-                return
-            except Exception:  # noqa: BLE001 — template died; spawn cold
-                traceback.print_exc()
+            # controller, is the parent — and it ignores SIGCHLD). Async +
+            # batched: the round trip must not block the event loop, and a
+            # creation burst coalesces into few template trips. Failed
+            # trips recover via spawn-ledger expiry (see spawn_async).
+            self._forkserver.spawn_async(
+                worker_id, env, log_path, self._worker_procs.__setitem__
+            )
+            return
+        self._worker_procs[worker_id] = self._popen_cold(
+            argv, env, log_path, pkg_root
+        )
+
+    @staticmethod
+    def _popen_cold(argv, env, log_path, cwd) -> subprocess.Popen:
         log_f = open(log_path, "ab")
-        proc = subprocess.Popen(
+        return subprocess.Popen(
             argv,
             env=env,
             stdout=log_f,
             stderr=subprocess.STDOUT,
-            cwd=pkg_root,
+            cwd=cwd,
             # NO pdeathsig here: head workers deliberately survive a
             # controller crash so a restarted controller re-adopts them
             # (controller FT). Orphan cleanup is the worker's reconnect
             # grace timeout, not process lineage.
         )
-        self._worker_procs[worker_id] = proc
 
     def _spawn_isolated(self, node: "NodeState", spec, tpu: bool = False):
         """Spawn a worker wrapped in the task's conda/container isolation
@@ -839,13 +846,18 @@ class Controller:
         booting = self._iso_booting.get((node.node_id, key))
         if booting is not None:
             last, prev_worker = booting
-            if time.monotonic() - last < rt_config.get("iso_boot_grace_s"):
+            attempts_so_far = self._iso_attempts.get((node.node_id, key), 0)
+            # Grace grows with attempts: slow env setups (image pull, heavy
+            # conda activate) on REMOTE nodes are unobservable from here —
+            # the widening window keeps them from being misread as dead.
+            grace = rt_config.get("iso_boot_grace_s") * (attempts_so_far + 1)
+            if time.monotonic() - last < grace:
                 return  # a worker for this env is already booting there
             proc = self._worker_procs.get(prev_worker)
             if proc is not None and hasattr(proc, "poll") and proc.poll() is None:
-                # Still ALIVE past the grace — a slow boot (first image
-                # pull, heavy conda activate), not a dead one. Extend the
-                # window rather than double-spawning or counting a failure.
+                # Still ALIVE past the grace — a slow boot, not a dead one.
+                # Extend the window rather than double-spawning or counting
+                # a failure.
                 self._iso_booting[(node.node_id, key)] = (
                     time.monotonic(), prev_worker,
                 )
@@ -859,11 +871,17 @@ class Controller:
             # surfaces RuntimeEnvSetupError to the queued tasks — the
             # reference's RUNTIME_ENV_SETUP_FAILED contract
             # (`python/ray/_private/runtime_env/container.py`).
+            # NOTE: _worker_env_keys[prev_worker] is kept for unobservable
+            # spawns — if the spawn is merely slow (remote) and registers
+            # later, its env key must still resolve or an ISOLATED worker
+            # would join the plain pool and run non-isolated tasks in the
+            # wrong world. Registration pops it; a truly dead attempt leaks
+            # one short string, bounded at 3 per (node, env).
             self._iso_booting.pop((node.node_id, key), None)
-            self._worker_env_keys.pop(prev_worker, None)
             if proc is not None:
                 self._worker_procs.pop(prev_worker, None)
-            attempts = self._iso_attempts.get((node.node_id, key), 0) + 1
+                self._worker_env_keys.pop(prev_worker, None)
+            attempts = attempts_so_far + 1
             self._iso_attempts[(node.node_id, key)] = attempts
             if attempts >= 3:
                 self._iso_unavailable[(node.node_id, key)] = (
@@ -2535,6 +2553,10 @@ class Controller:
         cpu_backlog = sum(
             1 for pt in itertools.islice(self.ready_queue, 256)
             if pt.spec.resources.get("TPU", 0) == 0
+            # Actor creations get FORCED dedicated spawns above — counting
+            # them here pre-forks pool workers nothing will ever run on
+            # (observed: ~30 junk forks per 100-actor burst).
+            and pt.spec.task_type != TaskType.ACTOR_CREATION_TASK
         )
         deficit = cpu_backlog - starting
         head_live = live_by_node.get(self.head.node_id, 0)
@@ -3508,7 +3530,7 @@ class Controller:
                 if over is None:
                     continue
                 pids = {
-                    wid: p.pid for wid, p in self._worker_procs.items()
+                    wid: p.pid for wid, p in list(self._worker_procs.items())
                     if p.poll() is None
                 }
                 if not pids:

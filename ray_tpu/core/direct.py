@@ -63,13 +63,18 @@ def _spec_blob(spec_or_bytes) -> bytes:
 
 # A lease idle longer than this returns to the controller's pool.
 LEASE_IDLE_RETURN_S = 2.0
+# A lease with tasks in flight but NO completion for this long gets a
+# health probe; an unanswered probe closes the conn (the close handler
+# resubmits its pendings). Catches wedged conns/workers that look open.
+LEASE_STALL_PING_S = 10.0
 # Leases requested per scheduling key when the fast path misses (the
 # controller grants up to available capacity; extras idle-return).
 LEASE_WANT = 4
 
 
 class _Lease:
-    __slots__ = ("worker_id", "addr", "conn", "inflight", "draining", "last_used")
+    __slots__ = ("worker_id", "addr", "conn", "inflight", "draining",
+                 "last_used", "pinging")
 
     def __init__(self, worker_id: str, addr: str, conn: Connection):
         self.worker_id = worker_id
@@ -78,6 +83,7 @@ class _Lease:
         self.inflight = 0
         self.draining = False
         self.last_used = time.monotonic()
+        self.pinging = False  # stall-watchdog health probe in flight
 
 
 class _Pending:
@@ -85,7 +91,7 @@ class _Pending:
 
     __slots__ = ("spec_bytes", "return_hexes", "event", "retries", "lease",
                  "actor_hex", "resubmit_kind", "publish", "arg_pins", "discard",
-                 "rebalance", "cancelled")
+                 "rebalance", "rebalance_t", "cancelled")
 
     def __init__(self, spec_bytes: bytes, return_hexes: List[str],
                  retries: int, resubmit_kind: str, actor_hex: str = ""):
@@ -106,6 +112,7 @@ class _Pending:
         # A steal is in flight: if the worker drops it (unstarted), it
         # REASSIGNS to a fresher lease instead of resolving as cancelled.
         self.rebalance = False
+        self.rebalance_t = 0.0  # when the steal was sent (ack watchdog)
         # cancel() beat the rebalance: a drop resolves as cancelled.
         self.cancelled = False
         # Strong ObjectRefs pinning this call's arguments until completion —
@@ -116,7 +123,7 @@ class _Pending:
 
 class _ActorChannel:
     __slots__ = ("mode", "conn", "addr", "buffer", "pending_hexes", "cooldown",
-                 "out_batch", "out_scheduled")
+                 "out_batch", "out_scheduled", "calls")
 
     def __init__(self):
         self.mode = "classic"  # classic | handoff | direct
@@ -125,6 +132,7 @@ class _ActorChannel:
         self.buffer: List[TaskSpec] = []  # specs queued during handoff
         self.pending_hexes: set = set()
         self.cooldown = 0.0  # monotonic time before retrying a failed handoff
+        self.calls = 0  # classic submissions so far (handoff gates on >1)
         # Submission coalescing: compact calls accumulated between io-loop
         # wake-ups ship as ONE message (the worker's io thread unpickling
         # one frame per call stole the GIL from its executing main thread).
@@ -168,6 +176,13 @@ class DirectCallManager:
         self._closed = False
         self._idle_timer_started = False
         self._idle_task_fut = None
+        # Debug ring: lease lifecycle events (cheap; dumped by forensics).
+        self._lease_log: List[Tuple] = []
+
+    def _llog(self, *ev):
+        self._lease_log.append((round(time.monotonic(), 3),) + ev)
+        if len(self._lease_log) > 200:
+            del self._lease_log[:100]
 
     # ------------------------------------------------------------ normal
     def eligible(self, spec: TaskSpec) -> bool:
@@ -350,9 +365,12 @@ class DirectCallManager:
                     give_back = new
                 else:
                     if new:
+                        for _l in new:
+                            self._llog("grant", _l.worker_id, id(_l))
                         self._leases.setdefault(key, []).extend(new)
                         if not self._idle_timer_started:
                             self._idle_timer_started = True
+                            self._llog("idle_loop_start")
                             self._idle_task_fut = self.io.call_nowait(
                                 self._idle_return_loop()
                             )
@@ -428,13 +446,24 @@ class DirectCallManager:
                     and not entry.rebalance and not entry.actor_hex
                 ):
                     by_lease.setdefault(l, []).append((task_hex, entry))
+            planned: Dict[_Lease, int] = {}
             for _ in idle:
-                deep = max(by_lease, key=lambda l: l.inflight, default=None) \
-                    if by_lease else None
-                if deep is None or not by_lease.get(deep):
+                deep = max(
+                    (l for l in by_lease
+                     if by_lease[l]
+                     # Leave one task per lease un-stolen: the deepest one
+                     # is (usually) RUNNING — stealing it is a guaranteed
+                     # refusal round trip, and a fully-emptied healthy
+                     # lease would sit idle.
+                     and planned.get(l, 0) < l.inflight - 1),
+                    key=lambda l: l.inflight, default=None,
+                )
+                if deep is None:
                     break
                 task_hex, entry = by_lease[deep].pop()
                 entry.rebalance = True
+                entry.rebalance_t = now
+                planned[deep] = planned.get(deep, 0) + 1
                 steals.append((deep, task_hex))
         for lease, task_hex in steals:
             self._pipelined(lease.conn, {"type": "drop_task", "task": task_hex})
@@ -636,6 +665,7 @@ class DirectCallManager:
         to_fail: List[_Pending] = []
         with self._lock:
             if lease is not None:
+                self._llog("recover_lost", lease.worker_id, id(lease))
                 for lst in self._leases.values():
                     if lease in lst:
                         lst.remove(lease)
@@ -682,25 +712,124 @@ class DirectCallManager:
     # -------------------------------------------------- lease lifecycle
     async def _idle_return_loop(self):
         import asyncio
+        import traceback
 
         while not self._closed:
             await asyncio.sleep(LEASE_IDLE_RETURN_S / 2)
-            now = time.monotonic()
-            give_back: List[_Lease] = []
-            with self._lock:
-                for key, lst in list(self._leases.items()):
-                    for lease in list(lst):
+            try:
+                await self._idle_sweep_once()
+            except Exception:  # noqa: BLE001 — the sweep is the liveness
+                # backstop for the whole lease plane; one bad tick (a lease
+                # mutated mid-scan, a closing conn) must never kill it.
+                self._llog("sweep_error", traceback.format_exc()[-400:])
+
+    async def _idle_sweep_once(self):
+        now = time.monotonic()
+        self._llog("sweep", sum(len(v) for v in self._leases.values()),
+                   len(self._pending))
+        give_back: List[_Lease] = []
+        rebalance: List[Tuple] = []
+        stalled: List[_Lease] = []
+        busy: List[_Lease] = []
+        with self._lock:
+            for key, lst in list(self._leases.items()):
+                for lease in list(lst):
+                    if (
+                        lease.inflight == 0
+                        and now - lease.last_used > LEASE_IDLE_RETURN_S
+                    ):
+                        self._llog("idle_return", lease.worker_id, id(lease))
+                        lst.remove(lease)
+                        give_back.append(lease)
+                if not lst:
+                    self._leases.pop(key, None)
+                    continue
+                # Liveness backstop: a task pipelined behind a long one
+                # while another lease sits idle normally rebalances on
+                # the grant/idle-transition steals — but those are
+                # single events; if either notification is lost (worker
+                # hiccup, conn race) the task would wait out the ENTIRE
+                # long task. This periodic sweep bounds that to one
+                # idle-loop tick (observed once as a stranded fast task
+                # behind a 10 s sleeper with three idle leases).
+                if any(l.inflight > 1 for l in lst) and any(
+                    l.inflight == 0 and not l.draining
+                    and not l.conn._closed for l in lst
+                ):
+                    rebalance.append(key)
+                for lease in lst:
+                    if lease.inflight > 0 and not lease.conn._closed:
+                        busy.append(lease)
                         if (
-                            lease.inflight == 0
-                            and now - lease.last_used > LEASE_IDLE_RETURN_S
+                            not lease.pinging
+                            and now - lease.last_used > LEASE_STALL_PING_S
                         ):
-                            lst.remove(lease)
-                            give_back.append(lease)
-                    if not lst:
-                        self._leases.pop(key, None)
-            for lease in give_back:
-                lease.conn.close()
-                await self._return_lease_id(lease.worker_id)
+                            lease.pinging = True
+                            stalled.append(lease)
+            # A steal sent but never acked (dropped OR executed) within
+            # 2 s means the lease conn is likely blackholed — probe it
+            # NOW rather than waiting out LEASE_STALL_PING_S (observed:
+            # both a fast task and its drop request vanishing on one
+            # lease while the socket looked open).
+            for entry in self._pending.values():
+                l = entry.lease
+                if (
+                    entry.rebalance and l is not None
+                    and now - entry.rebalance_t > 0.75
+                    and not l.pinging and not l.conn._closed
+                ):
+                    l.pinging = True
+                    stalled.append(l)
+        for key in rebalance:
+            self._steal_for(key)
+        for lease in busy:
+            # Lost-wakeup repair: a dropped post-flush wakeup leaves
+            # direct_task frames parked in the conn's buffer while the
+            # worker looks idle (observed as two tasks blackholed on
+            # one lease). Re-firing the (idempotent) flush every sweep
+            # tick bounds that wedge to one tick.
+            try:
+                lease.conn._loop.call_soon_threadsafe(
+                    lease.conn._flush_posts
+                )
+            except RuntimeError:
+                pass
+        for lease in stalled:
+            # No completion for LEASE_STALL_PING_S: prove the worker's
+            # io round trip, or the conn dies and its pendings resubmit
+            # via the close handler.
+            self.io.call_nowait(self._probe_stalled_lease(lease))
+        for lease in give_back:
+            lease.conn.close()
+            await self._return_lease_id(lease.worker_id)
+
+    async def _probe_stalled_lease(self, lease: _Lease):
+        """Health-probe a lease that has inflight work but no completions:
+        an answered ping proves the socket + worker io loop both ways (the
+        tasks are just long); an unanswered one means a wedged conn or dead
+        worker — close it, and the close handler resubmits its pendings."""
+        import asyncio
+
+        try:
+            await asyncio.wait_for(
+                lease.conn.request({"type": "lease_ping"}), timeout=2.5
+            )
+        except Exception:  # noqa: BLE001 — no pong: recover via close
+            lease.conn.close()
+        else:
+            # A pong settles the lease: the worker demonstrably processed
+            # everything sent before the ping (same-conn FIFO) — any
+            # still-unacked steal was a REFUSAL (the task already started;
+            # it completes normally), so clear those markers and refresh
+            # the stall clock, else this probe would refire every sweep
+            # tick for a long task's whole runtime.
+            with self._lock:
+                lease.last_used = time.monotonic()
+                for entry in self._pending.values():
+                    if entry.lease is lease and entry.rebalance:
+                        entry.rebalance = False
+        finally:
+            lease.pinging = False
 
     def on_revoke(self, worker_id: str):
         """Controller wants the worker back (queued-path backlog)."""
@@ -709,6 +838,7 @@ class DirectCallManager:
             for lst in self._leases.values():
                 for lease in lst:
                     if lease.worker_id == worker_id:
+                        self._llog("revoke", worker_id, id(lease), lease.inflight)
                         lease.draining = True
                         if lease.inflight == 0:
                             lst.remove(lease)
@@ -719,6 +849,7 @@ class DirectCallManager:
 
     def _finish_drain(self, lease: _Lease):
         with self._lock:
+            self._llog("finish_drain", lease.worker_id, id(lease))
             for lst in self._leases.values():
                 if lease in lst:
                     lst.remove(lease)
@@ -740,7 +871,13 @@ class DirectCallManager:
             if ch is None:
                 ch = self._actors[actor_hex] = _ActorChannel()
             if ch.mode == "classic":
-                if time.monotonic() >= ch.cooldown:
+                # Direct-channel handoff costs a round trip + fence + a TCP
+                # connect per actor — pure loss for one-shot actors (envelope
+                # ping probes, init-then-idle patterns). The FIRST call rides
+                # the classic plane; sustained traffic (second call onward)
+                # triggers the upgrade.
+                ch.calls += 1
+                if ch.calls > 1 and time.monotonic() >= ch.cooldown:
                     ch.mode = "handoff"
                     self.io.call_nowait(self._handoff(actor_hex, ch))
                     # THIS call buffers behind the fence (order preserved:

@@ -34,6 +34,7 @@ import socket
 import struct
 import subprocess
 import sys
+import threading
 import time
 from typing import Dict, Optional
 
@@ -116,6 +117,10 @@ class ForkServerClient:
         self.log_path = os.path.join(session_dir, f"forkserver-{name}.log")
         self.proc: Optional[subprocess.Popen] = None
         self._ready = False
+        # spawn_async coalescing (see there).
+        self._q: list = []
+        self._q_lock = threading.Lock()
+        self._flusher_active = False
 
     def start(self, pdeathsig: bool = False):
         """Launch the template (non-blocking: readiness is polled later).
@@ -157,12 +162,15 @@ class ForkServerClient:
 
     @property
     def ready(self) -> bool:
-        """True once the template is accepting fork requests."""
-        if self._ready:
-            return True
+        """True while the template is alive and accepting fork requests.
+        Re-checks liveness every call: a dead template must flip this back
+        to False so spawners fall back to cold Popen instead of retrying
+        the warm path forever."""
         if self.proc is None or self.proc.poll() is not None:
+            self._ready = False
             return False
-        self._ready = os.path.exists(self.sock_path)
+        if not self._ready:
+            self._ready = os.path.exists(self.sock_path)
         return self._ready
 
     def spawn(self, worker_id: str, env: Dict[str, str], log_path: str) -> PidHandle:
@@ -179,6 +187,63 @@ class ForkServerClient:
         if "pid" not in resp:
             raise RuntimeError(f"forkserver error: {resp.get('error')}")
         return PidHandle(resp["pid"])
+
+    def spawn_async(self, worker_id: str, env: Dict[str, str], log_path: str,
+                    register) -> None:
+        """Queue a fork; `register(worker_id, PidHandle)` fires from the
+        flusher thread. Queued requests coalesce into BATCHED template round
+        trips — a 2,000-actor burst pays ~60 round trips instead of 2,000
+        (each trip costs a template scheduling delay on a loaded host, and
+        none of them may block the caller's event loop).
+
+        A failed TRIP (template death, timeout) deliberately does NOT
+        cold-respawn here: the forks may have succeeded before the failure
+        (a reply timeout proves nothing), and a blind respawn would
+        duplicate live worker_ids. Recovery is the spawn ledger: boots that
+        never register expire and re-fire demand through _schedule, which
+        re-checks `ready` (False once the template is gone) and takes the
+        cold path."""
+        with self._q_lock:
+            self._q.append((worker_id, env, log_path, register))
+            if self._flusher_active:
+                return
+            self._flusher_active = True
+        threading.Thread(
+            target=self._flush_spawns, name="rtpu-fork-flush", daemon=True
+        ).start()
+
+    def _flush_spawns(self):
+        while True:
+            with self._q_lock:
+                batch = self._q[:32]
+                del self._q[:32]
+                if not batch:
+                    self._flusher_active = False
+                    return
+            try:
+                sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                sock.settimeout(30.0)
+                try:
+                    sock.connect(self.sock_path)
+                    _send_msg(sock, {"batch": [
+                        {"worker_id": w, "env": e, "log_path": lp}
+                        for w, e, lp, _ in batch
+                    ]})
+                    resp = _recv_msg(sock)
+                finally:
+                    sock.close()
+                pids = resp.get("pids")
+                if pids is None:
+                    raise RuntimeError(f"forkserver error: {resp.get('error')}")
+                for (wid, _, _, register), pid in zip(batch, pids):
+                    if pid:
+                        register(wid, PidHandle(pid))
+            except Exception:  # noqa: BLE001 — template gone/wedged; see
+                # spawn_async docstring for why there is NO cold fallback
+                # here (duplicate worker_id risk).
+                import traceback
+
+                traceback.print_exc()
 
     def stop(self):
         if self.proc is not None and self.proc.poll() is None:
@@ -229,10 +294,29 @@ def template_main():
     # The expensive part, paid exactly once per node: interpreter + imports.
     import numpy  # noqa: F401
     from . import worker_main  # noqa: F401  (pulls rpc/store/serialization)
+    # The in-task client API stack too — _init_client_api would otherwise
+    # import+compile these per forked child (~120 ms each on the bench host).
+    from . import api, cluster_backend, remote_function, runtime  # noqa: F401
+    from ..util import placement_group  # noqa: F401  (api's lazy import)
+    # Native libs: dlopen + ctypes prototype setup once; children inherit
+    # the loaded handle through fork instead of re-opening per boot.
+    from .. import native as _native
+
+    _native.load_arena_lib()
+    _native.load_channel_lib()
     try:
         import jax  # noqa: F401  — import only; backend stays uninitialized
     except Exception:  # noqa: BLE001 — workers degrade to import-at-use
         pass
+
+    # Freeze the heap into the permanent generation: forked children never
+    # GC-walk (and so never copy-on-write-fault) the template's ~100s of MB
+    # of imported modules. On lazily-backed guests COW faults are extra
+    # expensive (core/mem.py), so this directly cuts fork-to-ready time.
+    import gc
+
+    gc.collect()
+    gc.freeze()
 
     signal.signal(signal.SIGCHLD, signal.SIG_IGN)  # auto-reap forked workers
     srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
@@ -254,15 +338,30 @@ def template_main():
             return
         try:
             req = _recv_msg(conn)
-            pid = os.fork()
-            if pid == 0:
-                srv.close()
-                conn.close()
+            reqs = req["batch"] if "batch" in req else [req]
+            pids = []
+            for r in reqs:
+                # Per-item failure (fork EAGAIN) records pid 0 and CONTINUES:
+                # a partial abort after some children forked would make the
+                # caller guess which booted — and a guessed cold respawn
+                # duplicates a live worker_id.
                 try:
-                    _child_exec(req)
-                finally:
-                    os._exit(1)
-            _send_msg(conn, {"pid": pid})
+                    pid = os.fork()
+                except OSError:
+                    pids.append(0)
+                    continue
+                if pid == 0:
+                    srv.close()
+                    conn.close()
+                    try:
+                        _child_exec(r)
+                    finally:
+                        os._exit(1)
+                pids.append(pid)
+            if "batch" in req:
+                _send_msg(conn, {"pids": pids})
+            else:
+                _send_msg(conn, {"pid": pids[0]})
         except Exception as e:  # noqa: BLE001 — report; keep serving
             try:
                 _send_msg(conn, {"error": repr(e)})

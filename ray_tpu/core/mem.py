@@ -46,17 +46,23 @@ def _get_libc():
     return _libc
 
 
-def populate_range_async(addr: int, length: int, chunk: int = 64 << 20,
-                         name: str = "rtpu-arena-prefault"):
-    """Fault in `[addr, addr+length)` from a background daemon thread, in
-    strides (content-preserving madvise — safe concurrent with writers).
+def populate_watermark_async(addr: int, length: int, used_fn,
+                             ahead: int = 512 << 20, chunk: int = 64 << 20,
+                             name: str = "rtpu-arena-prefault"):
+    """Keep the arena mapping faulted-in AHEAD of its allocation watermark,
+    from a nice-19 background daemon thread (content-preserving madvise —
+    safe concurrent with writers in any process).
 
-    Used once per session on the arena mapping: tmpfs pages, once faulted
-    into the guest, stay resident for the life of the arena FILE (frees
-    return blocks to the allocator, not pages to the host), so this one-time
-    warmup moves every later object write from the ~0.1-0.7 GiB/s cold-page
-    path to the 1-3 GiB/s warm path. Analog: plasma's optional up-front pool
-    preallocation (`src/ray/object_manager/plasma/plasma_allocator.cc`).
+    Why ahead-of-use rather than the whole capacity: tmpfs pages, once
+    faulted into the guest, stay resident for the life of the arena FILE
+    (frees return blocks to the allocator, not pages to the host), so
+    populating is a one-time cost per page — but populating the FULL
+    capacity up front burns seconds of this 1-vCPU box per session whether
+    or not the store is ever used. Tracking `used_fn()` (allocator
+    used-bytes, a shared-header read) pays only for what the session
+    actually touches, plus `ahead` of headroom so foreground writes land on
+    warm pages. Analog: plasma's optional pool preallocation
+    (`src/ray/object_manager/plasma/plasma_allocator.cc`).
     """
     libc = _get_libc()
     if libc is None or length <= 0:
@@ -70,17 +76,35 @@ def populate_range_async(addr: int, length: int, chunk: int = 64 << 20,
             os.setpriority(os.PRIO_PROCESS, 0, 19)
         except OSError:
             pass
+        import time
+
+        base = addr & ~(_PAGE - 1)
         end = addr + length
-        start = addr & ~(_PAGE - 1)
-        while start < end:
-            n = min(chunk, end - start)
+        done = base  # populated up to here; stays page-aligned (madvise
+        # rejects unaligned ADDRESSES with EINVAL — only lengths round)
+        while done < end:
             try:
-                if libc.madvise(start, n + _PAGE - 1 & ~(_PAGE - 1),
-                                _MADV_POPULATE_WRITE) != 0:
+                used = int(used_fn())
+            except Exception:  # noqa: BLE001 — arena detached/closed
+                return
+            # Headroom grows WITH usage: a control-plane-only session warms
+            # ~64 MiB (instant), a data-heavy one keeps up to `ahead` of
+            # warm runway. A fixed large headroom at boot cost ~1s of this
+            # 1-vCPU box per session — enough to push the controller's
+            # first FT snapshot past restart-test windows.
+            runway = max(64 << 20, min(ahead, used))
+            target = min(addr + used + runway, end)
+            if target <= done:
+                time.sleep(0.5)
+                continue
+            step = min(chunk, target - done)
+            step = (step + _PAGE - 1) & ~(_PAGE - 1)
+            try:
+                if libc.madvise(done, step, _MADV_POPULATE_WRITE) != 0:
                     return  # unsupported kernel — nothing to warm
             except Exception:  # noqa: BLE001
                 return
-            start += n
+            done += step
 
     import threading
 

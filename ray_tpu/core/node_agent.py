@@ -247,7 +247,7 @@ class NodeAgent:
                 if over is None:
                     continue
                 pids = {
-                    wid: p.pid for wid, p in self._worker_procs.items()
+                    wid: p.pid for wid, p in list(self._worker_procs.items())
                     if p.poll() is None
                 }
                 if not pids:
@@ -280,7 +280,9 @@ class NodeAgent:
             arena.unlink()
 
     def _kill_workers(self):
-        for proc in self._worker_procs.values():
+        # list(): the fork-flusher thread may still be registering
+        # PidHandles mid-burst; a live dict would raise mid-iteration.
+        for proc in list(self._worker_procs.values()):
             if proc.poll() is None:
                 proc.terminate()
 
@@ -378,23 +380,26 @@ class NodeAgent:
                 except Exception:  # noqa: BLE001
                     pass
                 return
+        def _popen_cold(wid, e, lp, argv=list(argv), cwd=pkg_root):
+            log_f = open(lp, "ab")
+            self._worker_procs[wid] = subprocess.Popen(
+                argv,
+                env=e,
+                stdout=log_f,
+                stderr=subprocess.STDOUT,
+                cwd=cwd,
+                preexec_fn=_set_pdeathsig,
+            )
+
         fs = getattr(self, "_forkserver", None)
         if not tpu and isolation is None and fs is not None and fs.ready:
-            try:
-                self._worker_procs[worker_id] = fs.spawn(worker_id, env, log_path)
-                return
-            except Exception:  # noqa: BLE001 — template died; spawn cold
-                traceback.print_exc()
-        log_f = open(log_path, "ab")
-        proc = subprocess.Popen(
-            argv,
-            env=env,
-            stdout=log_f,
-            stderr=subprocess.STDOUT,
-            cwd=pkg_root,
-            preexec_fn=_set_pdeathsig,
-        )
-        self._worker_procs[worker_id] = proc
+            # Async + batched, off the event loop (see ForkServerClient.
+            # spawn_async); failed trips recover via spawn-ledger expiry.
+            fs.spawn_async(
+                worker_id, env, log_path, self._worker_procs.__setitem__
+            )
+            return
+        _popen_cold(worker_id, env, log_path)
 
     def _tail_log(self, msg: dict) -> dict:
         """Serve this node's worker-log increments to the controller."""

@@ -88,12 +88,16 @@ class TaskContext(threading.local):
 
 
 class Runtime:
-    def __init__(self, backend: RuntimeBackend, job_id: JobID, address: str = "local"):
+    def __init__(self, backend: RuntimeBackend, job_id: JobID,
+                 address: str = "local", context: Optional[TaskContext] = None):
         self.backend = backend
         self.job_id = job_id
         self.address = address
         self.driver_task_id = TaskID.for_driver(job_id)
-        self._context = TaskContext()
+        # Workers pass their own pre-existing context so task ids recorded
+        # BEFORE the lazy runtime materialized (on any thread) stay visible
+        # — a replay-on-init would only cover the initializing thread.
+        self._context = context if context is not None else TaskContext()
         self._lock = threading.Lock()
 
     # ------------------------------------------------------------------ ctx

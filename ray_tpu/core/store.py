@@ -759,9 +759,21 @@ def make_store(
                 pass
             arena = Arena(name, capacity=capacity, create=True)
             if _rt_config.get("arena_prefault"):
-                # One-time background warmup of the whole mapping — later
-                # object writes hit warm pages (core/mem.py rationale).
-                mem.populate_range_async(arena._base, arena.capacity)
+                # Background warmup tracking the allocation watermark —
+                # object writes hit warm pages (core/mem.py rationale)
+                # without paying to fault capacity the session never uses.
+                # The closure's strong ref pins the Arena: __del__ (the only
+                # detach path in the creator) cannot run while the prefault
+                # thread holds it, and daemon threads are stopped before
+                # interpreter finalization — so the handle snapshot below
+                # cannot observe a concurrent detach.
+                def _used(a=arena):
+                    h = a._h
+                    if not h:  # defensive: explicit detach by future callers
+                        raise RuntimeError("arena detached")
+                    return a._lib.rt_arena_used(h)
+
+                mem.populate_watermark_async(arena._base, arena.capacity, _used)
         else:
             arena = Arena(name, create=False)
     except Exception:  # noqa: BLE001  (native build failed / arena absent)

@@ -105,7 +105,23 @@ class WorkerProcess:
         # profile-event flushes, so tracing/state stay complete without a
         # per-task control-plane message.
         self._task_events: List[dict] = []
+        # Lazily-built in-task API runtime (see _init_client_api): None until
+        # user code actually calls back into the ray_tpu API. The task
+        # context lives in OUR TaskContext object, which the runtime adopts
+        # at construction — ids recorded on any thread before the runtime
+        # exists are visible through it afterwards.
+        from .runtime import TaskContext
+
+        self._runtime = None
+        self._runtime_init_lock = threading.Lock()
+        self._ctx_local = TaskContext()
         self._start_orphan_watchdog()
+
+    def _set_ctx(self, task_id, actor_id=None):
+        """Record the current task/actor context (shared with the lazy API
+        runtime by construction — see _init_client_api)."""
+        self._ctx_local.task_id = task_id
+        self._ctx_local.actor_id = actor_id
 
     def _start_orphan_watchdog(self):
         """A STATELESS worker whose controller died must not linger: normally
@@ -188,6 +204,10 @@ class WorkerProcess:
                         self._dropped.add(msg["task"])
                 if dropped:
                     await conn.send({"type": "direct_dropped", "task": msg["task"]})
+            elif t == "lease_ping" and msg.get("req_id") is not None:
+                # Stall-watchdog health probe: answering proves this conn's
+                # read AND write paths plus the io loop are alive.
+                await conn.respond(msg["req_id"], {"ok": True})
 
         conn.on_push = on_push
         conn.start()
@@ -353,12 +373,12 @@ class WorkerProcess:
                 await self._connect()
                 print(f"[worker {self.worker_id}] reconnected to controller", flush=True)
                 # The nested API backend must follow — actor code calling
-                # ray_tpu.* would otherwise hit the dead socket.
-                from . import api
-
-                runtime = api._global_runtime()
-                if hasattr(runtime.backend, "reconnect"):
-                    runtime.backend.reconnect()
+                # ray_tpu.* would otherwise hit the dead socket. Only if it
+                # was ever built (it is lazy); a fresh one connects cleanly.
+                if self._runtime is not None and hasattr(
+                    self._runtime.backend, "reconnect"
+                ):
+                    self._runtime.backend.reconnect()
                 return True
             except (OSError, ConnectionError) as e:
                 await asyncio.sleep(0.5)
@@ -501,10 +521,8 @@ class WorkerProcess:
         is_actor_method: bool,
         reply=None,
     ):
-        from . import api
         from .runtime import resolve_payload
 
-        runtime = api._global_runtime()
         results: List[dict] = []
         restore_once = None
         try:
@@ -515,7 +533,7 @@ class WorkerProcess:
             # Env setup BEFORE context: if it raises (RuntimeEnvSetupError),
             # no task context was set, so nothing leaks onto later work.
             restore_env = self._runtime_env_vars(spec)
-            runtime.set_task_context(spec.task_id, spec.actor_id)
+            self._set_ctx(spec.task_id, spec.actor_id)
             streaming = spec.num_returns == -1
             _restored = [False]
 
@@ -523,7 +541,7 @@ class WorkerProcess:
                 if not _restored[0]:
                     _restored[0] = True
                     restore_env()
-                    runtime.set_task_context(None)
+                    self._set_ctx(None)
 
             try:
                 result = func(*args, **kwargs)
@@ -595,10 +613,7 @@ class WorkerProcess:
         profiling showed dominating per-call cost."""
         import inspect
 
-        runtime = self._runtime
-        ctx = runtime._context
-        ctx.task_id = spec.task_id
-        ctx.actor_id = spec.actor_id
+        self._set_ctx(spec.task_id, spec.actor_id)
         try:
             _, args, kwargs = cloudpickle.loads(spec.func_payload)
             result = getattr(self.actor_instance, spec.method_name)(*args, **kwargs)
@@ -609,19 +624,16 @@ class WorkerProcess:
             err = TaskError(e, traceback.format_exc(), spec.name)
             results = [self.store_result(spec.return_ids[0].hex(), err)]
         finally:
-            ctx.task_id = None
-            ctx.actor_id = None
+            self._set_ctx(None)
         reply(results)
 
     def _create_actor(self, spec: TaskSpec, deps: Dict[str, dict]):
-        from . import api
         from .runtime import resolve_payload
 
-        runtime = api._global_runtime()
         try:
             resolved = self._resolve(spec, deps)
             cls, args, kwargs = resolve_payload(spec.func_payload, resolved)
-            runtime.set_task_context(spec.task_id, spec.actor_id)
+            self._set_ctx(spec.task_id, spec.actor_id)
             # Actor env vars persist for the actor's lifetime (its process
             # is dedicated) — reference behavior for actor runtime_env.
             self._runtime_env_vars(spec)
@@ -629,7 +641,7 @@ class WorkerProcess:
                 self.actor_instance = cls(*args, **kwargs)
                 self._actor_hex = spec.actor_id.hex()
             finally:
-                runtime.set_task_context(None)
+                self._set_ctx(None)
             if spec.options.max_concurrency > 1:
                 self.actor_pool = concurrent.futures.ThreadPoolExecutor(
                     max_workers=spec.options.max_concurrency
@@ -655,9 +667,19 @@ class WorkerProcess:
 
     # --------------------------------------------------------------- loop
     def run(self):
+        mark = getattr(self, "_boot_mark", lambda p: None)
         self.io.call(self._start_direct_server())
+        mark("direct-server")
         self.io.call(self._connect())
-        self._init_client_api()
+        mark("connected")
+        from . import api
+
+        # DEFERRED bootstrap: the in-task API backend (its own RPC
+        # connection + io thread) is built on first API use, not at boot —
+        # fork-to-ready profiling showed it dominating worker start, and
+        # most workers/actors never call back into the API at all.
+        api.set_runtime_factory(self._init_client_api)
+        first_msg = [True]
         while not self._stop:
             if self.task_queue.empty():
                 if self._reply_batch:
@@ -666,6 +688,9 @@ class WorkerProcess:
             elif len(self._task_events) >= 512:
                 self._flush_task_events()
             msg = self.task_queue.get()
+            if first_msg[0]:
+                first_msg[0] = False
+                mark("first-msg")
             mtype = msg["type"]
             if mtype == "exit":
                 break
@@ -692,6 +717,17 @@ class WorkerProcess:
                     self._in_batch = False
                     self._flush_direct_replies()
                 continue
+            if self._reply_batch:
+                # Backlog batching must never hold a COMPLETED result
+                # hostage behind the NEXT task's execution: with the queue
+                # never empty (a burst arrived together), a fast task's
+                # reply would otherwise wait out its successor entirely —
+                # observed as a finished task invisible to wait() for the
+                # whole 10 s of the sleeper behind it. Actor-call bursts
+                # keep their one-flush-per-burst batching via _in_batch
+                # (execute_actor_batch above); everything else ships
+                # completed replies before the next execute begins.
+                self._flush_direct_replies()
             self._process_task_msg(mtype, msg)
         self.local_store.close_all()
         dump = getattr(self, "_profile_dump", None)
@@ -790,21 +826,29 @@ class WorkerProcess:
                     )
 
     def _init_client_api(self):
-        """Install a Runtime so user code can call the full API from tasks."""
-        from . import api
-        from .cluster_backend import ClusterBackend
-        from .ids import JobID
-        from .runtime import Runtime
+        """Install a Runtime so user code can call the full API from tasks.
+        Lazy (registered as api.set_runtime_factory at boot) + idempotent:
+        runs on whatever thread first touches the API; the thread's pending
+        task context (recorded by _set_ctx) is replayed onto the runtime."""
+        with self._runtime_init_lock:
+            if self._runtime is not None:
+                return self._runtime
+            from . import api
+            from .cluster_backend import ClusterBackend
+            from .ids import JobID
+            from .runtime import Runtime
 
-        backend = ClusterBackend.connect(
-            f"{self.host}:{self.port}", role="worker", worker=self
-        )
-        runtime = Runtime(
-            backend, JobID.from_int(os.getpid() % (2**28)), address=f"{self.host}:{self.port}"
-        )
-        backend.set_runtime(runtime)
-        api.set_global_runtime(runtime)
-        self._runtime = runtime  # fast-path handle (no api lookup per call)
+            backend = ClusterBackend.connect(
+                f"{self.host}:{self.port}", role="worker", worker=self
+            )
+            runtime = Runtime(
+                backend, JobID.from_int(os.getpid() % (2**28)),
+                address=f"{self.host}:{self.port}", context=self._ctx_local,
+            )
+            backend.set_runtime(runtime)
+            api.set_global_runtime(runtime)
+            self._runtime = runtime  # fast-path handle (no api lookup per call)
+            return runtime
 
 
 def main():
@@ -812,7 +856,33 @@ def main():
     worker_id = os.environ["RAY_TPU_WORKER_ID"]
     session_dir = os.environ.get("RAY_TPU_SESSION_DIR", "/tmp/ray_tpu")
     store.set_session_tag(os.environ.get("RAY_TPU_SESSION_TAG", ""))
+    trace_boot = os.environ.get("RAY_TPU_BOOT_TRACE") == "1"
+    if trace_boot:
+        def _proc_cpu():
+            # utime+stime across ALL threads (time.process_time misses the
+            # io thread) — /proc/self/stat fields 14/15, in clock ticks.
+            with open("/proc/self/stat") as f:
+                p = f.read().rsplit(")", 1)[1].split()
+            return (int(p[11]) + int(p[12])) / os.sysconf("SC_CLK_TCK")
+
+        t0 = time.monotonic()
+        c0 = _proc_cpu()
+
+        def _mark(phase):
+            print(
+                f"[boot-trace {worker_id}] {phase}: wall "
+                f"{(time.monotonic() - t0) * 1000:.1f}ms cpu "
+                f"{(_proc_cpu() - c0) * 1000:.1f}ms",
+                flush=True,
+            )
+    else:
+        def _mark(phase):
+            pass
+
+    _mark("main-entry")
     wp = WorkerProcess(address, worker_id, session_dir)
+    _mark("worker-init")
+    wp._boot_mark = _mark
     profile_dir = os.environ.get("RAY_TPU_WORKER_PROFILE")
     if profile_dir:
         # Dev tool (mirrors the controller's profile hook): cProfile the

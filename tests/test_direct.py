@@ -282,3 +282,30 @@ def test_big_direct_result_registers(cluster):
 
     out = ray_tpu.get(big.remote(), timeout=60)
     assert float(out.sum()) == 300_000.0
+
+
+def test_completed_reply_not_held_behind_next_task():
+    """Regression: the worker's reply batch only flushed when its queue went
+    EMPTY — a fast task's completed result could sit unsent for the entire
+    execution of the task queued behind it (observed: wait() blind to a
+    finished task for the full 10 s of a sleeper submitted with it)."""
+    import time
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=1)  # one lease lane: both tasks share the queue
+    try:
+        @ray_tpu.remote
+        def fast():
+            return "f"
+
+        @ray_tpu.remote
+        def slow():
+            time.sleep(5)
+            return "s"
+
+        ray_tpu.get(fast.remote(), timeout=60)  # warm the single lane
+        f, s = fast.remote(), slow.remote()
+        ready, not_ready = ray_tpu.wait([f, s], num_returns=1, timeout=3)
+        assert ready == [f] and not_ready == [s]
+    finally:
+        ray_tpu.shutdown()
