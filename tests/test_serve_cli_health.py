@@ -103,30 +103,43 @@ def test_serve_cli_deploy_and_status(tmp_path):
         cluster.shutdown()
 
 
+def _wait_until(pred, timeout_s: float, what: str):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.3)
+    raise AssertionError(what)
+
+
 def test_health_check_detects_wedged_node(monkeypatch):
     """SIGSTOP keeps the agent's TCP connection open but unresponsive — only
     active probing can declare the node dead."""
-    monkeypatch.setenv("RAY_TPU_HEALTH_CHECK_PERIOD_S", "0.4")
-    monkeypatch.setenv("RAY_TPU_HEALTH_CHECK_TIMEOUT_S", "0.4")
-    monkeypatch.setenv("RAY_TPU_HEALTH_CHECK_FAILURES", "2")
+    # Probe knobs must tolerate a LOADED box pre-wedge: 0.4s/x2 let ordinary
+    # scheduling lag (full-suite runs) kill the healthy node before the
+    # first assertion. 1s/x3 still detects the SIGSTOP within several
+    # probe rounds, well inside the 20s detection window.
+    monkeypatch.setenv("RAY_TPU_HEALTH_CHECK_PERIOD_S", "1.0")
+    monkeypatch.setenv("RAY_TPU_HEALTH_CHECK_TIMEOUT_S", "1.0")
+    monkeypatch.setenv("RAY_TPU_HEALTH_CHECK_FAILURES", "3")
     cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
     node = cluster.add_node(num_cpus=2, resources={"wedge": 1.0})
     ray_tpu.init(address=cluster.address)
+
+    def alive_state():
+        return {n["NodeID"]: n["Alive"] for n in ray_tpu.nodes()}
+
     try:
-        assert any(
-            n["NodeID"] == node.node_id and n["Alive"] for n in ray_tpu.nodes()
+        _wait_until(
+            lambda: alive_state().get(node.node_id) is True,
+            30, "node never became alive",
         )
         os.kill(node.process.pid, signal.SIGSTOP)
         try:
-            deadline = time.monotonic() + 20
-            dead = False
-            while time.monotonic() < deadline:
-                states = {n["NodeID"]: n["Alive"] for n in ray_tpu.nodes()}
-                if states.get(node.node_id) is False:
-                    dead = True
-                    break
-                time.sleep(0.3)
-            assert dead, "wedged node was never declared dead"
+            _wait_until(
+                lambda: alive_state().get(node.node_id) is False,
+                20, "wedged node was never declared dead",
+            )
         finally:
             os.kill(node.process.pid, signal.SIGCONT)
     finally:
