@@ -117,10 +117,29 @@ class jax_utils:
         coord = env.get("RAY_TPU_JAX_COORDINATOR")
         if not coord:
             return False
+        num = int(env["RAY_TPU_JAX_NUM_PROCESSES"])
+        pid = int(env["RAY_TPU_JAX_PROCESS_ID"])
+        # Idempotent ONLY for the same gang: a worker process may run several
+        # gang loops (actor reuse), but jax.distributed initializes once per
+        # process — joining a *different* coordinator is impossible, so fail
+        # loudly rather than let the new gang hang in rendezvous.
+        from jax._src import distributed as _dist
+
+        gs = _dist.global_state
+        if getattr(gs, "client", None) is not None:
+            have = (gs.coordinator_address, gs.num_processes, gs.process_id)
+            if have == (coord, num, pid):
+                return True
+            raise RuntimeError(
+                f"jax.distributed already initialized for a different gang "
+                f"(have coordinator/num/pid {have}, want {(coord, num, pid)}); "
+                f"this process cannot re-join — restart the gang with fresh "
+                f"workers (WorkerGroup.shutdown kills them)"
+            )
         jax.distributed.initialize(
             coordinator_address=coord,
-            num_processes=int(env["RAY_TPU_JAX_NUM_PROCESSES"]),
-            process_id=int(env["RAY_TPU_JAX_PROCESS_ID"]),
+            num_processes=num,
+            process_id=pid,
         )
         return True
 

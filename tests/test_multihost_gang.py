@@ -1,0 +1,93 @@
+"""Multi-host SPMD gang end-to-end (VERDICT r4 item 1).
+
+Two SEPARATE worker processes, each with 4 virtual CPU devices, join one
+jax.distributed gang, build the union dp×fsdp mesh, and run a shard_map
+allreduce plus one GPT train step whose collectives cross the process
+boundary. Loss must match the single-process 8-device run of the SAME
+`run_gang_step` within tolerance.
+
+Reference analog: the e2e-tested torch process-group path
+(`python/ray/train/torch/config.py:106` via
+`python/ray/train/_internal/backend_executor.py:124`).
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+from ray_tpu.train.gang_check import spawn_gang
+
+_single = {}
+
+
+def _single_process_reference():
+    """Single-process 8-device run of run_gang_step (cached per session)."""
+    if not _single:
+        from ray_tpu.train.gang_check import run_gang_step
+
+        _single.update(run_gang_step())
+    return _single
+
+
+def test_gang_subprocess_pair(tmp_path):
+    """Hermetic 2-process gang through `jax_utils.maybe_init_distributed`."""
+    outs = spawn_gang(nprocs=2, devices_per_proc=4)
+
+    for o in outs:
+        assert o["n_global"] == 8.0
+        assert o["n_local"] == 4.0
+        assert o["psum"] == 28.0  # sum(range(8)) — saw every process's shard
+    assert outs[0]["loss"] == pytest.approx(outs[1]["loss"], abs=1e-6)
+
+    ref = _single_process_reference()
+    assert ref["psum"] == 28.0
+    assert outs[0]["loss"] == pytest.approx(ref["loss"], rel=2e-3)
+    assert outs[0]["grad_norm"] == pytest.approx(ref["grad_norm"], rel=2e-2)
+
+
+@pytest.mark.cluster
+def test_jax_trainer_two_process_gang(tmp_path):
+    """The full JaxTrainer path: JaxBackend fans out coordinator env, two
+    worker PROCESSES join one mesh and train one step across it."""
+
+    # Defined inside the test so cloudpickle ships it by value (the test
+    # module is not importable inside cluster workers).
+    def _gang_loop(config):
+        import os
+
+        # 4 virtual CPU devices per process, set BEFORE the backend
+        # initializes (replaces the conftest-inherited 8-device flag).
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+        from ray_tpu import train
+        from ray_tpu.train.jax_trainer import jax_utils
+
+        assert jax_utils.maybe_init_distributed(), "JaxBackend env missing"
+        from ray_tpu.train.gang_check import run_gang_step
+
+        out = run_gang_step()
+        out["rank"] = train.get_context().get_world_rank()
+        train.report(out)
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        trainer = JaxTrainer(
+            _gang_loop,
+            scaling_config=ScalingConfig(num_workers=2),
+            run_config=RunConfig(storage_path=str(tmp_path)),
+        )
+        result = trainer.fit()
+        assert result.error is None, result.error
+        m = result.metrics
+        assert m["n_global"] == 8.0
+        assert m["n_local"] == 4.0
+        assert m["psum"] == 28.0
+
+        ref = _single_process_reference()
+        assert m["loss"] == pytest.approx(ref["loss"], rel=2e-3)
+        assert m["grad_norm"] == pytest.approx(ref["grad_norm"], rel=2e-2)
+    finally:
+        ray_tpu.shutdown()
