@@ -146,6 +146,10 @@ class NodeState:
     last_active: float = field(default_factory=time.monotonic)
     # Latest cpu/mem/disk/TPU sample (reference: reporter_agent node stats).
     sys_metrics: Dict[str, float] = field(default_factory=dict)
+    # Worker ids the node's agent spawned whose process is currently alive
+    # (from health-probe replies) — the controller's only liveness signal
+    # for agent-spawned isolated workers it has no proc handle for.
+    agent_alive_workers: set = field(default_factory=set)
 
     def utilization(self) -> float:
         fracs = [
@@ -854,7 +858,16 @@ class Controller:
             if time.monotonic() - last < grace:
                 return  # a worker for this env is already booting there
             proc = self._worker_procs.get(prev_worker)
-            if proc is not None and hasattr(proc, "poll") and proc.poll() is None:
+            alive = (
+                proc is not None and hasattr(proc, "poll") and proc.poll() is None
+            ) or (
+                # Agent-spawned: no proc handle here, but the agent reports
+                # spawn liveness in health-probe replies — a slow remote env
+                # setup (5-min image pull) must extend the window like local
+                # slow boots do, not burn the attempt budget (ADVICE r4).
+                proc is None and prev_worker in node.agent_alive_workers
+            )
+            if alive:
                 # Still ALIVE past the grace — a slow boot, not a dead one.
                 # Extend the window rather than double-spawning or counting
                 # a failure.
@@ -3345,6 +3358,10 @@ class Controller:
                 ok = bool((resp or {}).get("ok"))
                 if ok and resp.get("sys"):
                     node.sys_metrics = resp["sys"]
+                if ok:
+                    node.agent_alive_workers = set(
+                        resp.get("spawned_alive") or ()
+                    )
             except Exception:  # noqa: BLE001
                 ok = False
             if ok:

@@ -465,8 +465,14 @@ class DirectCallManager:
                 entry.rebalance_t = now
                 planned[deep] = planned.get(deep, 0) + 1
                 steals.append((deep, task_hex))
-        for lease, task_hex in steals:
-            self._pipelined(lease.conn, {"type": "drop_task", "task": task_hex})
+            # Post the drop frames UNDER the lock: marking rebalance and
+            # enqueueing the frame must be atomic w.r.t. the stall probe's
+            # (snapshot marked steals, enqueue ping) — otherwise a pong can
+            # "prove" a drop processed whose frame was sent after the ping,
+            # and a real drop later resolves as a bogus TaskCancelledError.
+            # post() only appends to a buffer, so this is cheap.
+            for lease, task_hex in steals:
+                self._pipelined(lease.conn, {"type": "drop_task", "task": task_hex})
 
     def _classic_fallback(self, triples, pop: bool = True):
         """Buffered-but-never-sent specs go to the scheduler (safe: zero
@@ -725,13 +731,16 @@ class DirectCallManager:
 
     async def _idle_sweep_once(self):
         now = time.monotonic()
-        self._llog("sweep", sum(len(v) for v in self._leases.values()),
-                   len(self._pending))
         give_back: List[_Lease] = []
         rebalance: List[Tuple] = []
         stalled: List[_Lease] = []
         busy: List[_Lease] = []
         with self._lock:
+            # Counters read under the lock: a concurrent mutation outside it
+            # raises "dict changed size during iteration", which the outer
+            # catch turns into a whole aborted sweep tick (ADVICE r4).
+            self._llog("sweep", sum(len(v) for v in self._leases.values()),
+                       len(self._pending))
             for key, lst in list(self._leases.items()):
                 for lease in list(lst):
                     if (
@@ -811,23 +820,34 @@ class DirectCallManager:
         import asyncio
 
         try:
-            await asyncio.wait_for(
-                lease.conn.request({"type": "lease_ping"}), timeout=2.5
-            )
+            # The ping rides the POST pipeline (post_request), and the set of
+            # steals it can prove anything about is snapshotted in the same
+            # locked region that enqueues it. Steals also enqueue their drop
+            # frame under this lock, so: marked ⇒ drop frame FIFO-before the
+            # ping ⇒ the pong proves the worker saw the drop. Steals issued
+            # after the snapshot post after the ping and stay marked.
+            with self._lock:
+                marked = [
+                    h for h, e in self._pending.items()
+                    if e.lease is lease and e.rebalance
+                ]
+                fut = lease.conn.post_request({"type": "lease_ping"})
+            await asyncio.wait_for(fut, timeout=2.5)
         except Exception:  # noqa: BLE001 — no pong: recover via close
             lease.conn.close()
         else:
             # A pong settles the lease: the worker demonstrably processed
-            # everything sent before the ping (same-conn FIFO) — any
-            # still-unacked steal was a REFUSAL (the task already started;
-            # it completes normally), so clear those markers and refresh
-            # the stall clock, else this probe would refire every sweep
-            # tick for a long task's whole runtime.
+            # everything posted before the ping (same-conn FIFO) — any
+            # still-unacked marked steal was a REFUSAL (the task already
+            # started; it completes normally), so clear those markers and
+            # refresh the stall clock, else this probe would refire every
+            # sweep tick for a long task's whole runtime.
             with self._lock:
                 lease.last_used = time.monotonic()
-                for entry in self._pending.values():
-                    if entry.lease is lease and entry.rebalance:
-                        entry.rebalance = False
+                for h in marked:
+                    e = self._pending.get(h)
+                    if e is not None and e.lease is lease and e.rebalance:
+                        e.rebalance = False
         finally:
             lease.pinging = False
 

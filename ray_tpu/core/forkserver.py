@@ -121,6 +121,13 @@ class ForkServerClient:
         self._q: list = []
         self._q_lock = threading.Lock()
         self._flusher_active = False
+        # Wedged-template latch: consecutive failed TRIPS against a template
+        # whose process is still alive (socket up, requests timing out). The
+        # spawn-ledger recovery path re-checks `ready`, which only went False
+        # on template DEATH — without this latch a wedged-but-alive template
+        # loops warm retries forever and CPU workers never boot (ADVICE r4).
+        self._trip_failures = 0
+        self._wedged = False
 
     def start(self, pdeathsig: bool = False):
         """Launch the template (non-blocking: readiness is polled later).
@@ -166,6 +173,8 @@ class ForkServerClient:
         Re-checks liveness every call: a dead template must flip this back
         to False so spawners fall back to cold Popen instead of retrying
         the warm path forever."""
+        if self._wedged:
+            return False
         if self.proc is None or self.proc.poll() is not None:
             self._ready = False
             return False
@@ -238,12 +247,31 @@ class ForkServerClient:
                 for (wid, _, _, register), pid in zip(batch, pids):
                     if pid:
                         register(wid, PidHandle(pid))
+                self._trip_failures = 0
+                # A successful trip disproves the wedge diagnosis (e.g. two
+                # transient timeouts under host load) — un-latch so the rest
+                # of the session keeps the ~10 ms warm path.
+                self._wedged = False
             except Exception:  # noqa: BLE001 — template gone/wedged; see
                 # spawn_async docstring for why there is NO cold fallback
                 # here (duplicate worker_id risk).
                 import traceback
 
                 traceback.print_exc()
+                self._trip_failures += 1
+                if self._trip_failures >= 2 and not self._wedged:
+                    # Two consecutive failed trips = the template is wedged
+                    # even if its process is alive. Latch `ready` False so
+                    # ledger-expiry respawns take the cold Popen path. Do NOT
+                    # kill the template: on agent nodes its forked workers
+                    # chain pdeathsig to it — killing it would take live
+                    # workers down with it.
+                    self._wedged = True
+                    print(
+                        f"forkserver: latched wedged after "
+                        f"{self._trip_failures} failed trips; cold spawns",
+                        flush=True,
+                    )
 
     def stop(self):
         if self.proc is not None and self.proc.poll() is None:

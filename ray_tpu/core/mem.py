@@ -80,6 +80,13 @@ def populate_watermark_async(addr: int, length: int, used_fn,
 
         base = addr & ~(_PAGE - 1)
         end = addr + length
+        # Mappings are page-granular, so the span's LAST page belongs to the
+        # mapping even when addr+length ends mid-page — rounding the end up
+        # is safe, and every chunk below is clamped to it. (Rounding the
+        # STEP up unclamped made the final madvise run past the mapping on
+        # non-page-aligned capacities → EINVAL → prefault silently aborted
+        # short of the end; ADVICE r4.)
+        end_up = (end + _PAGE - 1) & ~(_PAGE - 1)
         done = base  # populated up to here; stays page-aligned (madvise
         # rejects unaligned ADDRESSES with EINVAL — only lengths round)
         while done < end:
@@ -99,9 +106,21 @@ def populate_watermark_async(addr: int, length: int, used_fn,
                 continue
             step = min(chunk, target - done)
             step = (step + _PAGE - 1) & ~(_PAGE - 1)
+            step = min(step, end_up - done)  # never run past the mapping
+            if step <= 0:
+                return
             try:
                 if libc.madvise(done, step, _MADV_POPULATE_WRITE) != 0:
-                    return  # unsupported kernel — nothing to warm
+                    import errno as _errno
+
+                    err = ctypes.get_errno()
+                    if done == base and err == _errno.EINVAL:
+                        return  # unsupported kernel (<5.14) — nothing to warm
+                    # Transient range/pressure error (e.g. ENOMEM under
+                    # memory pressure): skip this chunk rather than aborting
+                    # the whole warmup; the pages fault lazily if touched.
+                    done += step
+                    continue
             except Exception:  # noqa: BLE001
                 return
             done += step

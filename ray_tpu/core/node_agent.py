@@ -299,7 +299,20 @@ class NodeAgent:
                 # response doubles as the node's system-metrics report
                 # (reference: `reporter_agent.py:277` node reporter).
                 await self.conn.respond(
-                    msg["req_id"], {"ok": True, "sys": self._sys_sampler.sample()}
+                    msg["req_id"],
+                    {
+                        "ok": True,
+                        "sys": self._sys_sampler.sample(),
+                        # Spawn liveness for workers THIS agent launched: the
+                        # controller has no proc handle for them, so a slow
+                        # remote env boot (image pull, heavy conda activate)
+                        # would otherwise be misread as dead and burn the
+                        # (node, env) attempt budget (ADVICE r4).
+                        "spawned_alive": [
+                            wid for wid, p in list(self._worker_procs.items())
+                            if p.poll() is None
+                        ],
+                    },
                 )
             elif mtype == "enqueue_task":
                 if self.dispatcher is not None:

@@ -123,10 +123,15 @@ class jax_utils:
         # gang loops (actor reuse), but jax.distributed initializes once per
         # process — joining a *different* coordinator is impossible, so fail
         # loudly rather than let the new gang hang in rendezvous.
-        from jax._src import distributed as _dist
+        try:
+            from jax._src import distributed as _dist
 
-        gs = _dist.global_state
-        if getattr(gs, "client", None) is not None:
+            gs = _dist.global_state
+        except Exception:  # noqa: BLE001 — private API moved on a jax
+            # upgrade; fall through to initialize (pre-guard behavior). A
+            # genuine double-init then raises from jax itself.
+            gs = None
+        if gs is not None and getattr(gs, "client", None) is not None:
             have = (gs.coordinator_address, gs.num_processes, gs.process_id)
             if have == (coord, num, pid):
                 return True
