@@ -650,9 +650,12 @@ def flash_attention(
     """Blockwise attention. Pallas on TPU; XLA reference elsewhere.
 
     Default blocks (1024, 1024) come from the v5e sweeps in
-    scripts/bench_flash.py and the per-kernel runs at gpt2-large shape:
-    53/49 TFLOP/s fwd+bwd at 8k/16k (25-27% of peak), and at S=1024 the
-    single-KV-block forward runs 2x faster than block_k=512."""
+    scripts/bench_flash.py: 60 TFLOP/s fwd+bwd at BOTH 8k and 16k (30.5% of
+    the 197 TFLOP/s peak; r5 remeasure — blocks ≥2048 fail to compile), and
+    at S=1024 the single-KV-block forward runs 2x faster than block_k=512.
+    Note the D=64 head dim caps attention matmuls at ~50% MXU utilization
+    (the contraction or output dim is half the 128-wide systolic array), so
+    30.5% nominal ≈ 60% of the achievable ceiling."""
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
     if not _on_tpu():
         return attention_reference(q, k, v, causal, scale)
