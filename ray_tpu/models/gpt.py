@@ -297,8 +297,11 @@ def _attention(cfg: GPTConfig, q, k, v, mesh=None):
     return flash_attention(q, k, v, causal=True)
 
 
-def _block(cfg: GPTConfig, rope_tables, mesh, x, layer_params, positions):
-    """One transformer block; x: [B, S, E] in cfg.dtype."""
+def _block(cfg: GPTConfig, rope_tables, mesh, x, layer_params, positions,
+           return_kv: bool = False):
+    """One transformer block; x: [B, S, E] in cfg.dtype. With return_kv the
+    post-RoPE K/V ([B, H, S, Dh]) come back too — the prefill path stores
+    them in the decode cache."""
     # Cast this layer's master weights to compute dtype (bf16 → MXU).
     p = jax.tree_util.tree_map(lambda a: a.astype(cfg.dtype), layer_params)
     B, S, E = x.shape
@@ -346,9 +349,10 @@ def _block(cfg: GPTConfig, rope_tables, mesh, x, layer_params, positions):
             u = jax.nn.gelu(u)
         mlp_out = jnp.einsum("bsf,fe->bse", u, p["w_out"]) + p["b_out"]
 
-    if cfg.parallel_block:
-        return x + attn_out + mlp_out, aux
-    return x + mlp_out, aux
+    out = x + attn_out + mlp_out if cfg.parallel_block else x + mlp_out
+    if return_kv:
+        return out, (aux, k, v)
+    return out, aux
 
 
 _LAYER_KEYS = (
@@ -734,3 +738,169 @@ def make_pipeline_train_step(
             params, batch, cfg, mesh, num_microbatches
         ),
     )
+
+
+# ---------------------------------------------------------------- generation
+# KV-cache autoregressive inference (reference analog: the Serve LLM
+# deployments the reference runs through vLLM/transformers — here decode is
+# a first-class device-side loop: prefill fills the cache in one forward,
+# then `lax.scan` advances one token per step entirely on-device, so a
+# generation of N tokens is ONE dispatch, not N host round-trips — which is
+# what the axon tunnel's ~100 ms RTT would otherwise cost per token).
+
+
+def init_cache(cfg: GPTConfig, batch: int, max_seq: Optional[int] = None):
+    """Decode cache: stacked per-layer post-RoPE K/V + current length."""
+    M = max_seq or cfg.max_seq
+    shape = (cfg.n_layers, batch, cfg.n_heads, M, cfg.d_head)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+        "len": jnp.zeros((), jnp.int32),
+    }
+
+
+def prefill(params, tokens, cfg: GPTConfig, cache):
+    """Run the prompt [B, S0] through the model, filling cache[:, :, :, :S0].
+
+    Returns (last_logits [B, V] f32, cache). Prompts are fixed-length
+    (left-pad upstream for ragged batches). No remat (inference)."""
+    B, S = tokens.shape
+    positions = jnp.arange(S)
+    x = params["tok_embed"][tokens].astype(cfg.dtype)
+    if cfg.pos == "learned":
+        x = x + params["pos_embed"][positions].astype(cfg.dtype)
+    rope_tables = None
+    if cfg.pos == "rotary":
+        rd = min(cfg.rotary_dim, cfg.d_head)
+        rope_tables = rope_frequencies(rd, cfg.max_seq, dtype=jnp.float32)
+    layer_stack = {k: params[k] for k in _LAYER_KEYS if k in params}
+
+    icfg = dataclasses.replace(cfg, remat=False, remat_policy=None)
+
+    def scan_body(x, layer_params):
+        x, (aux, k, v) = _block(
+            icfg, rope_tables, None, x, layer_params, positions, return_kv=True
+        )
+        return x, (k, v)
+
+    x, (ks, vs) = jax.lax.scan(scan_body, x, layer_stack)  # [L, B, H, S, Dh]
+
+    M = cache["k"].shape[3]
+    cache = {
+        "k": jax.lax.dynamic_update_slice(
+            cache["k"], ks.astype(cache["k"].dtype), (0, 0, 0, 0, 0)
+        ),
+        "v": jax.lax.dynamic_update_slice(
+            cache["v"], vs.astype(cache["v"].dtype), (0, 0, 0, 0, 0)
+        ),
+        "len": jnp.asarray(S, jnp.int32),
+    }
+    x = _norm(x, params["ln_f_w"], params["ln_f_b"], cfg.norm)
+    head = params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("be,ev->bv", x[:, -1], head.astype(cfg.dtype))
+    return logits.astype(jnp.float32), cache
+
+
+def decode_step(params, token, cache, cfg: GPTConfig):
+    """One autoregressive step: token [B] int32 → (logits [B, V] f32, cache).
+
+    Attention is a plain masked dot against the cache — at S=1 the MXU
+    matmuls are [B,H,1,D]x[B,H,M,D]; flash brings nothing and Pallas grid
+    overhead would dominate."""
+    if cfg.mlp_type == "moe":
+        raise NotImplementedError("decode_step does not support MoE yet")
+    B = token.shape[0]
+    pos = cache["len"]                       # scalar int32
+    x = params["tok_embed"][token][:, None].astype(cfg.dtype)  # [B, 1, E]
+    if cfg.pos == "learned":
+        x = x + params["pos_embed"][pos][None, None].astype(cfg.dtype)
+    rope_tables = None
+    if cfg.pos == "rotary":
+        rd = min(cfg.rotary_dim, cfg.d_head)
+        rope_tables = rope_frequencies(rd, cfg.max_seq, dtype=jnp.float32)
+    M = cache["k"].shape[3]
+    scale = 1.0 / math.sqrt(cfg.d_head)
+    H, Dh = cfg.n_heads, cfg.d_head
+    cols = jnp.arange(M)
+
+    layer_stack = {k: params[k] for k in _LAYER_KEYS if k in params}
+
+    def scan_body(x, inp):
+        layer_params, ck, cv = inp
+        p = jax.tree_util.tree_map(lambda a: a.astype(cfg.dtype), layer_params)
+        h = _norm(x, p["ln1_w"], p["ln1_b"], cfg.norm)
+        qkv = jnp.einsum("bse,ethd->btshd", h, p["w_qkv"]) + p["b_qkv"][:, None]
+        q, k, v = (
+            qkv[:, i].transpose(0, 2, 1, 3).reshape(B, H, 1, Dh) for i in range(3)
+        )
+        if cfg.pos == "rotary":
+            cos, sin = rope_tables
+            rd = min(cfg.rotary_dim, Dh)
+            c, s = cos[pos][None], sin[pos][None]  # [1, rd/2]
+            q = jnp.concatenate([apply_rope(q[..., :rd], c, s, None), q[..., rd:]], -1) \
+                if rd < Dh else apply_rope(q, c, s, None)
+            k = jnp.concatenate([apply_rope(k[..., :rd], c, s, None), k[..., rd:]], -1) \
+                if rd < Dh else apply_rope(k, c, s, None)
+        ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, pos, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, pos, 0))
+        scores = jnp.einsum(
+            "bhsd,bhtd->bhst", q, ck, preferred_element_type=jnp.float32
+        ) * scale                                      # [B, H, 1, M]
+        scores = jnp.where(cols[None, None, None, :] <= pos, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bhst,bhtd->bhsd", probs.astype(cv.dtype), cv)
+        attn_out = jnp.einsum("bhsd,hde->bse", attn, p["w_o"]) + p["b_o"]
+
+        if cfg.parallel_block:
+            mlp_in = h
+        else:
+            x = x + attn_out
+            mlp_in = _norm(x, p["ln2_w"], p["ln2_b"], cfg.norm)
+        u = jnp.einsum("bse,ef->bsf", mlp_in, p["w_in"]) + p["b_in"]
+        if cfg.activation == "swiglu":
+            g = jnp.einsum("bse,ef->bsf", mlp_in, p["w_gate"])
+            u = jax.nn.silu(g) * u
+        else:
+            u = jax.nn.gelu(u)
+        mlp_out = jnp.einsum("bsf,fe->bse", u, p["w_out"]) + p["b_out"]
+        out = x + attn_out + mlp_out if cfg.parallel_block else x + mlp_out
+        return out, (ck, cv)
+
+    x, (ks, vs) = jax.lax.scan(scan_body, x, (layer_stack, cache["k"], cache["v"]))
+    cache = {"k": ks, "v": vs, "len": pos + 1}
+    x = _norm(x, params["ln_f_w"], params["ln_f_b"], cfg.norm)
+    head = params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("be,ev->bv", x[:, -1], head.astype(cfg.dtype))
+    return logits.astype(jnp.float32), cache
+
+
+def make_generate(cfg: GPTConfig, max_new_tokens: int, temperature: float = 0.0):
+    """Returns jittable `gen(params, prompt [B, S0], rng) -> tokens
+    [B, max_new_tokens]`: prefill + a device-side `lax.scan` decode loop —
+    one dispatch per GENERATION, not per token."""
+
+    def sample(logits, key):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, -1).astype(jnp.int32)
+        return jax.random.categorical(key, logits / temperature).astype(jnp.int32)
+
+    def gen(params, prompt, rng):
+        B, S0 = prompt.shape
+        cache = init_cache(cfg, B, S0 + max_new_tokens)
+        logits, cache = prefill(params, prompt, cfg, cache)
+        rng, k0 = jax.random.split(rng)
+        first = sample(logits, k0)
+
+        def step(carry, key):
+            token, cache = carry
+            logits, cache = decode_step(params, token, cache, cfg)
+            nxt = sample(logits, key)
+            return (nxt, cache), token
+
+        keys = jax.random.split(rng, max_new_tokens - 1) if max_new_tokens > 1 \
+            else jnp.zeros((0, 2), jnp.uint32)
+        (last, _), toks = jax.lax.scan(step, (first, cache), keys)
+        return jnp.concatenate([toks.T, last[:, None]], axis=1)
+
+    return gen

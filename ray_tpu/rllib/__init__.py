@@ -30,6 +30,7 @@ from .algorithms.apex_dqn import ApexDQN, ApexDQNConfig
 from .algorithms.cql import CQL, CQLConfig
 from .algorithms.dt import DT, DTConfig
 from .algorithms.multi_agent_ppo import MultiAgentPPO, MultiAgentPPOConfig
+from .algorithms.dreamerv3 import DreamerV3, DreamerV3Config
 from . import offline
 from .env import register_env, make_env
 from .env.env_runner import EnvRunner
@@ -66,6 +67,8 @@ __all__ = [
     "DT",
     "DTConfig",
     "MultiAgentPPO",
+    "DreamerV3",
+    "DreamerV3Config",
     "MultiAgentPPOConfig",
     "offline",
     "register_env",

@@ -1,0 +1,480 @@
+"""DreamerV3-lite — model-based RL on latent imagination.
+
+Reference: `rllib/algorithms/dreamerv3/dreamerv3.py:1` (the reference's only
+model-based algorithm; ~45-algorithm catalog). This is a compact
+re-derivation of the DreamerV3 recipe (Hafner et al. 2023), TPU-native:
+the ENTIRE update — world-model sequence learning, latent imagination,
+λ-returns, actor/critic/world-model optimizers — is one jit-compiled
+`lax.scan` program; the host only feeds replayed sequences.
+
+Kept from the paper (the load-bearing pieces):
+  * RSSM world model: deterministic GRU path + categorical stochastic
+    latents (straight-through gradients, 1% unimix), KL balancing with
+    free bits.
+  * Heads: decoder (symlog MSE), reward (symlog MSE), continue (BCE).
+  * Behavior learned purely in imagination: actor-critic on H-step latent
+    rollouts from replayed posterior starts; λ-returns; percentile return
+    normalization; EMA critic for bootstrap values.
+Dropped for "lite": image encoders (vector obs only), twohot critic bins,
+per-dim reward clipping schedules.
+
+Acting is RECURRENT (h carried across env steps) via the EnvRunner's
+stateful-module protocol (`act`/`initial_state`).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+
+from ..core.learner import Learner
+from ..core.rl_module import RLModule, _mlp_apply, _mlp_init
+from ..env.spaces import Discrete
+from .algorithm import Algorithm
+from .algorithm_config import AlgorithmConfig
+
+
+def symlog(x):
+    return jnp.sign(x) * jnp.log1p(jnp.abs(x))
+
+
+def symexp(x):
+    return jnp.sign(x) * (jnp.exp(jnp.abs(x)) - 1.0)
+
+
+class DreamerV3Config(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 3e-4                 # world model
+        self.actor_lr = 1e-4
+        self.critic_lr = 1e-4
+        self.rollout_fragment_length = 64
+        self.replay_capacity = 500     # fragments ([T, N] rollouts)
+        self.seq_len = 16              # training sequence length
+        self.batch_size_seqs = 32      # sequences per grad step
+        self.num_grad_steps = 8        # grad steps per training_step
+        self.horizon = 15              # imagination depth
+        self.deter_dim = 128
+        self.stoch_groups = 8          # categorical groups ...
+        self.stoch_classes = 8         # ... x classes each
+        self.units = 128
+        self.free_bits = 1.0
+        self.kl_dyn = 0.5              # KL(sg(post) || prior) weight
+        self.kl_rep = 0.1              # KL(post || sg(prior)) weight
+        self.gamma = 0.997
+        self.lam = 0.95
+        self.entropy_coef = 1e-3
+        self.critic_ema = 0.02         # Polyak rate for the bootstrap critic
+        self.learning_starts = 1024    # env steps before updates begin
+        self.grad_clip = 100.0
+
+
+class DreamerV3Module(RLModule):
+    """params = {"wm": {enc, gru, prior, post, dec, rew, cont},
+    "actor": mlp, "critic": mlp, "critic_t": mlp, "ret_scale": scalar}."""
+
+    def __init__(self, obs_dim: int, act_n: int, cfg: DreamerV3Config):
+        self.obs_dim = obs_dim
+        self.act_n = act_n              # discrete action count
+        self.deter = cfg.deter_dim
+        self.G = cfg.stoch_groups
+        self.C = cfg.stoch_classes
+        self.units = cfg.units
+        self.z_dim = self.G * self.C
+
+    # ------------------------------------------------------------- params
+    def init(self, rng):
+        U, D, Z = self.units, self.deter, self.z_dim
+        ks = jax.random.split(rng, 10)
+        gin = Z + self.act_n  # GRU input: [z, action one-hot]
+        wm = {
+            "enc": _mlp_init(ks[0], (self.obs_dim, U, U), scale_last=1.0),
+            "gru": {
+                "wx": jax.nn.initializers.orthogonal()(ks[1], (gin, 3 * D), jnp.float32),
+                "wh": jax.nn.initializers.orthogonal()(ks[2], (D, 3 * D), jnp.float32),
+                "b": jnp.zeros((3 * D,), jnp.float32),
+            },
+            "prior": _mlp_init(ks[3], (D, U, Z), scale_last=1.0),
+            "post": _mlp_init(ks[4], (D + U, U, Z), scale_last=1.0),
+            "dec": _mlp_init(ks[5], (D + Z, U, self.obs_dim), scale_last=1.0),
+            "rew": _mlp_init(ks[6], (D + Z, U, 1), scale_last=0.0),
+            "cont": _mlp_init(ks[7], (D + Z, U, 1), scale_last=1.0),
+        }
+        return {
+            "wm": wm,
+            "actor": _mlp_init(ks[8], (D + Z, U, self.act_n), scale_last=0.01),
+            "critic": _mlp_init(ks[9], (D + Z, U, 1), scale_last=0.0),
+            "critic_t": _mlp_init(ks[9], (D + Z, U, 1), scale_last=0.0),
+            "ret_scale": jnp.asarray(1.0, jnp.float32),
+        }
+
+    # ---------------------------------------------------------------- rssm
+    def _gru(self, p, h, x):
+        D = self.deter
+        gates = x @ p["wx"][:, : 2 * D] + h @ p["wh"][:, : 2 * D] + p["b"][: 2 * D]
+        r, u = jnp.split(jax.nn.sigmoid(gates), 2, axis=-1)
+        cand = jnp.tanh(
+            x @ p["wx"][:, 2 * D:] + (r * h) @ p["wh"][:, 2 * D:] + p["b"][2 * D:]
+        )
+        return u * h + (1.0 - u) * cand
+
+    def _logits(self, mlp, x):
+        return _mlp_apply(mlp, x, activation=jax.nn.silu).reshape(
+            x.shape[:-1] + (self.G, self.C)
+        )
+
+    def _probs(self, logits):
+        # 1% unimix: keeps KL finite and exploration alive (DreamerV3 §2).
+        return 0.99 * jax.nn.softmax(logits, -1) + 0.01 / self.C
+
+    def _sample_z(self, rng, logits):
+        """Straight-through categorical sample → flat [.., G*C]."""
+        probs = self._probs(logits)
+        idx = jax.random.categorical(rng, jnp.log(probs), axis=-1)
+        hard = jax.nn.one_hot(idx, self.C, dtype=probs.dtype)
+        z = hard + probs - lax.stop_gradient(probs)
+        return z.reshape(z.shape[:-2] + (self.z_dim,))
+
+    def _mode_z(self, logits):
+        probs = self._probs(logits)
+        hard = jax.nn.one_hot(jnp.argmax(probs, -1), self.C, dtype=probs.dtype)
+        return hard.reshape(hard.shape[:-2] + (self.z_dim,))
+
+    def _kl(self, post_logits, prior_logits):
+        p = self._probs(post_logits)
+        q = self._probs(prior_logits)
+        return jnp.sum(p * (jnp.log(p) - jnp.log(q)), axis=(-2, -1))
+
+    def encode(self, wm, obs):
+        return _mlp_apply(wm["enc"], symlog(obs), activation=jax.nn.silu)
+
+    def head(self, mlp, h, z, activation=jax.nn.silu):
+        return _mlp_apply(mlp, jnp.concatenate([h, z], -1), activation=activation)
+
+    # --------------------------------------- EnvRunner stateful protocol
+    def initial_state(self, n: int):
+        return (
+            jnp.zeros((n, self.deter), jnp.float32),
+            jnp.zeros((n, self.z_dim), jnp.float32),
+            jnp.zeros((n, self.act_n), jnp.float32),
+        )
+
+    def act(self, params, obs, state, rng, greedy: bool = False):
+        """One recurrent acting step: advance h with (z, a) from the LAST
+        step, infer the posterior over z from the new observation, sample an
+        action from the actor on (h, z)."""
+        wm = params["wm"]
+        h, z_prev, a_prev = state
+        h = self._gru(wm["gru"], h, jnp.concatenate([z_prev, a_prev], -1))
+        embed = self.encode(wm, jnp.asarray(obs, jnp.float32))
+        post = self._logits(wm["post"], jnp.concatenate([h, embed], -1))
+        kz, ka = jax.random.split(rng)
+        z = self._sample_z(kz, post)
+        logits = self.head(params["actor"], h, z)
+        if greedy:
+            action = jnp.argmax(logits, -1)
+        else:
+            action = jax.random.categorical(ka, logits, axis=-1)
+        logp = jax.nn.log_softmax(logits)[
+            jnp.arange(logits.shape[0]), action
+        ]
+        value = self.head(params["critic"], h, z)[..., 0]
+        a_onehot = jax.nn.one_hot(action, self.act_n, dtype=jnp.float32)
+        return action.astype(jnp.int32), logp, value, (h, z, a_onehot)
+
+
+def make_dreamer_update(module: DreamerV3Module, wm_opt, actor_opt, critic_opt,
+                        cfg: DreamerV3Config):
+    G, C = module.G, module.C
+    H = cfg.horizon
+
+    def observe(wm, seq, rng):
+        """Run the RSSM over a [L, B] sequence; returns losses + posterior
+        (h, z) features for every step (imagination starts)."""
+        obs = seq["obs"]          # [L, B, obs]
+        acts = jax.nn.one_hot(seq["actions"], module.act_n, dtype=jnp.float32)
+        is_first = seq["is_first"][..., None]  # [L, B, 1]
+        L, B = obs.shape[0], obs.shape[1]
+        embed = module.encode(wm, obs)
+        a_prev = jnp.concatenate([jnp.zeros_like(acts[:1]), acts[:-1]], 0)
+        keys = jax.random.split(rng, L)
+
+        def step(carry, inp):
+            h, z = carry
+            emb_t, a_t, first_t, key = inp
+            keep = 1.0 - first_t
+            h, z, a_t = h * keep, z * keep, a_t * keep
+            h = module._gru(wm["gru"], h, jnp.concatenate([z, a_t], -1))
+            prior = module._logits(wm["prior"], h)
+            post = module._logits(wm["post"], jnp.concatenate([h, emb_t], -1))
+            z = module._sample_z(key, post)
+            return (h, z), (h, z, prior, post)
+
+        h0 = jnp.zeros((B, module.deter), jnp.float32)
+        z0 = jnp.zeros((B, module.z_dim), jnp.float32)
+        _, (hs, zs, priors, posts) = lax.scan(
+            step, (h0, z0), (embed, a_prev, is_first, keys)
+        )
+        return hs, zs, priors, posts
+
+    def wm_loss(wm, seq, rng):
+        hs, zs, priors, posts = observe(wm, seq, rng)
+        obs_hat = module.head(wm["dec"], hs, zs)
+        rew_hat = module.head(wm["rew"], hs, zs)[..., 0]
+        cont_logit = module.head(wm["cont"], hs, zs)[..., 0]
+
+        recon = jnp.mean(jnp.sum((obs_hat - symlog(seq["obs"])) ** 2, -1))
+        rew_l = jnp.mean((rew_hat - symlog(seq["rewards"])) ** 2)
+        cont_target = 1.0 - seq["dones"]
+        cont_l = jnp.mean(
+            optax.sigmoid_binary_cross_entropy(cont_logit, cont_target)
+        )
+        kl_dyn = module._kl(lax.stop_gradient(posts), priors)
+        kl_rep = module._kl(posts, lax.stop_gradient(priors))
+        fb = cfg.free_bits
+        kl = cfg.kl_dyn * jnp.mean(jnp.maximum(kl_dyn, fb)) + cfg.kl_rep * jnp.mean(
+            jnp.maximum(kl_rep, fb)
+        )
+        loss = recon + rew_l + cont_l + kl
+        aux = {
+            "wm_loss": loss, "recon": recon, "reward_loss": rew_l,
+            "cont_loss": cont_l, "kl": jnp.mean(kl_dyn),
+            "starts": (lax.stop_gradient(hs), lax.stop_gradient(zs)),
+        }
+        return loss, aux
+
+    def imagine(params, h0, z0, rng):
+        """Roll the actor through the world model PRIOR for H steps."""
+        wm = params["wm"]
+
+        def step(carry, key):
+            h, z = carry
+            ka, kz = jax.random.split(key)
+            logits = module.head(params["actor"], h, z)
+            a = jax.random.categorical(ka, logits, axis=-1)
+            logp = jnp.take_along_axis(
+                jax.nn.log_softmax(logits), a[..., None], -1
+            )[..., 0]
+            ent = -jnp.sum(
+                jax.nn.softmax(logits) * jax.nn.log_softmax(logits), -1
+            )
+            a1 = jax.nn.one_hot(a, module.act_n, dtype=jnp.float32)
+            h = module._gru(wm["gru"], h, jnp.concatenate([z, a1], -1))
+            z = module._sample_z(kz, module._logits(wm["prior"], h))
+            return (h, z), (h, z, logp, ent)
+
+        keys = jax.random.split(rng, H)
+        _, (hs, zs, logps, ents) = lax.scan(step, (h0, z0), keys)
+        # Include the start state's features at index 0 for value/reward.
+        hs = jnp.concatenate([h0[None], hs], 0)       # [H+1, S, D]
+        zs = jnp.concatenate([z0[None], zs], 0)
+        return hs, zs, logps, ents
+
+    def behavior_loss(ac_params, params, starts, rng, ret_scale):
+        params = {**params, "actor": ac_params["actor"], "critic": ac_params["critic"]}
+        h0, z0 = starts
+        hs, zs, logps, ents = imagine(params, h0, z0, rng)
+        wm = params["wm"]
+        # Rewards/continues predicted from each imagined state; v from the
+        # EMA critic for stable bootstraps.
+        rew = symexp(module.head(wm["rew"], hs, zs)[..., 0])          # [H+1, S]
+        cont = jax.nn.sigmoid(module.head(wm["cont"], hs, zs)[..., 0])
+        v_t = module.head(params["critic_t"], hs, zs)[..., 0]
+        disc = cfg.gamma * cont
+
+        # λ-returns, reverse scan: R_k = r_k + d_k((1-λ)v_{k+1} + λR_{k+1}).
+        def back(acc, inp):
+            r_k, d_k, v_next = inp
+            R = r_k + d_k * ((1.0 - cfg.lam) * v_next + cfg.lam * acc)
+            return R, R
+
+        last = v_t[-1]
+        Rs_rev = lax.scan(
+            back, last,
+            (rew[:-1][::-1], disc[:-1][::-1], v_t[1:][::-1]),
+        )[1]
+        R = Rs_rev[::-1]                                # [H, S]
+
+        # Imagination weights: stop counting past a predicted termination.
+        w = jnp.concatenate(
+            [jnp.ones_like(disc[:1]), jnp.cumprod(disc[:-1], 0)], 0
+        )[:-1]
+        w = lax.stop_gradient(w)
+
+        v = module.head(params["critic"], hs[:-1], zs[:-1])[..., 0]    # [H, S]
+        adv = lax.stop_gradient((R - v_t[:-1]) / ret_scale)
+        actor_l = -jnp.mean(w * (logps * adv + cfg.entropy_coef * ents))
+        critic_l = jnp.mean(w * (v - lax.stop_gradient(R)) ** 2)
+        aux = {
+            "actor_loss": actor_l, "critic_loss": critic_l,
+            "return_mean": jnp.mean(R), "entropy": jnp.mean(ents),
+            "R": lax.stop_gradient(R),
+        }
+        return actor_l + critic_l, aux
+
+    def update(state, batches, rng):
+        params, opt_states = state
+
+        def grad_step(carry, inp):
+            params, (wm_os, a_os, c_os) = carry
+            seq, key = inp
+            k_wm, k_im = jax.random.split(key)
+
+            (wl, wm_aux), wm_grads = jax.value_and_grad(wm_loss, has_aux=True)(
+                params["wm"], seq, k_wm
+            )
+            wm_up, wm_os = wm_opt.update(wm_grads, wm_os, params["wm"])
+            params = {**params, "wm": optax.apply_updates(params["wm"], wm_up)}
+
+            hs, zs = wm_aux.pop("starts")
+            # Every posterior state is an imagination start ([L*B, ...]).
+            h0 = hs.reshape(-1, hs.shape[-1])
+            z0 = zs.reshape(-1, zs.shape[-1])
+
+            ac = {"actor": params["actor"], "critic": params["critic"]}
+            (bl, b_aux), ac_grads = jax.value_and_grad(behavior_loss, has_aux=True)(
+                ac, params, (h0, z0), k_im, params["ret_scale"]
+            )
+            a_up, a_os = actor_opt.update(ac_grads["actor"], a_os, params["actor"])
+            c_up, c_os = critic_opt.update(ac_grads["critic"], c_os, params["critic"])
+            params = {
+                **params,
+                "actor": optax.apply_updates(params["actor"], a_up),
+                "critic": optax.apply_updates(params["critic"], c_up),
+            }
+            # EMA critic + percentile return normalization (DreamerV3 §4).
+            R = b_aux.pop("R")
+            spread = jnp.percentile(R, 95) - jnp.percentile(R, 5)
+            params = {
+                **params,
+                "critic_t": jax.tree.map(
+                    lambda t, o: (1 - cfg.critic_ema) * t + cfg.critic_ema * o,
+                    params["critic_t"], params["critic"],
+                ),
+                "ret_scale": jnp.maximum(
+                    1.0, 0.99 * params["ret_scale"] + 0.01 * spread
+                ),
+            }
+            aux = {**wm_aux, **b_aux, "ret_scale": params["ret_scale"]}
+            return (params, (wm_os, a_os, c_os)), aux
+
+        k = jax.tree_util.tree_leaves(batches)[0].shape[0]
+        keys = jax.random.split(rng, k)
+        (params, opt_states), auxs = lax.scan(
+            grad_step, (params, opt_states), (batches, keys)
+        )
+        return (params, opt_states), jax.tree.map(lambda x: x.mean(), auxs)
+
+    return update
+
+
+class _FragmentReplay:
+    """Ring buffer of time-major rollout fragments; samples [B, L] windows
+    (time-major [L, B] out) with is_first derived from dones."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self.frags: List[Dict[str, np.ndarray]] = []
+        self.steps = 0
+
+    def add(self, frag: Dict[str, np.ndarray]):
+        keep = {k: frag[k] for k in ("obs", "actions", "rewards", "dones")}
+        self.frags.append(keep)
+        self.steps += keep["rewards"].size
+        while len(self.frags) > self.capacity:
+            old = self.frags.pop(0)
+            self.steps -= old["rewards"].size
+
+    def sample(self, rng: np.random.Generator, n_batches: int, batch_seqs: int,
+               seq_len: int) -> Dict[str, np.ndarray]:
+        out = {k: [] for k in ("obs", "actions", "rewards", "dones", "is_first")}
+        for _ in range(n_batches * batch_seqs):
+            f = self.frags[rng.integers(len(self.frags))]
+            T, N = f["rewards"].shape
+            env = int(rng.integers(N))
+            t0 = int(rng.integers(max(1, T - seq_len + 1)))
+            sl = slice(t0, t0 + seq_len)
+            if T - t0 < seq_len:  # short fragment: pad by wrapping (rare)
+                idx = np.arange(seq_len) % (T - t0)
+                pick = lambda a: a[sl][idx]  # noqa: E731
+            else:
+                pick = lambda a: a[sl]  # noqa: E731
+            d = pick(f["dones"][:, env])
+            is_first = np.zeros(seq_len, np.float32)
+            is_first[0] = 1.0
+            is_first[1:] = d[:-1]  # step after a done starts a new episode
+            out["obs"].append(pick(f["obs"][:, env]))
+            out["actions"].append(pick(f["actions"][:, env]))
+            out["rewards"].append(pick(f["rewards"][:, env]))
+            out["dones"].append(d)
+            out["is_first"].append(is_first)
+        # [k, L, B, ...] time-major per grad step.
+        def stack(key):
+            a = np.stack(out[key])  # [k*B, L, ...]
+            a = a.reshape(n_batches, batch_seqs, seq_len, *a.shape[2:])
+            return np.swapaxes(a, 1, 2)  # [k, L, B, ...]
+
+        return {k: stack(k) for k in out}
+
+
+class DreamerV3(Algorithm):
+    config_class = DreamerV3Config
+
+    def setup(self):
+        super().setup()
+        cfg = self.config
+        self._replay = _FragmentReplay(cfg.replay_capacity)
+        self._np_rng = np.random.default_rng(cfg.seed)
+
+    def _make_module(self):
+        if not isinstance(self.action_space, Discrete):
+            raise TypeError("DreamerV3-lite supports discrete action spaces")
+        obs_dim = int(np.prod(self.observation_space.shape))
+        return DreamerV3Module(obs_dim, self.action_space.n, self.config)
+
+    def _make_learner(self) -> Learner:
+        cfg = self.config
+
+        def opt(lr):
+            tx = optax.adam(lr)
+            if cfg.grad_clip:
+                tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip), tx)
+            return tx
+
+        wm_opt, actor_opt, critic_opt = opt(cfg.lr), opt(cfg.actor_lr), opt(cfg.critic_lr)
+        learner = Learner(
+            self.module,
+            make_dreamer_update(self.module, wm_opt, actor_opt, critic_opt, cfg),
+            seed=cfg.seed,
+        )
+        learner.opt_state = (
+            wm_opt.init(learner.params["wm"]),
+            actor_opt.init(learner.params["actor"]),
+            critic_opt.init(learner.params["critic"]),
+        )
+        return learner
+
+    def training_step(self) -> Dict:
+        cfg = self.config
+        batches = self._sample_batches()
+        env_steps = 0
+        for b in batches:
+            env_steps += b["rewards"].size
+            self._replay.add(b)
+
+        metrics: Dict = {}
+        if self._replay.steps >= cfg.learning_starts:
+            seqs = self._replay.sample(
+                self._np_rng, cfg.num_grad_steps, cfg.batch_size_seqs, cfg.seq_len
+            )
+            metrics = self.learner_group.update(seqs)
+            self._weights = self.learner_group.get_weights()
+        return {"_env_steps_this_iter": env_steps, "info": {"learner": metrics}}
+
+
+DreamerV3Config.algo_class = DreamerV3

@@ -13,6 +13,7 @@ from __future__ import annotations
 from typing import Any, Dict, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from . import make_env
@@ -61,18 +62,44 @@ class EnvRunner:
 
         mod = self.module
 
-        def _act(params, obs, rng):
-            dist, value = mod.forward(params, obs)
-            action = mod.sample(rng, dist)
-            logp = mod.log_prob(dist, action)
-            return action, logp, value
+        # Stateful-module protocol (recurrent policies — DreamerV3's RSSM;
+        # reference analog: RLlib's RNN policy state in `Policy.compute_
+        # actions` state_batches): a module exposing `act`/`initial_state`
+        # owns its whole action computation and threads a per-env state
+        # pytree through the rollout; state rows reset where an episode
+        # ended.
+        self._stateful = hasattr(mod, "act") and hasattr(mod, "initial_state")
+        if self._stateful:
+            import functools
 
-        def _act_greedy(params, obs):
-            dist, value = mod.forward(params, obs)
-            return mod.greedy(dist), value
+            # Cached: rebuilt-per-reset zero pytrees would re-transfer to
+            # device on nearly every step in short-episode envs.
+            self._init_state = jax.device_put(mod.initial_state(self.num_envs))
+            self._state = self._init_state
+            self._act_st = jax.jit(functools.partial(mod.act, greedy=False))
+            self._act_st_greedy = jax.jit(functools.partial(mod.act, greedy=True))
 
-        self._act = jax.jit(_act)
-        self._act_greedy = jax.jit(_act_greedy)
+            def _reset_rows(state, done, init):
+                def blend(s, s0):
+                    mask = done.reshape(done.shape + (1,) * (s.ndim - 1))
+                    return jnp.where(mask > 0, s0, s)
+
+                return jax.tree.map(blend, state, init)
+
+            self._reset_rows = jax.jit(_reset_rows)
+        else:
+            def _act(params, obs, rng):
+                dist, value = mod.forward(params, obs)
+                action = mod.sample(rng, dist)
+                logp = mod.log_prob(dist, action)
+                return action, logp, value
+
+            def _act_greedy(params, obs):
+                dist, value = mod.forward(params, obs)
+                return mod.greedy(dist), value
+
+            self._act = jax.jit(_act)
+            self._act_greedy = jax.jit(_act_greedy)
 
     def get_spaces(self):
         return self.env.observation_space, self.env.action_space
@@ -98,9 +125,13 @@ class EnvRunner:
 
         ep_returns, ep_lengths = [], []
         obs, mobs = self._obs, self._mobs
+        state = self._state if self._stateful else None
         for t in range(T):
             self._rng, key = jax.random.split(self._rng)
-            action, logp, value = self._act(params, mobs, key)
+            if self._stateful:
+                action, logp, value, state = self._act_st(params, mobs, state, key)
+            else:
+                action, logp, value = self._act(params, mobs, key)
             action_np = np.asarray(action)
             obs_buf[t] = mobs
             act_buf[t] = action_np
@@ -117,9 +148,15 @@ class EnvRunner:
             )
             rew_buf[t] = rew
             done_buf[t] = (term | trunc).astype(np.float32)
+            if self._stateful and done_buf[t].any():
+                state = self._reset_rows(
+                    state, jnp.asarray(done_buf[t]), self._init_state
+                )
             ep_returns.extend(info.get("episode_returns", []))
             ep_lengths.extend(info.get("episode_lengths", []))
         self._obs, self._mobs = obs, mobs
+        if self._stateful:
+            self._state = state
 
         return {
             "obs": obs_buf,
@@ -139,16 +176,29 @@ class EnvRunner:
         env = make_env(self._env_name, self.num_envs, **self._env_kwargs)
         params = jax.device_put(params)
         obs, _ = env.reset()
+        init_state = (
+            jax.device_put(self.module.initial_state(env.num_envs))
+            if self._stateful else None
+        )
+        state = init_state
+        eval_rng = jax.random.PRNGKey(0)
         returns: list = []
         guard = 0
         while len(returns) < num_episodes and guard < 100_000:
             guard += 1
             mobs = obs if self._env_to_module is None else self._env_to_module(obs)
-            action, _ = self._act_greedy(params, mobs)
+            if self._stateful:
+                eval_rng, key = jax.random.split(eval_rng)
+                action, _, _, state = self._act_st_greedy(params, mobs, state, key)
+            else:
+                action, _ = self._act_greedy(params, mobs)
             action_np = np.asarray(action)
             if self._module_to_env is not None:
                 action_np = self._module_to_env(action_np)
             obs, rew, term, trunc, info = env.step(action_np)
+            done = (term | trunc).astype(np.float32)
+            if self._stateful and done.any():
+                state = self._reset_rows(state, jnp.asarray(done), init_state)
             returns.extend(info.get("episode_returns", []))
         return {
             "episode_reward_mean": float(np.mean(returns[:num_episodes])) if returns else float("nan"),

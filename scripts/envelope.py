@@ -105,6 +105,21 @@ def main():
     report("object_args_to_one_task", n, "args",
            {"seconds": round(time.perf_counter() - t0, 2)})
 
+    # ---- Data shuffle throughput across workers (ref: shuffle release
+    # tests, `release/nightly_tests`; guards the columnar path now that the
+    # r4 process-wide pyarrow lock is off by default) ----
+    from ray_tpu import data as rdata
+
+    N_ROWS = 2_000_000 if big else 200_000
+    ds = rdata.range(N_ROWS, parallelism=16)
+    t0 = time.perf_counter()
+    shuffled = ds.random_shuffle(seed=0)
+    got = shuffled.count()
+    dt = time.perf_counter() - t0
+    assert got == N_ROWS, (got, N_ROWS)
+    report("data_shuffle_rows_per_s", round(N_ROWS / dt, 1), "rows/s",
+           {"rows": N_ROWS, "seconds": round(dt, 2), "blocks": 16})
+
     ray_tpu.shutdown()
 
     # ---- cross-node transfer envelope (ref: 1 GiB×50 nodes broadcast +

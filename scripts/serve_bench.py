@@ -1,0 +1,142 @@
+"""Serve batched-generation bench on the real TPU (BASELINE.json config #5).
+
+The reference's headline Serve workload is Llama-2-7B batched inference
+(tokens/s + latency through proxy → router → replica); GPT-2-large decode
+is the single-v5e-chip stand-in (VERDICT r4 "Next" #4b). The replica holds
+the params in HBM and serves `make_generate` — prefill + a device-side
+`lax.scan` decode loop, ONE dispatch per request batch (the axon tunnel's
+~100 ms RTT would dominate a per-token loop).
+
+Requests ride the full data plane: HTTP proxy → router (power-of-two
+replica choice) → @serve.batch queue (router-side batching to the jitted
+batch shape) → TPU replica.
+
+Run: python scripts/serve_bench.py [--requests 64] [--batch 8]
+Prints one JSON line per metric (tokens/s, p50/p99 latency).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PROMPT_LEN = 128
+NEW_TOKENS = 64
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--clients", type=int, default=16)
+    args = ap.parse_args()
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init()
+
+    B = args.batch
+
+    @serve.deployment(ray_actor_options={"num_tpus": 1},
+                      max_ongoing_requests=256)
+    class GPT2Decode:
+        def __init__(self):
+            import jax
+            import numpy as np
+
+            from ray_tpu.models import gpt2_large, init_params
+            from ray_tpu.models.gpt import make_generate
+
+            self.jax = jax
+            self.np = np
+            cfg = gpt2_large(max_seq=PROMPT_LEN + NEW_TOKENS,
+                             attn_impl="flash", remat=False)
+            self.cfg = cfg
+            self.params = jax.jit(lambda k: init_params(k, cfg))(
+                jax.random.PRNGKey(0)
+            )
+            self.gen = jax.jit(make_generate(cfg, NEW_TOKENS))
+            self.rng = jax.random.PRNGKey(0)
+            # Warm the compile at the serving batch shape so the first
+            # request doesn't pay ~40 s of XLA.
+            warm = jax.numpy.zeros((B, PROMPT_LEN), jax.numpy.int32)
+            self.gen(self.params, warm, self.rng).block_until_ready()
+
+        @serve.batch(max_batch_size=B, batch_wait_timeout_s=0.05)
+        def generate(self, prompts):
+            jnp = self.jax.numpy
+            n = len(prompts)
+            batch = self.np.zeros((B, PROMPT_LEN), self.np.int32)
+            for i, p in enumerate(prompts):
+                batch[i] = self.np.asarray(p, self.np.int32)[:PROMPT_LEN]
+            self.rng, key = self.jax.random.split(self.rng)
+            out = self.np.asarray(
+                self.gen(self.params, jnp.asarray(batch), key)
+            )
+            return [out[i].tolist() for i in range(n)]
+
+    handle = serve.run(GPT2Decode.bind(), name="gptbench", route_prefix="/gen")
+
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(0, 50000, (args.requests, PROMPT_LEN)).tolist()
+
+    # Warm one request through the full path (compile already paid in ctor).
+    handle.generate.remote(prompts[0]).result(timeout_s=600)
+
+    latencies = []
+    lock = threading.Lock()
+    t0 = time.perf_counter()
+
+    def client(idxs):
+        for i in idxs:
+            t = time.perf_counter()
+            out = handle.generate.remote(prompts[i]).result(timeout_s=600)
+            dt = time.perf_counter() - t
+            assert len(out) == NEW_TOKENS
+            with lock:
+                latencies.append(dt)
+
+    threads = [
+        threading.Thread(target=client,
+                         args=(range(c, args.requests, args.clients),),
+                         daemon=True)
+        for c in range(args.clients)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+
+    lat = np.sort(np.asarray(latencies))
+    total_tokens = args.requests * NEW_TOKENS
+    print(json.dumps({
+        "metric": "serve_gpt2_large_decode_tokens_per_s",
+        "value": round(total_tokens / wall, 1),
+        "unit": "tokens/s",
+        "extra": {
+            "requests": args.requests,
+            "batch": B,
+            "prompt_len": PROMPT_LEN,
+            "new_tokens": NEW_TOKENS,
+            "p50_s": round(float(lat[len(lat) // 2]), 3),
+            "p99_s": round(float(lat[min(len(lat) - 1, int(len(lat) * 0.99))]), 3),
+            "wall_s": round(wall, 1),
+            "requests_per_s": round(args.requests / wall, 2),
+        },
+    }), flush=True)
+    serve.delete("gptbench")
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()
