@@ -23,9 +23,17 @@ class _DeploymentState:
     def __init__(self, spec: Dict[str, Any]):
         self.spec = spec
         self.target_replicas: int = spec["opts"]["num_replicas"]
-        self.replicas: List = []  # ActorHandles
+        self.replicas: List = []  # READY ActorHandles (routable)
         self.replica_tags: List[str] = []
+        # Replicas whose __init__ has not answered a ping yet (model load +
+        # jit compile can take MINUTES for LLM replicas — the reference's
+        # DeploymentState keeps a STARTING state for exactly this;
+        # `deployment_state.py:1210`). Not routable, not respawn-eligible
+        # until replica_startup_timeout_s.
+        self.starting: List = []  # [(handle, tag, started_at)]
         self.next_replica_id = 0
+        # Consecutive missed pings per READY replica tag; replaced at 3.
+        self.miss_counts: Dict[str, int] = {}
         # autoscaling bookkeeping
         self.ongoing_ema: float = 0.0
         self.last_scale_action_t: float = 0.0
@@ -66,6 +74,8 @@ class ServeController:
                     # and push the (possibly changed) user_config to them.
                     state.replicas = prev.replicas
                     state.replica_tags = prev.replica_tags
+                    state.starting = prev.starting
+                    state.miss_counts = prev.miss_counts
                     state.next_replica_id = prev.next_replica_id
                     new_cfg = spec["opts"].get("user_config")
                     if new_cfg is not None and new_cfg != prev.spec["opts"].get("user_config"):
@@ -74,12 +84,12 @@ class ServeController:
                         ]
                 elif prev is not None:
                     # Code changed: old replicas are stale — drain them all.
-                    self._drain(prev, len(prev.replicas))
+                    self._drain(prev, len(prev.replicas) + len(prev.starting))
                 deployments[name] = state
             # Kill replicas of deployments that disappeared.
             for name, prev in old["deployments"].items():
                 if name not in deployments:
-                    self._drain(prev, len(prev.replicas))
+                    self._drain(prev, len(prev.replicas) + len(prev.starting))
             self._apps[app_name] = {
                 "deployments": deployments,
                 "route_prefix": route_prefix,
@@ -99,7 +109,7 @@ class ServeController:
             app = self._apps.pop(app_name, None)
             if app:
                 for state in app["deployments"].values():
-                    self._drain(state, len(state.replicas))
+                    self._drain(state, len(state.replicas) + len(state.starting))
                 self._version += 1
 
     def shutdown(self) -> None:
@@ -238,7 +248,10 @@ class ServeController:
                     for dname, state in app["deployments"].items()
                 ]
             for app_name, dname, state, replicas, tags in work:
-                refs = [h.ping.remote() for h in replicas]
+                with self._lock:
+                    starting = list(state.starting)
+                probes = list(replicas) + [h for h, _, _ in starting]
+                refs = [h.ping.remote() for h in probes]
                 ready = set()
                 if refs:
                     done, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=5.0)
@@ -248,16 +261,60 @@ class ServeController:
                             ready.add(ref)
                         except Exception:  # noqa: BLE001
                             pass
-                alive = [(h, t) for h, t, r in zip(replicas, tags, refs) if r in ready]
+                ready_refs = refs[: len(replicas)]
+                starting_refs = refs[len(replicas):]
+                now = time.time()
+                startup_tmo = float(
+                    state.spec["opts"].get("replica_startup_timeout_s") or 600.0
+                )
+
+                keep, promote, kill = [], [], []
+                # READY replicas: a missed ping is counted, not fatal — a
+                # replica busy with a long batch stays ROUTED until three
+                # consecutive misses prove it wedged/dead (previously one
+                # missed window silently LEAKED the actor and respawned).
+                for h, t, r in zip(replicas, tags, ready_refs):
+                    if r in ready:
+                        state.miss_counts.pop(t, None)
+                        keep.append((h, t))
+                    else:
+                        m = state.miss_counts.get(t, 0) + 1
+                        state.miss_counts[t] = m
+                        (kill if m >= 3 else keep).append((h, t))
+                # STARTING replicas: a ping answer means __init__ finished →
+                # promote to routable; silence is normal (model load/compile)
+                # until the startup timeout.
+                still_starting = []
+                for (h, t, t0), r in zip(starting, starting_refs):
+                    if r in ready:
+                        promote.append((h, t))
+                    elif now - t0 > startup_tmo:
+                        kill.append((h, t))
+                    else:
+                        still_starting.append((h, t, t0))
+
+                for h, t in kill:
+                    state.miss_counts.pop(t, None)
+                    try:
+                        ray_tpu.kill(h)  # never leak a replaced replica
+                    except Exception:  # noqa: BLE001
+                        pass
 
                 with self._lock:
                     app = self._apps.get(app_name)
                     if app is None or app["deployments"].get(dname) is not state:
                         continue  # redeployed/removed while we were pinging
-                    changed = len(alive) != len(state.replicas)
-                    state.replicas = [h for h, _ in alive]
-                    state.replica_tags = [t for _, t in alive]
-                    need = state.target_replicas - len(state.replicas)
+                    routable = keep + promote
+                    changed = (
+                        [h for h, _ in routable] != state.replicas
+                        or bool(kill)
+                    )
+                    state.replicas = [h for h, _ in routable]
+                    state.replica_tags = [t for _, t in routable]
+                    state.starting = still_starting
+                    need = state.target_replicas - len(state.replicas) - len(
+                        state.starting
+                    )
                     excess = -need
                 for _ in range(max(need, 0)):
                     self._start_replica(app_name, dname, state)
@@ -295,17 +352,22 @@ class ServeController:
             spec["opts"].get("user_config"),
         )
         with self._lock:
-            state.replicas.append(handle)
-            state.replica_tags.append(tag)
+            # New replicas are STARTING (unroutable) until their first
+            # answered ping proves __init__ completed.
+            state.starting.append((handle, tag, time.time()))
 
     def _drain(self, state: _DeploymentState, n: int):
         import ray_tpu
 
         for _ in range(n):
-            if not state.replicas:
+            # Unready (starting) replicas go first: they serve nothing yet.
+            if state.starting:
+                handle, _tag, _t0 = state.starting.pop()
+            elif state.replicas:
+                handle = state.replicas.pop()
+                state.replica_tags.pop()
+            else:
                 break
-            handle = state.replicas.pop()
-            state.replica_tags.pop()
             try:
                 ray_tpu.kill(handle)
             except Exception:  # noqa: BLE001

@@ -32,6 +32,10 @@ class DeploymentOptions:
     autoscaling_config: Optional[AutoscalingConfig] = None
     ray_actor_options: Dict[str, Any] = dataclasses.field(default_factory=dict)
     health_check_period_s: float = 10.0
+    # How long a replica may sit in __init__ (model load + jit compile)
+    # before the controller gives up and replaces it. LLM replicas
+    # legitimately take minutes.
+    replica_startup_timeout_s: float = 600.0
     max_num_models_per_replica: int = 3  # multiplexing LRU size
 
 
@@ -91,6 +95,7 @@ def deployment(
     user_config: Optional[dict] = None,
     autoscaling_config: Optional[dict] = None,
     ray_actor_options: Optional[dict] = None,
+    replica_startup_timeout_s: Optional[float] = None,
 ):
     """`@serve.deployment` decorator (reference: `serve/api.py` `deployment`)."""
 
@@ -110,6 +115,8 @@ def deployment(
             )
         if ray_actor_options is not None:
             opts.ray_actor_options = dict(ray_actor_options)
+        if replica_startup_timeout_s is not None:
+            opts.replica_startup_timeout_s = float(replica_startup_timeout_s)
         return Deployment(cls, name or cls.__name__, opts)
 
     if _cls is not None:

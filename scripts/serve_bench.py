@@ -45,7 +45,8 @@ def main():
     B = args.batch
 
     @serve.deployment(ray_actor_options={"num_tpus": 1},
-                      max_ongoing_requests=256)
+                      max_ongoing_requests=256,
+                      replica_startup_timeout_s=2400)
     class GPT2Decode:
         def __init__(self):
             import jax
@@ -82,7 +83,12 @@ def main():
             )
             return [out[i].tolist() for i in range(n)]
 
-    handle = serve.run(GPT2Decode.bind(), name="gptbench", route_prefix="/gen")
+    # Blocks until the replica is READY — its ctor pays the axon attach +
+    # XLA compile of the whole generation program (minutes).
+    handle = serve.run(
+        GPT2Decode.bind(), name="gptbench", route_prefix="/gen",
+        timeout_s=2400,
+    )
 
     import numpy as np
 

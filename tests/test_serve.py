@@ -196,3 +196,35 @@ class TestLifecycle:
 
         serve.delete("life")
         assert "life" not in serve.status()["applications"]
+
+
+class TestSlowStartup:
+    def test_slow_init_replica_not_replaced_or_leaked(self, serve_instance, tmp_path):
+        """A replica busy in __init__ (model load + jit compile in real LLM
+        deployments) must stay STARTING — one replica total, no respawn
+        storm, no leaked actors (r5 regression: the reconciler replaced any
+        replica that missed ONE 5s ping window and never killed the old
+        one, so a 2-minute compile piled up replicas on the one TPU)."""
+        import time
+
+        boots = str(tmp_path / "boots")
+
+        @serve.deployment(replica_startup_timeout_s=120)
+        class Slow:
+            def __init__(self):
+                with open(boots, "a") as f:
+                    f.write("x")
+                time.sleep(12)  # several reconcile ping windows
+
+            def __call__(self, x):
+                return x + 1
+
+        handle = serve.run(Slow.bind(), name="slow", route_prefix="/slow",
+                           timeout_s=90)
+        assert handle.remote(1).result(timeout_s=30) == 2
+        # Grace for one more reconcile pass, then the invariant: exactly one
+        # replica ever booted.
+        time.sleep(3)
+        with open(boots) as f:
+            assert f.read() == "x", "slow-starting replica was respawned"
+        serve.delete("slow")
