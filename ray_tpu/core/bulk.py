@@ -178,7 +178,7 @@ class BulkServer:
     def _serve_one(self, sock: socket.socket, req: dict, streaming: list):
         offset = int(req.get("offset", 0))
         length = req.get("length")
-        if req.get("mode") == "map":
+        if req.get("mode") in ("map", "borrow"):
             self._serve_map(sock, req)
             return
         tmo = rt_config.get("transfer_chunk_timeout_s")
@@ -216,18 +216,41 @@ class BulkServer:
 
     def _serve_map(self, sock: socket.socket, req: dict):
         """Same-host handover: reply with (path, offset, size); hold the pin
-        until the client acks that it copied the span."""
+        until the client acks that it copied the span — or, in `borrow`
+        mode, until the client CLOSES the connection (the span is adopted
+        zero-copy; the open socket IS the lease — plasma's shared-segment
+        lifetime, carried by a connection instead of an fd refcount)."""
         tmo = rt_config.get("transfer_chunk_timeout_s")
-        if req.get("name"):
-            src = self.local_store.bulk_map_source(req["name"])
-        else:
-            path = req["path"]
-            src = contextlib.nullcontext((path, 0, os.stat(path).st_size))
-        with src as (path, base, total):
+        if req.get("mode") == "borrow" and not (
+            req.get("name")
+            and getattr(self.local_store, "supports_borrow_of", lambda n: False)(
+                req["name"]
+            )
+        ):
+            # Pin-less sources (plain shm, chained borrows, raw paths) must
+            # not hand out leases they cannot honor — decline; the client
+            # falls back to the copy planes.
+            raise ValueError("source cannot pin this object for a borrow")
+        with (
+            self.local_store.bulk_map_source(req["name"])
+            if req.get("name")
+            else contextlib.nullcontext((req["path"], 0, os.stat(req["path"]).st_size))
+        ) as (path, base, total):
             body = json.dumps(
                 {"path": path, "offset": base, "size": total}
             ).encode()
             sock.sendall(_HDR.pack(2, len(body)) + body)
+            if req.get("mode") == "borrow":
+                # Park until EOF — the borrower never writes; its close (or
+                # death) releases the pin. No deadline: the borrow is as
+                # long-lived as the borrowed object.
+                sock.settimeout(None)
+                try:
+                    while sock.recv(4096):
+                        pass
+                except OSError:
+                    pass
+                return
             # Pin must outlive the client's pread: wait for the 1-byte ack.
             _recv_exact(sock, 1, max(tmo, total / (256 << 20)))
 
@@ -351,11 +374,15 @@ def _local_addrs() -> set:
 def _copy_span_from_file(src_fd: int, src_base: int, size: int, writer):
     """Land `size` bytes of an open file into the writer, fastest path first:
 
-    1. file→file `sendfile` into the writer's backing-file span (`sink()`):
-       zero userspace copies AND no mmap faults — the write()-side tmpfs
-       allocation path is ~25× faster than faulting pages through a fresh
-       mapping on lazily-backed guest kernels (see mem.py).
-    2. Fallback: batch the destination faults (`ensure_populated`) and
+    1. file→file `copy_file_range` into the writer's backing-file span
+       (`sink()`): zero userspace copies AND no mmap faults — the
+       write()-side tmpfs allocation path is ~25× faster than faulting
+       pages through a fresh mapping on lazily-backed guest kernels (see
+       mem.py). Measured on this host class (r5): copy_file_range 2.6
+       GiB/s vs sendfile 1.8-2.3 vs pread+pwrite 1.9 for a cold 1 GiB.
+    2. `sendfile` when copy_file_range is unsupported (pre-5.3 kernels /
+       cross-fs).
+    3. Fallback: batch the destination faults (`ensure_populated`) and
        preadv straight into the writer's mapping.
     """
     sink = getattr(writer, "sink", lambda: None)()
@@ -363,20 +390,31 @@ def _copy_span_from_file(src_fd: int, src_base: int, size: int, writer):
         dst_path, dst_base = sink
         dfd = os.open(dst_path, os.O_WRONLY)
         try:
-            os.lseek(dfd, dst_base, os.SEEK_SET)
             done = 0
+            use_cfr = hasattr(os, "copy_file_range")
+            os.lseek(dfd, dst_base, os.SEEK_SET)
             while done < size:
+                want = min(_SENDFILE_SPAN, size - done)
                 try:
-                    n = os.sendfile(dfd, src_fd, src_base + done,
-                                    min(_SENDFILE_SPAN, size - done))
+                    if use_cfr:
+                        n = os.copy_file_range(
+                            src_fd, dfd, want, src_base + done, dst_base + done
+                        )
+                    else:
+                        n = os.sendfile(dfd, src_fd, src_base + done, want)
                 except InterruptedError:
                     continue
                 except OSError as e:
-                    if e.errno in (errno.EINVAL, errno.ENOSYS) and done == 0:
-                        break  # no file→file sendfile here; fall through
+                    if e.errno in (errno.EINVAL, errno.ENOSYS, errno.EXDEV):
+                        if use_cfr:
+                            use_cfr = False  # retry the span via sendfile
+                            os.lseek(dfd, dst_base + done, os.SEEK_SET)
+                            continue
+                        if done == 0:
+                            break  # no file→file path here; fall through
                     raise
                 if n <= 0:
-                    raise ConnectionError("bulk map sendfile hit EOF")
+                    raise ConnectionError("bulk map copy hit EOF")
                 done += n
             else:
                 return
@@ -391,6 +429,41 @@ def _copy_span_from_file(src_fd: int, src_base: int, size: int, writer):
         if got <= 0:
             raise ConnectionError("bulk map pread hit EOF")
         done += got
+
+
+def bulk_borrow(addr: str, where: dict, size: int, tmo: float):
+    """Same-host zero-copy adoption: ask the source for its span and KEEP
+    the connection open as the pin lease. Returns (path, offset, sock) —
+    closing `sock` releases the source-side pin. Raises if the server
+    declines or metadata mismatches (caller falls back to the copy path)."""
+    sock = _open_bulk_conn(addr, tmo)
+    try:
+        req = json.dumps({
+            "name": where.get("name"), "path": where.get("path"),
+            "mode": "borrow",
+        }).encode()
+        sock.sendall(_LEN.pack(len(req)) + req)
+        status, n = _HDR.unpack(_recv_exact(sock, _HDR.size, tmo))
+        if status == 1:
+            raise RuntimeError(
+                f"bulk borrow failed: "
+                f"{_recv_exact(sock, n, tmo).decode(errors='replace')}"
+            )
+        if status != 2:
+            raise RuntimeError("bulk borrow declined by server")
+        info = json.loads(_recv_exact(sock, n, tmo))
+        path, base = info["path"], int(info["offset"])
+        if not path.startswith(("/dev/shm/", "/tmp/")) and not where.get("path"):
+            raise RuntimeError(f"bulk borrow refused suspicious path {path!r}")
+        if int(info["size"]) != size:
+            raise RuntimeError(
+                f"bulk borrow size mismatch: expected {size}, "
+                f"source has {info['size']}"
+            )
+        return path, base, sock
+    except BaseException:
+        sock.close()
+        raise
 
 
 def _pull_map(addr: str, where: dict, size: int, writer, tmo: float) -> bool:
@@ -435,11 +508,24 @@ def bulk_pull_into(addr: str, where: dict, size: int, writer,
     straight into `writer`'s arena mapping: same-host map handover when the
     peer is this machine, else `streams` parallel connections of contiguous
     spans. Blocking — call in an executor."""
+    import sys as _sys
+    import time as _time
+
     tmo = rt_config.get("transfer_chunk_timeout_s")
     host = addr.rsplit(":", 1)[0]
+    big = size >= (256 << 20) and rt_config.get("transfer_log_big")
     if rt_config.get("bulk_same_host_map") and host in _local_addrs():
+        _m0 = _time.monotonic()
         if _pull_map(addr, where, size, writer, tmo):
+            if big:
+                _md = _time.monotonic() - _m0
+                print(f"bulk_plane MAP {size >> 20}MiB {_md:.2f}s "
+                      f"({size / 2**30 / max(_md, 1e-9):.2f} GiB/s)",
+                      flush=True, file=_sys.stderr)
             return
+    elif big:
+        print(f"bulk_plane TCP (host={host!r} not local or map off)",
+              flush=True, file=_sys.stderr)
     streams = streams or rt_config.get("bulk_streams")
     streams = max(1, min(streams, max(1, size // (8 << 20))))
     if streams == 1:

@@ -114,6 +114,15 @@ define("bulk_min_bytes", 1 << 20,
 define("bulk_same_host_map", True,
        doc="Same-host pulls pread the source shm file directly (plasma "
            "fd-passing by name) instead of looping through TCP")
+define("transfer_log_big", True,
+       doc="Log one stderr line per big (>=256 MiB) object transfer with "
+           "plane + throughput attribution (session-log forensics; set 0 "
+           "to silence)")
+define("bulk_same_host_borrow", True,
+       doc="Same-host pulls ADOPT the source span zero-copy (borrow name + "
+           "pin held at the source until released) instead of copying it — "
+           "the plasma shared-segment design; the page-supply-bound copy "
+           "path remains the fallback and the cross-machine behavior")
 define("iso_boot_grace_s", 30.0,
        doc="Seconds an isolated (conda/container) worker spawn may take to "
            "register before it counts as a dead attempt (the window widens "

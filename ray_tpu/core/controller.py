@@ -1043,6 +1043,12 @@ class Controller:
     # ----------------------------------------------------------- handlers
     async def h_register_driver(self, conn, meta, msg):
         meta["kind"] = "driver"
+        # A worker's nested-API backend registers as a driver too — adopt
+        # its node so gets materialize objects in ITS node's arena (pulling
+        # into the head instead was a triple copy on one machine and a
+        # correctness hole across machines: the worker would try to open a
+        # /dev/shm name that only exists on the head).
+        meta["node_id"] = msg.get("node_id", HEAD_NODE)
         self.drivers.add(conn)
         return {
             "ok": True,
@@ -1299,6 +1305,13 @@ class Controller:
         obj = self._obj(hex_id)
         if obj.inline is not None or node_id in obj.locations:
             return
+        if (obj.size or 0) >= (1 << 30) and rt_config.get("transfer_log_big"):
+            # Stderr diagnostic (session log): big-object transfer routing.
+            print(
+                f"ensure_local node={node_id} id={hex_id[:8]} "
+                f"size={(obj.size or 0) >> 20}MiB",
+                flush=True, file=__import__("sys").stderr,
+            )
         if obj.spilled_path is not None and obj.spilled_node == node_id:
             return
         key = (node_id, hex_id)

@@ -12,6 +12,13 @@ from .controller import run_controller
 def main():
     from .rpc import ensure_auth_token
 
+    if os.environ.get("RAY_TPU_CTRL_STACKDUMP"):
+        # Dev tool: periodic all-thread stack dumps into the controller log
+        # (what IS the event loop doing during a stall?).
+        import faulthandler
+
+        faulthandler.dump_traceback_later(3, repeat=True)
+
     # Manually-started heads (no driver set the secret yet): generate one —
     # spawned workers/agents inherit it; drivers discover it in address.json.
     ensure_auth_token()

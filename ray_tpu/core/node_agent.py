@@ -29,6 +29,7 @@ import signal
 import subprocess
 import sys
 import traceback
+import time
 from typing import Dict, Optional
 
 from . import config as rt_config
@@ -83,9 +84,41 @@ async def pull_chunked(peer, where: dict, local_store, hex_id: str,
         if resp.get("error"):
             raise RuntimeError(resp["error"])
         return local_store.create_raw(hex_id, resp["data"])
+    # Same-host zero-copy adoption first (plasma shared-segment design): no
+    # allocation, no copy — this host's page-supply throughput (~0.5 GiB/s
+    # for fresh pages at 8 GiB scale, measured r5) is the wall every copy
+    # path hits, and same-machine "transfers" never need one.
+    if (
+        where.get("bulk")
+        and size >= rt_config.get("bulk_min_bytes")
+        and rt_config.get("bulk_same_host_map")
+        and rt_config.get("bulk_same_host_borrow")
+        and hasattr(local_store, "adopt_borrow")
+    ):
+        from . import bulk as bulk_mod
+
+        host = where["bulk"].rsplit(":", 1)[0]
+        if host in bulk_mod._local_addrs():
+            t0 = time.monotonic()
+            try:
+                path, base, pin = await asyncio.get_running_loop().run_in_executor(
+                    None, bulk_mod.bulk_borrow, where["bulk"], where, size, tmo
+                )
+                name = local_store.adopt_borrow(hex_id, path, base, size, pin)
+                if size >= (256 << 20) and rt_config.get("transfer_log_big"):
+                    print(
+                        f"pull_timing id={hex_id[:8]} size={size >> 20}MiB "
+                        f"BORROW {time.monotonic() - t0:.3f}s",
+                        flush=True, file=sys.stderr,
+                    )
+                return name, size
+            except Exception:  # noqa: BLE001 — fall back to the copy planes
+                traceback.print_exc()
+    t0 = time.monotonic()
     name, writer = local_store.create_begin(hex_id, size)
     if writer is None:
         return name, size  # completed earlier pull / locally produced
+    t_create = time.monotonic() - t0
     # Bulk plane first: sendfile → recv_into straight between arena mappings
     # (bulk.py). Any failure falls back to the RPC chunk plane below, which
     # rewrites every offset, so a half-written bulk span is harmless.
@@ -103,8 +136,25 @@ async def pull_chunked(peer, where: dict, local_store, hex_id: str,
         if pulled:
             # Outside the fallback-swallowing try: a commit failure must
             # surface, not send released-writer writes down the chunk plane.
+            t_bulk = time.monotonic() - t0 - t_create
             writer.commit()
+            if size >= (256 << 20) and rt_config.get("transfer_log_big"):
+                t_commit = time.monotonic() - t0 - t_create - t_bulk
+                print(
+                    f"pull_timing id={hex_id[:8]} size={size >> 20}MiB "
+                    f"create={t_create:.2f}s bulk={t_bulk:.2f}s "
+                    f"commit={t_commit:.2f}s "
+                    f"({size / 2**30 / max(t_bulk, 1e-9):.2f} GiB/s bulk)",
+                    flush=True, file=sys.stderr,
+                )
             return name, size
+    if size >= (256 << 20) and rt_config.get("transfer_log_big"):
+        print(
+            f"pull_timing id={hex_id[:8]} size={size >> 20}MiB taking CHUNK "
+            f"plane (bulk addr={bool(where.get('bulk'))}, "
+            f"min={rt_config.get('bulk_min_bytes') >> 20}MiB)",
+            flush=True, file=sys.stderr,
+        )
     try:
         sem = asyncio.Semaphore(rt_config.get("transfer_chunk_parallel"))
 

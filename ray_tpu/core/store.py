@@ -63,6 +63,25 @@ def _untrack(seg: shared_memory.SharedMemory):
         pass
 
 
+BORROW_PREFIX = "borrow!"
+
+
+def make_borrow_name(path: str, offset: int, size: int) -> str:
+    """Self-describing zero-copy location: any process on this machine can
+    open and map the span from the name alone (plasma's shared-segment
+    property, carried in the name instead of an fd). The pin that keeps the
+    source span alive is held by the node agent that adopted the borrow."""
+    return f"{BORROW_PREFIX}{path}!{offset}!{size}"
+
+
+def parse_borrow_name(name: str):
+    """(path, offset, size) or None."""
+    if not name.startswith(BORROW_PREFIX):
+        return None
+    path, off, size = name[len(BORROW_PREFIX):].rsplit("!", 2)
+    return path, int(off), int(size)
+
+
 def shm_name_for(object_hex: str) -> str:
     # shm_open names are limited (~255 incl. leading /); 28-byte ids are 56 hex.
     return f"{_SHM_PREFIX}{SESSION_TAG}-{object_hex}"
@@ -74,6 +93,63 @@ class LocalStore:
     def __init__(self):
         self._open: Dict[str, shared_memory.SharedMemory] = {}
         self._lock = threading.Lock()
+        # Borrowed spans (same-host zero-copy adoption): name -> (mmap, pin)
+        # — the mmap keeps views valid, the pin socket (agent only) keeps the
+        # SOURCE span alive until release.
+        self._borrows: Dict[str, tuple] = {}
+
+    # ------------------------------------------------------------- borrows
+    def supports_borrow_of(self, name: str) -> bool:
+        """Only sources that can PIN the span for the borrow's lifetime may
+        serve borrows. Plain shm segments have no pin (an unlink would
+        strand borrowers that haven't mapped yet), and chained borrows
+        would pin the intermediary, not the origin."""
+        return False
+
+    def adopt_borrow(self, object_hex: str, path: str, offset: int,
+                     size: int, pin) -> str:
+        """Register a same-host borrowed span as a local object. `pin` is an
+        open socket whose closure releases the source-side pin (may be None
+        in processes that merely READ an already-adopted borrow). Maps the
+        span EAGERLY so the data outlives any later unlink of the name."""
+        name = make_borrow_name(path, offset, size)
+        stale_pin = None
+        with self._lock:
+            entry = self._borrows.get(name)
+            if entry is None:
+                self._borrows[name] = (None, pin)
+            elif entry[1] is None and pin is not None:
+                self._borrows[name] = (entry[0], pin)
+            else:
+                stale_pin = pin  # duplicate adoption — one lease suffices
+        if stale_pin is not None:
+            try:
+                stale_pin.close()
+            except OSError:
+                pass
+        try:
+            self._borrow_view(name)
+        except OSError:
+            pass  # reads will surface the error with context
+        return name
+
+    def _borrow_view(self, name: str) -> memoryview:
+        import mmap as _mmap
+
+        parsed = parse_borrow_name(name)
+        path, offset, size = parsed
+        with self._lock:
+            entry = self._borrows.get(name)
+            if entry is not None and entry[0] is not None:
+                return memoryview(entry[0])[offset:offset + size]
+            fd = os.open(path, os.O_RDONLY)
+            try:
+                mm = _mmap.mmap(fd, 0, prot=_mmap.PROT_READ)
+            finally:
+                os.close(fd)
+            pin = entry[1] if entry is not None else None
+            self._borrows[name] = (mm, pin)
+            return memoryview(mm)[offset:offset + size]
 
     # ------------------------------------------------------------- creation
     def create_packed(self, object_hex: str, payload: bytes, buffers) -> Tuple[str, int]:
@@ -147,6 +223,8 @@ class LocalStore:
 
     def read_raw(self, shm_name: str) -> bytes:
         """Packed frame bytes of a local object (for serving a peer's pull)."""
+        if shm_name.startswith(BORROW_PREFIX):
+            return bytes(self._borrow_view(shm_name))
         with self._lock:
             seg = self._open.get(shm_name)
             if seg is None:
@@ -157,6 +235,8 @@ class LocalStore:
 
     # --------------------------------------- chunked transfer (pull plane)
     def raw_size(self, shm_name: str) -> int:
+        if shm_name.startswith(BORROW_PREFIX):
+            return parse_borrow_name(shm_name)[2]
         with self._lock:
             seg = self._open.get(shm_name)
             if seg is None:
@@ -166,6 +246,8 @@ class LocalStore:
         return seg.size
 
     def read_raw_slice(self, shm_name: str, offset: int, length: int) -> bytes:
+        if shm_name.startswith(BORROW_PREFIX):
+            return bytes(self._borrow_view(shm_name)[offset:offset + length])
         with self._lock:
             seg = self._open.get(shm_name)
             if seg is None:
@@ -178,6 +260,14 @@ class LocalStore:
     def bulk_source(self, shm_name: str):
         """(fd, base_offset, size) of the file backing `shm_name` — the bulk
         server (`bulk.py`) sendfiles spans straight from the page cache."""
+        if shm_name.startswith(BORROW_PREFIX):
+            path, offset, size = parse_borrow_name(shm_name)
+            fd = os.open(path, os.O_RDONLY)
+            try:
+                yield fd, offset, size
+            finally:
+                os.close(fd)
+            return
         fd = os.open(f"/dev/shm/{shm_name}", os.O_RDONLY)
         try:
             yield fd, 0, os.fstat(fd).st_size
@@ -188,6 +278,10 @@ class LocalStore:
     def bulk_map_source(self, shm_name: str):
         """(path, offset, size) for SAME-HOST handover — the puller opens the
         backing file itself and preads (plasma fd-passing, by name)."""
+        if shm_name.startswith(BORROW_PREFIX):
+            # Chained borrow: hand out the ORIGINAL file span.
+            yield parse_borrow_name(shm_name)
+            return
         path = f"/dev/shm/{shm_name}"
         yield path, 0, os.stat(path).st_size
 
@@ -212,6 +306,8 @@ class LocalStore:
         """Attach and deserialize. Numpy arrays are zero-copy views over the
         mapping; the segment handle stays open in this process's cache so the
         views remain valid."""
+        if shm_name.startswith(BORROW_PREFIX):
+            return serialization.unpack(self._borrow_view(shm_name))
         with self._lock:
             seg = self._open.get(shm_name)
             if seg is None:
@@ -230,6 +326,17 @@ class LocalStore:
     def spill(self, shm_name: str, spill_dir: str) -> str:
         """Copy a segment to disk and drop the shm (controller-directed)."""
         os.makedirs(spill_dir, exist_ok=True)
+        if shm_name.startswith(BORROW_PREFIX):
+            import hashlib
+
+            path = os.path.join(
+                spill_dir,
+                "borrow-" + hashlib.md5(shm_name.encode()).hexdigest(),
+            )
+            with open(path, "wb") as f:
+                f.write(self._borrow_view(shm_name))  # memoryview: no copy
+            self.release(shm_name)
+            return path
         path = os.path.join(spill_dir, shm_name)
         with self._lock:
             seg = self._open.get(shm_name)
@@ -243,6 +350,22 @@ class LocalStore:
         return path
 
     def release(self, shm_name: str, unlink: bool = False):
+        if shm_name.startswith(BORROW_PREFIX):
+            with self._lock:
+                entry = self._borrows.pop(shm_name, None)
+            if entry is not None:
+                mm, pin = entry
+                if pin is not None:
+                    try:
+                        pin.close()  # releases the source-side span pin
+                    except OSError:
+                        pass
+                if mm is not None:
+                    try:
+                        mm.close()
+                    except (BufferError, ValueError):
+                        pass  # live views; mapping dies with the process
+            return  # never unlink the source-owned file
         with self._lock:
             seg = self._open.pop(shm_name, None)
         if seg is None and unlink:
@@ -518,6 +641,15 @@ class ArenaStore:
             return None, bytes(frame), size
         name, size = self.create_packed(object_hex, payload, buffers)
         return name, None, size
+
+    def adopt_borrow(self, object_hex: str, path: str, offset: int,
+                     size: int, pin) -> str:
+        return self.fallback.adopt_borrow(object_hex, path, offset, size, pin)
+
+    def supports_borrow_of(self, name: str) -> bool:
+        # Arena objects carry a real pin (bulk_map_source holds locate());
+        # everything else (plain shm, chained borrows) must be copied.
+        return name.startswith(ARENA_PREFIX)
 
     # -------------------------------------------------------------- reading
     def read(self, name: str) -> Any:

@@ -293,14 +293,11 @@ class ServeController:
                     else:
                         still_starting.append((h, t, t0))
 
-                for h, t in kill:
-                    state.miss_counts.pop(t, None)
-                    try:
-                        ray_tpu.kill(h)  # never leak a replaced replica
-                    except Exception:  # noqa: BLE001
-                        pass
-
                 with self._lock:
+                    # Staleness check BEFORE any kill/apply: an in-place
+                    # redeploy SHARES the replica lists by reference, so a
+                    # kill issued against a stale snapshot would leave a
+                    # dead handle routable in the successor state.
                     app = self._apps.get(app_name)
                     if app is None or app["deployments"].get(dname) is not state:
                         continue  # redeployed/removed while we were pinging
@@ -316,6 +313,12 @@ class ServeController:
                         state.starting
                     )
                     excess = -need
+                for h, t in kill:
+                    state.miss_counts.pop(t, None)
+                    try:
+                        ray_tpu.kill(h)  # never leak a replaced replica
+                    except Exception:  # noqa: BLE001
+                        pass
                 for _ in range(max(need, 0)):
                     self._start_replica(app_name, dname, state)
                     changed = True
@@ -352,9 +355,21 @@ class ServeController:
             spec["opts"].get("user_config"),
         )
         with self._lock:
-            # New replicas are STARTING (unroutable) until their first
-            # answered ping proves __init__ completed.
-            state.starting.append((handle, tag, time.time()))
+            app = self._apps.get(app_name)
+            live = app is not None and app["deployments"].get(dname) is state
+            if live:
+                # New replicas are STARTING (unroutable) until their first
+                # answered ping proves __init__ completed.
+                state.starting.append((handle, tag, time.time()))
+        if not live:
+            # The deployment was replaced/deleted while the actor spawned —
+            # appending to the orphaned state would leak a live replica.
+            import ray_tpu
+
+            try:
+                ray_tpu.kill(handle)
+            except Exception:  # noqa: BLE001
+                pass
 
     def _drain(self, state: _DeploymentState, n: int):
         import ray_tpu

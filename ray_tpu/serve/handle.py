@@ -108,27 +108,41 @@ class _Batcher:
         return DeploymentResponse(future=fut)
 
     def _flush(self):
-        with self._lock:
-            if self._timer is not None:
-                self._timer.cancel()
-                self._timer = None
-            pending, self._pending = self._pending, []
-        if not pending:
-            return
-        # Split by model_id (multiplexed batches must be homogeneous).
-        by_model: Dict[str, List[Tuple[Any, Any]]] = {}
-        for arg, fut, mid in pending:
-            by_model.setdefault(mid, []).append((arg, fut))
-        for mid, items in by_model.items():
-            args = [a for a, _ in items]
-            futs = [f for _, f in items]
-            try:
-                results = self.router.call_batch(self.method, args, mid)
-                for f, r in zip(futs, results):
-                    f.set_result(r)
-            except Exception as e:  # noqa: BLE001
-                for f in futs:
-                    f.set_result(e)
+        while True:
+            with self._lock:
+                if self._timer is not None:
+                    self._timer.cancel()
+                    self._timer = None
+                # At most max_batch_size per dispatch: a submit racing
+                # between the caller's flush decision and this lock could
+                # otherwise overfill the batch (observed: 9 items reaching a
+                # max_batch_size=8 replica, which had shaped its jit program
+                # for exactly 8).
+                pending = self._pending[: self.max_batch_size]
+                del self._pending[: self.max_batch_size]
+                leftover = len(self._pending)
+                if 0 < leftover < self.max_batch_size and self._timer is None:
+                    self._timer = threading.Timer(self.wait_s, self._flush)
+                    self._timer.daemon = True
+                    self._timer.start()
+            if not pending:
+                return
+            # Split by model_id (multiplexed batches must be homogeneous).
+            by_model: Dict[str, List[Tuple[Any, Any]]] = {}
+            for arg, fut, mid in pending:
+                by_model.setdefault(mid, []).append((arg, fut))
+            for mid, items in by_model.items():
+                args = [a for a, _ in items]
+                futs = [f for _, f in items]
+                try:
+                    results = self.router.call_batch(self.method, args, mid)
+                    for f, r in zip(futs, results):
+                        f.set_result(r)
+                except Exception as e:  # noqa: BLE001
+                    for f in futs:
+                        f.set_result(e)
+            if leftover < self.max_batch_size:
+                return  # partial remainder waits out its timer
 
 
 class Router:

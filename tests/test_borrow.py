@@ -1,0 +1,102 @@
+"""Same-host zero-copy borrow plane (core/bulk.py bulk_borrow +
+store adopt_borrow).
+
+Reference analog: plasma's shared segments — same-machine consumers map the
+store's memory instead of copying it (`object_manager/plasma/fling.cc` fd
+passing). Here the span is adopted by name with the open socket as the pin
+lease; cross-MACHINE pulls keep the copy planes.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+pytestmark = pytest.mark.cluster
+
+SIZE = 6 << 20  # > bulk_min_bytes so the bulk/borrow plane engages
+
+
+@pytest.fixture
+def two_nodes():
+    ray_tpu.shutdown()
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    for i in range(2):
+        cluster.add_node(num_cpus=2, resources={f"w{i + 1}": 1},
+                         object_store_memory=256 << 20)
+    ray_tpu.init(address=cluster.address)
+    yield
+    ray_tpu.shutdown()
+    cluster.shutdown()
+
+
+def test_cross_node_pull_borrows_and_reads_correctly(two_nodes):
+    @ray_tpu.remote(resources={"w1": 1})
+    def produce():
+        return np.arange(SIZE // 8, dtype=np.float64)
+
+    @ray_tpu.remote(resources={"w2": 1})
+    def consume(box):
+        a = ray_tpu.get(box[0])
+        return float(a[0]), float(a[-1]), float(a.sum())
+
+    ref = produce.remote()
+    ray_tpu.wait([ref], num_returns=1, timeout=120)
+    n = SIZE // 8
+    first, last, total = ray_tpu.get(consume.remote([ref]), timeout=300)
+    assert first == 0.0
+    assert last == float(n - 1)
+    assert total == float(n * (n - 1) // 2)
+
+
+def test_borrowed_view_survives_source_release(two_nodes):
+    """An adopted mapping must stay valid even if the source object is
+    freed afterwards (tmpfs data lives while mapped; the pin prevents the
+    source arena from reusing the span while the borrow is held)."""
+
+    @ray_tpu.remote(resources={"w1": 1})
+    def produce():
+        return np.full(SIZE // 8, 7.0)
+
+    @ray_tpu.remote(resources={"w2": 1})
+    class Holder:
+        def grab(self, box):
+            self.a = ray_tpu.get(box[0])
+            return True
+
+        def read_after(self):
+            return float(self.a[0]) + float(self.a[-1])
+
+    ref = produce.remote()
+    h = Holder.remote()
+    assert ray_tpu.get(h.grab.remote([ref]), timeout=300)
+    del ref  # drop the driver's handle — source may free the object
+    import time
+
+    time.sleep(1.0)
+    assert ray_tpu.get(h.read_after.remote(), timeout=120) == 14.0
+
+
+def test_copy_fallback_when_borrow_disabled(two_nodes):
+    from ray_tpu.core import config as rt_config
+
+    os.environ["RAY_TPU_BULK_SAME_HOST_BORROW"] = "0"
+    rt_config._reset_cache_for_tests()
+    try:
+        @ray_tpu.remote(resources={"w1": 1})
+        def produce():
+            return np.arange(SIZE // 8, dtype=np.float64)
+
+        @ray_tpu.remote(resources={"w2": 1})
+        def consume(box):
+            a = ray_tpu.get(box[0])
+            return float(a[-1])
+
+        ref = produce.remote()
+        assert ray_tpu.get(consume.remote([ref]), timeout=300) == float(SIZE // 8 - 1)
+    finally:
+        del os.environ["RAY_TPU_BULK_SAME_HOST_BORROW"]
+        rt_config._reset_cache_for_tests()
