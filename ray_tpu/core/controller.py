@@ -720,7 +720,7 @@ class Controller:
             1 for w in self.workers.values() if w.state == STARTING
         ) + sum(n.spawning for n in self.nodes.values())
         boot_cap = rt_config.get("worker_boot_concurrency")
-        if self._forkserver is not None and self._forkserver.ready:
+        if self._forkserver is not None and self._forkserver.usable:
             # Forked workers skip the ~2s interpreter boot the cap was sized
             # for; registration (the remaining cost) tolerates a deeper queue.
             boot_cap *= 4
@@ -745,6 +745,7 @@ class Controller:
         node.spawning += 1
         self._spawn_ledger.append((node.node_id, time.monotonic(), tpu))
         worker_id = f"w{next(self._worker_counter)}"
+        self._event("worker_spawn", worker=worker_id, forced=force)
         if isolation is not None:
             # Registration looks the env_key up by worker_id (the worker
             # itself doesn't need to know its isolation hash).
@@ -797,7 +798,7 @@ class Controller:
                 return
         if (
             not tpu and isolation is None
-            and self._forkserver is not None and self._forkserver.ready
+            and self._forkserver is not None and self._forkserver.usable
         ):
             # Warm path: ~10 ms fork from the pre-imported template. Fork
             # preserves the no-pdeathsig property (the template, not the
@@ -1133,6 +1134,7 @@ class Controller:
                     break
         self._worker_arrival.set()
         self._worker_arrival.clear()
+        self._event("worker_registered", worker=worker_id)
         self._schedule()
         return {"ok": True}
 

@@ -182,6 +182,19 @@ class ForkServerClient:
             self._ready = os.path.exists(self.sock_path)
         return self._ready
 
+    @property
+    def usable(self) -> bool:
+        """True while the template is ready OR still BOOTING (alive, not
+        wedged). Spawn demand should queue on a booting template instead of
+        falling back to cold Popen: a burst of cold interpreter boots
+        starves the template's own import on a small host, locking the
+        whole session into the ~200x slower cold path (observed: a
+        100-actor burst at session start kept the template unready for its
+        entire 41 s; the same burst through the template is ~1 s of forks)."""
+        if self._wedged:
+            return False
+        return self.proc is not None and self.proc.poll() is None
+
     def spawn(self, worker_id: str, env: Dict[str, str], log_path: str) -> PidHandle:
         """Fork a worker (blocking, ~10 ms). Raises if the template is gone."""
         sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
@@ -222,6 +235,16 @@ class ForkServerClient:
         ).start()
 
     def _flush_spawns(self):
+        # Wait out the template's boot (interpreter + imports, seconds —
+        # longer on a thrashed host) before the first trip: demand queued
+        # here is exactly what must NOT fall back to cold Popen.
+        deadline = time.monotonic() + 120.0
+        while (
+            not self.ready
+            and self.usable
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.25)
         while True:
             with self._q_lock:
                 batch = self._q[:32]
@@ -230,6 +253,7 @@ class ForkServerClient:
                     self._flusher_active = False
                     return
             try:
+                t0 = time.monotonic()
                 sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
                 sock.settimeout(30.0)
                 try:
@@ -241,6 +265,9 @@ class ForkServerClient:
                     resp = _recv_msg(sock)
                 finally:
                     sock.close()
+                import sys as _sys
+                print(f"fs-trip n={len(batch)} {time.monotonic()-t0:.2f}s",
+                      flush=True, file=_sys.stderr)
                 pids = resp.get("pids")
                 if pids is None:
                     raise RuntimeError(f"forkserver error: {resp.get('error')}")
@@ -365,8 +392,10 @@ def template_main():
         except OSError:
             return
         try:
+            _t0 = time.time()
             req = _recv_msg(conn)
             reqs = req["batch"] if "batch" in req else [req]
+            print(f"fs-tmpl recv n={len(reqs)} wall={time.time():.2f}", flush=True)
             pids = []
             for r in reqs:
                 # Per-item failure (fork EAGAIN) records pid 0 and CONTINUES:
@@ -390,6 +419,7 @@ def template_main():
                 _send_msg(conn, {"pids": pids})
             else:
                 _send_msg(conn, {"pid": pids[0]})
+            print(f"fs-tmpl replied n={len(pids)} took={time.time()-_t0:.2f}s", flush=True)
         except Exception as e:  # noqa: BLE001 — report; keep serving
             try:
                 _send_msg(conn, {"error": repr(e)})

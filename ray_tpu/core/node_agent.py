@@ -455,7 +455,7 @@ class NodeAgent:
             )
 
         fs = getattr(self, "_forkserver", None)
-        if not tpu and isolation is None and fs is not None and fs.ready:
+        if not tpu and isolation is None and fs is not None and fs.usable:
             # Async + batched, off the event loop (see ForkServerClient.
             # spawn_async); failed trips recover via spawn-ledger expiry.
             fs.spawn_async(

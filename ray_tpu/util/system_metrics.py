@@ -60,18 +60,31 @@ class SystemMetricsSampler:
         }
 
 
+_tpu_stats_disabled = False
+
+
 def tpu_duty_cycle() -> float:
-    """Best-effort TPU utilization: reported ONLY from processes that have
-    already initialized JAX (never import it here — a metrics sampler that
-    triggers the ~2s jax import + chip attach inside an agent's ping
-    handler would blow the health-probe deadline AND steal the chip from
-    the workers that need it)."""
+    """Best-effort TPU utilization: reported ONLY from processes whose JAX
+    BACKEND is already initialized (never import or initialize here — a
+    metrics sampler that triggers the ~2s jax import / axon chip attach
+    inside a health tick would blow the probe deadline AND steal the chip;
+    observed r5: `jax.devices()` in the controller's health loop cost ~2s
+    per tick through the tunnel, starving actor-burst scheduling). A slow
+    stats call latches sampling off for the process lifetime."""
+    global _tpu_stats_disabled
     import sys
 
-    if "jax" not in sys.modules:
+    if _tpu_stats_disabled or "jax" not in sys.modules:
         return 0.0
     try:
         jax = sys.modules["jax"]
+        # Backend-initialized check WITHOUT triggering initialization.
+        backends = getattr(
+            getattr(jax, "_src", None) and jax._src.xla_bridge, "_backends", None
+        )
+        if not backends:
+            return 0.0
+        t0 = time.monotonic()
         devs = jax.devices()
         if not devs or devs[0].platform not in ("tpu", "axon"):
             return 0.0
@@ -79,8 +92,11 @@ def tpu_duty_cycle() -> float:
         # runtime exposes them (duty-cycle counters need libtpu monitoring,
         # absent from this environment).
         stats = devs[0].memory_stats() or {}
+        if time.monotonic() - t0 > 0.25:
+            _tpu_stats_disabled = True  # tunnel round-trip — too slow to poll
         limit = stats.get("bytes_limit") or 0
         used = stats.get("bytes_in_use") or 0
         return round(100.0 * used / limit, 1) if limit else 0.0
     except Exception:  # noqa: BLE001
+        _tpu_stats_disabled = True
         return 0.0

@@ -69,6 +69,21 @@ def main():
            {"seconds": round(time.perf_counter() - t0, 1)})
     for a in actors:
         ray_tpu.kill(a)
+    del actors
+
+    if big:
+        # ---- 10k-actor probe (ref: 40,000+ cluster-wide on 64 nodes;
+        # VERDICT r4 #3 asked for a recorded 10k probe on this 1-vCPU box) ----
+        N_BIG = 10_000
+        t0 = time.perf_counter()
+        actors = [A.remote() for _ in range(N_BIG)]
+        assert sum(ray_tpu.get([a.ping.remote() for a in actors],
+                               timeout=7200)) == N_BIG
+        report("actors_10k_probe", N_BIG, "actors",
+               {"seconds": round(time.perf_counter() - t0, 1)})
+        for a in actors:
+            ray_tpu.kill(a)
+        del actors
 
     # ---- many placement groups (ref: 1,000+) ----
     from ray_tpu.util.placement_group import placement_group, remove_placement_group
