@@ -37,8 +37,11 @@ from ray_tpu.parallel import MeshSpec
 
 
 def main():
-    B, S = 2, 256
-    mesh = MeshSpec(fsdp=2, tp=2, dp=2).build(jax.devices()[:8])
+    B, S = 4, 256
+    # fsdp=4 x tp=2: NO dp axis — on a virtual single-host mesh dp
+    # REPLICATES state per device (8 x per-device footprint shares one
+    # RAM), which is what OOM-killed the dp=2 variants.
+    mesh = MeshSpec(fsdp=4, tp=2).build(jax.devices()[:8])
     cfg = gptj_6b(max_seq=S, attn_impl="ref", remat=True)
     shardings = param_shardings(cfg, mesh)
 
@@ -82,7 +85,7 @@ def main():
     print(json.dumps({
         "probe": "gptj_6b_step_executed_cpu_mesh",
         "params_b": round(cfg.n_params / 1e9, 2),
-        "mesh": {"fsdp": 2, "tp": 2, "dp": 2},
+        "mesh": {"fsdp": 4, "tp": 2},
         "batch": B, "seq": S,
         "loss": round(loss, 4), "grad_norm": round(gnorm, 4),
         "init_s": round(t_init, 1),
