@@ -14,8 +14,8 @@ bytes in Python objects at all —
     one kernel→arena copy, GIL released).
 
 The control plane (who pulls what from where) stays on the authenticated
-pickle-RPC plane (`rpc.py`); this module moves only sealed bytes, after the
-same fixed-format auth preamble. Large objects split into a few contiguous
+closed-grammar msgpack RPC plane (`rpc.py` — no pickle on the wire); this
+module moves only sealed bytes, after the same fixed-format auth preamble. Large objects split into a few contiguous
 spans pulled over parallel connections (`bulk_streams`); each span's recv
 loop enforces a PROGRESS deadline (`transfer_chunk_timeout_s` of no bytes ⇒
 abort), mirroring the per-chunk deadlines of the RPC chunk plane.

@@ -875,6 +875,179 @@ def decode_step(params, token, cache, cfg: GPTConfig):
     return logits.astype(jnp.float32), cache
 
 
+# --------------------------------------------------- paged KV-cache decode
+# Block-table cache layout for the continuous-batching engine
+# (`ray_tpu.serve.engine`): the KV cache is a pool of fixed-size token
+# blocks [L, NB, H, BS, Dh]; each sequence owns an ordered block table and
+# token position p lives at (table[p // BS], p % BS). Unlike `init_cache`'s
+# dense [L, B, H, M, Dh] layout, sequences of wildly different lengths
+# share one physical pool with no per-sequence max_seq reservation — the
+# memory model that makes iteration-level admission worth doing.
+# Block 0 is the engine's null block: padding lanes in bucketed batches
+# point their tables at it so their writes land somewhere harmless.
+
+
+def init_paged_cache(cfg: GPTConfig, num_blocks: int, block_size: int):
+    """Physical paged KV pool: {"k","v"} of [L, NB, H, BS, Dh] in cfg.dtype."""
+    shape = (cfg.n_layers, num_blocks, cfg.n_heads, block_size, cfg.d_head)
+    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def _rope_rotate(x, c, s):
+    """Half-split rotation with caller-broadcast (cos, sin) — the per-lane
+    positions of a paged decode batch don't fit apply_rope's leading-dim
+    broadcast."""
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], -1).astype(x.dtype)
+
+
+def _rope_qk(cfg: GPTConfig, q, k, rope_tables, positions):
+    """RoPE for [B, H, 1, Dh] q/k at per-lane integer positions [B]."""
+    cos, sin = rope_tables
+    rd = min(cfg.rotary_dim, cfg.d_head)
+    c = cos[positions][:, None, None, :]  # [B, 1, 1, rd/2]
+    s = sin[positions][:, None, None, :]
+    if rd < cfg.d_head:
+        q = jnp.concatenate([_rope_rotate(q[..., :rd], c, s), q[..., rd:]], -1)
+        k = jnp.concatenate([_rope_rotate(k[..., :rd], c, s), k[..., rd:]], -1)
+        return q, k
+    return _rope_rotate(q, c, s), _rope_rotate(k, c, s)
+
+
+def prefill_paged(params, tokens, real_len, block_table, kv, cfg: GPTConfig):
+    """Prompt prefill into the paged cache, one sequence per call.
+
+    tokens [1, Sp] right-padded to the shape bucket; `real_len` (traced
+    scalar) marks the prompt's true length; `block_table` [W] int32 maps its
+    blocks. K/V of padded positions scatter to the null block. Returns
+    (next-token logits [V] f32, kv) — logits are read at real_len-1, not at
+    the padded tail.
+    """
+    if cfg.mlp_type == "moe":
+        raise NotImplementedError("paged decode does not support MoE yet")
+    _, Sp = tokens.shape
+    BS = kv["k"].shape[3]
+    W = block_table.shape[0]
+    positions = jnp.arange(Sp)
+    x = params["tok_embed"][tokens].astype(cfg.dtype)
+    if cfg.pos == "learned":
+        x = x + params["pos_embed"][positions].astype(cfg.dtype)
+    rope_tables = None
+    if cfg.pos == "rotary":
+        rd = min(cfg.rotary_dim, cfg.d_head)
+        rope_tables = rope_frequencies(rd, cfg.max_seq, dtype=jnp.float32)
+    layer_stack = {k: params[k] for k in _LAYER_KEYS if k in params}
+    icfg = dataclasses.replace(cfg, remat=False, remat_policy=None)
+
+    def scan_body(x, layer_params):
+        x, (aux, k, v) = _block(
+            icfg, rope_tables, None, x, layer_params, positions, return_kv=True
+        )
+        return x, (k, v)
+
+    x, (ks, vs) = jax.lax.scan(scan_body, x, layer_stack)  # [L, 1, H, Sp, Dh]
+
+    valid = positions < real_len
+    phys = jnp.where(valid, block_table[jnp.minimum(positions // BS, W - 1)], 0)
+    off = positions % BS
+    # kv[:, phys, :, off] — advanced dims lead: [Sp, L, H, Dh].
+    kv = {
+        "k": kv["k"].at[:, phys, :, off].set(
+            ks[:, 0].transpose(2, 0, 1, 3).astype(kv["k"].dtype)
+        ),
+        "v": kv["v"].at[:, phys, :, off].set(
+            vs[:, 0].transpose(2, 0, 1, 3).astype(kv["v"].dtype)
+        ),
+    }
+    x = _norm(x, params["ln_f_w"], params["ln_f_b"], cfg.norm)
+    h = x[0, jnp.maximum(real_len - 1, 0)]  # [E] — last REAL position
+    head = params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("e,ev->v", h, head.astype(cfg.dtype))
+    return logits.astype(jnp.float32), kv
+
+
+def decode_step_paged(params, token, positions, block_tables, kv, cfg: GPTConfig):
+    """One iteration-level decode step over the paged cache.
+
+    token [B] int32 — each lane's current token (written at `positions[b]`,
+    attending to its own history 0..positions[b]); block_tables [B, W]
+    int32. Lanes are independent sequences at unrelated positions — the
+    continuous batch. Returns (logits [B, V] f32, kv). Padding lanes
+    (block table = null block, position 0) produce garbage logits the
+    engine discards.
+    """
+    if cfg.mlp_type == "moe":
+        raise NotImplementedError("paged decode does not support MoE yet")
+    B = token.shape[0]
+    W = block_tables.shape[1]
+    BS = kv["k"].shape[3]
+    M = W * BS
+    H, Dh = cfg.n_heads, cfg.d_head
+    scale = 1.0 / math.sqrt(cfg.d_head)
+    x = params["tok_embed"][token][:, None].astype(cfg.dtype)  # [B, 1, E]
+    if cfg.pos == "learned":
+        x = x + params["pos_embed"][positions][:, None].astype(cfg.dtype)
+    rope_tables = None
+    if cfg.pos == "rotary":
+        rd = min(cfg.rotary_dim, cfg.d_head)
+        rope_tables = rope_frequencies(rd, cfg.max_seq, dtype=jnp.float32)
+    phys = jnp.take_along_axis(
+        block_tables, (positions // BS)[:, None], axis=1
+    )[:, 0]                                            # [B] physical block
+    off = positions % BS
+    cols = jnp.arange(M)
+    layer_stack = {k: params[k] for k in _LAYER_KEYS if k in params}
+
+    def scan_body(x, inp):
+        layer_params, kk, vv = inp  # kk/vv: [NB, H, BS, Dh]
+        p = jax.tree_util.tree_map(lambda a: a.astype(cfg.dtype), layer_params)
+        h = _norm(x, p["ln1_w"], p["ln1_b"], cfg.norm)
+        qkv = jnp.einsum("bse,ethd->btshd", h, p["w_qkv"]) + p["b_qkv"][:, None]
+        q, k, v = (
+            qkv[:, i].transpose(0, 2, 1, 3).reshape(B, H, 1, Dh) for i in range(3)
+        )
+        if cfg.pos == "rotary":
+            q, k = _rope_qk(cfg, q, k, rope_tables, positions)
+        # Scatter this step's K/V to each lane's (block, offset) slot.
+        kk = kk.at[phys, :, off].set(k[:, :, 0].astype(kk.dtype))
+        vv = vv.at[phys, :, off].set(v[:, :, 0].astype(vv.dtype))
+        # Gather each lane's history: [B, W, H, BS, Dh] -> [B, H, W*BS, Dh].
+        gk = kk[block_tables].transpose(0, 2, 1, 3, 4).reshape(B, H, M, Dh)
+        gv = vv[block_tables].transpose(0, 2, 1, 3, 4).reshape(B, H, M, Dh)
+        scores = jnp.einsum(
+            "bhsd,bhtd->bhst", q, gk, preferred_element_type=jnp.float32
+        ) * scale                                       # [B, H, 1, M]
+        scores = jnp.where(
+            cols[None, None, None, :] <= positions[:, None, None, None],
+            scores, -1e30,
+        )
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bhst,bhtd->bhsd", probs.astype(gv.dtype), gv)
+        attn_out = jnp.einsum("bhsd,hde->bse", attn, p["w_o"]) + p["b_o"]
+
+        if cfg.parallel_block:
+            mlp_in = h
+        else:
+            x = x + attn_out
+            mlp_in = _norm(x, p["ln2_w"], p["ln2_b"], cfg.norm)
+        u = jnp.einsum("bse,ef->bsf", mlp_in, p["w_in"]) + p["b_in"]
+        if cfg.activation == "swiglu":
+            g = jnp.einsum("bse,ef->bsf", mlp_in, p["w_gate"])
+            u = jax.nn.silu(g) * u
+        else:
+            u = jax.nn.gelu(u)
+        mlp_out = jnp.einsum("bsf,fe->bse", u, p["w_out"]) + p["b_out"]
+        out = x + attn_out + mlp_out if cfg.parallel_block else x + mlp_out
+        return out, (kk, vv)
+
+    x, (ks, vs) = jax.lax.scan(scan_body, x, (layer_stack, kv["k"], kv["v"]))
+    kv = {"k": ks, "v": vs}
+    x = _norm(x, params["ln_f_w"], params["ln_f_b"], cfg.norm)
+    head = params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("be,ev->bv", x[:, -1], head.astype(cfg.dtype))
+    return logits.astype(jnp.float32), kv
+
+
 def make_generate(cfg: GPTConfig, max_new_tokens: int, temperature: float = 0.0):
     """Returns jittable `gen(params, prompt [B, S0], rng) -> tokens
     [B, max_new_tokens]`: prefill + a device-side `lax.scan` decode loop —

@@ -48,6 +48,22 @@ __all__ = [
 ]
 
 
+# Continuous-batching LLM engine (serve.engine) — lazy: LLMDeployment pulls
+# in JAX + the model stack, which plain control-plane users never need.
+_ENGINE_EXPORTS = frozenset(
+    {"LLMDeployment", "InferenceEngine", "EngineOptions", "KVBlockManager"}
+)
+__all__ += ["LLMDeployment", "InferenceEngine", "EngineOptions", "KVBlockManager"]
+
+
+def __getattr__(name):
+    if name in _ENGINE_EXPORTS:
+        from . import engine as _engine
+
+        return getattr(_engine, name)
+    raise AttributeError(name)
+
+
 def ingress(*_a, **_k):
     """FastAPI-style ingress decorator is a no-op shim (no fastapi in the
     image); plain `__call__(request)` deployments cover HTTP ingress."""

@@ -377,12 +377,16 @@ class ServeController:
         for _ in range(n):
             # Unready (starting) replicas go first: they serve nothing yet.
             if state.starting:
-                handle, _tag, _t0 = state.starting.pop()
+                handle, tag, _t0 = state.starting.pop()
             elif state.replicas:
                 handle = state.replicas.pop()
-                state.replica_tags.pop()
+                tag = state.replica_tags.pop()
             else:
                 break
+            # Drop the drained replica's miss counter: leaving it would leak
+            # an entry per replica generation (redeploy/scale-down/delete)
+            # and poison a later replica that reuses the tag.
+            state.miss_counts.pop(tag, None)
             try:
                 ray_tpu.kill(handle)
             except Exception:  # noqa: BLE001

@@ -1,0 +1,48 @@
+"""Continuous-batching LLM inference engine (vLLM-style iteration-level
+scheduling + paged KV cache) — see `ray_tpu/serve/README.md`.
+
+Layering:
+  * `kv_manager` — paged KV block map: free list, per-sequence block
+    tables, admission-by-budget (no JAX imports).
+  * `scheduler` — iteration-level working-set former: admit / retire /
+    preempt every decode step; shape buckets for XLA (no JAX imports).
+  * `engine` — the driver loop over `models/gpt.py`'s
+    `prefill_paged` / `decode_step_paged`, streaming tokens per iteration.
+  * `deployment` — `LLMDeployment`, the engine wired through the Serve
+    controller/router/streaming planes.
+
+`InferenceEngine` / `LLMDeployment` import JAX and the model stack, so they
+resolve lazily; the schedulers stay importable in lightweight contexts.
+"""
+
+from .kv_manager import KVBlockManager, KVCacheExhausted, KVStats
+from .scheduler import Scheduler, SchedulerOutput, Sequence
+
+__all__ = [
+    "KVBlockManager",
+    "KVCacheExhausted",
+    "KVStats",
+    "Scheduler",
+    "SchedulerOutput",
+    "Sequence",
+    "EngineOptions",
+    "InferenceEngine",
+    "RequestOutput",
+    "LLMDeployment",
+]
+
+_LAZY = {
+    "EngineOptions": "engine",
+    "InferenceEngine": "engine",
+    "RequestOutput": "engine",
+    "LLMDeployment": "deployment",
+}
+
+
+def __getattr__(name):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(name)
+    import importlib
+
+    return getattr(importlib.import_module(f".{mod}", __name__), name)

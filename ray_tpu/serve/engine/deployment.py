@@ -1,0 +1,93 @@
+"""`LLMDeployment` — the continuous-batching engine as a Serve replica.
+
+Contrast with `@serve.batch` (the router-side static batch former in
+`serve/handle.py`): there the ROUTER forms a fixed batch and the replica
+decodes it to completion — one long request gates every short one behind
+it. Here each replica runs an `InferenceEngine` driver thread and actor
+methods only enqueue/drain: the ENGINE re-forms the batch every decode
+iteration, so a short request submitted mid-decode joins immediately and
+exits first. Use `@serve.batch` for stateless fixed-shape scoring; use
+`LLMDeployment` for autoregressive generation with mixed output lengths.
+
+The replica runs with max_concurrency > 1: a `generate` call blocked
+draining its stream must not gate another caller's `submit` — the actual
+compute all happens on the engine's single driver thread regardless.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ..deployment import deployment as _deployment
+
+
+class _LLMReplica:
+    """User-facing methods of one engine replica (wrapped by Serve's generic
+    `Replica` actor; streaming rides `handle_request_streaming`)."""
+
+    def __init__(
+        self,
+        model: str = "gpt2-small",
+        model_overrides: Optional[Dict[str, Any]] = None,
+        engine_options: Optional[Dict[str, Any]] = None,
+        params=None,
+    ):
+        from ...models.gpt import CONFIGS
+        from .engine import EngineOptions, InferenceEngine
+
+        overrides = dict(model_overrides or {})
+        if isinstance(overrides.get("dtype"), str):
+            # Deployment specs travel the control plane as plain data;
+            # accept "float32"/"bfloat16" and resolve to the jnp dtype here.
+            import jax.numpy as jnp
+
+            overrides["dtype"] = getattr(jnp, overrides["dtype"])
+        cfg = CONFIGS[model](**overrides)
+        self.engine = InferenceEngine(
+            cfg,
+            params=params,
+            options=EngineOptions(**(engine_options or {})),
+        )
+        self.engine.start()
+
+    def generate(
+        self,
+        prompt: List[int],
+        max_new_tokens: int = 16,
+        eos_token: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        """Blocking: returns {"tokens": [...], "finish_reason": ...}."""
+        rid = self.engine.submit(prompt, max_new_tokens, eos_token=eos_token)
+        out = self.engine.stream(rid)
+        tokens = list(out)
+        return {"tokens": tokens, "finish_reason": out.finish_reason}
+
+    def generate_stream(
+        self,
+        prompt: List[int],
+        max_new_tokens: int = 16,
+        eos_token: Optional[int] = None,
+    ):
+        """Generator: one token per chunk as iterations complete — call via
+        `handle.options(stream=True).generate_stream.remote(...)`."""
+        rid = self.engine.submit(prompt, max_new_tokens, eos_token=eos_token)
+        yield from self.engine.stream(rid)
+
+    def __call__(self, request) -> Dict[str, Any]:
+        """HTTP ingress: POST {"prompt": [ids], "max_new_tokens": n}."""
+        body = request.json() if hasattr(request, "json") else dict(request)
+        return self.generate(
+            body["prompt"],
+            int(body.get("max_new_tokens", 16)),
+            body.get("eos_token"),
+        )
+
+    def engine_stats(self) -> Dict[str, Any]:
+        return self.engine.stats()
+
+
+LLMDeployment = _deployment(
+    name="LLMDeployment",
+    max_ongoing_requests=64,
+    ray_actor_options={"max_concurrency": 16},
+)(_LLMReplica)

@@ -1,0 +1,463 @@
+"""Continuous-batching inference engine (reference-era analog: vLLM's
+`LLMEngine.step()` loop — Orca-style iteration-level scheduling over a
+PagedAttention cache, here driving `models/gpt.py`'s paged decode path).
+
+One `step()` is one model iteration:
+
+    1. `Scheduler.schedule()` re-forms the working set — admits queued
+       prompts the moment the KV free list covers them, preempts on
+       exhaustion (finished sequences were already retired and their blocks
+       freed at the END of the previous step).
+    2. Admitted prompts prefill (one jitted program per prompt, prompt
+       length padded to a power-of-two bucket) and emit their first token —
+       that's TTFT, decoupled from everything else in flight.
+    3. All RUNNING sequences advance one token through ONE jitted
+       `decode_step_paged` call — batch padded to a power-of-two lane
+       bucket and block-table width bucket, so XLA compiles a bounded set
+       of programs no matter how the working set churns.
+    4. New tokens stream to per-request output queues; sequences hitting
+       their stop condition retire immediately, returning their blocks for
+       the NEXT step's admissions.
+
+The engine owns a dedicated driver thread (all JAX compute on one thread);
+`submit()`/`stream()` are called from any thread — replica actor method
+threads under Serve (`LLMDeployment` runs with max_concurrency > 1 so a
+blocked `generate` never gates another request's `submit`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+from collections import deque
+
+from .kv_manager import KVBlockManager
+from .scheduler import Scheduler, Sequence, SchedulerOutput, _next_pow2
+
+_FINISH = object()  # stream sentinel
+
+# Jitted paged kernels are process-wide singletons: every engine (and every
+# replica in local-mode tests) shares one XLA program cache, keyed by the
+# (cfg, shape-bucket) signature jax.jit already tracks. Re-wrapping per
+# engine would recompile identical programs per instance.
+_JITS = None
+
+
+def _paged_jits():
+    global _JITS
+    if _JITS is None:
+        import jax
+
+        from ...models.gpt import decode_step_paged, prefill_paged
+
+        _JITS = (
+            jax.jit(prefill_paged, static_argnums=(5,), donate_argnums=(4,)),
+            jax.jit(decode_step_paged, static_argnums=(5,), donate_argnums=(4,)),
+        )
+    return _JITS
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineOptions:
+    num_blocks: int = 64          # physical KV blocks (incl. null block 0)
+    block_size: int = 16          # token slots per block
+    max_num_seqs: int = 8         # decode-batch lane ceiling
+    max_prefills_per_step: int = 1
+    temperature: float = 0.0      # 0 = greedy
+    seed: int = 0
+
+
+class RequestOutput:
+    """Per-request stream endpoint: the engine thread feeds it, any
+    consumer thread drains it."""
+
+    def __init__(self, request_id: str):
+        self.request_id = request_id
+        self._q: "queue.Queue" = queue.Queue()
+        self.finish_reason: Optional[str] = None
+        # Registry-cleanup handshake (under the engine lock): the engine
+        # drops the registry entry once the request is BOTH finished and
+        # retrieved, whichever happens first — a fast request may finish
+        # before its caller ever reaches stream().
+        self.finished = False
+        self.retrieved = False
+
+    def __iter__(self) -> Iterator[int]:
+        while True:
+            item = self._q.get()
+            if item is _FINISH:
+                return
+            if isinstance(item, Exception):
+                raise item
+            yield item
+
+
+class InferenceEngine:
+    def __init__(
+        self,
+        cfg,
+        params=None,
+        options: Optional[EngineOptions] = None,
+    ):
+        import jax
+
+        from ...models.gpt import init_paged_cache, init_params
+
+        self.cfg = dataclasses.replace(cfg, remat=False, remat_policy=None)
+        self.opts = options or EngineOptions()
+        self._jnp = jax.numpy
+        if params is None:
+            params = init_params(jax.random.PRNGKey(self.opts.seed), cfg)
+        self.params = params
+        self.kv = init_paged_cache(
+            self.cfg, self.opts.num_blocks, self.opts.block_size
+        )
+        self.block_manager = KVBlockManager(
+            self.opts.num_blocks, self.opts.block_size
+        )
+        self.scheduler = Scheduler(
+            self.block_manager,
+            max_num_seqs=self.opts.max_num_seqs,
+            max_prefills_per_step=self.opts.max_prefills_per_step,
+        )
+        # cfg is static (hashable frozen dataclass); kv buffers are donated
+        # — each call consumes self.kv and hands back its successor.
+        self._prefill, self._decode = _paged_jits()
+        import numpy as np
+
+        self._np = np
+        self._sample_rng = np.random.default_rng(self.opts.seed)
+        self._lock = threading.Lock()          # scheduler + queues
+        self._work = threading.Condition(self._lock)
+        self._outputs: Dict[str, RequestOutput] = {}
+        self._next_id = 0
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        # Rolling throughput/latency accounting (host-side, cheap). The
+        # latency windows are bounded — a long-lived replica must not
+        # accumulate one float per request forever.
+        self.total_tokens = 0
+        self.total_preemptions = 0
+        self.total_finished = 0
+        self._ttfts: "deque[float]" = deque(maxlen=1024)
+        self._tpots: "deque[float]" = deque(maxlen=1024)
+        self._step_ttfts: List[float] = []     # reset each step()
+        self._step_tpots: List[float] = []
+        self._tok_window: List[float] = []     # token-emit timestamps
+        self._init_metrics()
+
+    # ------------------------------------------------------------- metrics
+    def _init_metrics(self):
+        try:
+            from ...util.metrics import Counter, Gauge, Histogram
+
+            self._m_queue = Gauge(
+                "serve_engine_queue_depth", "prompts waiting for KV admission"
+            )
+            self._m_running = Gauge(
+                "serve_engine_running_seqs", "sequences in the decode batch"
+            )
+            self._m_kv = Gauge(
+                "serve_engine_kv_utilization", "allocated fraction of KV blocks"
+            )
+            self._m_tps = Gauge(
+                "serve_engine_tokens_per_s", "generated tokens/s (10s window)"
+            )
+            self._m_tokens = Counter(
+                "serve_engine_tokens_total", "tokens generated"
+            )
+            self._m_preempt = Counter(
+                "serve_engine_preemptions_total", "recompute preemptions"
+            )
+            self._m_ttft = Histogram(
+                "serve_engine_ttft_s", "time to first token"
+            )
+            self._m_tpot = Histogram(
+                "serve_engine_tpot_s", "time per output token after the first"
+            )
+        except Exception:  # noqa: BLE001 — metrics are never load-bearing
+            self._m_queue = None
+
+    def _export_metrics(self, stats: Dict[str, Any]):
+        if self._m_queue is None:
+            return
+        try:
+            self._m_queue.set(stats["queue_depth"])
+            self._m_running.set(stats["running"])
+            self._m_kv.set(stats["kv_utilization"])
+            self._m_tps.set(stats["tokens_per_s"])
+            if stats["step_tokens"]:
+                self._m_tokens.inc(stats["step_tokens"])
+            if stats["step_preemptions"]:
+                self._m_preempt.inc(stats["step_preemptions"])
+            for t in stats["step_ttfts"]:
+                self._m_ttft.observe(t)
+            for t in stats["step_tpots"]:
+                self._m_tpot.observe(t)
+        except Exception:  # noqa: BLE001 — no runtime in unit tests
+            pass
+
+    # -------------------------------------------------------------- intake
+    def submit(
+        self,
+        prompt: List[int],
+        max_new_tokens: int,
+        request_id: Optional[str] = None,
+        eos_token: Optional[int] = None,
+    ) -> str:
+        """Enqueue a request; returns its id immediately. Raises ValueError
+        for requests that could NEVER run (too long for the model window or
+        the whole KV pool) — transient fullness just queues."""
+        if self._stop.is_set():
+            raise RuntimeError("engine is shut down")
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if len(prompt) + max_new_tokens > self.cfg.max_seq:
+            raise ValueError(
+                f"prompt {len(prompt)} + max_new_tokens {max_new_tokens} "
+                f"exceeds max_seq {self.cfg.max_seq}"
+            )
+        if not self.block_manager.fits_ever(len(prompt) + max_new_tokens):
+            raise ValueError(
+                f"request needs {len(prompt) + max_new_tokens} KV slots; pool "
+                f"holds {(self.opts.num_blocks - 1) * self.opts.block_size}"
+            )
+        with self._work:
+            if request_id is None:
+                request_id = f"req-{self._next_id}"
+                self._next_id += 1
+            seq = Sequence(
+                request_id=request_id,
+                prompt=prompt,
+                max_new_tokens=max_new_tokens,
+                eos_token=eos_token,
+            )
+            self.scheduler.add(seq)
+            self._outputs[request_id] = RequestOutput(request_id)
+            self._work.notify_all()
+        return request_id
+
+    def stream(self, request_id: str) -> RequestOutput:
+        """Claim a request's output stream (single consumer). Valid until
+        claimed no matter how fast the request finished; unknown/already-
+        claimed ids raise KeyError."""
+        with self._lock:
+            out = self._outputs[request_id]
+            out.retrieved = True
+            if out.finished:
+                del self._outputs[request_id]
+            return out
+
+    def generate(
+        self,
+        prompt: List[int],
+        max_new_tokens: int,
+        eos_token: Optional[int] = None,
+    ) -> List[int]:
+        """Blocking convenience: submit + drain (the driver thread must be
+        running — `start()` — or another thread must call `step()`)."""
+        rid = self.submit(prompt, max_new_tokens, eos_token=eos_token)
+        return list(self.stream(rid))
+
+    # ---------------------------------------------------------------- step
+    def _sample(self, logits_row) -> int:
+        if self.opts.temperature <= 0.0:
+            return int(logits_row.argmax())
+        z = logits_row / self.opts.temperature
+        z = z - z.max()
+        p = self._np.exp(z)
+        p /= p.sum()
+        return int(self._sample_rng.choice(len(p), p=p))
+
+    def _emit(self, seq: Sequence, tok: int):
+        seq.append_token(tok)
+        out = self._outputs.get(seq.request_id)
+        if out is not None:
+            out._q.put(tok)
+        self.total_tokens += 1
+        self._tok_window.append(time.monotonic())
+
+    def _maybe_finish(self, seq: Sequence) -> bool:
+        reason = seq.should_stop()
+        if reason is None:
+            return False
+        with self._lock:
+            self.scheduler.finish(seq, reason)
+            out = self._outputs.get(seq.request_id)
+            if out is not None:
+                out.finish_reason = reason
+                out.finished = True
+                if out.retrieved:
+                    del self._outputs[seq.request_id]
+        if out is not None:
+            out._q.put(_FINISH)
+        self.total_finished += 1
+        if seq.first_token_t is not None:
+            ttft = seq.first_token_t - seq.arrival_t
+            self._ttfts.append(ttft)
+            self._step_ttfts.append(ttft)
+            n = seq.num_generated  # survives preemption's output fold
+            if n > 1 and seq.finish_t is not None:
+                tpot = (seq.finish_t - seq.first_token_t) / (n - 1)
+                self._tpots.append(tpot)
+                self._step_tpots.append(tpot)
+        return True
+
+    def _run_prefill(self, seq: Sequence):
+        jnp = self._jnp
+        np = self._np
+        table = self.block_manager.block_table(seq.request_id)
+        P = len(seq.prompt)
+        # Same bucketing primitive as the scheduler's decode shapes —
+        # agreement between the two is what bounds the XLA program set.
+        Sp = _next_pow2(P)
+        W = _next_pow2(len(table))
+        tokens = np.zeros((1, Sp), np.int32)
+        tokens[0, :P] = seq.prompt
+        bt = np.zeros((W,), np.int32)
+        bt[: len(table)] = table
+        logits, self.kv = self._prefill(
+            self.params,
+            jnp.asarray(tokens),
+            jnp.asarray(P, jnp.int32),
+            jnp.asarray(bt),
+            self.kv,
+            self.cfg,
+        )
+        tok = self._sample(np.asarray(logits))
+        self._emit(seq, tok)
+        self._maybe_finish(seq)
+
+    def _run_decode(self, out: SchedulerOutput):
+        jnp = self._jnp
+        np = self._np
+        seqs = out.decodes
+        B = out.batch_bucket
+        W = out.width_bucket
+        tokens = np.zeros((B,), np.int32)
+        positions = np.zeros((B,), np.int32)
+        tables = np.zeros((B, W), np.int32)  # padding lanes -> null block
+        for i, seq in enumerate(seqs):
+            tokens[i] = seq.output[-1]
+            positions[i] = seq.num_tokens - 1   # where this token's KV lands
+            table = self.block_manager.block_table(seq.request_id)
+            tables[i, : len(table)] = table
+        logits, self.kv = self._decode(
+            self.params,
+            jnp.asarray(tokens),
+            jnp.asarray(positions),
+            jnp.asarray(tables),
+            self.kv,
+            self.cfg,
+        )
+        logits = np.asarray(logits)
+        for i, seq in enumerate(seqs):
+            self._emit(seq, self._sample(logits[i]))
+            self._maybe_finish(seq)
+
+    def step(self) -> Dict[str, Any]:
+        """One engine iteration; safe to drive manually (tests) or from the
+        driver thread. Returns a stats snapshot."""
+        t0 = time.monotonic()
+        self._step_ttfts, self._step_tpots = [], []
+        tok0 = self.total_tokens
+        with self._lock:
+            out = self.scheduler.schedule()
+        self.total_preemptions += len(out.preempted)
+        for seq in out.prefills:
+            self._run_prefill(seq)
+        if out.decodes:
+            self._run_decode(out)
+
+        now = time.monotonic()
+        self._tok_window = [t for t in self._tok_window if now - t <= 10.0]
+        kv_stats = self.block_manager.stats()
+        stats = {
+            "queue_depth": self.scheduler.queue_depth,
+            "running": self.scheduler.num_running,
+            "kv_utilization": kv_stats.utilization,
+            "kv_free_blocks": kv_stats.free_blocks,
+            "tokens_per_s": (
+                len(self._tok_window) / max(now - self._tok_window[0], 1e-3)
+                if self._tok_window
+                else 0.0
+            ),
+            "step_tokens": self.total_tokens - tok0,
+            "step_preemptions": len(out.preempted),
+            "step_prefills": len(out.prefills),
+            "step_decodes": len(out.decodes),
+            "step_ttfts": list(self._step_ttfts),
+            "step_tpots": list(self._step_tpots),
+            "step_s": now - t0,
+        }
+        self._export_metrics(stats)
+        return stats
+
+    def stats(self) -> Dict[str, Any]:
+        np = self._np
+        kv_stats = self.block_manager.stats()
+        with self._lock:
+            ttfts = list(self._ttfts)
+            tpots = list(self._tpots)
+        return {
+            "queue_depth": self.scheduler.queue_depth,
+            "running": self.scheduler.num_running,
+            "kv_utilization": kv_stats.utilization,
+            "total_tokens": self.total_tokens,
+            "total_finished": self.total_finished,
+            "total_preemptions": self.total_preemptions,
+            "ttft_p50_s": float(np.median(ttfts)) if ttfts else None,
+            "tpot_p50_s": float(np.median(tpots)) if tpots else None,
+        }
+
+    # -------------------------------------------------------- driver thread
+    def start(self):
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="llm-engine"
+        )
+        self._thread.start()
+
+    def shutdown(self):
+        self._stop.set()
+        with self._work:
+            self._work.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        # Fail every open stream — a consumer blocked in queue.get() would
+        # otherwise hang forever once the driver thread is gone.
+        with self._lock:
+            outs = list(self._outputs.values())
+            self._outputs.clear()
+        for out in outs:
+            out._q.put(RuntimeError("engine shut down"))
+
+    def _loop(self):
+        while not self._stop.is_set():
+            with self._work:
+                while not self.scheduler.has_work() and not self._stop.is_set():
+                    self._work.wait(timeout=0.1)
+            if self._stop.is_set():
+                return
+            try:
+                self.step()
+            except Exception as e:  # noqa: BLE001 — fail every open stream
+                with self._lock:
+                    outs = list(self._outputs.values())
+                    self._outputs.clear()
+                    # Drop all scheduler state: without it the loop would
+                    # respin on the same poisoned batch forever.
+                    for seq in list(self.scheduler.running):
+                        self.scheduler.finish(seq, "error")
+                    self.scheduler.waiting.clear()
+                    self.scheduler._seqs.clear()
+                for out in outs:
+                    out._q.put(e)

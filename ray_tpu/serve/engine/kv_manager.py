@@ -1,0 +1,164 @@
+"""Paged KV-cache block manager (reference-era analog: vLLM's BlockManager,
+`vllm/core/block_manager.py` — the PagedAttention half of iteration-level
+scheduling).
+
+The physical KV cache is a fixed pool of `num_blocks` blocks of
+`block_size` token slots each (the engine owns the actual [L, NB, H, BS, Dh]
+arrays; this class owns only the *map*). Each live sequence holds an ordered
+block table — logical token position `p` lives in physical block
+`table[p // block_size]` at offset `p % block_size`. Blocks are never
+shared (no prefix caching yet) and never compacted: fragmentation is
+internal to the last block of each sequence only, so utilization accounting
+distinguishes *allocated* slots from *used* token slots.
+
+Admission control rides on `can_allocate`: the scheduler refuses (queues,
+never crashes) a prefill whose prompt + first token doesn't fit the free
+list, and preempts the youngest running sequence when decode growth hits
+the budget mid-flight.
+
+Block 0 is RESERVED as the null/scratch block: the engine pads decode
+batches to bucket shapes by pointing dummy lanes' block tables at block 0,
+so their writes land somewhere harmless. It is never handed out.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List
+
+
+class KVCacheExhausted(RuntimeError):
+    """Raised by allocate/grow when the free list cannot cover the request.
+
+    The scheduler treats this as back-pressure (requeue/preempt), never as a
+    crash — it reaches user code only on programming errors (e.g. a prompt
+    longer than the whole pool, which `fits_ever` screens at submit)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class KVStats:
+    num_blocks: int          # allocatable blocks (excludes the null block)
+    free_blocks: int
+    used_blocks: int
+    num_seqs: int
+    utilization: float       # allocated fraction of the pool, 0..1
+
+
+class KVBlockManager:
+    """Free-list allocator mapping sequence ids to ordered block tables."""
+
+    NULL_BLOCK = 0
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise ValueError("need >= 2 blocks (block 0 is the null block)")
+        if block_size < 1:
+            raise ValueError("block_size must be >= 1")
+        self.block_size = block_size
+        self.num_blocks = num_blocks
+        # Block 0 reserved; LIFO free list so recently-freed (cache-warm)
+        # blocks are reused first.
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self._tables: Dict[str, List[int]] = {}
+        self._lens: Dict[str, int] = {}   # tokens stored per sequence
+
+    # ------------------------------------------------------------- queries
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def blocks_for(self, num_tokens: int) -> int:
+        return -(-num_tokens // self.block_size)  # ceil div
+
+    def can_allocate(self, num_tokens: int) -> bool:
+        return self.blocks_for(num_tokens) <= len(self._free)
+
+    def fits_ever(self, num_tokens: int) -> bool:
+        """Could this many tokens fit an EMPTY pool? (submit-time sanity)"""
+        return self.blocks_for(num_tokens) <= self.num_blocks - 1
+
+    def block_table(self, seq_id: str) -> List[int]:
+        return list(self._tables[seq_id])
+
+    def seq_len(self, seq_id: str) -> int:
+        return self._lens[seq_id]
+
+    def stats(self) -> KVStats:
+        total = self.num_blocks - 1
+        used = total - len(self._free)
+        return KVStats(
+            num_blocks=total,
+            free_blocks=len(self._free),
+            used_blocks=used,
+            num_seqs=len(self._tables),
+            utilization=used / total if total else 0.0,
+        )
+
+    # --------------------------------------------------------- allocation
+    def allocate(self, seq_id: str, num_tokens: int) -> List[int]:
+        """Claim blocks for a new sequence of `num_tokens` tokens.
+
+        Raises KVCacheExhausted when the free list can't cover it (the
+        caller keeps the request queued) and ValueError on reuse of a live
+        seq_id (a scheduler bug, not back-pressure)."""
+        if seq_id in self._tables:
+            raise ValueError(f"sequence {seq_id!r} already has an allocation")
+        if num_tokens < 1:
+            raise ValueError("allocate needs >= 1 token")
+        need = self.blocks_for(num_tokens)
+        if need > len(self._free):
+            raise KVCacheExhausted(
+                f"{need} blocks needed, {len(self._free)} free"
+            )
+        table = [self._free.pop() for _ in range(need)]
+        self._tables[seq_id] = table
+        self._lens[seq_id] = num_tokens
+        return list(table)
+
+    def grow(self, seq_id: str, new_len: int) -> List[int]:
+        """Extend `seq_id`'s table to cover `new_len` tokens (decode append).
+
+        Returns the (possibly extended) block table. KVCacheExhausted when a
+        new block is needed but the pool is dry — the scheduler preempts."""
+        table = self._tables[seq_id]
+        cur = self._lens[seq_id]
+        if new_len < cur:
+            raise ValueError(f"cannot shrink {seq_id!r}: {cur} -> {new_len}")
+        need = self.blocks_for(new_len) - len(table)
+        if need > len(self._free):
+            raise KVCacheExhausted(
+                f"{need} blocks needed, {len(self._free)} free"
+            )
+        for _ in range(need):
+            table.append(self._free.pop())
+        self._lens[seq_id] = new_len
+        return list(table)
+
+    def free(self, seq_id: str) -> int:
+        """Return a finished/preempted sequence's blocks to the free list.
+
+        Raises KeyError on an unknown (or already-freed) seq_id — the
+        double-free guard; freed block ids are asserted absent from the
+        free list before reinsertion."""
+        table = self._tables.pop(seq_id)  # KeyError = double free
+        del self._lens[seq_id]
+        for b in table:
+            assert b != self.NULL_BLOCK and b not in self._free, (
+                f"block {b} double-freed (seq {seq_id!r})"
+            )
+            self._free.append(b)
+        return len(table)
+
+    def check_invariants(self) -> None:
+        """Every block is in exactly one place: free list xor one table."""
+        seen = set(self._free)
+        assert len(seen) == len(self._free), "free list has duplicates"
+        assert self.NULL_BLOCK not in seen, "null block on the free list"
+        for sid, table in self._tables.items():
+            assert len(table) == self.blocks_for(self._lens[sid]), (
+                f"{sid!r}: table/len mismatch"
+            )
+            for b in table:
+                assert b not in seen, f"block {b} owned twice"
+                seen.add(b)
+        assert len(seen) == self.num_blocks - 1, "lost/leaked blocks"

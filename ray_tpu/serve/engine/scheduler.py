@@ -1,0 +1,228 @@
+"""Iteration-level scheduler (reference-era analog: Orca's iteration-level
+scheduling as productized by vLLM's `core/scheduler.py`).
+
+The unit of scheduling is ONE decode iteration, not one request: every call
+to `schedule()` re-forms the working set — finished sequences were retired
+by the engine a step earlier (their blocks already back on the free list),
+queued prefills are admitted the moment the KV budget covers their prompt,
+and the decode batch is whatever is RUNNING right now. A long generation
+therefore never gates a short one behind it: the short request joins the
+batch at the next iteration boundary and exits as soon as it hits its stop
+condition.
+
+Batch-shape discipline for XLA: decode batches are padded up to a bucket
+size (powers of two up to `max_num_seqs`) and block-table widths to a
+bucket width, so the jitted paged-decode program compiles once per
+(batch_bucket, width_bucket) pair instead of once per working-set shape.
+Bucketing lives here (scheduler policy); padding lives in the engine
+(tensor mechanics).
+
+Preemption: when decode growth exhausts the pool, the YOUNGEST running
+sequence (last admitted — minimizes wasted work) is preempted by recompute:
+its blocks are freed and it re-enters the wait queue with prompt+generated
+as the new prompt, vLLM's recompute-style preemption.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional
+
+from .kv_manager import KVBlockManager, KVCacheExhausted
+
+WAITING = "WAITING"
+RUNNING = "RUNNING"
+FINISHED = "FINISHED"
+
+
+@dataclasses.dataclass
+class Sequence:
+    """One request's generation state, host-side."""
+
+    request_id: str
+    prompt: List[int]
+    max_new_tokens: int
+    eos_token: Optional[int] = None
+    arrival_t: float = dataclasses.field(default_factory=time.monotonic)
+    output: List[int] = dataclasses.field(default_factory=list)
+    state: str = WAITING
+    # Lifetime token count: unlike len(output) it survives preemption's
+    # output→prompt fold, so per-token latency (TPOT) stays honest.
+    num_generated: int = 0
+    first_token_t: Optional[float] = None
+    finish_t: Optional[float] = None
+    finish_reason: Optional[str] = None
+    preemptions: int = 0
+
+    @property
+    def num_tokens(self) -> int:
+        return len(self.prompt) + len(self.output)
+
+    def append_token(self, tok: int) -> None:
+        if self.first_token_t is None:
+            self.first_token_t = time.monotonic()
+        self.output.append(tok)
+        self.num_generated += 1
+
+    def should_stop(self) -> Optional[str]:
+        if len(self.output) >= self.max_new_tokens:
+            return "length"
+        if self.eos_token is not None and self.output and \
+                self.output[-1] == self.eos_token:
+            return "eos"
+        return None
+
+
+@dataclasses.dataclass
+class SchedulerOutput:
+    """One iteration's work order for the engine."""
+
+    prefills: List[Sequence]       # admitted this step: run prompt, emit tok 0
+    decodes: List[Sequence]        # running: one decode_step token each
+    preempted: List[Sequence]      # freed + requeued this step (for logging)
+    batch_bucket: int              # padded decode batch size (0 = no decode)
+    width_bucket: int              # padded block-table width (blocks)
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+class Scheduler:
+    def __init__(
+        self,
+        kv: KVBlockManager,
+        max_num_seqs: int = 8,
+        max_prefills_per_step: int = 1,
+    ):
+        self.kv = kv
+        self.max_num_seqs = max_num_seqs
+        self.max_prefills_per_step = max_prefills_per_step
+        self.waiting: Deque[Sequence] = deque()
+        self.running: List[Sequence] = []
+        self._seqs: Dict[str, Sequence] = {}
+
+    # ------------------------------------------------------------ intake
+    def add(self, seq: Sequence) -> None:
+        if seq.request_id in self._seqs:
+            raise ValueError(f"duplicate request_id {seq.request_id!r}")
+        # +1: the prompt's first generated token also needs a KV slot.
+        if not self.kv.fits_ever(len(seq.prompt) + seq.max_new_tokens):
+            raise KVCacheExhausted(
+                f"request {seq.request_id!r} needs "
+                f"{len(seq.prompt) + seq.max_new_tokens} KV slots but the "
+                f"whole pool holds {(self.kv.num_blocks - 1) * self.kv.block_size}"
+            )
+        self._seqs[seq.request_id] = seq
+        self.waiting.append(seq)
+
+    def get(self, request_id: str) -> Sequence:
+        return self._seqs[request_id]
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.waiting)
+
+    @property
+    def num_running(self) -> int:
+        return len(self.running)
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    # --------------------------------------------------------- scheduling
+    def finish(self, seq: Sequence, reason: str) -> None:
+        """Retire a sequence NOW — its blocks hit the free list before the
+        next schedule() so a queued prefill can take them this iteration."""
+        seq.state = FINISHED
+        seq.finish_reason = reason
+        seq.finish_t = time.monotonic()
+        if seq in self.running:
+            self.running.remove(seq)
+            self.kv.free(seq.request_id)
+        del self._seqs[seq.request_id]
+
+    def schedule(self) -> SchedulerOutput:
+        prefills: List[Sequence] = []
+        preempted: List[Sequence] = []
+
+        # 1. Grow every running sequence's table for the token this
+        # iteration will append; preempt the youngest on exhaustion.
+        for seq in list(self.running):
+            if seq.state != RUNNING:
+                continue  # preempted as a victim earlier in this loop
+            while True:
+                try:
+                    self.kv.grow(seq.request_id, seq.num_tokens + 1)
+                    break
+                except KVCacheExhausted:
+                    victim = self._pick_victim(exclude=seq)
+                    if victim is None:
+                        # seq itself is the youngest — preempt it.
+                        self._preempt(seq)
+                        preempted.append(seq)
+                        break
+                    self._preempt(victim)
+                    preempted.append(victim)
+
+        # 2. Admit queued prefills while the batch and KV budget allow.
+        # FCFS: head-of-line blocking on the QUEUE is fine (arrival order is
+        # fair); what iteration-level scheduling removes is blocking on the
+        # multi-second decode of earlier admissions.
+        while (
+            self.waiting
+            and len(prefills) < self.max_prefills_per_step
+            # running already includes this step's admissions (appended
+            # below) — adding len(prefills) would double-count them.
+            and len(self.running) < self.max_num_seqs
+        ):
+            seq = self.waiting[0]
+            try:
+                # Prompt + the first generated token, so admission never
+                # immediately re-triggers a preemption cycle.
+                self.kv.allocate(seq.request_id, len(seq.prompt) + 1)
+            except KVCacheExhausted:
+                break  # stays queued — refusal, not failure
+            self.waiting.popleft()
+            seq.state = RUNNING
+            prefills.append(seq)
+            self.running.append(seq)
+
+        decodes = [s for s in self.running if s not in prefills]
+        bb = _next_pow2(len(decodes)) if decodes else 0
+        max_w = max(
+            (len(self.kv.block_table(s.request_id)) for s in decodes),
+            default=0,
+        )
+        return SchedulerOutput(
+            prefills=prefills,
+            decodes=decodes,
+            preempted=preempted,
+            batch_bucket=min(bb, _next_pow2(self.max_num_seqs)),
+            width_bucket=_next_pow2(max_w) if max_w else 0,
+        )
+
+    def _pick_victim(self, exclude: Sequence) -> Optional[Sequence]:
+        for seq in reversed(self.running):  # youngest first
+            if seq is not exclude:
+                return seq
+        return None
+
+    def _preempt(self, seq: Sequence) -> None:
+        """Recompute-style preemption: fold generated tokens into the prompt
+        and requeue at the FRONT (it has seniority over never-run arrivals)."""
+        self.running.remove(seq)
+        self.kv.free(seq.request_id)
+        # Already-generated tokens were already streamed out; fold them into
+        # the prompt and shrink the remaining generation budget to match.
+        seq.max_new_tokens -= len(seq.output)
+        seq.prompt = seq.prompt + seq.output
+        seq.output = []
+        seq.state = WAITING
+        seq.preemptions += 1
+        self.waiting.appendleft(seq)

@@ -60,7 +60,26 @@ class SystemMetricsSampler:
         }
 
 
-_tpu_stats_disabled = False
+# Slow/failed samples back off with a cooldown instead of a permanent
+# latch: one transient hiccup (GC pause, momentary tunnel stall) must not
+# kill the metric for the process lifetime. Consecutive bad samples double
+# the cooldown up to _TPU_COOLDOWN_MAX_S; one good sample resets it.
+_TPU_COOLDOWN_S = 30.0
+_TPU_COOLDOWN_MAX_S = 600.0
+_tpu_bad_streak = 0
+_tpu_retry_at = 0.0
+
+
+def _tpu_sample_failed():
+    global _tpu_bad_streak, _tpu_retry_at
+    _tpu_bad_streak += 1
+    # Exponent clamped BEFORE pow: an unbounded streak would overflow
+    # float pow (~2.0**1024) inside the metrics tick's except handler.
+    cooldown = min(
+        _TPU_COOLDOWN_S * (2.0 ** min(_tpu_bad_streak - 1, 16)),
+        _TPU_COOLDOWN_MAX_S,
+    )
+    _tpu_retry_at = time.monotonic() + cooldown
 
 
 def tpu_duty_cycle() -> float:
@@ -70,11 +89,11 @@ def tpu_duty_cycle() -> float:
     inside a health tick would blow the probe deadline AND steal the chip;
     observed r5: `jax.devices()` in the controller's health loop cost ~2s
     per tick through the tunnel, starving actor-burst scheduling). A slow
-    stats call latches sampling off for the process lifetime."""
-    global _tpu_stats_disabled
+    stats call pauses sampling for a (growing) cooldown, then retries."""
+    global _tpu_bad_streak
     import sys
 
-    if _tpu_stats_disabled or "jax" not in sys.modules:
+    if time.monotonic() < _tpu_retry_at or "jax" not in sys.modules:
         return 0.0
     try:
         jax = sys.modules["jax"]
@@ -93,10 +112,12 @@ def tpu_duty_cycle() -> float:
         # absent from this environment).
         stats = devs[0].memory_stats() or {}
         if time.monotonic() - t0 > 0.25:
-            _tpu_stats_disabled = True  # tunnel round-trip — too slow to poll
+            _tpu_sample_failed()  # tunnel round-trip — too slow to poll
+        else:
+            _tpu_bad_streak = 0
         limit = stats.get("bytes_limit") or 0
         used = stats.get("bytes_in_use") or 0
         return round(100.0 * used / limit, 1) if limit else 0.0
     except Exception:  # noqa: BLE001
-        _tpu_stats_disabled = True
+        _tpu_sample_failed()
         return 0.0

@@ -1,18 +1,28 @@
-"""Serve batched-generation bench on the real TPU (BASELINE.json config #5).
+"""Serve generation bench: static router-batching vs the continuous-batching
+engine, under CONTINUOUS load.
 
-The reference's headline Serve workload is Llama-2-7B batched inference
-(tokens/s + latency through proxy → router → replica); GPT-2-large decode
-is the single-v5e-chip stand-in (VERDICT r4 "Next" #4b). The replica holds
-the params in HBM and serves `make_generate` — prefill + a device-side
-`lax.scan` decode loop, ONE dispatch per request batch (the axon tunnel's
-~100 ms RTT would dominate a per-token loop).
+Two serving modes over the same GPT config, both riding the full data plane
+(HTTP proxy → router → replica):
 
-Requests ride the full data plane: HTTP proxy → router (power-of-two
-replica choice) → @serve.batch queue (router-side batching to the jitted
-batch shape) → TPU replica.
+  * static  — the r5 path: `@serve.batch` forms a fixed batch in the router
+    and the replica decodes it TO COMPLETION with `make_generate` (one
+    dispatch per batch). Every request in a batch pays the LONGEST
+    generation in it; arrivals during a decode wait out the whole batch.
+  * engine  — `serve.LLMDeployment`: iteration-level scheduler + paged KV
+    cache (`ray_tpu/serve/engine/`). Short requests join mid-decode and
+    exit at their own stop condition.
 
-Run: python scripts/serve_bench.py [--requests 64] [--batch 8]
-Prints one JSON line per metric (tokens/s, p50/p99 latency).
+Continuous load: Poisson arrivals (seeded), mixed output lengths (short
+with probability 1-p_long, long otherwise). The headline numbers are
+USEFUL tokens/s (requested tokens only — the static path burns decode
+steps on tokens nobody asked for) and the SHORT-request p99, which the
+static path couples to the long-request duration.
+
+Run (CPU, records BENCH_SERVE_engine.json):
+    JAX_PLATFORMS=cpu python scripts/serve_bench.py --mode both \
+        --out BENCH_SERVE_engine.json
+Single mode: --mode engine | --mode static. The r5 TPU batch bench is
+`--model gpt2-large --tpu --mode static`.
 """
 
 from __future__ import annotations
@@ -26,121 +36,274 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-PROMPT_LEN = 128
-NEW_TOKENS = 64
+TINY = dict(
+    vocab_size=512,
+    n_layers=4,
+    d_model=128,
+    n_heads=4,
+    d_head=32,
+    d_mlp=512,
+    max_seq=512,
+    attn_impl="ref",
+    remat=False,
+    pos="rotary",
+    rotary_dim=32,
+    norm="rmsnorm",
+    activation="swiglu",
+)
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--requests", type=int, default=64)
-    ap.add_argument("--batch", type=int, default=8)
-    ap.add_argument("--clients", type=int, default=16)
-    args = ap.parse_args()
+def build_static_app(serve, model_kwargs, batch, new_tokens, tpu):
+    """Ingress with router-side batching on __call__: proxy → router batcher
+    → one `make_generate` dispatch per formed batch."""
+    actor_opts = {"num_tpus": 1} if tpu else {}
 
-    import ray_tpu
-    from ray_tpu import serve
-
-    ray_tpu.init()
-
-    B = args.batch
-
-    @serve.deployment(ray_actor_options={"num_tpus": 1},
-                      max_ongoing_requests=256,
-                      replica_startup_timeout_s=2400)
-    class GPT2Decode:
+    @serve.deployment(
+        max_ongoing_requests=256,
+        ray_actor_options=actor_opts,
+        replica_startup_timeout_s=2400,
+    )
+    class GPTStatic:
         def __init__(self):
             import jax
             import numpy as np
 
-            from ray_tpu.models import gpt2_large, init_params
-            from ray_tpu.models.gpt import make_generate
+            from ray_tpu.models.gpt import GPTConfig, init_params, make_generate
 
-            self.jax = jax
-            self.np = np
-            cfg = gpt2_large(max_seq=PROMPT_LEN + NEW_TOKENS,
-                             attn_impl="flash", remat=False)
+            self.jax, self.np = jax, np
+            kw = dict(model_kwargs)
+            if isinstance(kw.get("dtype"), str):
+                kw["dtype"] = getattr(jax.numpy, kw["dtype"])
+            cfg = GPTConfig(**kw)
             self.cfg = cfg
-            self.params = jax.jit(lambda k: init_params(k, cfg))(
-                jax.random.PRNGKey(0)
-            )
-            self.gen = jax.jit(make_generate(cfg, NEW_TOKENS))
+            self.params = init_params(jax.random.PRNGKey(0), cfg)
+            self.gen = jax.jit(make_generate(cfg, new_tokens))
             self.rng = jax.random.PRNGKey(0)
-            # Warm the compile at the serving batch shape so the first
-            # request doesn't pay ~40 s of XLA.
-            warm = jax.numpy.zeros((B, PROMPT_LEN), jax.numpy.int32)
-            self.gen(self.params, warm, self.rng).block_until_ready()
 
-        @serve.batch(max_batch_size=B, batch_wait_timeout_s=0.05)
-        def generate(self, prompts):
+        @serve.batch(max_batch_size=batch, batch_wait_timeout_s=0.02)
+        def __call__(self, requests):
             jnp = self.jax.numpy
-            n = len(prompts)
-            batch = self.np.zeros((B, PROMPT_LEN), self.np.int32)
-            for i, p in enumerate(prompts):
-                batch[i] = self.np.asarray(p, self.np.int32)[:PROMPT_LEN]
+            np = self.np
+            bodies = [r.json() for r in requests]
+            P = len(bodies[0]["prompt"])
+            arr = np.zeros((batch, P), np.int32)
+            for i, b in enumerate(bodies):
+                arr[i] = np.asarray(b["prompt"], np.int32)
             self.rng, key = self.jax.random.split(self.rng)
-            out = self.np.asarray(
-                self.gen(self.params, jnp.asarray(batch), key)
-            )
-            return [out[i].tolist() for i in range(n)]
+            out = np.asarray(self.gen(self.params, jnp.asarray(arr), key))
+            # Fixed-shape decode: everyone rides to new_tokens; deliver the
+            # requested prefix. The waste is the point being measured.
+            return [
+                {"tokens": out[i, : int(b.get("max_new_tokens", new_tokens))].tolist()}
+                for i, b in enumerate(bodies)
+            ]
 
-    # Blocks until the replica is READY — its ctor pays the axon attach +
-    # XLA compile of the whole generation program (minutes).
-    handle = serve.run(
-        GPT2Decode.bind(), name="gptbench", route_prefix="/gen",
-        timeout_s=2400,
+    return GPTStatic.bind()
+
+
+def build_engine_app(serve, model_kwargs, max_num_seqs):
+    return serve.LLMDeployment.options(max_ongoing_requests=256).bind(
+        model="gpt2-small",
+        model_overrides=model_kwargs,
+        engine_options=dict(
+            num_blocks=129, block_size=16, max_num_seqs=max_num_seqs
+        ),
     )
 
+
+def run_load(base_url, reqs, rate, seed):
+    """Poisson open-loop client: one thread per request, launched on the
+    arrival clock (not closed-loop — stragglers must not throttle offered
+    load). Returns per-request (kind, latency_s) + wall time."""
+    import numpy as np
+    import requests as rq
+
+    rng = np.random.default_rng(seed)
+    inter = rng.exponential(1.0 / rate, size=len(reqs))
+    results = [None] * len(reqs)
+    errors = []
+    threads = []
+
+    def fire(i, body):
+        t0 = time.perf_counter()
+        try:
+            r = rq.post(base_url, json=body, timeout=600)
+            out = r.json()
+            if r.status_code != 200 or len(out.get("tokens", ())) != body["max_new_tokens"]:
+                raise RuntimeError(f"bad response {r.status_code}: {out}")
+            results[i] = time.perf_counter() - t0
+        except Exception as e:  # noqa: BLE001
+            errors.append((i, e))
+
+    t_start = time.perf_counter()
+    for i, body in enumerate(reqs):
+        time.sleep(inter[i])
+        th = threading.Thread(target=fire, args=(i, body), daemon=True)
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join()
+    wall = time.perf_counter() - t_start
+    if errors:
+        raise RuntimeError(
+            f"{len(errors)}/{len(reqs)} requests failed; first: "
+            f"req {errors[0][0]}: {errors[0][1]!r}"
+        )
+    return results, wall
+
+
+def percentile(xs, p):
+    """Rounded percentile, or None for an empty bucket (e.g. --p-long 0/1)."""
+    if not xs:
+        return None
+    xs = sorted(xs)
+    return round(xs[min(len(xs) - 1, int(len(xs) * p))], 3)
+
+
+def bench_mode(mode, args, model_kwargs):
     import numpy as np
 
-    rng = np.random.default_rng(0)
-    prompts = rng.integers(0, 50000, (args.requests, PROMPT_LEN)).tolist()
+    import ray_tpu
+    from ray_tpu import serve
 
-    # Warm one request through the full path (compile already paid in ctor).
-    handle.generate.remote(prompts[0]).result(timeout_s=600)
+    serve.start(http_options={"host": "127.0.0.1", "port": 0})
+    app = (
+        build_static_app(serve, model_kwargs, args.batch, args.long, args.tpu)
+        if mode == "static"
+        else build_engine_app(serve, model_kwargs, args.batch)
+    )
+    serve.run(app, name=f"bench_{mode}", route_prefix=f"/{mode}",
+              timeout_s=2400)
+    base = f"http://127.0.0.1:{serve.http_port()}/{mode}"
 
-    latencies = []
-    lock = threading.Lock()
-    t0 = time.perf_counter()
-
-    def client(idxs):
-        for i in idxs:
-            t = time.perf_counter()
-            out = handle.generate.remote(prompts[i]).result(timeout_s=600)
-            dt = time.perf_counter() - t
-            assert len(out) == NEW_TOKENS
-            with lock:
-                latencies.append(dt)
-
-    threads = [
-        threading.Thread(target=client,
-                         args=(range(c, args.requests, args.clients),),
-                         daemon=True)
-        for c in range(args.clients)
+    rng = np.random.default_rng(args.seed)
+    prompts = rng.integers(
+        1, model_kwargs["vocab_size"], (args.requests, args.prompt_len)
+    ).tolist()
+    kinds = rng.random(args.requests) < args.p_long
+    reqs = [
+        {
+            "prompt": prompts[i],
+            "max_new_tokens": args.long if kinds[i] else args.short,
+        }
+        for i in range(args.requests)
     ]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    wall = time.perf_counter() - t0
 
-    lat = np.sort(np.asarray(latencies))
-    total_tokens = args.requests * NEW_TOKENS
-    print(json.dumps({
-        "metric": "serve_gpt2_large_decode_tokens_per_s",
-        "value": round(total_tokens / wall, 1),
-        "unit": "tokens/s",
-        "extra": {
-            "requests": args.requests,
-            "batch": B,
-            "prompt_len": PROMPT_LEN,
-            "new_tokens": NEW_TOKENS,
-            "p50_s": round(float(lat[len(lat) // 2]), 3),
-            "p99_s": round(float(lat[min(len(lat) - 1, int(len(lat) * 0.99))]), 3),
-            "wall_s": round(wall, 1),
-            "requests_per_s": round(args.requests / wall, 2),
+    # Warm every shape bucket the run will hit (XLA compiles) with a burst
+    # at full batch width, mixed lengths, OUTSIDE the timed window.
+    warm = [
+        {"prompt": prompts[0], "max_new_tokens": args.long if i % 2 else args.short}
+        for i in range(args.batch)
+    ]
+    run_load(base, warm, rate=1000.0, seed=0)
+
+    lats, wall = run_load(base, reqs, args.rate, args.seed + 1)
+    useful = sum(r["max_new_tokens"] for r in reqs)
+    short_l = [l for l, k in zip(lats, kinds) if not k]
+    long_l = [l for l, k in zip(lats, kinds) if k]
+    out = {
+        "mode": mode,
+        "requests": args.requests,
+        "wall_s": round(wall, 2),
+        "useful_tokens_per_s": round(useful / wall, 1),
+        "device_tokens_per_s": round(
+            (args.requests * args.long if mode == "static" else useful) / wall, 1
+        ),
+        "short": {
+            "n": len(short_l),
+            "new_tokens": args.short,
+            "p50_s": percentile(short_l, 0.50),
+            "p99_s": percentile(short_l, 0.99),
         },
-    }), flush=True)
-    serve.delete("gptbench")
+        "long": {
+            "n": len(long_l),
+            "new_tokens": args.long,
+            "p50_s": percentile(long_l, 0.50),
+            "p99_s": percentile(long_l, 0.99),
+        },
+    }
+    if mode == "engine":
+        h = serve.get_app_handle("bench_engine")
+        out["engine_stats"] = h.engine_stats.remote().result(timeout_s=30)
+    serve.delete(f"bench_{mode}")
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", choices=["static", "engine", "both"],
+                    default="both")
+    ap.add_argument("--model", choices=["tiny", "gpt2-large"], default="tiny")
+    ap.add_argument("--tpu", action="store_true",
+                    help="TPU replica (flash attention, num_tpus=1)")
+    ap.add_argument("--requests", type=int, default=48)
+    ap.add_argument("--rate", type=float, default=4.0,
+                    help="Poisson arrival rate, req/s")
+    ap.add_argument("--batch", type=int, default=8,
+                    help="static batch size / engine max_num_seqs")
+    ap.add_argument("--prompt-len", type=int, default=16)
+    ap.add_argument("--short", type=int, default=4)
+    ap.add_argument("--long", type=int, default=48)
+    ap.add_argument("--p-long", type=float, default=0.3)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=None,
+                    help="write the comparison JSON here as well")
+    args = ap.parse_args()
+
+    if args.model == "tiny":
+        model_kwargs = dict(TINY)
+        if not args.tpu:
+            os.environ.setdefault("JAX_PLATFORMS", "cpu")
+            model_kwargs["dtype"] = "float32"
+    else:
+        model_kwargs = dict(
+            vocab_size=50304, n_layers=36, d_model=1280, n_heads=20,
+            d_mlp=5120, max_seq=args.prompt_len + args.long,
+            attn_impl="flash" if args.tpu else "ref", remat=False,
+        )
+
+    import ray_tpu
+
+    ray_tpu.init()
+    modes = ["static", "engine"] if args.mode == "both" else [args.mode]
+    results = {}
+    for mode in modes:
+        results[mode] = bench_mode(mode, args, model_kwargs)
+        print(json.dumps(results[mode]), flush=True)
+
+    report = {
+        "metric": "serve_continuous_load_engine_vs_static",
+        "config": {
+            "model": args.model,
+            "rate_req_s": args.rate,
+            "prompt_len": args.prompt_len,
+            "short": args.short,
+            "long": args.long,
+            "p_long": args.p_long,
+            "batch": args.batch,
+            "platform": "tpu" if args.tpu else "cpu",
+        },
+        "results": results,
+    }
+    if "static" in results and "engine" in results:
+        report["comparison"] = {
+            "useful_tokens_per_s_ratio": round(
+                results["engine"]["useful_tokens_per_s"]
+                / results["static"]["useful_tokens_per_s"],
+                2,
+            ),
+        }
+        sp = results["static"]["short"]["p99_s"]
+        ep = results["engine"]["short"]["p99_s"]
+        if sp and ep:
+            report["comparison"]["short_p99_ratio"] = round(ep / sp, 3)
+    print(json.dumps(report), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+    from ray_tpu import serve
+
+    serve.shutdown()
     ray_tpu.shutdown()
 
 

@@ -188,3 +188,23 @@ def test_serve_grpc_streaming(cluster_runtime):
         assert [c.payload.decode() for c in chunks] == ["a", "b", "c"]
     finally:
         serve.shutdown()
+
+
+# ----------------------------------------------------- control-plane codec
+def test_codec_rejects_sets_at_sender():
+    """The closed grammar has no set type: coercing set/frozenset to list on
+    the wire silently changed types on the receiver (pickle preserved them).
+    Like every other non-grammar value, they must fail AT THE SENDER."""
+    from ray_tpu.core.rpc import _packb, _unpackb
+
+    for bad in [{1, 2, 3}, frozenset({"a"})]:
+        with pytest.raises(TypeError, match="closed .?grammar has no set"):
+            _packb({"v": bad})
+
+    # The harmless stand-ins still normalize, and tuples round-trip as
+    # tuples (list/tuple shape fidelity matters to handlers).
+    msg = {"t": (1, 2), "l": [3, 4], "b": bytearray(b"x"), "n": 7}
+    out = _unpackb(_packb(msg))
+    assert out["t"] == (1, 2) and isinstance(out["t"], tuple)
+    assert out["l"] == [3, 4] and isinstance(out["l"], list)
+    assert out["b"] == b"x" and out["n"] == 7

@@ -198,6 +198,26 @@ class TestLifecycle:
         assert "life" not in serve.status()["applications"]
 
 
+class TestControllerState:
+    def test_drain_prunes_miss_counts(self):
+        """Replicas removed via _drain (redeploy/scale-down/app delete) must
+        drop their miss_counts entries — they leaked one per replica
+        generation, and a later replica reusing the tag inherited the stale
+        misses (ADVICE r5 #3). Pure unit: _drain only touches `state`."""
+        from ray_tpu.serve.controller import ServeController, _DeploymentState
+
+        state = _DeploymentState(
+            {"opts": {"num_replicas": 2}, "cls": b"", "init_args": b""}
+        )
+        state.replicas = [object(), object()]
+        state.replica_tags = ["app#d#0", "app#d#1"]
+        state.starting = [(object(), "app#d#2", 0.0)]
+        state.miss_counts = {"app#d#0": 2, "app#d#1": 1, "app#d#2": 1}
+        ServeController._drain(None, state, 3)
+        assert state.replicas == [] and state.starting == []
+        assert state.miss_counts == {}, "drained tags leaked miss counters"
+
+
 class TestSlowStartup:
     def test_slow_init_replica_not_replaced_or_leaked(self, serve_instance, tmp_path):
         """A replica busy in __init__ (model load + jit compile in real LLM

@@ -124,3 +124,49 @@ def test_joblib_backend(cluster_runtime):
     with joblib.parallel_backend("ray_tpu", n_jobs=4):
         out = joblib.Parallel()(joblib.delayed(lambda x: x**2)(i) for i in range(8))
     assert out == [i**2 for i in range(8)]
+
+
+# ---------------------------------------------------- system metrics latch
+def test_tpu_duty_cycle_cooldown_not_permanent(monkeypatch):
+    """A slow/failed TPU stats sample must pause sampling for a cooldown and
+    then RETRY — the r5 permanent latch killed the metric for the process
+    lifetime on one transient hiccup (ADVICE r5 #2)."""
+    import time as _time
+
+    from ray_tpu.util import system_metrics as sm
+
+    monkeypatch.setattr(sm, "_tpu_bad_streak", 0)
+    monkeypatch.setattr(sm, "_tpu_retry_at", 0.0)
+
+    sm._tpu_sample_failed()
+    first_cooldown = sm._tpu_retry_at - _time.monotonic()
+    assert 0 < first_cooldown <= sm._TPU_COOLDOWN_S + 1
+    # In cooldown: short-circuits to 0.0 without touching jax.
+    assert sm.tpu_duty_cycle() == 0.0
+
+    # Consecutive failures back off exponentially, capped.
+    sm._tpu_sample_failed()
+    second_cooldown = sm._tpu_retry_at - _time.monotonic()
+    assert second_cooldown > first_cooldown
+    for _ in range(10):
+        sm._tpu_sample_failed()
+    assert sm._tpu_retry_at - _time.monotonic() <= sm._TPU_COOLDOWN_MAX_S + 1
+
+    # After the cooldown expires the sampler RETRIES (the regression): a
+    # failing stats path increments the streak again instead of staying off.
+    import jax
+
+    jax.devices()  # ensure a backend exists so the probe reaches devices()
+    monkeypatch.setattr(sm, "_tpu_retry_at", 0.0)
+    streak_before = sm._tpu_bad_streak
+
+    def boom():
+        raise RuntimeError("transient stats failure")
+
+    monkeypatch.setattr(jax, "devices", boom)
+    assert sm.tpu_duty_cycle() == 0.0
+    assert sm._tpu_bad_streak == streak_before + 1, "sampler did not retry"
+
+    # And a healthy (fast, non-TPU) sample resets nothing harmful: with the
+    # real devices() on CPU the probe reports 0.0 without re-latching.
+    monkeypatch.undo()
