@@ -51,6 +51,17 @@ def _global_runtime() -> Runtime:
     return _runtime
 
 
+def _runtime_or_attach() -> Optional[Runtime]:
+    """Runtime if this process has one (or a pending worker factory, which
+    is forced — the same cost any API call pays). Never BOOTS a runtime
+    from a plain script: observability helpers (metrics, tracing) use this
+    so an un-inited process stays un-inited."""
+    rt = _runtime_if_initialized()
+    if rt is None and is_initialized():
+        rt = _global_runtime()
+    return rt
+
+
 def _runtime_if_initialized() -> Optional[Runtime]:
     """Lock-free, non-initializing peek at the runtime. The ONLY safe
     accessor from __del__/GC paths: a destructor can fire on ANY thread —
@@ -260,14 +271,26 @@ def available_resources() -> dict:
     return _global_runtime().backend.available_resources()
 
 
-def timeline(filename: Optional[str] = None):
-    """Export task events as chrome://tracing JSON (reference: `ray.timeline`)."""
+def timeline(filename: Optional[str] = None, *, raw: bool = False):
+    """Task events for the live session (reference: `ray.timeline`).
+
+    Returns the raw controller timeline events. With ``filename``, writes
+    chrome://tracing / Perfetto-loadable JSON (spans + causality flow
+    arrows via `util.tracing.chrome_trace_with_flows`); pass ``raw=True``
+    to dump the raw event dicts instead.
+    """
     events = _global_runtime().backend.state_summary().get("timeline", [])
     if filename:
         import json
 
+        if raw:
+            data = events
+        else:
+            from ..util.tracing import chrome_trace_with_flows
+
+            data = chrome_trace_with_flows(events)
         with open(filename, "w") as f:
-            json.dump(events, f)
+            json.dump(data, f)
     return events
 
 

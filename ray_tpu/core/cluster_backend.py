@@ -612,11 +612,28 @@ class ClusterBackend(RuntimeBackend):
         self._send_nowait({"type": "stream_release", "task": task_hex, "from_index": from_index})
 
     # ------------------------------------------------------------- metrics
-    def record_metric(self, name: str, kind: str, value: float, tags: dict) -> None:
+    def record_metric(self, name: str, kind: str, value: float, tags: dict,
+                      **extra) -> None:
+        # `extra` carries family metadata (help) and histogram bucket deltas
+        # (boundaries/buckets/sum/count) — see util/metrics.py.
         self._send(
             {"type": "record_metric", "name": name, "kind": kind,
-             "value": value, "tags": tags}
+             "value": value, "tags": tags, **extra}
         )
+
+    def prune_metrics(self, tags: dict) -> None:
+        """Drop exported series whose tags include all of `tags`."""
+        self._send({"type": "prune_metrics", "tags": tags})
+
+    def record_trace_event(self, ev) -> None:
+        """Ship tracing span/timeline events (one dict or a batch list —
+        util/tracing.record_span / record_events); rides the same controller
+        channel as worker task_events batches."""
+        events = ev if isinstance(ev, list) else [ev]
+        if self.worker is not None:
+            for e in events:
+                e.setdefault("worker", self.worker.worker_id)
+        self._send({"type": "task_events", "events": events})
 
     # --------------------------------------------------------- log tailing
     def start_log_tailer(self):

@@ -58,6 +58,37 @@ BUSY = "busy"
 STARTING = "starting"
 ACTOR = "actor"
 DEAD = "dead"
+
+# ---------------------------------------------- prometheus exposition utils
+# Hoisted to module level: compiled ONCE, not re-imported/recompiled on
+# every /metrics scrape.
+import re as _re  # noqa: E402
+
+_METRIC_NAME_RE = _re.compile(r"[^a-zA-Z0-9_:]")
+_LABEL_KEY_RE = _re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _san_name(name: str) -> str:
+    return _METRIC_NAME_RE.sub("_", name)
+
+
+def _esc_label(v) -> str:  # prometheus exposition label-value escaping
+    return str(v).replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+
+
+def _esc_help(v) -> str:  # HELP lines escape backslash + newline only
+    return str(v).replace("\\", r"\\").replace("\n", r"\n")
+
+
+def _format_tags(tags) -> str:
+    return ",".join(
+        f'{_LABEL_KEY_RE.sub("_", k)}="{_esc_label(v)}"' for k, v in tags
+    )
+
+
+def _format_le(b: float) -> str:
+    # 0.25 -> "0.25", 1.0 -> "1.0" (float repr is stable and scrape-safe)
+    return repr(float(b))
 # Leased to a submitter for the direct task path (reference: worker leases,
 # `direct_task_transport.cc:135` — steady-state submissions bypass the
 # scheduler entirely; the controller only grants/returns the lease).
@@ -339,8 +370,16 @@ class Controller:
         self._gc_candidates: Set[str] = set()
         # Reverse index: conn_id -> hex ids it holds (O(refs) disconnects).
         self._conn_refs: Dict[int, Set[str]] = {}
-        # (name, tags) -> (value, kind) — user metrics for /metrics.
-        self.user_metrics: Dict[Tuple[str, tuple], Tuple[float, str]] = {}
+        # (name, tags) -> (value, kind, last_update_ts) — user scalar metrics
+        # for /metrics; (name, tags) -> dict for histogram families. Series
+        # idle past _metric_staleness_s are dropped at scrape time (gauges
+        # from dead replicas/workers must not persist forever).
+        self.user_metrics: Dict[Tuple[str, tuple], Tuple[float, str, float]] = {}
+        self.user_hists: Dict[Tuple[str, tuple], dict] = {}
+        self.user_metric_help: Dict[str, str] = {}
+        self._metric_staleness_s = float(
+            os.environ.get("RAY_TPU_METRIC_STALENESS_S", 900.0)
+        )
         self.metrics_port = 0
         self._metrics_server: Optional[asyncio.base_events.Server] = None
 
@@ -1752,6 +1791,7 @@ class Controller:
         self._event(
             "task_submitted", task=spec.task_id.hex(), name=spec.name,
             parent=spec.parent_task_id.hex() if spec.parent_task_id else None,
+            trace=spec.trace_id or None,
         )
         self._enqueue(pt)
         self._schedule()
@@ -4210,18 +4250,62 @@ class Controller:
     # -------------------------------------------------- prometheus metrics
     async def h_record_metric(self, conn, meta, msg):
         """User metrics (reference: `ray.util.metrics` Counter/Gauge/Histogram
-        → `metrics_agent.py` Prometheus re-export)."""
+        → `metrics_agent.py` Prometheus re-export). Histograms arrive as
+        client-bucketed deltas (boundaries/buckets/sum/count) and aggregate
+        here into real exposition families."""
         name, kind, value = msg["name"], msg["kind"], float(msg["value"])
         tags = tuple(sorted((msg.get("tags") or {}).items()))
         key = (name, tags)
-        if kind == "counter":
-            cur, _ = self.user_metrics.get(key, (0.0, None))
-            self.user_metrics[key] = (cur + value, kind)
-        else:  # gauge (histograms export observed value gauges + counts)
-            self.user_metrics[key] = (value, kind)
+        now = time.time()
+        if msg.get("help"):
+            self.user_metric_help.setdefault(name, str(msg["help"]))
+        if kind == "histogram":
+            boundaries = tuple(float(b) for b in msg.get("boundaries") or ())
+            deltas = list(msg.get("buckets") or [])
+            if len(deltas) != len(boundaries) + 1:
+                return None  # malformed shipment; never poison the family
+            h = self.user_hists.get(key)
+            if h is None or h["boundaries"] != boundaries:
+                # New series (or a reconfigured client changed boundaries —
+                # restart the series rather than merging incompatible grids).
+                h = self.user_hists[key] = {
+                    "boundaries": boundaries,
+                    "buckets": [0] * (len(boundaries) + 1),
+                    "sum": 0.0, "count": 0,
+                }
+            h["buckets"] = [a + int(b) for a, b in zip(h["buckets"], deltas)]
+            h["sum"] += float(msg.get("sum") or 0.0)
+            h["count"] += int(msg.get("count") or 0)
+            h["ts"] = now
+        elif kind == "counter":
+            cur = self.user_metrics.get(key, (0.0, None, 0.0))[0]
+            self.user_metrics[key] = (cur + value, kind, now)
+        else:  # gauge
+            self.user_metrics[key] = (value, kind, now)
         return None
 
+    async def h_prune_metrics(self, conn, meta, msg):
+        """Drop user-metric series whose tags include all of msg['tags'] —
+        called when a Serve replica drains so its gauges/histograms leave
+        /metrics immediately instead of waiting out the staleness window."""
+        match = {str(k): str(v) for k, v in (msg.get("tags") or {}).items()}
+        if not match:
+            return None
+        for d in (self.user_metrics, self.user_hists):
+            for key in [k for k in d if match.items() <= dict(k[1]).items()]:
+                del d[key]
+        return None
+
+    def _prune_stale_metrics(self, now: float):
+        cut = now - self._metric_staleness_s
+        for key in [k for k, v in self.user_metrics.items() if v[2] < cut]:
+            del self.user_metrics[key]
+        for key in [k for k, v in self.user_hists.items() if v.get("ts", now) < cut]:
+            del self.user_hists[key]
+
     def _prometheus_text(self) -> str:
+        now = time.time()
+        self._prune_stale_metrics(now)
         lines = [
             "# TYPE ray_tpu_tasks_pending gauge",
             f"ray_tpu_tasks_pending {len(self.ready_queue) + len(self.waiting_tasks)}",
@@ -4238,29 +4322,74 @@ class Controller:
             "# TYPE ray_tpu_actors gauge",
             f"ray_tpu_actors {sum(1 for a in self.actors.values() if a.state == 'alive')}",
         ]
-        def esc(v) -> str:  # prometheus exposition label escaping
-            return str(v).replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
-
-        import re
-
+        node_families: Dict[str, List[str]] = {}
         for n in self.nodes.values():
             if not n.alive:
                 continue
             for k, v in n.available.items():
-                lines.append(
-                    f'ray_tpu_node_resource_available{{node="{esc(n.node_id)}",'
-                    f'resource="{esc(k)}"}} {v}'
+                node_families.setdefault("ray_tpu_node_resource_available", []).append(
+                    f'ray_tpu_node_resource_available{{node="{_esc_label(n.node_id)}",'
+                    f'resource="{_esc_label(k)}"}} {v}'
                 )
             for k, v in n.sys_metrics.items():
                 if k == "ts":
                     continue
-                lines.append(
-                    f'ray_tpu_node_{k}{{node="{esc(n.node_id)}"}} {v}'
+                fam = _san_name(f"ray_tpu_node_{k}")
+                node_families.setdefault(fam, []).append(
+                    f'{fam}{{node="{_esc_label(n.node_id)}"}} {v}'
                 )
-        for (name, tags), (value, kind) in self.user_metrics.items():
-            name = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
-            tag_s = ",".join(f'{re.sub(r"[^a-zA-Z0-9_]", "_", k)}="{esc(v)}"' for k, v in tags)
-            lines.append(f"{name}{{{tag_s}}} {value}" if tag_s else f"{name} {value}")
+        for fam, series in node_families.items():
+            lines.append(f"# TYPE {fam} gauge")
+            lines.extend(series)
+
+        # User scalars, grouped into families so every series sits under one
+        # # HELP/# TYPE header (scrapers misclassify bare counters otherwise).
+        scalar_fams: Dict[str, List[Tuple[tuple, float]]] = {}
+        fam_kind: Dict[str, str] = {}
+        fam_raw: Dict[str, str] = {}
+        for (name, tags), (value, kind, _ts) in self.user_metrics.items():
+            fam = _san_name(name)
+            scalar_fams.setdefault(fam, []).append((tags, value))
+            fam_kind.setdefault(fam, kind)
+            fam_raw.setdefault(fam, name)
+        for fam, series in scalar_fams.items():
+            help_text = self.user_metric_help.get(fam_raw[fam])
+            if help_text:
+                lines.append(f"# HELP {fam} {_esc_help(help_text)}")
+            lines.append(f"# TYPE {fam} {fam_kind[fam] or 'gauge'}")
+            for tags, value in series:
+                tag_s = _format_tags(tags)
+                lines.append(f"{fam}{{{tag_s}}} {value}" if tag_s else f"{fam} {value}")
+
+        # Histograms: cumulative _bucket{le=...} + _sum + _count per series.
+        hist_fams: Dict[str, List[Tuple[tuple, dict]]] = {}
+        hist_raw: Dict[str, str] = {}
+        for (name, tags), h in self.user_hists.items():
+            fam = _san_name(name)
+            hist_fams.setdefault(fam, []).append((tags, h))
+            hist_raw.setdefault(fam, name)
+        for fam, series in hist_fams.items():
+            help_text = self.user_metric_help.get(hist_raw[fam])
+            if help_text:
+                lines.append(f"# HELP {fam} {_esc_help(help_text)}")
+            lines.append(f"# TYPE {fam} histogram")
+            for tags, h in series:
+                tag_s = _format_tags(tags)
+                cum = 0
+                for b, cnt in zip(h["boundaries"], h["buckets"]):
+                    cum += cnt
+                    le = _format_le(b)
+                    sep = "," if tag_s else ""
+                    lines.append(f'{fam}_bucket{{{tag_s}{sep}le="{le}"}} {cum}')
+                sep = "," if tag_s else ""
+                lines.append(f'{fam}_bucket{{{tag_s}{sep}le="+Inf"}} {h["count"]}')
+                lines.append(
+                    f"{fam}_sum{{{tag_s}}} {h['sum']}" if tag_s else f"{fam}_sum {h['sum']}"
+                )
+                lines.append(
+                    f"{fam}_count{{{tag_s}}} {h['count']}" if tag_s
+                    else f"{fam}_count {h['count']}"
+                )
         return "\n".join(lines) + "\n"
 
     async def _on_metrics_connection(self, reader, writer):

@@ -51,6 +51,7 @@ def _compact_actor_spec(spec: TaskSpec):
         [oid.binary() for oid in spec.arg_refs],
         spec.sequence_number,
         spec.parent_task_id.binary() if spec.parent_task_id else b"",
+        spec.trace_id,
     )
 
 

@@ -85,6 +85,11 @@ class TaskContext(threading.local):
     def __init__(self):
         self.task_id: Optional[TaskID] = None
         self.actor_id: Optional[ActorID] = None
+        # Dapper-style trace id this thread's submissions inherit (set by the
+        # executing worker from spec.trace_id, or explicitly via
+        # `util.tracing.set_trace_id` at a request entry point like the
+        # Serve HTTP proxy).
+        self.trace_id: Optional[str] = None
 
 
 class Runtime:
@@ -104,6 +109,10 @@ class Runtime:
     @property
     def current_task_id(self) -> TaskID:
         return self._context.task_id or self.driver_task_id
+
+    @property
+    def current_trace_id(self) -> str:
+        return getattr(self._context, "trace_id", None) or ""
 
     def set_task_context(self, task_id: Optional[TaskID], actor_id: Optional[ActorID] = None):
         self._context.task_id = task_id
@@ -205,6 +214,7 @@ class Runtime:
             job_id=self.job_id,
             task_type=TaskType.NORMAL_TASK,
             parent_task_id=self.current_task_id,
+            trace_id=self.current_trace_id,
             func_payload=payload,
             arg_refs=[r.id for r in arg_refs],
             num_returns=num_returns,
@@ -261,6 +271,7 @@ class Runtime:
             job_id=self.job_id,
             task_type=TaskType.ACTOR_CREATION_TASK,
             parent_task_id=self.current_task_id,
+            trace_id=self.current_trace_id,
             func_payload=payload,
             arg_refs=[r.id for r in arg_refs],
             num_returns=0,
@@ -301,6 +312,7 @@ class Runtime:
             job_id=self.job_id,
             task_type=TaskType.ACTOR_TASK,
             parent_task_id=self.current_task_id,
+            trace_id=self.current_trace_id,
             func_payload=payload,
             arg_refs=[r.id for r in arg_refs],
             num_returns=num_returns,

@@ -127,6 +127,10 @@ class TaskSpec:
     # reference analog: `parent_task_id` in common.proto's TaskSpec; drives
     # the tracing span tree (`ray_tpu/util/tracing.py`).
     parent_task_id: Optional[TaskID] = None
+    # Dapper-style trace id inherited from the submitting context (empty =
+    # this task roots its own trace); `util/tracing.py` keys span forests
+    # and the Serve request path by it.
+    trace_id: str = ""
 
 
 # ------------------------------------------------------ typed wire contract
@@ -245,6 +249,8 @@ def spec_to_proto_bytes(spec: TaskSpec) -> bytes:
     msg.depth = spec.depth
     if spec.parent_task_id is not None:
         msg.parent_task_id = spec.parent_task_id.binary()
+    if spec.trace_id:
+        msg.trace_id = spec.trace_id
     return msg.SerializeToString()
 
 
@@ -297,4 +303,5 @@ def spec_from_proto_bytes(data: bytes) -> TaskSpec:
         owner_address=msg.owner_address,
         depth=msg.depth,
         parent_task_id=TaskID(msg.parent_task_id) if msg.parent_task_id else None,
+        trace_id=msg.trace_id,
     )

@@ -42,7 +42,7 @@ def _spec_from_compact(c) -> TaskSpec:
     from .ids import ActorID, JobID, ObjectID, TaskID
     from .task_spec import TaskType
 
-    task_bytes, actor_bytes, method, payload, nret, arg_ref_bytes, seq, parent = c
+    task_bytes, actor_bytes, method, payload, nret, arg_ref_bytes, seq, parent, trace = c
     task_id = TaskID(task_bytes)
     return TaskSpec(
         task_id=task_id,
@@ -62,6 +62,7 @@ def _spec_from_compact(c) -> TaskSpec:
         method_name=method,
         sequence_number=seq,
         parent_task_id=TaskID(parent) if parent else None,
+        trace_id=trace,
     )
 
 
@@ -117,11 +118,25 @@ class WorkerProcess:
         self._ctx_local = TaskContext()
         self._start_orphan_watchdog()
 
-    def _set_ctx(self, task_id, actor_id=None):
+    def _set_ctx(self, task_id, actor_id=None, trace_id=None):
         """Record the current task/actor context (shared with the lazy API
-        runtime by construction — see _init_client_api)."""
+        runtime by construction — see _init_client_api). `trace_id` is the
+        Dapper-style trace this thread's nested submissions inherit."""
         self._ctx_local.task_id = task_id
         self._ctx_local.actor_id = actor_id
+        self._ctx_local.trace_id = trace_id
+
+    @staticmethod
+    def _trace_of(spec: TaskSpec) -> str:
+        """Effective trace id: inherited from the submitter, else this task
+        roots its own trace."""
+        return spec.trace_id or spec.task_id.hex()
+
+    def _record_event(self, ev: dict):
+        """Thread-safe append to the batched task_events channel (actor-pool
+        threads record phases too; the flush swap runs under _reply_lock)."""
+        with self._reply_lock:
+            self._task_events.append(ev)
 
     def _start_orphan_watchdog(self):
         """A STATELESS worker whose controller died must not linger: normally
@@ -514,6 +529,21 @@ class WorkerProcess:
 
         return restore
 
+    def _flush_phases(self, spec: TaskSpec, phases):
+        """Ship per-task phase spans (dep-fetch/deserialize/execute/store)
+        through the batched task_events channel — the controller timeline
+        nests them under the task via util/tracing."""
+        if not phases:
+            return
+        task_hex = spec.task_id.hex()
+        trace = self._trace_of(spec)
+        for name, t0, t1 in phases:
+            self._record_event(
+                {"ts": t0, "event": "task_phase", "phase": name,
+                 "task": task_hex, "dur": max(t1 - t0, 0.0),
+                 "trace": trace, "worker": self.worker_id}
+            )
+
     def _execute(
         self,
         spec: TaskSpec,
@@ -525,15 +555,20 @@ class WorkerProcess:
 
         results: List[dict] = []
         restore_once = None
+        phases: List[tuple] = []  # (name, start, end) wall-clock
         try:
+            t0 = time.time()
             resolved = self._resolve(spec, deps)
+            t1 = time.time()
+            phases.append(("dep_fetch", t0, t1))
             func, args, kwargs = resolve_payload(spec.func_payload, resolved)
+            phases.append(("deserialize", t1, time.time()))
             if is_actor_method:
                 func = getattr(self.actor_instance, spec.method_name)
             # Env setup BEFORE context: if it raises (RuntimeEnvSetupError),
             # no task context was set, so nothing leaks onto later work.
             restore_env = self._runtime_env_vars(spec)
-            self._set_ctx(spec.task_id, spec.actor_id)
+            self._set_ctx(spec.task_id, spec.actor_id, self._trace_of(spec))
             streaming = spec.num_returns == -1
             _restored = [False]
 
@@ -543,6 +578,7 @@ class WorkerProcess:
                     restore_env()
                     self._set_ctx(None)
 
+            t_exec = time.time()
             try:
                 result = func(*args, **kwargs)
             finally:
@@ -551,6 +587,7 @@ class WorkerProcess:
                 # iteration below and must still see cwd/sys.path/env_vars.
                 if not streaming:
                     restore_once()
+                    phases.append(("execute", t_exec, time.time()))
             import inspect
 
             if streaming:
@@ -573,12 +610,16 @@ class WorkerProcess:
                     return
                 finally:
                     restore_once()
+                    # Streaming: the generator body runs during iteration —
+                    # the execute phase spans construction through last yield.
+                    phases.append(("execute", t_exec, time.time()))
                 self.send({"type": "task_done", "task": spec.task_id.hex(),
                            "results": [], "stream_count": count})
                 return
             if inspect.isgenerator(result):
                 result = tuple(result) if spec.num_returns > 1 else list(result)
             n = spec.num_returns
+            t_store = time.time()
             if n == 1:
                 results.append(self.store_result(spec.return_ids[0].hex(), result))
             elif n > 1:
@@ -589,6 +630,7 @@ class WorkerProcess:
                     )
                 for oid, v in zip(spec.return_ids, result):
                     results.append(self.store_result(oid.hex(), v))
+            phases.append(("store_result", t_store, time.time()))
         except BaseException as e:  # noqa: BLE001
             if restore_once is not None:
                 restore_once()  # streaming path may still hold env + context
@@ -600,6 +642,8 @@ class WorkerProcess:
             results = [
                 self.store_result(oid.hex(), err) for oid in spec.return_ids
             ]
+        finally:
+            self._flush_phases(spec, phases)
         if reply is not None:
             reply(results)
         else:
@@ -613,7 +657,7 @@ class WorkerProcess:
         profiling showed dominating per-call cost."""
         import inspect
 
-        self._set_ctx(spec.task_id, spec.actor_id)
+        self._set_ctx(spec.task_id, spec.actor_id, self._trace_of(spec))
         try:
             _, args, kwargs = cloudpickle.loads(spec.func_payload)
             result = getattr(self.actor_instance, spec.method_name)(*args, **kwargs)
@@ -633,7 +677,7 @@ class WorkerProcess:
         try:
             resolved = self._resolve(spec, deps)
             cls, args, kwargs = resolve_payload(spec.func_payload, resolved)
-            self._set_ctx(spec.task_id, spec.actor_id)
+            self._set_ctx(spec.task_id, spec.actor_id, self._trace_of(spec))
             # Actor env vars persist for the actor's lifetime (its process
             # is dedicated) — reference behavior for actor runtime_env.
             self._runtime_env_vars(spec)
@@ -767,7 +811,8 @@ class WorkerProcess:
                 {"ts": now, "event": "task_submitted", "task": task_hex,
                  "name": spec.name,
                  "parent": spec.parent_task_id.hex()
-                 if spec.parent_task_id else None}
+                 if spec.parent_task_id else None,
+                 "trace": spec.trace_id or None}
             )
             self._task_events.append(
                 {"ts": now, "event": "task_dispatched", "task": task_hex,

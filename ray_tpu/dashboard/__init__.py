@@ -18,6 +18,8 @@ Endpoints:
     GET /api/jobs          submitted jobs
     GET /api/pgs           placement groups
     GET /api/events        recent timeline events (?limit=N)
+    GET /api/traces        recent request traces (summary rows, ?limit=N)
+    GET /api/traces?trace_id=ID  one trace's full span forest
     GET /api/logs?worker_id=ID   tail of one worker's log
 """
 
@@ -121,6 +123,28 @@ class DashboardServer:
             elif name == "events":
                 limit = max(0, int(query.get("limit", 100)))
                 data = {"events": list(c.timeline[-limit:]) if limit else []}
+            elif name == "traces":
+                from ..util import tracing
+
+                # Same bounded window as state_summary (what the CLI and
+                # api.timeline() see): keeps the two surfaces consistent and
+                # caps the forest assembly this does on the controller's
+                # event loop (the full timeline can hold 100k events).
+                events = list(c.timeline[-10000:])
+                trace_id = query.get("trace_id")
+                if trace_id:
+                    forest = tracing.trace_forest(events)
+                    t = forest.get(trace_id)
+                    if t is None:
+                        return (
+                            "404 Not Found",
+                            "application/json",
+                            json.dumps({"error": f"unknown trace {trace_id}"}).encode(),
+                        )
+                    data = t
+                else:
+                    limit = max(1, int(query.get("limit", 50)))
+                    data = {"traces": tracing.trace_summaries(events, limit)}
             elif name == "logs":
                 wid = query.get("worker_id", "")
                 if not wid:
@@ -190,6 +214,7 @@ _INDEX_HTML = b"""<!doctype html>
 <h2>Workers</h2><div id="workers"></div>
 <h2>Placement groups</h2><div id="pgs"></div>
 <h2>Jobs</h2><div id="jobs"></div>
+<h2>Traces</h2><div id="traces"></div>
 <h2>Recent events</h2><div id="events"></div>
 <script>
 function esc(s) {
@@ -212,9 +237,10 @@ async function refresh() {
       ['nodes_alive','num_workers','pending_tasks','running_tasks','objects']
         .map(k => '<div class="tile"><b>'+esc(k==='nodes_alive'?cl[k]:s[k])+'</b>'+esc(k.replace(/_/g,' '))+'</div>').join('') +
       '<div class="tile"><b>'+esc(JSON.stringify(res.total ?? res))+'</b>resources</div>';
-    const [n,a,t,w,p,jb,e] = await Promise.all([
+    const [n,a,t,w,p,jb,e,tr] = await Promise.all([
       j('/api/nodes'), j('/api/actors'), j('/api/tasks'),
-      j('/api/workers'), j('/api/pgs'), j('/api/jobs'), j('/api/events')]);
+      j('/api/workers'), j('/api/pgs'), j('/api/jobs'), j('/api/events'),
+      j('/api/traces?limit=15')]);
     document.getElementById('nodes').innerHTML =
       table(n.nodes, ['NodeID','Alive','Resources','Available']);
     document.getElementById('actors').innerHTML =
@@ -227,6 +253,8 @@ async function refresh() {
       table(p.placement_groups, ['pg_id','name','strategy','ready','bundle_nodes']);
     document.getElementById('jobs').innerHTML =
       table(jb.jobs, ['job_id','status','entrypoint']);
+    document.getElementById('traces').innerHTML =
+      table(tr.traces, ['trace_id','name','start','duration','n_tasks','n_spans']);
     document.getElementById('events').innerHTML =
       table((e.events||[]).slice().reverse().slice(0,25), ['ts','event','task','node']);
   } catch (err) { console.error(err); }

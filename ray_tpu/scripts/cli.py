@@ -211,13 +211,70 @@ def cmd_workflow(backend, info, args):
 def cmd_timeline(backend, info, args):
     events = backend._request({"type": "state_summary"})["timeline"]
     if args.output:
+        if args.raw:
+            data = events
+        else:
+            from ray_tpu.util.tracing import chrome_trace_with_flows
+
+            data = chrome_trace_with_flows(events)
         with open(args.output, "w") as f:
-            json.dump(events, f)
-        print(f"wrote {len(events)} events to {args.output}")
+            json.dump(data, f)
+        kind = "raw events" if args.raw else "chrome-trace events"
+        print(f"wrote {len(data)} {kind} to {args.output}")
     else:
         for ev in events[-args.tail:]:
             fields = {k: v for k, v in ev.items() if k not in ("ts", "event")}
             print(f"{ev['ts']:.3f} {ev['event']:28s} {fields}")
+
+
+def _print_span_tree(span, t0, depth=0):
+    start = span["submitted_at"]
+    dur = span["duration"]
+    off = f"+{(start - t0) * 1e3:8.1f}ms" if start is not None else " " * 10
+    dur_s = f"{dur * 1e3:8.1f}ms" if dur is not None else "   (open)"
+    print(f"{off} {dur_s}  {'  ' * depth}{span['name'] or span['task_id'][:8]}"
+          f"  [{span['task_id'][:8]}]")
+    for ph in span.get("phases", ()):
+        print(f"{'':10} {ph['dur'] * 1e3:8.1f}ms  {'  ' * (depth + 1)}"
+              f"· {ph['phase']}")
+    for child in span.get("children", ()):
+        _print_span_tree(child, t0, depth + 1)
+
+
+def cmd_trace(backend, info, args):
+    """`trace` — list recent traces; `trace <id>` — one request's span
+    forest; `-o FILE` writes that trace as Perfetto-loadable JSON."""
+    from ray_tpu.util import tracing
+
+    events = backend._request({"type": "state_summary"})["timeline"]
+    if not args.trace_id:
+        rows = tracing.trace_summaries(events, args.limit)
+        for r in rows:
+            r["start"] = f"{r['start']:.3f}" if r["start"] is not None else ""
+            r["duration_ms"] = (
+                f"{r['duration'] * 1e3:.1f}" if r["duration"] is not None else ""
+            )
+        _table(rows, ["trace_id", "name", "start", "duration_ms", "n_tasks", "n_spans"])
+        return
+    forest = tracing.trace_forest(events)
+    t = forest.get(args.trace_id)
+    if t is None:
+        raise SystemExit(f"unknown trace {args.trace_id}")
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(
+                tracing.chrome_trace_with_flows(events, trace_id=args.trace_id), f
+            )
+        print(f"wrote trace {args.trace_id} to {args.output}")
+        return
+    t0 = t["start"] or 0.0
+    dur = f"{t['duration'] * 1e3:.1f}ms" if t["duration"] is not None else "(open)"
+    print(f"trace {t['trace_id']}  start={t0:.3f}  duration={dur}")
+    for ev in sorted(t["spans"], key=lambda e: e["ts"]):
+        print(f"+{(ev['ts'] - t0) * 1e3:8.1f}ms {ev.get('dur', 0) * 1e3:8.1f}ms"
+              f"  {ev.get('name', 'span')}  {ev.get('args') or ''}")
+    for root in t["tasks"]:
+        _print_span_tree(root, t0)
 
 
 def main(argv=None):
@@ -231,8 +288,16 @@ def main(argv=None):
     p_logs = sub.add_parser("logs", help="dump worker logs")
     p_logs.add_argument("worker", nargs="?", default=None, help="worker id (all if omitted)")
     p_tl = sub.add_parser("timeline", help="chrome-trace events")
-    p_tl.add_argument("-o", "--output", default=None)
+    p_tl.add_argument("-o", "--output", default=None,
+                      help="write Perfetto-loadable chrome-trace JSON")
+    p_tl.add_argument("--raw", action="store_true",
+                      help="with -o: dump raw controller events instead")
     p_tl.add_argument("--tail", type=int, default=50)
+    p_tr = sub.add_parser("trace", help="list/inspect per-request traces")
+    p_tr.add_argument("trace_id", nargs="?", default=None)
+    p_tr.add_argument("-o", "--output", default=None,
+                      help="with a trace id: write that trace as chrome-trace JSON")
+    p_tr.add_argument("--limit", type=int, default=25)
     p_job = sub.add_parser("job", help="submit/inspect cluster jobs")
     job_sub = p_job.add_subparsers(dest="job_command", required=True)
     p_sub = job_sub.add_parser("submit")
@@ -272,6 +337,7 @@ def main(argv=None):
             "list": cmd_list,
             "logs": cmd_logs,
             "timeline": cmd_timeline,
+            "trace": cmd_trace,
             "job": cmd_job,
             "serve": cmd_serve,
             "workflow": cmd_workflow,

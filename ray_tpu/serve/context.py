@@ -34,3 +34,14 @@ def get_multiplexed_model_id() -> str:
 
 def _set_multiplexed_model_id(model_id: str):
     _local.multiplexed_model_id = model_id
+
+
+def get_request_id() -> str:
+    """Id of the Serve request being handled on this thread (assigned per
+    HTTP request by the proxy, equal to the request's trace_id — the same
+    id keys `/api/traces` and `ray_tpu trace`). Empty outside a request."""
+    return getattr(_local, "request_id", "")
+
+
+def _set_request_id(request_id: str):
+    _local.request_id = request_id

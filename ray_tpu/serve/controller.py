@@ -391,6 +391,15 @@ class ServeController:
                 ray_tpu.kill(handle)
             except Exception:  # noqa: BLE001
                 pass
+            # A dead replica's metric series (engine gauges/histograms tagged
+            # with its replica id) must leave /metrics now, not linger until
+            # the controller's staleness sweep.
+            try:
+                from ..util.metrics import prune_series
+
+                prune_series({"replica": tag})
+            except Exception:  # noqa: BLE001
+                pass
 
     def ping(self) -> str:
         return "ok"

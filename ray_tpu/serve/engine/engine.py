@@ -148,6 +148,10 @@ class InferenceEngine:
         self._step_ttfts: List[float] = []     # reset each step()
         self._step_tpots: List[float] = []
         self._tok_window: List[float] = []     # token-emit timestamps
+        # request_id -> {trace, submit_t, admit_t, first_t} (wall-clock):
+        # per-request span bookkeeping for traced (Serve) submissions —
+        # untraced submits (engine unit tests, direct callers) skip it.
+        self._trace_info: Dict[str, Dict[str, Any]] = {}
         self._init_metrics()
 
     # ------------------------------------------------------------- metrics
@@ -179,6 +183,21 @@ class InferenceEngine:
             self._m_tpot = Histogram(
                 "serve_engine_tpot_s", "time per output token after the first"
             )
+            try:
+                # Under Serve, tag every series with its replica so scrapes
+                # distinguish replicas and the controller can prune a
+                # drained replica's series (serve/controller._drain).
+                from ..context import get_replica_context
+
+                ctx = get_replica_context()
+                tags = {"app": ctx.app_name, "deployment": ctx.deployment,
+                        "replica": ctx.replica_tag}
+                for m in (self._m_queue, self._m_running, self._m_kv,
+                          self._m_tps, self._m_tokens, self._m_preempt,
+                          self._m_ttft, self._m_tpot):
+                    m.set_default_tags(tags)
+            except Exception:  # noqa: BLE001 — engine used outside Serve
+                pass
         except Exception:  # noqa: BLE001 — metrics are never load-bearing
             self._m_queue = None
 
@@ -227,6 +246,12 @@ class InferenceEngine:
                 f"request needs {len(prompt) + max_new_tokens} KV slots; pool "
                 f"holds {(self.opts.num_blocks - 1) * self.opts.block_size}"
             )
+        try:
+            from ...util.tracing import get_trace_id
+
+            trace_id = get_trace_id()
+        except Exception:  # noqa: BLE001
+            trace_id = None
         with self._work:
             if request_id is None:
                 request_id = f"req-{self._next_id}"
@@ -239,6 +264,10 @@ class InferenceEngine:
             )
             self.scheduler.add(seq)
             self._outputs[request_id] = RequestOutput(request_id)
+            if trace_id:
+                self._trace_info[request_id] = {
+                    "trace": trace_id, "submit_t": time.time(),
+                }
             self._work.notify_all()
         return request_id
 
@@ -306,9 +335,48 @@ class InferenceEngine:
                 tpot = (seq.finish_t - seq.first_token_t) / (n - 1)
                 self._tpots.append(tpot)
                 self._step_tpots.append(tpot)
+        self._emit_request_spans(seq)
         return True
 
+    def _emit_request_spans(self, seq: Sequence):
+        """Ship queue-wait/admission/prefill/first-token/completion spans for
+        a finished traced request (one shipment per request)."""
+        rec = self._trace_info.pop(seq.request_id, None)
+        if rec is None:
+            return
+        try:
+            from ...util.tracing import record_events, span_event
+
+            tid = rec["trace"]
+            now = time.time()
+            submit = rec["submit_t"]
+            admit = rec.get("admit_t", now)
+            first = rec.get("first_t", admit)
+            attrs = {"request_id": seq.request_id,
+                     "tokens": seq.num_generated}
+            # One control-plane message for the whole request — per-span
+            # sends inside step() would stall the decode loop for every
+            # in-flight sequence at high completion rates.
+            record_events([
+                span_event("engine.queue_wait", submit, admit - submit,
+                           trace_id=tid, attrs=attrs),
+                span_event("engine.admission", admit, 0.0, trace_id=tid,
+                           attrs=attrs),
+                span_event("engine.prefill", admit, first - admit,
+                           trace_id=tid, attrs=attrs),
+                span_event("engine.first_token", first, 0.0, trace_id=tid,
+                           attrs=attrs),
+                span_event("engine.completion", first, now - first,
+                           trace_id=tid,
+                           attrs={**attrs, "finish_reason": seq.finish_reason}),
+            ])
+        except Exception:  # noqa: BLE001 — tracing is never load-bearing
+            pass
+
     def _run_prefill(self, seq: Sequence):
+        rec = self._trace_info.get(seq.request_id)
+        if rec is not None and "admit_t" not in rec:
+            rec["admit_t"] = time.time()
         jnp = self._jnp
         np = self._np
         table = self.block_manager.block_table(seq.request_id)
@@ -331,6 +399,8 @@ class InferenceEngine:
         )
         tok = self._sample(np.asarray(logits))
         self._emit(seq, tok)
+        if rec is not None:
+            rec.setdefault("first_t", time.time())
         self._maybe_finish(seq)
 
     def _run_decode(self, out: SchedulerOutput):
@@ -369,6 +439,14 @@ class InferenceEngine:
         with self._lock:
             out = self.scheduler.schedule()
         self.total_preemptions += len(out.preempted)
+        for seq in out.preempted:
+            # Recompute preemption re-queues the request: its admission,
+            # prefill, and first-token spans restart at the next schedule
+            # (keeping first_t would put first_token BEFORE admission).
+            rec = self._trace_info.get(seq.request_id)
+            if rec is not None:
+                rec.pop("admit_t", None)
+                rec.pop("first_t", None)
         for seq in out.prefills:
             self._run_prefill(seq)
         if out.decodes:
@@ -437,6 +515,7 @@ class InferenceEngine:
         with self._lock:
             outs = list(self._outputs.values())
             self._outputs.clear()
+            self._trace_info.clear()
         for out in outs:
             out._q.put(RuntimeError("engine shut down"))
 
@@ -453,6 +532,7 @@ class InferenceEngine:
                 with self._lock:
                     outs = list(self._outputs.values())
                     self._outputs.clear()
+                    self._trace_info.clear()
                     # Drop all scheduler state: without it the loop would
                     # respin on the same poisoned batch forever.
                     for seq in list(self.scheduler.running):

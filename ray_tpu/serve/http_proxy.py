@@ -19,6 +19,7 @@ from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
 
+from ..util import tracing
 from ._common import response_bytes as _as_bytes
 
 
@@ -55,6 +56,32 @@ class HTTPProxy:
                 pass
 
             def _serve(self):
+                # One request id per HTTP request; it IS the trace id every
+                # downstream hop inherits (handle → replica → engine), so
+                # `/api/traces?trace_id=<x-request-id>` shows the whole path.
+                rid = tracing.new_trace_id()
+                self.request_id = rid
+                t0 = time.time()
+                status = 500
+                try:
+                    tracing.set_trace_id(rid)
+                except Exception:  # noqa: BLE001 — runtime still booting
+                    pass
+                try:
+                    status, _ = self._serve_traced()
+                finally:
+                    try:
+                        tracing.record_span(
+                            "proxy.request", t0, time.time() - t0,
+                            trace_id=rid,
+                            attrs={"method": self.command, "path": self.path,
+                                   "status": status, "request_id": rid},
+                        )
+                        tracing.set_trace_id(None)
+                    except Exception:  # noqa: BLE001
+                        pass
+
+            def _serve_traced(self):
                 try:
                     status, payload = proxy._handle(self)
                 except Exception as e:  # noqa: BLE001
@@ -73,12 +100,14 @@ class HTTPProxy:
                         self.send_response(500)
                         self.send_header("Content-Length", str(len(err)))
                         self.send_header("Content-Type", "application/json")
+                        self.send_header("x-request-id", self.request_id)
                         self.end_headers()
                         self.wfile.write(err)
-                        return
+                        return 500, None
                     self.send_response(status)
                     self.send_header("Content-Type", "application/octet-stream")
                     self.send_header("Transfer-Encoding", "chunked")
+                    self.send_header("x-request-id", self.request_id)
                     self.end_headers()
                     try:
                         chunks = (
@@ -100,12 +129,14 @@ class HTTPProxy:
                         # ASGI servers) so the client unblocks; a kept-alive
                         # connection would leave it waiting mid-body forever.
                         self.close_connection = True
-                    return
+                    return status, None
                 self.send_response(status)
                 self.send_header("Content-Length", str(len(payload)))
                 self.send_header("Content-Type", "application/json")
+                self.send_header("x-request-id", self.request_id)
                 self.end_headers()
                 self.wfile.write(payload)
+                return status, None
 
             do_GET = do_POST = do_PUT = do_DELETE = _serve
 

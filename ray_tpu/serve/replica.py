@@ -8,14 +8,17 @@ Batched methods receive the router-formed list in one call.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
 
+from ..util import tracing
 from .context import (
     ReplicaContext,
     _set_multiplexed_model_id,
     _set_replica_context,
+    _set_request_id,
 )
 
 
@@ -50,6 +53,27 @@ class Replica:
         if fn is not None:
             fn(user_config)
 
+    def _enter_request(self) -> str:
+        """Adopt the request/trace id the executing worker inherited from
+        the submitting context (the HTTP proxy or a Python caller)."""
+        rid = tracing.get_trace_id() or ""
+        _set_request_id(rid)
+        return rid
+
+    def _record_span(self, name: str, rid: str, method: str, t0: float):
+        if not rid:
+            return  # untraced call (no request context) — keep timeline lean
+        try:
+            tracing.record_span(
+                name, t0, time.time() - t0, trace_id=rid,
+                attrs={"app": self._ctx.app_name,
+                       "deployment": self._ctx.deployment,
+                       "replica": self._ctx.replica_tag,
+                       "method": method, "request_id": rid},
+            )
+        except Exception:  # noqa: BLE001 — tracing is never load-bearing
+            pass
+
     def handle_request(
         self,
         method: str,
@@ -59,10 +83,15 @@ class Replica:
     ) -> Any:
         _set_replica_context(self._ctx)
         _set_multiplexed_model_id(multiplexed_model_id)
+        rid = self._enter_request()
         self._num_processed += 1
-        if self._is_function:
-            return self._callable(*args, **kwargs)
-        return getattr(self._callable, method)(*args, **kwargs)
+        t0 = time.time()
+        try:
+            if self._is_function:
+                return self._callable(*args, **kwargs)
+            return getattr(self._callable, method)(*args, **kwargs)
+        finally:
+            self._record_span("replica.handle", rid, method, t0)
 
     def handle_request_streaming(
         self,
@@ -76,8 +105,10 @@ class Replica:
         `handle.options(stream=True)`). Runs as a streaming actor task."""
         _set_replica_context(self._ctx)
         _set_multiplexed_model_id(multiplexed_model_id)
+        rid = self._enter_request()
         self._num_processed += 1
         fn = self._callable if self._is_function else getattr(self._callable, method)
+        t0 = time.time()
         out = fn(*args, **kwargs)
         import inspect
 
@@ -85,7 +116,11 @@ class Replica:
             raise TypeError(
                 f"stream=True requires {method} to be a generator function"
             )
-        yield from out
+        try:
+            yield from out
+        finally:
+            # Span covers the full drain — the generator body runs lazily.
+            self._record_span("replica.handle_stream", rid, method, t0)
 
     def handle_batch(
         self,

@@ -1,11 +1,46 @@
 """User metrics API (reference: `python/ray/util/metrics.py` Counter/Gauge/
 Histogram → OpenCensus → `metrics_agent.py` Prometheus). Redesign: metrics
 push straight to the controller over the control plane and are served from
-its `/metrics` HTTP endpoint (see address.json's metrics_url)."""
+its `/metrics` HTTP endpoint (see address.json's metrics_url). Histograms
+accumulate observations into configurable bucket boundaries CLIENT-side and
+ship per-bucket deltas; the controller aggregates and emits real
+`# TYPE <name> histogram` exposition (`_bucket{le=...}` / `_sum` /
+`_count`), so `histogram_quantile()` works in Prometheus."""
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+import bisect
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# Default latency-shaped boundaries (seconds), reference-style.
+DEFAULT_BOUNDARIES: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+_FLUSH_INTERVAL_S = 0.25
+
+
+def _backend():
+    """The connected cluster backend, or None (never boots a runtime from a
+    plain script — see api._runtime_or_attach); un-inited processes just
+    keep metrics local."""
+    from ..core import api
+
+    rt = api._runtime_or_attach()
+    return rt.backend if rt is not None else None
+
+
+def prune_series(tags: Dict[str, str]) -> None:
+    """Drop every exported series whose tags include all of `tags` (e.g.
+    `{"replica": tag}` when a Serve replica drains) — dead components must
+    not leave gauges frozen in /metrics until the staleness sweep."""
+    backend = _backend()
+    fn = getattr(backend, "prune_metrics", None) if backend else None
+    if fn is not None:
+        fn({str(k): str(v) for k, v in tags.items()})
 
 
 class _Metric:
@@ -28,7 +63,7 @@ class _Metric:
         backend = api._global_runtime().backend
         send = getattr(backend, "record_metric", None)
         if send is not None:
-            send(self._name, self.kind, value, merged)
+            send(self._name, self.kind, value, merged, help=self._description)
 
 
 class Counter(_Metric):
@@ -47,17 +82,93 @@ class Gauge(_Metric):
         self._record(value, tags)
 
 
+class _Flusher:
+    """One daemon thread per process ships every histogram's pending bucket
+    deltas every _FLUSH_INTERVAL_S — observations stay a lock-guarded local
+    accumulate (no control-plane message per observe), and the tail of a
+    burst still lands without requiring another observe to piggyback on."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._histograms: List["Histogram"] = []
+        self._thread: Optional[threading.Thread] = None
+
+    def register(self, hist: "Histogram"):
+        with self._lock:
+            if hist not in self._histograms:
+                self._histograms.append(hist)
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True, name="metrics-flusher"
+                )
+                self._thread.start()
+
+    def _loop(self):
+        while True:
+            time.sleep(_FLUSH_INTERVAL_S)
+            with self._lock:
+                hists = list(self._histograms)
+            for h in hists:
+                try:
+                    h._flush()
+                except Exception:  # noqa: BLE001 — metrics never load-bearing
+                    pass
+
+
+_FLUSHER = _Flusher()
+
+
 class Histogram(_Metric):
-    """Exported as a last-observation gauge plus a _count counter (full
-    bucketed export is a TODO; the reference's boundaries arg is accepted)."""
+    """Bucketed distribution metric. `observe()` accumulates into
+    `boundaries` client-side; deltas ship to the controller, which exposes
+    cumulative `<name>_bucket{le=...}`, `<name>_sum`, `<name>_count`
+    Prometheus series (percentile-capable via `histogram_quantile()`)."""
 
-    kind = "gauge"
+    kind = "histogram"
 
-    def __init__(self, name, description="", boundaries=None, tag_keys=()):
+    def __init__(
+        self,
+        name: str,
+        description: str = "",
+        boundaries: Optional[Sequence[float]] = None,
+        tag_keys: Tuple[str, ...] = (),
+    ):
         super().__init__(name, description, tag_keys)
-        self.boundaries = boundaries or []
-        self._count = Counter(f"{name}_count", description, tag_keys)
+        bounds = tuple(float(b) for b in (boundaries or DEFAULT_BOUNDARIES))
+        if list(bounds) != sorted(bounds) or len(set(bounds)) != len(bounds):
+            raise ValueError(f"histogram boundaries must be sorted/unique: {bounds}")
+        self.boundaries = bounds
+        self._plock = threading.Lock()
+        # tags-key -> [bucket deltas (len = len(bounds)+1, last = +Inf),
+        #              sum delta, count delta]
+        self._pending: Dict[Tuple[Tuple[str, str], ...], list] = {}
 
     def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
-        self._record(value, tags)
-        self._count.inc(1.0, tags)
+        value = float(value)
+        merged = {**self._default_tags, **(tags or {})}
+        key = tuple(sorted((str(k), str(v)) for k, v in merged.items()))
+        idx = bisect.bisect_left(self.boundaries, value)  # le semantics
+        with self._plock:
+            acc = self._pending.get(key)
+            if acc is None:
+                acc = self._pending[key] = [[0] * (len(self.boundaries) + 1), 0.0, 0]
+            acc[0][idx] += 1
+            acc[1] += value
+            acc[2] += 1
+        _FLUSHER.register(self)
+
+    def _flush(self):
+        with self._plock:
+            if not self._pending:
+                return
+            backend = _backend()
+            send = getattr(backend, "record_metric", None) if backend else None
+            if send is None:
+                return  # keep accumulating; deltas are bounded per tag-set
+            pending, self._pending = self._pending, {}
+        for key, (buckets, total, count) in pending.items():
+            send(
+                self._name, "histogram", 0.0, dict(key),
+                boundaries=list(self.boundaries), buckets=buckets,
+                sum=total, count=count, help=self._description,
+            )

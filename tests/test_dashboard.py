@@ -87,6 +87,43 @@ def test_tasks_api_shows_running(dash):
     assert ray_tpu.get(ref) == 1
 
 
+def test_traces_api(dash):
+    @ray_tpu.remote
+    def traced_child(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def traced_root():
+        return ray_tpu.get(traced_child.remote(1))
+
+    assert ray_tpu.get(traced_root.remote()) == 2
+
+    rows = []
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        rows = json.loads(_get(dash + "/api/traces")[2])["traces"]
+        if any(r["name"] == "traced_root" for r in rows):
+            break
+        time.sleep(0.2)
+    row = next(r for r in rows if r["name"] == "traced_root")
+    assert row["n_tasks"] >= 2  # root + child under one trace
+
+    detail = json.loads(
+        _get(dash + f"/api/traces?trace_id={row['trace_id']}")[2]
+    )
+    assert detail["trace_id"] == row["trace_id"]
+    names = {t["name"] for t in detail["tasks"]}
+    assert "traced_root" in names
+    kids = {c["name"] for t in detail["tasks"] for c in t["children"]}
+    assert "traced_child" in kids
+
+    import urllib.error
+
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(dash + "/api/traces?trace_id=nope")
+    assert ei.value.code == 404
+
+
 def test_unknown_api_404(dash):
     import urllib.error
 

@@ -72,6 +72,73 @@ def test_user_metrics_exported(cluster_rt):
     text = urllib.request.urlopen(info["metrics_url"], timeout=5).read().decode()
     assert "my_app_events 5" in text
     assert 'my_app_qps{route="a"} 7.5' in text
+    # Every user family carries a TYPE header so scrapers classify counters
+    # as counters (bare series default to untyped).
+    assert "# TYPE my_app_events counter" in text
+    assert "# TYPE my_app_qps gauge" in text
+
+
+def _scrape(pred, deadline_s=10.0):
+    """Poll /metrics until `pred(text)` holds (client-side histogram deltas
+    flush on a short interval)."""
+    info = _session_info()
+    end = time.monotonic() + deadline_s
+    text = ""
+    while time.monotonic() < end:
+        text = urllib.request.urlopen(info["metrics_url"], timeout=5).read().decode()
+        if pred(text):
+            return text
+        time.sleep(0.25)
+    return text
+
+
+def test_histogram_bucket_exposition(cluster_rt):
+    """Histograms export real cumulative `_bucket{le=...}` / `_sum` /
+    `_count` series (percentile-capable), not a last-value gauge."""
+    from ray_tpu.util.metrics import Histogram
+
+    h = Histogram("obs_req_lat_s", "request latency", boundaries=[0.1, 1.0, 10.0])
+    for v in (0.05, 0.5, 0.6, 5.0, 50.0):
+        h.observe(v)
+    text = _scrape(lambda t: "obs_req_lat_s_count 5" in t)
+    assert "# TYPE obs_req_lat_s histogram" in text
+    assert "# HELP obs_req_lat_s request latency" in text
+    assert 'obs_req_lat_s_bucket{le="0.1"} 1' in text
+    assert 'obs_req_lat_s_bucket{le="1.0"} 3' in text  # cumulative
+    assert 'obs_req_lat_s_bucket{le="10.0"} 4' in text
+    assert 'obs_req_lat_s_bucket{le="+Inf"} 5' in text
+    assert "obs_req_lat_s_count 5" in text
+    assert "obs_req_lat_s_sum 56." in text  # 0.05+0.5+0.6+5+50
+
+
+def test_histogram_tagged_series(cluster_rt):
+    from ray_tpu.util.metrics import Histogram
+
+    h = Histogram("obs_tagged_s", boundaries=[1.0])
+    h.observe(0.5, tags={"route": "a"})
+    h.observe(2.0, tags={"route": "b"})
+    text = _scrape(lambda t: t.count("obs_tagged_s_count") >= 2)
+    assert 'obs_tagged_s_bucket{route="a",le="1.0"} 1' in text
+    assert 'obs_tagged_s_bucket{route="b",le="1.0"} 0' in text
+    assert 'obs_tagged_s_bucket{route="b",le="+Inf"} 1' in text
+
+
+def test_metric_staleness_pruning(shutdown_only):
+    """Series idle past the staleness window drop out of /metrics — gauges
+    from dead replicas/workers must not persist forever."""
+    os.environ["RAY_TPU_METRIC_STALENESS_S"] = "1.0"
+    try:
+        ray_tpu.init(num_cpus=2)
+        from ray_tpu.util.metrics import Gauge
+
+        Gauge("obs_stale_g").set(4.2)
+        text = _scrape(lambda t: "obs_stale_g 4.2" in t)
+        assert "obs_stale_g 4.2" in text
+        time.sleep(1.5)
+        text = _scrape(lambda t: "obs_stale_g" not in t, deadline_s=5.0)
+        assert "obs_stale_g" not in text
+    finally:
+        os.environ.pop("RAY_TPU_METRIC_STALENESS_S", None)
 
 
 def test_tail_logs_returns_worker_output(cluster_rt):
@@ -121,6 +188,25 @@ def test_cli_status_and_lists(cluster_rt):
     assert r.returncode == 0, r.stderr
     r = _run_cli("logs")
     assert r.returncode == 0, r.stderr
+    r = _run_cli("trace")
+    assert r.returncode == 0, r.stderr
+    assert "trace_id" in r.stdout
+
+
+def test_cli_timeline_writes_chrome_trace(cluster_rt, tmp_path):
+    @ray_tpu.remote
+    def noop():
+        return 1
+
+    ray_tpu.get(noop.remote())
+    out = str(tmp_path / "tl.json")
+    r = _run_cli("timeline", "-o", out)
+    assert r.returncode == 0, r.stderr
+    events = json.load(open(out))
+    assert isinstance(events, list) and events
+    # Perfetto-loadable chrome-trace events, not raw controller dicts.
+    assert all("ph" in e for e in events)
+    assert any(e["ph"] == "X" for e in events)
 
 
 def test_tail_logs_from_remote_node():

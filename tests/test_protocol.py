@@ -99,6 +99,15 @@ def test_taskspec_proto_roundtrip_pg_and_actor():
     assert out.options.num_returns == -1  # streaming normalized
 
 
+def test_taskspec_proto_roundtrip_trace_id():
+    """trace_id rides the wire (Dapper-style propagation for tracing)."""
+    spec = _mk_spec(trace_id="abcd1234ef567890")
+    out = spec_from_proto_bytes(spec_to_proto_bytes(spec))
+    assert out.trace_id == "abcd1234ef567890"
+    # Default: empty (task roots its own trace on the executing worker).
+    assert spec_from_proto_bytes(spec_to_proto_bytes(_mk_spec())).trace_id == ""
+
+
 def test_wire_is_proto_not_pickle():
     """The submit wire must carry protobuf (schema-validated), not pickle."""
     from ray_tpu.protocol import ray_tpu_pb2 as pb

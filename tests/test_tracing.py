@@ -80,3 +80,201 @@ def test_task_tree_and_flows(cluster_runtime):
     flows = tracing.chrome_trace_with_flows(ray_tpu.timeline())
     kinds = {e["ph"] for e in flows}
     assert {"X", "s", "f"} <= kinds  # spans + causality arrows
+
+
+def test_worker_phase_spans_nest_under_task(cluster_runtime):
+    """Executing workers record dep-fetch/deserialize/execute/store-result
+    phase events through the batched task_events channel; they attach to
+    the task's span and inherit the trace id."""
+    @ray_tpu.remote
+    def leafy(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def rooty():
+        return ray_tpu.get(leafy.remote(21))
+
+    assert ray_tpu.get(rooty.remote()) == 42
+    end = time.monotonic() + 10.0
+    child = root = None
+    while time.monotonic() < end:
+        spans = tracing.build_trace(ray_tpu.timeline())
+        by_name = {}
+        for s in spans.values():
+            by_name.setdefault(s.name, []).append(s)
+        if "leafy" in by_name and "rooty" in by_name:
+            child, root = by_name["leafy"][0], by_name["rooty"][0]
+            if child.phases and root.phases:
+                break
+        time.sleep(0.2)
+    assert child is not None and child.phases, "no phase events arrived"
+    phase_names = {p["phase"] for p in child.phases}
+    assert {"dep_fetch", "deserialize", "execute", "store_result"} <= phase_names
+    # Phases sit inside the task's span window and carry its trace.
+    assert all(p["dur"] >= 0.0 for p in child.phases)
+    # One trace id across the whole submission tree: the root task roots
+    # the trace; the child inherits it through the worker's context.
+    assert child.trace == root.trace == root.task_id
+    tree = child.to_dict()
+    assert tree["phases"] and tree["trace"] == root.task_id
+
+
+def test_chrome_trace_deterministic_across_hash_seeds():
+    """Lane/flow ids derive from crc32, not builtin hash() — identical
+    exports regardless of PYTHONHASHSEED (the salted-hash lanes used to
+    reshuffle every run)."""
+    import json as _json
+    import os
+    import subprocess
+    import sys
+
+    script = r"""
+import importlib.util, json, sys
+spec = importlib.util.spec_from_file_location(
+    "tracing_standalone", sys.argv[1])
+mod = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(mod)
+events = [
+    {"ts": 1.0, "event": "task_submitted", "task": "aa" * 12, "name": "root",
+     "parent": None},
+    {"ts": 1.1, "event": "task_dispatched", "task": "aa" * 12, "worker": "w1"},
+    {"ts": 1.2, "event": "task_submitted", "task": "bb" * 12, "name": "kid",
+     "parent": "aa" * 12},
+    {"ts": 1.3, "event": "task_phase", "task": "bb" * 12, "phase": "execute",
+     "dur": 0.1, "worker": "w2"},
+    {"ts": 1.5, "event": "task_done", "task": "bb" * 12},
+    {"ts": 1.6, "event": "task_done", "task": "aa" * 12},
+    {"ts": 1.0, "event": "span", "name": "proxy.request", "dur": 0.6,
+     "trace": "t1"},
+]
+print(json.dumps(mod.chrome_trace_with_flows(events), sort_keys=True))
+"""
+    src = tracing.__file__
+    outs = []
+    for seed in ("0", "12345"):
+        env = dict(os.environ, PYTHONHASHSEED=seed)
+        r = subprocess.run(
+            [sys.executable, "-c", script, src],
+            capture_output=True, text=True, timeout=60, env=env,
+        )
+        assert r.returncode == 0, r.stderr
+        outs.append(r.stdout)
+    assert outs[0] == outs[1]
+    data = _json.loads(outs[0])
+    # Deterministic = derived from content: lanes come from crc32.
+    import zlib
+
+    task_events = [e for e in data if e.get("args", {}).get("task_id") == "aa" * 12
+                   and e["ph"] == "X" and e.get("cat") != "phase"]
+    assert task_events
+    assert task_events[0]["tid"] == zlib.crc32(("aa" * 12).encode()) % 1000
+
+
+def test_api_timeline_writes_chrome_trace(cluster_runtime, tmp_path):
+    """api.timeline(filename) writes chrome://tracing/Perfetto JSON as its
+    docstring always promised (raw events via raw=True or return value)."""
+    import json
+
+    @ray_tpu.remote
+    def t():
+        return 1
+
+    assert ray_tpu.get(t.remote()) == 1
+    chrome_path = str(tmp_path / "chrome.json")
+    raw_path = str(tmp_path / "raw.json")
+    events = ray_tpu.timeline(chrome_path)
+    assert isinstance(events, list) and events
+    assert any("event" in e for e in events)  # return value stays raw
+    chrome = json.load(open(chrome_path))
+    assert chrome and all("ph" in e for e in chrome)
+    ray_tpu.timeline(raw_path, raw=True)
+    raw = json.load(open(raw_path))
+    assert raw == events
+
+
+def test_serve_request_trace_end_to_end(cluster_runtime):
+    """Acceptance path: one HTTP request against serve.LLMDeployment yields
+    a single trace containing proxy, queue-wait, prefill, and first-token
+    spans (plus replica + completion), visible via the timeline, the
+    dashboard /api/traces, and exportable as chrome-trace JSON — and the
+    engine's TTFT histogram lands in /metrics with bucketed series."""
+    import json
+    import urllib.request
+
+    from ray_tpu import serve
+
+    serve.start(http_options={"host": "127.0.0.1", "port": 0})
+    app = serve.LLMDeployment.bind(
+        model="gpt2-small",
+        model_overrides=dict(
+            vocab_size=64, n_layers=2, d_model=48, n_heads=3, d_head=16,
+            d_mlp=96, max_seq=128, attn_impl="ref", remat=False,
+            dtype="float32",
+        ),
+        engine_options={"num_blocks": 32, "block_size": 4, "max_num_seqs": 4},
+    )
+    serve.run(app, name="llm-trace", route_prefix="/llm-trace")
+    try:
+        port = serve.http_port()
+        body = json.dumps({"prompt": [1, 2, 3], "max_new_tokens": 4}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/llm-trace", data=body, method="POST"
+        )
+        resp = urllib.request.urlopen(req, timeout=120)
+        rid = resp.headers.get("x-request-id")
+        out = json.loads(resp.read())
+        assert rid and len(out["tokens"]) == 4
+
+        want = {
+            "proxy.request", "replica.handle", "engine.queue_wait",
+            "engine.admission", "engine.prefill", "engine.first_token",
+            "engine.completion",
+        }
+        end = time.monotonic() + 20.0
+        names = set()
+        while time.monotonic() < end:
+            spans = [
+                e for e in ray_tpu.timeline()
+                if e.get("event") == "span" and e.get("trace") == rid
+            ]
+            names = {e["name"] for e in spans}
+            if want <= names:
+                break
+            time.sleep(0.3)
+        assert want <= names, f"missing spans: {want - names}"
+
+        # Dashboard surfaces the same trace.
+        with open("/tmp/ray_tpu/session_latest/address.json") as f:
+            info = json.load(f)
+        rows = json.loads(
+            urllib.request.urlopen(info["dashboard_url"] + "/api/traces",
+                                   timeout=5).read()
+        )["traces"]
+        assert any(r["trace_id"] == rid for r in rows)
+        detail = json.loads(
+            urllib.request.urlopen(
+                info["dashboard_url"] + f"/api/traces?trace_id={rid}", timeout=5
+            ).read()
+        )
+        assert {"engine.prefill", "proxy.request"} <= {
+            s["name"] for s in detail["spans"]
+        }
+
+        # Chrome-trace export of exactly this request.
+        chrome = tracing.chrome_trace_with_flows(ray_tpu.timeline(), trace_id=rid)
+        assert any(e.get("name") == "engine.prefill" for e in chrome)
+
+        # TTFT histogram: bucketed exposition reaches /metrics.
+        end = time.monotonic() + 10.0
+        text = ""
+        while time.monotonic() < end:
+            text = urllib.request.urlopen(
+                info["metrics_url"], timeout=5).read().decode()
+            if "serve_engine_ttft_s_count" in text:
+                break
+            time.sleep(0.25)
+        assert "# TYPE serve_engine_ttft_s histogram" in text
+        assert "serve_engine_ttft_s_bucket" in text and 'le="+Inf"' in text
+        assert "serve_engine_ttft_s_sum" in text
+    finally:
+        serve.shutdown()
