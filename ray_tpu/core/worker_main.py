@@ -66,6 +66,37 @@ def _spec_from_compact(c) -> TaskSpec:
     )
 
 
+def _spec_from_compact_task(c) -> TaskSpec:
+    """Decode the NORMAL direct-task wire form (direct.py
+    _compact_task_spec): a plain list instead of the full proto — the
+    proto encode/decode round trip measured ~100µs per task across both
+    sides of the submit hot path. eligible() guarantees the omitted fields
+    (arg_refs, runtime_env, scheduling strategy) are defaults."""
+    from .ids import ObjectID, TaskID
+    from .task_spec import TaskOptions, TaskType
+
+    task_bytes, payload, nret, name, trace, parent, resources, retries, owner = c
+    task_id = TaskID(task_bytes)
+    return TaskSpec(
+        task_id=task_id,
+        job_id=task_id.job_id(),
+        task_type=TaskType.NORMAL_TASK,
+        func_payload=payload,
+        arg_refs=[],
+        num_returns=nret,
+        return_ids=(
+            [] if nret == -1
+            else [ObjectID.of(task_id, i) for i in range(max(nret, 1))]
+        ),
+        resources=dict(resources),
+        options=TaskOptions(num_returns=nret, max_retries=retries, name=name),
+        name=name,
+        parent_task_id=TaskID(parent) if parent else None,
+        trace_id=trace,
+        owner_address=owner,
+    )
+
+
 class WorkerProcess:
     def __init__(self, address: str, worker_id: str, session_dir: str):
         host, port = address.rsplit(":", 1)
@@ -101,11 +132,24 @@ class WorkerProcess:
         self._reply_batch: Dict[Connection, list] = {}
         self._reply_batch_t0 = 0.0
         self._in_batch = False  # inside execute_actor_batch processing
+        # Reply-hold bound: a batched completed reply must never wait out
+        # the NEXT task's execution (observed: a fast task's result blind
+        # to wait() for a 5s sleeper processed in the same burst). The io
+        # loop flushes any batch older than the 2ms window, independent of
+        # what the main thread is executing.
+        self._reply_timer_scheduled = False
         # Timeline events for direct tasks (the controller never sees their
         # dispatch/done) — batched to the controller like the reference's
         # profile-event flushes, so tracing/state stay complete without a
         # per-task control-plane message.
         self._task_events: List[dict] = []
+        # Storm protection: past this backlog, per-task timeline events are
+        # COUNTED instead of recorded (one task_events_dropped marker ships
+        # with the next flush). A 500k-task drain burst otherwise spends
+        # more control-plane CPU narrating itself than executing — the
+        # reference's profile-event channel drops under pressure too.
+        self._task_events_cap = 4096
+        self._task_events_dropped = 0
         # Lazily-built in-task API runtime (see _init_client_api): None until
         # user code actually calls back into the ray_tpu API. The task
         # context lives in OUR TaskContext object, which the runtime adopts
@@ -136,6 +180,9 @@ class WorkerProcess:
         """Thread-safe append to the batched task_events channel (actor-pool
         threads record phases too; the flush swap runs under _reply_lock)."""
         with self._reply_lock:
+            if len(self._task_events) >= self._task_events_cap:
+                self._task_events_dropped += 1
+                return
             self._task_events.append(ev)
 
     def _start_orphan_watchdog(self):
@@ -186,8 +233,16 @@ class WorkerProcess:
             t = msg.get("type")
             if t == "direct_task":
                 self.task_queue.put(
-                    {"type": "execute_task", "spec": msg["spec"],
-                     "deps": None, "direct_conn": conn}
+                    {"type": "execute_task", "spec": msg.get("spec"),
+                     "ct": msg.get("c"), "deps": None, "direct_conn": conn}
+                )
+            elif t == "direct_task_batch":
+                # One queue item per burst (the actor-batch discipline):
+                # per-task queue traffic on this io thread competes with the
+                # executing main thread for the GIL.
+                self.task_queue.put(
+                    {"type": "execute_task_batch", "items": msg["items"],
+                     "direct_conn": conn}
                 )
             elif t == "agent_task":
                 # LocalDispatcher push (local_dispatch.py): CLASSIC result
@@ -219,6 +274,23 @@ class WorkerProcess:
                         self._dropped.add(msg["task"])
                 if dropped:
                     await conn.send({"type": "direct_dropped", "task": msg["task"]})
+            elif t == "drop_tasks":
+                # Bulk steal (direct.py _steal_for): one frame carries every
+                # task the submitter wants back from this worker; the acks
+                # return as one frame too.
+                acked = []
+                with self._task_lock:
+                    for task_hex in msg["tasks"]:
+                        if (
+                            task_hex != self._current_task_hex
+                            and task_hex not in self._done_hexes
+                        ):
+                            self._dropped.add(task_hex)
+                            acked.append(task_hex)
+                if acked:
+                    await conn.send(
+                        {"type": "direct_dropped_batch", "tasks": acked}
+                    )
             elif t == "lease_ping" and msg.get("req_id") is not None:
                 # Stall-watchdog health probe: answering proves this conn's
                 # read AND write paths plus the io loop are alive.
@@ -240,6 +312,7 @@ class WorkerProcess:
             self._flush_direct_replies()
             self._send_direct_result(conn, spec, results, spec_blob=spec_blob)
             return
+        schedule_timer = False
         with self._reply_lock:
             if not self._reply_batch:
                 self._reply_batch_t0 = time.monotonic()
@@ -250,18 +323,43 @@ class WorkerProcess:
             # earlier results hostage — submitters may be blocked on them),
             # or queue drained outside a burst.
             flush = (
-                len(self._reply_batch[conn]) >= 64
+                len(self._reply_batch[conn]) >= 128
                 or time.monotonic() - self._reply_batch_t0 >= 0.002
                 or (not self._in_batch and self.task_queue.empty())
             )
+            if not flush and not self._reply_timer_scheduled:
+                # Arm the io-loop backstop: if the main thread disappears
+                # into a long execution, the batch still ships at ~2ms.
+                self._reply_timer_scheduled = True
+                schedule_timer = True
+        if schedule_timer:
+            try:
+                self.io.loop.call_soon_threadsafe(self._arm_reply_timer)
+            except RuntimeError:
+                with self._reply_lock:
+                    self._reply_timer_scheduled = False
         if flush:
             self._flush_direct_replies()
 
+    def _arm_reply_timer(self):
+        self.io.loop.call_later(0.002, self._reply_timer_fire)
+
+    def _reply_timer_fire(self):
+        with self._reply_lock:
+            self._reply_timer_scheduled = False
+        self._flush_direct_replies()
+
     def _flush_task_events(self):
         with self._reply_lock:
-            if not self._task_events:
+            if not self._task_events and not self._task_events_dropped:
                 return
             events, self._task_events = self._task_events, []
+            dropped, self._task_events_dropped = self._task_events_dropped, 0
+        if dropped:
+            events.append(
+                {"ts": time.time(), "event": "task_events_dropped",
+                 "n": dropped, "worker": self.worker_id}
+            )
         self.send({"type": "task_events", "events": events})
 
     def _flush_direct_replies(self):
@@ -311,6 +409,11 @@ class WorkerProcess:
                 # Registered results live in a node arena — ship the spec so
                 # the controller can reconstruct them after a node death
                 # (inline results live with the submitter; no lineage needed).
+                # Compact-wire tasks re-encode here, off the inline fast path.
+                if spec_blob == "lazy":
+                    from .task_spec import spec_to_proto_bytes
+
+                    spec_blob = spec_to_proto_bytes(spec)
                 done["spec"] = spec_blob
             self.send(done)
             conn.post({"type": "direct_done", "task": task_hex, "registered": True})
@@ -532,17 +635,17 @@ class WorkerProcess:
     def _flush_phases(self, spec: TaskSpec, phases):
         """Ship per-task phase spans (dep-fetch/deserialize/execute/store)
         through the batched task_events channel — the controller timeline
-        nests them under the task via util/tracing."""
+        nests them under the task via util/tracing. ONE compact event
+        carries all phases (tracing.build_trace expands it): four dicts per
+        task measured on the drain-throughput hot path."""
         if not phases:
             return
-        task_hex = spec.task_id.hex()
-        trace = self._trace_of(spec)
-        for name, t0, t1 in phases:
-            self._record_event(
-                {"ts": t0, "event": "task_phase", "phase": name,
-                 "task": task_hex, "dur": max(t1 - t0, 0.0),
-                 "trace": trace, "worker": self.worker_id}
-            )
+        self._record_event(
+            {"ts": phases[0][1], "event": "task_phases",
+             "task": spec.task_id.hex(), "trace": self._trace_of(spec),
+             "worker": self.worker_id,
+             "spans": [[name, t0, max(t1 - t0, 0.0)] for name, t0, t1 in phases]}
+        )
 
     def _execute(
         self,
@@ -650,6 +753,39 @@ class WorkerProcess:
             self.send(
                 {"type": "task_done", "task": spec.task_id.hex(), "results": results}
             )
+
+    def _execute_task_fast(self, spec: TaskSpec, reply):
+        """Hot path for simple direct NORMAL tasks (no arg refs, one
+        return, no runtime_env, not streaming) — the actor fast path's
+        twin. Skips the generic machinery (env save/restore closures,
+        streaming plumbing, per-phase list juggling) that measured ~40% of
+        a trivial task's worker-side cost; phase timestamps stay honest."""
+        import inspect
+
+        from .runtime import resolve_payload
+
+        self._set_ctx(spec.task_id, None, self._trace_of(spec))
+        t0 = time.time()
+        try:
+            func, args, kwargs = resolve_payload(spec.func_payload, ())
+            t1 = time.time()
+            result = func(*args, **kwargs)
+            if inspect.isgenerator(result):
+                result = list(result)
+            t2 = time.time()
+            results = [self.store_result(spec.return_ids[0].hex(), result)]
+        except BaseException as e:  # noqa: BLE001
+            err = TaskError(e, traceback.format_exc(), spec.name)
+            t1 = t2 = time.time()
+            results = [self.store_result(spec.return_ids[0].hex(), err)]
+        finally:
+            self._set_ctx(None)
+        t3 = time.time()
+        self._flush_phases(spec, [
+            ("dep_fetch", t0, t0), ("deserialize", t0, t1),
+            ("execute", t1, t2), ("store_result", t2, t3),
+        ])
+        reply(results)
 
     def _execute_actor_fast(self, spec: TaskSpec, reply):
         """Hot path for simple direct actor calls (no arg refs, one return,
@@ -761,6 +897,19 @@ class WorkerProcess:
                     self._in_batch = False
                     self._flush_direct_replies()
                 continue
+            if mtype == "execute_task_batch":
+                conn = msg["direct_conn"]
+                self._in_batch = True  # one reply flush per burst, not per call
+                try:
+                    for ct in msg["items"]:
+                        self._process_task_msg(
+                            "execute_task",
+                            {"ct": ct, "deps": None, "direct_conn": conn},
+                        )
+                finally:
+                    self._in_batch = False
+                    self._flush_direct_replies()
+                continue
             if self._reply_batch:
                 # Backlog batching must never hold a COMPLETED result
                 # hostage behind the NEXT task's execution: with the queue
@@ -783,16 +932,40 @@ class WorkerProcess:
         from .task_spec import spec_from_proto_bytes
 
         compact = msg.get("c")
+        compact_task = msg.get("ct")
+        # Drop check BEFORE the spec decode for the compact forms (the task
+        # id is their first element): a bulk steal leaves thousands of
+        # to-be-skipped frames in the queue, and decoding each one first
+        # measured ~30% of the victim worker's drain-burst CPU.
+        pre_hex = (
+            compact[0].hex() if compact is not None
+            else compact_task[0].hex() if compact_task is not None
+            else None
+        )
+        if pre_hex is not None:
+            with self._task_lock:
+                if pre_hex in self._dropped:
+                    self._dropped.discard(pre_hex)
+                    return
         if compact is not None:
             spec = _spec_from_compact(compact)
+        elif compact_task is not None:
+            spec = _spec_from_compact_task(compact_task)
         else:
             spec = spec_from_proto_bytes(msg["spec"])
         deps = msg.get("deps", {})
         direct_conn = msg.get("direct_conn")
         reply = None
         if direct_conn is not None:
+            # spec_blob: proto bytes when they rode the wire, the sentinel
+            # "lazy" for compact normal tasks (re-encoded only on the rare
+            # registered-result path so lineage survives), None for actor
+            # calls (actor results are not reconstructible).
+            blob = msg.get("spec")
+            if blob is None and compact_task is not None:
+                blob = "lazy"
             reply = (
-                lambda results, s=spec, c=direct_conn, b=msg.get("spec"):
+                lambda results, s=spec, c=direct_conn, b=blob:
                 self._queue_direct_result(c, s, results, spec_blob=b)
             )
         with self._task_lock:
@@ -804,26 +977,41 @@ class WorkerProcess:
                 self._current_task_hex = spec.task_id.hex()
         if skip:
             return
+        t_span = None
         if direct_conn is not None and self.actor_pool is None:
             task_hex = spec.task_id.hex()
             now = time.time()
-            self._task_events.append(
-                {"ts": now, "event": "task_submitted", "task": task_hex,
-                 "name": spec.name,
-                 "parent": spec.parent_task_id.hex()
-                 if spec.parent_task_id else None,
-                 "trace": spec.trace_id or None}
-            )
-            self._task_events.append(
-                {"ts": now, "event": "task_dispatched", "task": task_hex,
-                 "worker": self.worker_id}
-            )
-            if not self._in_batch and self.task_queue.empty():
+            early = not self._in_batch and self.task_queue.empty()
+            t_span = (now, early)
+            if early:
                 # Nothing queued behind: this may be a LONG task — make it
-                # visible as RUNNING before execution starts.
+                # visible as RUNNING before execution starts. Burst tasks
+                # skip this pair entirely and report ONE task_span event at
+                # completion instead (7 dicts/task measured on the drain
+                # hot path before the consolidation).
+                self._task_events.append(
+                    {"ts": now, "event": "task_submitted", "task": task_hex,
+                     "name": spec.name,
+                     "parent": spec.parent_task_id.hex()
+                     if spec.parent_task_id else None,
+                     "trace": spec.trace_id or None}
+                )
+                self._task_events.append(
+                    {"ts": now, "event": "task_dispatched", "task": task_hex,
+                     "worker": self.worker_id}
+                )
                 self._flush_task_events()
         if mtype == "execute_task":
-            self._execute(spec, deps, is_actor_method=False, reply=reply)
+            if (
+                reply is not None
+                and deps is None
+                and not spec.arg_refs
+                and spec.num_returns == 1
+                and spec.options.runtime_env is None
+            ):
+                self._execute_task_fast(spec, reply)
+            else:
+                self._execute(spec, deps, is_actor_method=False, reply=reply)
             with self._task_lock:
                 self._done_hexes.append(spec.task_id.hex())
             agent_conn = msg.get("agent_conn")
@@ -834,11 +1022,8 @@ class WorkerProcess:
                     )
                 except ConnectionError:
                     pass  # agent gone; controller owns the result anyway
-            if direct_conn is not None:
-                self._task_events.append(
-                    {"ts": time.time(), "event": "task_done",
-                     "task": spec.task_id.hex()}
-                )
+            if t_span is not None:
+                self._emit_task_span(spec, t_span)
         elif mtype == "create_actor":
             self._create_actor(spec, deps)
         elif mtype == "execute_actor_task":
@@ -858,17 +1043,27 @@ class WorkerProcess:
                 and spec.options.runtime_env is None
             ):
                 self._execute_actor_fast(spec, reply)
-                self._task_events.append(
-                    {"ts": time.time(), "event": "task_done",
-                     "task": spec.task_id.hex()}
-                )
+                if t_span is not None:
+                    self._emit_task_span(spec, t_span)
             else:
                 self._execute(spec, deps, is_actor_method=True, reply=reply)
-                if direct_conn is not None:
-                    self._task_events.append(
-                        {"ts": time.time(), "event": "task_done",
-                         "task": spec.task_id.hex()}
-                    )
+                if t_span is not None:
+                    self._emit_task_span(spec, t_span)
+
+    def _emit_task_span(self, spec: TaskSpec, t_span):
+        """One consolidated timeline event per completed direct task:
+        submit/dispatch/done timestamps + identity in a single dict
+        (tracing.build_trace expands it; the controller's running view
+        only needs the pop when the early RUNNING pair was emitted)."""
+        t0, early = t_span
+        self._record_event(
+            {"ts": t0, "event": "task_span", "task": spec.task_id.hex(),
+             "name": spec.name,
+             "parent": spec.parent_task_id.hex()
+             if spec.parent_task_id else None,
+             "trace": spec.trace_id or None, "worker": self.worker_id,
+             "done": time.time(), "early": early}
+        )
 
     def _init_client_api(self):
         """Install a Runtime so user code can call the full API from tasks.

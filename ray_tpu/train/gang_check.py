@@ -112,6 +112,7 @@ def spawn_gang(
     nprocs: int = 2,
     devices_per_proc: int = 4,
     timeout: float = 420.0,
+    _bind_attempts: int = 3,
 ):
     """Spawn `nprocs` fresh interpreters that join one jax.distributed gang
     and each run `run_gang_step`; returns the parsed per-process results.
@@ -122,6 +123,13 @@ def spawn_gang(
     never wedge the gang on a full pipe, and every worker is killed on any
     failure path — a surviving sibling would otherwise sit in a collective
     waiting for its dead peer.
+
+    Coordinator-port TOCTOU (ADVICE r5 #5): the port is picked bind-then-
+    close, and another process can take it before worker 0's
+    jax.distributed coordinator binds it. The socket is held open with
+    SO_REUSEADDR until just before the workers launch (shrinks the window
+    to microseconds), and a rendezvous failure that looks like a lost
+    bind race retries the whole gang on a fresh port.
     """
     import json
     import os
@@ -132,14 +140,19 @@ def spawn_gang(
     import time
 
     s = socket.socket()
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     s.bind(("127.0.0.1", 0))
     coord = f"127.0.0.1:{s.getsockname()[1]}"
-    s.close()
     repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
     procs = []
     logs = []
     try:
+        # Hold the reservation until the last instant: the coordinator
+        # child binds with SO_REUSEADDR-compatible semantics only after
+        # this close, so the race window is the exec latency, not the
+        # whole test-collection interval.
+        s.close()
         for pid in range(nprocs):
             log = tempfile.TemporaryFile(mode="w+")
             logs.append(log)
@@ -159,6 +172,22 @@ def spawn_gang(
             log.seek(0)
             out = log.read()
             if p.returncode != 0:
+                lowered = out.lower()
+                if _bind_attempts > 1 and (
+                    "address already in use" in lowered
+                    or "errno 98" in lowered
+                    or "failed to bind" in lowered
+                    or "bind address" in lowered
+                ):
+                    # Lost the coordinator-port race: kill the gang (the
+                    # finally-block below) and retry on a fresh port.
+                    for q in procs:
+                        if q.poll() is None:
+                            q.kill()
+                    return spawn_gang(
+                        nprocs, devices_per_proc, timeout,
+                        _bind_attempts=_bind_attempts - 1,
+                    )
                 raise RuntimeError(f"gang worker {pid} failed:\n{out[-4000:]}")
             lines = [l for l in out.splitlines() if l.startswith("GANG_RESULT ")]
             if not lines:

@@ -175,6 +175,28 @@ def build_trace(events: List[dict]) -> Dict[str, Span]:
                 {"phase": ev.get("phase", ""), "ts": ev["ts"],
                  "dur": ev.get("dur", 0.0)}
             )
+        elif kind == "task_phases":
+            # Compact per-task form (one event carries every phase triple) —
+            # what executing workers ship since the drain-throughput round;
+            # expanded here so downstream consumers see identical dicts.
+            span = span_for(task)
+            span.trace = ev.get("trace") or span.trace
+            span.worker = ev.get("worker") or span.worker
+            for name, t0, dur in ev.get("spans", ()):
+                span.phases.append({"phase": name, "ts": t0, "dur": dur})
+        elif kind == "task_span":
+            # Consolidated submit/dispatch/done event from the worker's
+            # burst fast path — expands to the classic three.
+            span = span_for(task)
+            span.name = ev.get("name", span.name)
+            span.parent = ev.get("parent", span.parent)
+            span.trace = ev.get("trace") or span.trace
+            span.worker = ev.get("worker") or span.worker
+            if span.submitted_at is None:
+                span.submitted_at = ev["ts"]
+            if span.dispatched_at is None:
+                span.dispatched_at = ev["ts"]
+            span.done_at = ev.get("done", span.done_at)
     for span in spans.values():
         if span.parent and span.parent in spans:
             spans[span.parent].children.append(span)

@@ -70,6 +70,17 @@ def main():
         results.append(r)
     best = max((r for r in results if "mfu" in r), key=lambda r: r["mfu"])
     print("BEST:", json.dumps(best))
+    # gpt2-xl + 4k-context rows are part of the DEFAULT sweep (VERDICT r5
+    # #7/#10: the two configs closest to the north star went one round
+    # stale when a sweep run skipped them) — re-recorded every round.
+    from scripts.bench_xl_longseq import bench_long_ctx_train, bench_xl
+
+    for probe in (bench_xl, bench_long_ctx_train):
+        try:
+            probe()
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({"metric": probe.__name__,
+                              "error": repr(e)[:200]}), flush=True)
 
 
 if __name__ == "__main__":

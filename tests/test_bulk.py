@@ -1,10 +1,15 @@
 """Bulk object plane (`core/bulk.py`): sendfile/recv_into raw-socket
-transfers + same-host map handover. Reference analog: the object manager's
-chunked transfer over its buffer pool (`object_buffer_pool.h`) and plasma
-fd-passing (`plasma/fling.cc`)."""
+transfers, the pipelined chunk window, and same-host map handover.
+Reference analog: the object manager's chunked transfer over its buffer
+pool (`object_buffer_pool.h`), the push manager's bounded in-flight chunk
+window (`push_manager.h`), and plasma fd-passing (`plasma/fling.cc`)."""
 
 import os
 import secrets
+import socket
+import struct
+import threading
+import time
 
 import numpy as np
 import pytest
@@ -13,6 +18,94 @@ import ray_tpu
 from ray_tpu.cluster_utils import Cluster
 from ray_tpu.core import bulk, store
 from ray_tpu.core import config as rt_config
+
+
+# ------------------------------------------------ chunk-window bookkeeping
+class TestChunkPipeline:
+    """Pure bookkeeping tests — no sockets, no gigabytes (tier-1 cheap)."""
+
+    def test_window_never_exceeds_bound_and_offsets_land(self):
+        total, chunk, window = 1 << 20, 64 << 10, 3
+        src = np.random.default_rng(0).integers(0, 255, total, np.uint8).tobytes()
+        dst = bytearray(total)
+        landed_order = []
+
+        def land(view, off):
+            # Slow lander: forces the reader to exhaust the window so the
+            # bound is actually exercised.
+            time.sleep(0.002)
+            dst[off:off + len(view)] = view
+            landed_order.append(off)
+
+        cursor = [0]
+
+        def fill(view):
+            n = len(view)
+            view[:] = src[cursor[0]:cursor[0] + n]
+            cursor[0] += n
+
+        p = bulk.ChunkPipeline(total, chunk, window, land, deadline_s=30.0)
+        p.run(fill)
+        assert bytes(dst) == src
+        assert p.max_outstanding <= window
+        assert len(landed_order) == -(-total // chunk)
+
+    def test_out_of_order_landers_land_at_correct_offsets(self):
+        """Two landers with jittered delays land chunks out of order;
+        positional writes must still reassemble exactly."""
+        total, chunk, window = 1 << 20, 32 << 10, 6
+        src = np.random.default_rng(1).integers(0, 255, total, np.uint8).tobytes()
+        dst = bytearray(total)
+        order = []
+        jitter = [0.003, 0.0]  # alternating: even-index chunks land late
+
+        def land(view, off):
+            time.sleep(jitter[(off // chunk) % 2])
+            dst[off:off + len(view)] = view
+            order.append(off)
+
+        cursor = [0]
+
+        def fill(view):
+            n = len(view)
+            view[:] = src[cursor[0]:cursor[0] + n]
+            cursor[0] += n
+
+        p = bulk.ChunkPipeline(total, chunk, window, land, deadline_s=30.0,
+                               landers=2)
+        p.run(fill)
+        assert bytes(dst) == src
+        assert p.max_outstanding <= window
+        assert order != sorted(order), "landers never reordered — test is vacuous"
+
+    def test_lander_error_aborts_and_propagates(self):
+        def land(view, off):
+            raise OSError("disk gone")
+
+        def fill(view):
+            view[:] = b"\0" * len(view)
+
+        p = bulk.ChunkPipeline(1 << 20, 64 << 10, 3, land, deadline_s=5.0)
+        with pytest.raises(OSError, match="disk gone"):
+            p.run(fill)
+
+    def test_stalled_lander_hits_progress_deadline(self):
+        """A lander that never returns must abort the transfer within the
+        progress deadline (no free buffer ⇒ reader times out), not hang."""
+        release = threading.Event()
+
+        def land(view, off):
+            release.wait(10.0)
+
+        def fill(view):
+            view[:] = b"\0" * len(view)
+
+        p = bulk.ChunkPipeline(1 << 20, 32 << 10, 2, land, deadline_s=0.3)
+        t0 = time.monotonic()
+        with pytest.raises(socket.timeout, match="bulk landing stalled"):
+            p.run(fill)
+        assert time.monotonic() - t0 < 5.0
+        release.set()
 
 
 @pytest.fixture
@@ -107,6 +200,203 @@ def test_bulk_spilled_file_source(bulk_pair, tmp_path):
     writer.commit()
     assert dst.read_raw(dname) == data
     dst.release(dname, unlink=True)
+
+
+class _FaultyBulkServer:
+    """Raw-socket stand-in for a failing peer: speaks just enough of the
+    bulk wire format to advertise a span, then misbehaves — `mode="kill"`
+    closes mid-payload (worker death), `mode="stall"` stops sending
+    (wedged peer / blackholed link)."""
+
+    def __init__(self, size: int, mode: str, send_bytes: int = 4 << 20):
+        self.size = size
+        self.mode = mode
+        self.send_bytes = send_bytes
+        self._sock = socket.create_server(("127.0.0.1", 0), backlog=4)
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    def _serve(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_one, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_one(self, conn):
+        try:
+            # Auth preamble (present iff the client sent one) + request.
+            conn.settimeout(10.0)
+            tok = os.environ.get("RAY_TPU_AUTH_TOKEN", "")
+            if tok:
+                conn.recv(len(bulk._AUTH_MAGIC) + 4 + len(tok.encode()),
+                          socket.MSG_WAITALL)
+            (n,) = struct.unpack("<I", conn.recv(4, socket.MSG_WAITALL))
+            conn.recv(n, socket.MSG_WAITALL)
+            conn.sendall(bulk._HDR.pack(0, self.size))
+            conn.sendall(b"\x5a" * self.send_bytes)
+            if self.mode == "kill":
+                conn.close()  # peer died mid-span
+                return
+            # stall: keep the socket open but send nothing more.
+            self._stop.wait(60.0)
+            conn.close()
+        except OSError:
+            pass
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+@pytest.mark.parametrize("mode", ["kill", "stall"])
+def test_bulk_chaos_abort_leaves_no_partial_object(bulk_pair, mode):
+    """Mid-transfer worker death and a stalled chunk must abort within the
+    per-chunk progress deadline, leave NO partial object visible, and let
+    the same pull succeed against a healthy source afterwards."""
+    src, good_addr, dst = bulk_pair
+    size = 32 << 20
+    faulty = _FaultyBulkServer(size, mode)
+    old = os.environ.get("RAY_TPU_TRANSFER_CHUNK_TIMEOUT_S")
+    os.environ["RAY_TPU_TRANSFER_CHUNK_TIMEOUT_S"] = "1.5"
+    rt_config._reset_cache_for_tests()
+    try:
+        hx = secrets.token_hex(28)
+        dname, writer = dst.create_begin(hx, size)
+        t0 = time.monotonic()
+        with pytest.raises((ConnectionError, OSError, RuntimeError)):
+            bulk.bulk_pull_into(
+                f"127.0.0.1:{faulty.port}", {"name": "whatever"}, size,
+                writer, streams=1,
+            )
+        took = time.monotonic() - t0
+        writer.abort()
+        # Stall aborts by the PROGRESS deadline (1.5s + slack), kill at once.
+        assert took < 10.0, f"abort took {took:.1f}s"
+        # No partial object visible: the aborted name is gone...
+        with pytest.raises(OSError):
+            dst.read_raw(dname)
+        # ...and a fresh pull of the same object id from a HEALTHY source
+        # starts clean and lands the real bytes (retry-on-another-plane).
+        data = np.random.default_rng(7).integers(0, 255, 1 << 20, np.uint8).tobytes()
+        good_name, good_size = src.create_raw(secrets.token_hex(28), data)
+        dname2, writer2 = dst.create_begin(hx, good_size)
+        assert writer2 is not None, "aborted pull left the object marked complete"
+        bulk.bulk_pull_into(good_addr, {"name": good_name}, good_size,
+                            writer2, streams=1)
+        writer2.commit()
+        assert dst.read_raw(dname2) == data
+        dst.release(dname2, unlink=True)
+        src.release(good_name, unlink=True)
+    finally:
+        faulty.stop()
+        if old is None:
+            os.environ.pop("RAY_TPU_TRANSFER_CHUNK_TIMEOUT_S", None)
+        else:
+            os.environ["RAY_TPU_TRANSFER_CHUNK_TIMEOUT_S"] = old
+        rt_config._reset_cache_for_tests()
+
+
+def test_bulk_pipelined_tcp_roundtrip(bulk_pair):
+    """The pipelined chunk window reassembles a multi-chunk span exactly
+    over real sockets (chunk size shrunk so a small object spans many)."""
+    src, addr, dst = bulk_pair
+    old_chunk = os.environ.get("RAY_TPU_BULK_CHUNK_BYTES")
+    os.environ["RAY_TPU_BULK_CHUNK_BYTES"] = str(1 << 20)
+    os.environ["RAY_TPU_BULK_SAME_HOST_MAP"] = "0"
+    rt_config._reset_cache_for_tests()
+    try:
+        n = (9 << 20) + 777  # ragged tail across 1 MiB chunks
+        data = np.random.default_rng(3).integers(0, 255, n, np.uint8).tobytes()
+        _roundtrip(src, addr, dst, data, streams=1, force_tcp=False)
+    finally:
+        if old_chunk is None:
+            os.environ.pop("RAY_TPU_BULK_CHUNK_BYTES", None)
+        else:
+            os.environ["RAY_TPU_BULK_CHUNK_BYTES"] = old_chunk
+        del os.environ["RAY_TPU_BULK_SAME_HOST_MAP"]
+        rt_config._reset_cache_for_tests()
+
+
+class _LyingMapServer:
+    """Answers every map/borrow request with an attacker-chosen path —
+    exercises the CLIENT-side validation (ADVICE r5 #4)."""
+
+    def __init__(self, answer_path: str, size: int):
+        import json as _json
+
+        self._body = _json.dumps(
+            {"path": answer_path, "offset": 0, "size": size}
+        ).encode()
+        self._sock = socket.create_server(("127.0.0.1", 0), backlog=4)
+        self.port = self._sock.getsockname()[1]
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    def _serve(self):
+        while True:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            try:
+                conn.settimeout(10.0)
+                tok = os.environ.get("RAY_TPU_AUTH_TOKEN", "")
+                if tok:
+                    conn.recv(len(bulk._AUTH_MAGIC) + 4 + len(tok.encode()),
+                              socket.MSG_WAITALL)
+                (n,) = struct.unpack("<I", conn.recv(4, socket.MSG_WAITALL))
+                conn.recv(n, socket.MSG_WAITALL)
+                conn.sendall(bulk._HDR.pack(2, len(self._body)) + self._body)
+            except OSError:
+                pass
+
+    def stop(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def test_bulk_borrow_and_map_validate_returned_path(bulk_pair, tmp_path):
+    """ADVICE r5 #4: name-addressed borrows accept only /dev/shm/ sources;
+    path-addressed maps must get back EXACTLY the requested path — the
+    client mmaps/preads whatever comes back, so it validates the answer
+    against its own request instead of trusting the server."""
+    src, addr, dst = bulk_pair
+    size = 1 << 20
+    # Honest name-addressed borrow still works (arena lives in /dev/shm).
+    data = b"\xbb" * size
+    name, _ = src.create_raw(secrets.token_hex(28), data)
+    path, base, sock = bulk.bulk_borrow(addr, {"name": name}, size, 10.0)
+    assert path.startswith("/dev/shm/")
+    sock.close()
+    src.release(name, unlink=True)
+    # A server answering a NAME borrow with a non-shm path is refused.
+    liar = _LyingMapServer("/etc/passwd", size)
+    try:
+        with pytest.raises(RuntimeError, match="suspicious path"):
+            bulk.bulk_borrow(f"127.0.0.1:{liar.port}", {"name": "x"}, size, 5.0)
+    finally:
+        liar.stop()
+    # A server answering a PATH map with a DIFFERENT path is refused.
+    want = str(tmp_path / "requested-file")
+    liar2 = _LyingMapServer(str(tmp_path / "other-file"), size)
+    try:
+        hx = secrets.token_hex(28)
+        dname, writer = dst.create_begin(hx, size)
+        with pytest.raises(RuntimeError, match="bulk map returned"):
+            bulk._pull_map(f"127.0.0.1:{liar2.port}", {"path": want}, size,
+                           writer, 5.0)
+        writer.abort()
+    finally:
+        liar2.stop()
 
 
 def test_bulk_error_reports(bulk_pair):

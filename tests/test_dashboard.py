@@ -70,14 +70,20 @@ def test_live_state_visible(dash):
 
 
 def test_tasks_api_shows_running(dash):
+    # Deadline-based poll, generous on cold runs: the first scrape races
+    # worker spawn (~2s cold interpreter boot without the forkserver), so a
+    # fixed 20x0.1s loop flaked when the task had not even dispatched yet.
+    # The task sleeps long enough that a poll tick always lands inside its
+    # RUNNING window once dispatched.
     @ray_tpu.remote
     def slow():
-        time.sleep(1.0)
+        time.sleep(3.0)
         return 1
 
     ref = slow.remote()
     seen_running = False
-    for _ in range(20):
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
         data = json.loads(_get(dash + "/api/tasks")[2])
         if any(t["state"] == "RUNNING" and t["name"] == "slow" for t in data["tasks"]):
             seen_running = True
