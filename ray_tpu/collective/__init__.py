@@ -142,10 +142,53 @@ class GroupRendezvous:
                 self._rounds.pop(key, None)  # all members served — free refs
         return refs
 
+    # Tombstoned (aborted) rounds kept so stragglers fail fast instead of
+    # re-opening the round and wedging; beyond this many table entries the
+    # oldest tombstones are dropped — dead members never bump `served`, so
+    # without a bound repeated aborts would leak entries in this detached
+    # actor forever.
+    _MAX_ROUNDS = 1024
+
+    def abort_rounds(self) -> int:
+        """Abort every in-progress round: waiters blocked in
+        contribute_and_await are released into the None/abort path, and
+        stragglers that have not contributed yet fail fast on the kept
+        tombstones (dropping them would let a live straggler re-open the
+        round and block out its full timeout alone). The gang supervisor
+        calls this when a member dies so surviving members never sit out
+        the full round timeout on a peer that will never arrive (ISSUE 4:
+        "interrupt the collective, no wedged barrier"). COMPLETED rounds
+        (event set with payload refs present — every contribution arrived,
+        laggards just haven't collected yet) are left alone: aborting one
+        would hand some members the real result and others None, desyncing
+        a group with no member dead. Aborted p2p tombstones have no served
+        counter and persist until the _MAX_ROUNDS eviction — after a
+        non-destructive abort, destroy/re-create the group before reusing
+        p2p keys. Returns the number of rounds aborted."""
+        with self._lock:
+            n = 0
+            for r in self._rounds.values():
+                if not r.get("aborted") and not (
+                    r["event"].is_set() and r["refs"]
+                ):
+                    r["aborted"] = True
+                    r["refs"].clear()
+                    r["event"].set()
+                    n += 1
+            if len(self._rounds) > self._MAX_ROUNDS:
+                excess = len(self._rounds) - self._MAX_ROUNDS
+                for key in [
+                    k for k, r in self._rounds.items() if r.get("aborted")
+                ][:excess]:
+                    self._rounds.pop(key)
+            return n
+
     # ------------------------------------------------------------------ p2p
     def put_p2p(self, key: str, ref) -> bool:
         with self._lock:
             r = self._round(key)
+            if r.get("aborted"):
+                return False  # tombstoned incarnation: don't park a payload
             r["refs"][0] = ref
             r["event"].set()
         return True
@@ -156,6 +199,12 @@ class GroupRendezvous:
         if not r["event"].wait(timeout):
             return None
         with self._lock:
+            if r.get("aborted"):
+                # abort_rounds cleared refs and set the event to release
+                # this waiter; KEEP the tombstone (same rule as
+                # contribute_and_await) so a straggler peer fails fast
+                # instead of re-opening the round.
+                return None
             self._rounds.pop(key, None)
             return r["refs"][0]
 
@@ -243,6 +292,24 @@ def create_collective_group(
     mapping = {a._id.hex(): r for a, r in zip(actors, ranks)}
     api.get(info.assign_ranks.remote(mapping))
     return True
+
+
+def abort_collective_group(group_name: str = "default", timeout: float = 5.0) -> bool:
+    """Interrupt every in-flight round of a group WITHOUT destroying it:
+    members blocked in a collective get a prompt TimeoutError instead of
+    waiting out the full round timeout on a dead peer. Driver-callable
+    (no membership required). Returns False when the group doesn't exist
+    or the abort didn't land within `timeout`."""
+    from ..core import api
+
+    try:
+        handle = api.get_actor_or_none(f"__collective_{group_name}")
+        if handle is None:
+            return False
+        api.get(handle.abort_rounds.remote(), timeout=timeout)
+        return True
+    except Exception:  # noqa: BLE001 — rendezvous actor itself may be dying
+        return False
 
 
 def destroy_collective_group(group_name: str = "default"):
@@ -437,7 +504,14 @@ def send(tensor, dst_rank: int, group_name: str = "default"):
     g = _group(group_name)
     key = _p2p_key(g, g["rank"], dst_rank)
     ref = api.put(np.asarray(tensor))
-    api.get(g["info"].put_p2p.remote(key, [ref]))  # nested: stays a ref
+    ok = api.get(g["info"].put_p2p.remote(key, [ref]))  # nested: stays a ref
+    if not ok:
+        # Tombstoned round: the group was aborted while the receiver
+        # waited — the payload was refused, and pretending delivery
+        # succeeded would desync sender and receiver.
+        raise TimeoutError(
+            f"send to rank {dst_rank} aborted (group {group_name!r} aborted)"
+        )
 
 
 def recv(src_rank: int, group_name: str = "default"):
@@ -455,6 +529,7 @@ __all__ = [
     "Backend",
     "init_collective_group",
     "create_collective_group",
+    "abort_collective_group",
     "destroy_collective_group",
     "get_rank",
     "get_collective_group_size",

@@ -621,6 +621,16 @@ class ClusterBackend(RuntimeBackend):
              "value": value, "tags": tags, **extra}
         )
 
+    def poll_events(self, cursor: int = -1, kinds=None, limit: int = 2000) -> dict:
+        """Cursor-based read of controller timeline events (actor_restarting,
+        actor_death, node_died, chaos_worker_killed, ...). Returns
+        {"cursor": next_cursor, "events": [...]}; cursor=-1 subscribes from
+        the current tail. Used by the elastic-training gang supervisor."""
+        return self._request({
+            "type": "poll_events", "cursor": cursor,
+            "kinds": list(kinds or ()), "limit": limit,
+        })
+
     def prune_metrics(self, tags: dict) -> None:
         """Drop exported series whose tags include all of `tags`."""
         self._send({"type": "prune_metrics", "tags": tags})

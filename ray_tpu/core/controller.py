@@ -399,6 +399,9 @@ class Controller:
         # (reference: `python/ray/autoscaler/sdk` → GCS resource_request).
         self._explicit_demands: List[Dict[str, float]] = []
         self.timeline: List[dict] = []
+        # Absolute index of timeline[0] — lets poll_events cursors survive
+        # truncation (a cursor is "events seen so far", not a list index).
+        self._timeline_base = 0
         self.drivers: Set[Connection] = set()
         self._worker_counter = itertools.count()
         # Isolated-worker bookkeeping (runtime_env conda/container):
@@ -2991,8 +2994,7 @@ class Controller:
         per-task control traffic."""
         events = msg.get("events", ())
         self.timeline.extend(events)
-        if len(self.timeline) > 100_000:
-            del self.timeline[:50_000]
+        self._trim_timeline()
         names: Dict[str, str] = {}
         for ev in events:
             kind = ev.get("event")
@@ -3390,6 +3392,8 @@ class Controller:
             self._schedule()
         else:
             self._set_actor_state(astate, "dead")
+            self._event("actor_death", actor=actor_hex,
+                        restarts_used=astate.restarts_used)
             err = TaskError(ActorDiedError(), "", f"actor {actor_hex[:12]}")
             self._drain_actor_queue(astate, err)
             for ispec in astate.inflight.values():
@@ -4167,6 +4171,40 @@ class Controller:
             })
         return {"actors": out}
 
+    async def h_poll_events(self, conn, meta, msg):
+        """Cursor-based event subscription over the timeline (the same feed
+        `_event` writes actor_restarting/actor_death/node_died into). A
+        client passes its last cursor and an optional `kinds` filter and
+        gets every matching event since — the gang supervisor's
+        death-notification path (docs/ELASTIC_TRAINING.md). cursor=-1 means
+        "subscribe from now" (returns no events, just the tail cursor)."""
+        cursor = int(msg.get("cursor", -1))
+        if cursor < 0:
+            return {
+                "cursor": self._timeline_base + len(self.timeline),
+                "events": [],
+            }
+        # Clamp to the tail: a stale cursor from a previous controller
+        # lifetime (restore resets the timeline) must re-anchor to "now"
+        # instead of reading an empty feed until the new timeline catches
+        # up to the old count.
+        idx = min(max(cursor - self._timeline_base, 0), len(self.timeline))
+        kinds = set(msg.get("kinds") or ())
+        # Floor of 1: limit<=0 would never advance the cursor — a silently
+        # dead subscription instead of an error.
+        limit = max(1, int(msg.get("limit", 2000)))
+        events = []
+        tl = self.timeline
+        # The cursor advances only past SCANNED entries: when `limit` stops
+        # the collection early, unreturned matches stay ahead of the cursor
+        # for the next poll instead of being silently skipped.
+        while idx < len(tl) and len(events) < limit:
+            e = tl[idx]
+            if not kinds or e.get("event") in kinds:
+                events.append(e)
+            idx += 1
+        return {"cursor": self._timeline_base + idx, "events": events}
+
     async def h_list_objects(self, conn, meta, msg):
         limit = msg.get("limit", 1000)
         out = []
@@ -4421,10 +4459,17 @@ class Controller:
         finally:
             writer.close()
 
-    def _event(self, kind: str, **fields):
-        self.timeline.append({"ts": time.time(), "event": kind, **fields})
+    def _trim_timeline(self):
+        """Cap + cursor-base bookkeeping MUST move together: dropping
+        entries without advancing _timeline_base would silently shift
+        every poll_events cursor by the truncation amount."""
         if len(self.timeline) > 100_000:
             del self.timeline[:50_000]
+            self._timeline_base += 50_000
+
+    def _event(self, kind: str, **fields):
+        self.timeline.append({"ts": time.time(), "event": kind, **fields})
+        self._trim_timeline()
 
 
 async def run_controller(args: dict):

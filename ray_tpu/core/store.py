@@ -894,18 +894,18 @@ def make_store(
                 # Background warmup tracking the allocation watermark —
                 # object writes hit warm pages (core/mem.py rationale)
                 # without paying to fault capacity the session never uses.
-                # The closure's strong ref pins the Arena: __del__ (the only
-                # detach path in the creator) cannot run while the prefault
-                # thread holds it, and daemon threads are stopped before
-                # interpreter finalization — so the handle snapshot below
-                # cannot observe a concurrent detach.
-                def _used(a=arena):
-                    h = a._h
-                    if not h:  # defensive: explicit detach by future callers
-                        raise RuntimeError("arena detached")
-                    return a._lib.rt_arena_used(h)
-
-                mem.populate_watermark_async(arena._base, arena.capacity, _used)
+                # used_safe() holds the arena's handle lock across the
+                # native read, so an explicit detach() (borrow/attach churn,
+                # close paths, tests) can never free the handle between the
+                # snapshot and the dereference — the unlocked snapshot here
+                # was a use-after-free segfault under a concurrent
+                # create/borrow/detach loop (ISSUE 4 satellite; stress test
+                # in tests/test_arena.py). A raise inside used_safe() ends
+                # the prefault loop cleanly (mem.populate_watermark_async
+                # treats any used_fn exception as "arena gone").
+                mem.populate_watermark_async(
+                    arena._base, arena.capacity, arena.used_safe
+                )
         else:
             arena = Arena(name, create=False)
     except Exception:  # noqa: BLE001  (native build failed / arena absent)

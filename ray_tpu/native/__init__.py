@@ -199,6 +199,18 @@ class Arena:
             )
         self._base = lib.rt_arena_base(self._h)
         self._owner = create
+        # Guards the handle's LIFETIME for CROSS-THREAD readers: detach()
+        # frees the native handle, so a thread that snapshots self._h and
+        # then dereferences it races a concurrent detach into a
+        # use-after-free (the store's prefault thread reading used-bytes
+        # during a borrow/detach cycle was the observed segfault —
+        # core/store.py). used_safe() and detach() share this lock; the
+        # other methods stay unlocked by contract — they are only called
+        # from the thread that owns the handle's lifetime. Any future
+        # background reader must go through the lock like used_safe().
+        import threading
+
+        self._hlock = threading.RLock()
 
     # -------------------------------------------------------------- objects
     def create(self, object_id: str, size: int, with_offset: bool = False):
@@ -278,10 +290,20 @@ class Arena:
         buf = (ctypes.c_char * size).from_address(self._base + offset)
         return memoryview(buf).cast("B")
 
+    def used_safe(self) -> int:
+        """used-bytes read that is safe against a concurrent detach()
+        (raises RuntimeError once detached — callers like the prefault
+        thread treat that as "arena gone, stop")."""
+        with self._hlock:
+            if not self._h:
+                raise RuntimeError("arena detached")
+            return self._lib.rt_arena_used(self._h)
+
     def detach(self):
-        if self._h:
-            self._lib.rt_arena_detach(self._h)
-            self._h = None
+        with self._hlock:
+            if self._h:
+                self._lib.rt_arena_detach(self._h)
+                self._h = None
 
     def unlink(self):
         self._lib.rt_arena_unlink(self.name.encode())

@@ -21,12 +21,19 @@ from .config import (
     ScalingConfig,
 )
 from .checkpoint import Checkpoint
-from .session import get_checkpoint, get_context, get_dataset_shard, report
+from .session import (
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    get_elastic_session,
+    report,
+)
 from .result import Result
 from .base_trainer import BaseTrainer
 from .data_parallel_trainer import DataParallelTrainer
 from .gbdt_trainer import GBDTTrainer, XGBoostTrainer
 from .jax_trainer import JaxTrainer
+from . import elastic  # noqa: F401 — fault-tolerant gang training (ISSUE 4)
 from . import huggingface  # noqa: F401 — HF checkpoint interop (GPT-2 family)
 from . import torch_trainer as torch  # ray_tpu.train.torch.prepare_model(...)
 from .torch_trainer import TorchTrainer
@@ -36,6 +43,8 @@ __all__ = [
     "get_context",
     "get_checkpoint",
     "get_dataset_shard",
+    "get_elastic_session",
+    "elastic",
     "Checkpoint",
     "Result",
     "RunConfig",

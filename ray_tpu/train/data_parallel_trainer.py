@@ -17,8 +17,23 @@ class CollectiveBackend(Backend):
 
     def __init__(self, group_name: Optional[str] = None):
         self.group_name = group_name or f"train_{uuid.uuid4().hex[:8]}"
+        self._started_once = False
 
     def on_start(self, worker_group, scaling):
+        if self._started_once:
+            # Gang RESTART: the rendezvous actor still holds the dead
+            # incarnation's round state (partial refs, tombstones, stale
+            # membership) — a re-formed gang joining it would desync. Kill
+            # it; the new members' init_collective_group recreates a fresh
+            # one under the same name (and the world size may have shrunk
+            # within the elasticity band).
+            from .. import collective
+
+            try:
+                collective.destroy_collective_group(self.group_name)
+            except Exception:  # noqa: BLE001 — already gone
+                pass
+        self._started_once = True
         if len(worker_group) > 1:
             worker_group.setup_collective(self.group_name)
 
@@ -65,7 +80,10 @@ class DataParallelTrainer(BaseTrainer):
         if self.datasets:
             # Registered BEFORE start so gang restarts re-attach shards too.
             executor.set_datasets(self.datasets)
-        executor.start()
+        # No explicit start(): run() performs the first start through the
+        # same guarded path as restarts, so a member dying during the
+        # INITIAL gang formation also consumes FailureConfig budget and
+        # tears down the partial group instead of escaping fit().
         config = dict(self.train_loop_config)
         if isinstance(self.backend, CollectiveBackend):
             config.setdefault("collective_group", self.backend.group_name)

@@ -60,11 +60,26 @@ class _Session:
     def __init__(self, context: TrainContext):
         self.context = context
         self.results: "queue.Queue" = queue.Queue()
+        # Cursor-readable copy of every report: a poll RESPONSE lost in
+        # flight (the gang poll batch raising because a sibling died) must
+        # not lose this worker's reports — the executor re-reads from its
+        # last acknowledged index. Cursor polls implicitly ack (and trim)
+        # everything below the requested index, so memory stays bounded by
+        # the poll interval. The destructive queue stays for drain-style
+        # consumers (tune's tuner); cursor mode discards it.
+        self.history: list = []
+        self.history_base = 0  # absolute index of history[0]
         self.finished = threading.Event()
         self.error: Optional[BaseException] = None
+        # Lazily-created ElasticSession (train/elastic): async shard writer
+        # + deterministic-resume state for this worker. Owned here so the
+        # worker thread can flush it when the loop ends.
+        self.elastic = None
 
     def report(self, metrics: Dict[str, Any], checkpoint=None):
-        self.results.put({"metrics": dict(metrics), "checkpoint": checkpoint})
+        entry = {"metrics": dict(metrics), "checkpoint": checkpoint}
+        self.history.append(entry)
+        self.results.put(entry)
 
 
 _session: Optional[_Session] = None
@@ -123,3 +138,11 @@ def get_dataset_shard(name: str = "train"):
     if s is None:
         return None
     return s.context.dataset_shards.get(name)
+
+
+def get_elastic_session():
+    """The worker's ElasticSession (created on first use) — async sharded
+    checkpointing + deterministic resume. See ray_tpu.train.elastic."""
+    from .elastic import elastic_session
+
+    return elastic_session()

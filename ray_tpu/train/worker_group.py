@@ -63,21 +63,73 @@ class TrainWorker:
                 self.session.error = e
                 self.session.error_tb = traceback.format_exc()
             finally:
+                # Land any still-queued async checkpoint shards before the
+                # executor can treat this worker as finished — "finished"
+                # must imply "reported checkpoints are durable". close()
+                # also stops the writer thread (local mode shares one
+                # process across workers AND incarnations; a flush-only
+                # teardown would leak one parked thread per restart).
+                es = getattr(self.session, "elastic", None)
+                if es is not None:
+                    try:
+                        es.close()
+                    except Exception as ce:  # noqa: BLE001
+                        # A shard that never landed is a worker failure —
+                        # finishing "successfully" would let a later
+                        # restore silently resume from an older step.
+                        if self.session.error is None:
+                            self.session.error = ce
+                            self.session.error_tb = traceback.format_exc()
                 self.session.finished.set()
 
         self._thread = threading.Thread(target=target, daemon=True)
         self._thread.start()
         return True
 
-    def poll(self):
-        """Drain pending results; returns (results, finished, error_str)."""
-        out = []
-        while not self.session.results.empty():
-            out.append(self.session.results.get())
+    def poll(self, from_index=None):
+        """Returns (results, finished, error_str). With `from_index` (int),
+        a NON-destructive read of reports from that cursor — idempotent, so
+        a response lost in flight (gang poll batch failing on a dead
+        sibling) costs nothing: the caller re-polls from the same cursor.
+        Without it, drain semantics (tune's tuner polls this way)."""
+        # finished is read BEFORE the results snapshot: the loop thread
+        # appends its last report strictly before finished.set(), so
+        # finished=True here guarantees the snapshot below contains every
+        # report. Snapshot-then-read would let a final report land in the
+        # window and be dropped forever when the caller stops polling on
+        # finished=True.
+        finished = self.session.finished.is_set()
+        if from_index is None:
+            out = []
+            while not self.session.results.empty():
+                out.append(self.session.results.get())
+            # Drain consumers never cursor-ack, so retire the drained
+            # entries from the cursor history too — otherwise a long
+            # drain-polled run (tune's tuner) retains every report and
+            # in-memory checkpoint payload for the life of the worker.
+            n = min(len(out), len(self.session.history))
+            if n:
+                del self.session.history[:n]
+                self.session.history_base += n
+        else:
+            base = self.session.history_base
+            out = list(self.session.history[max(from_index - base, 0):])
+            # Implicit ack: a caller polling from N has durably consumed
+            # everything below N — trim it, and discard the legacy queue
+            # this consumer will never drain, so per-worker memory stays
+            # bounded by one poll interval on long runs.
+            if from_index > base:
+                del self.session.history[: from_index - base]
+                self.session.history_base = from_index
+            while not self.session.results.empty():
+                try:
+                    self.session.results.get_nowait()
+                except Exception:  # noqa: BLE001 — racing reporter, fine
+                    break
         err = None
         if self.session.error is not None:
             err = f"{self.session.error!r}\n{getattr(self.session, 'error_tb', '')}"
-        return out, self.session.finished.is_set(), err
+        return out, finished, err
 
     def set_checkpoint(self, checkpoint):
         self.context.latest_checkpoint = checkpoint
@@ -151,12 +203,21 @@ class WorkerGroup:
     def __len__(self):
         return len(self.workers)
 
+    def actor_ids(self) -> List[str]:
+        """Hex actor ids of the gang members — the unit the supervisor
+        watches for death events and chaos harnesses target for kills."""
+        return [w._id.hex() for w in self.workers]
+
     def run_async(self, fn: Callable, config=None):
         payload = self._cloudpickle.dumps((fn, config))
         return api.get([w.run.remote(payload) for w in self.workers])
 
-    def poll(self):
-        return api.get([w.poll.remote() for w in self.workers])
+    def poll(self, cursors: Optional[List[int]] = None):
+        if cursors is None:
+            return api.get([w.poll.remote() for w in self.workers])
+        return api.get(
+            [w.poll.remote(c) for w, c in zip(self.workers, cursors)]
+        )
 
     def execute_all(self, fn: Callable):
         payload = self._cloudpickle.dumps(fn)

@@ -43,6 +43,44 @@ def prune_series(tags: Dict[str, str]) -> None:
         fn({str(k): str(v) for k, v in tags.items()})
 
 
+_ELASTIC: Optional[Dict[str, "_Metric"]] = None
+_ELASTIC_LOCK = threading.Lock()
+
+
+def elastic_metrics() -> Dict[str, "_Metric"]:
+    """Elastic-training metric families (train/elastic emits these):
+    `elastic_restarts_total` counts gang restarts, `elastic_recovery_seconds`
+    is the death-to-reformed-gang MTTR distribution, and
+    `ckpt_save_overlap_seconds` is async-checkpoint write time hidden behind
+    training steps. Created lazily so importing metrics never boots a
+    runtime."""
+    global _ELASTIC
+    with _ELASTIC_LOCK:
+        if _ELASTIC is None:
+            _ELASTIC = {
+                "elastic_restarts_total": Counter(
+                    "elastic_restarts_total",
+                    "Gang restarts performed by the elastic train supervisor",
+                    tag_keys=("experiment",),
+                ),
+                "elastic_recovery_seconds": Histogram(
+                    "elastic_recovery_seconds",
+                    "Seconds from gang-member death to the re-formed gang "
+                    "(elastic training MTTR)",
+                    boundaries=(0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0),
+                    tag_keys=("experiment",),
+                ),
+                "ckpt_save_overlap_seconds": Histogram(
+                    "ckpt_save_overlap_seconds",
+                    "Async checkpoint shard write seconds overlapped with "
+                    "training (work the step did NOT stall on)",
+                    boundaries=(0.001, 0.005, 0.025, 0.1, 0.5, 2.5, 10.0),
+                    tag_keys=("experiment",),
+                ),
+            }
+        return _ELASTIC
+
+
 class _Metric:
     kind = "gauge"
 

@@ -55,3 +55,8 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "cluster: test boots the multiprocess cluster plane"
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: kill-based fault-injection test (SIGKILL/OOM of live "
+        "workers or nodes); tier-1-safe quick variants stay unmarked",
+    )

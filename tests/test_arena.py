@@ -93,6 +93,56 @@ class TestArena:
         p.join(timeout=30)
 
 
+class TestArenaLifecycle:
+    def test_prefault_borrow_detach_stress(self):
+        """ISSUE 4 satellite: the prefault thread's `rt_arena_used` handle
+        snapshot raced a concurrent borrow/detach into a use-after-free
+        segfault (core/store.py:906). used_safe() holds the handle lock, so
+        a create/borrow/detach loop under the prefault thread (plus an
+        extra per-borrow used_safe hammer) must survive — 3 consecutive
+        runs, per the acceptance criterion. A regression here crashes the
+        interpreter, not the assert."""
+        import threading
+
+        from ray_tpu.core import mem
+
+        for run in range(3):
+            name = f"/rtpu-stress-{os.getpid()}-{run}"
+            a = Arena(name, capacity=1 << 22, create=True)
+            try:
+                # The store's prefault thread, tracking this arena's
+                # watermark through the lock-guarded reader.
+                mem.populate_watermark_async(
+                    a._base, a.capacity, a.used_safe, chunk=1 << 20,
+                    name=f"stress-prefault-{run}",
+                )
+                for i in range(25):
+                    b = Arena(name, create=False)  # borrow: second attach
+                    racing = threading.Thread(
+                        target=_hammer_used, args=(b,), daemon=True
+                    )
+                    racing.start()
+                    v = b.create(f"o{run}-{i}", 4096)
+                    v[:4] = b"abcd"
+                    v.release()
+                    b.seal(f"o{run}-{i}")
+                    b.detach()  # races the hammer's used_safe reads
+                    racing.join(timeout=10)
+                    assert not racing.is_alive()
+            finally:
+                a.unlink()
+                a.detach()  # races the prefault thread's used_safe reads
+            assert a._h is None
+
+
+def _hammer_used(arena):
+    while True:
+        try:
+            arena.used_safe()
+        except RuntimeError:
+            return  # detached — the loop must end HERE, never in a segfault
+
+
 class TestArenaStore:
     def test_put_read_roundtrip(self, arena):
         s = store.ArenaStore(arena)

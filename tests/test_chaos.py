@@ -35,6 +35,7 @@ class TestConfigRegistry:
         assert "inline_threshold_bytes" in flags and flags["lineage_cap"] == 20_000
 
 
+@pytest.mark.chaos
 def test_worker_killer_tasks_survive():
     """Tasks with retries complete despite a WorkerKiller murdering busy
     workers mid-flight (VERDICT item 10 done-criterion: FT tests use the
@@ -60,6 +61,7 @@ def test_worker_killer_tasks_survive():
         ray_tpu.shutdown()
 
 
+@pytest.mark.chaos
 def test_node_killer_node_death_recovery():
     from ray_tpu.cluster_utils import Cluster
 
@@ -87,6 +89,7 @@ def test_node_killer_node_death_recovery():
         cluster.shutdown()
 
 
+@pytest.mark.chaos
 def test_memory_monitor_kills_runaway_worker(monkeypatch):
     """A worker allocating past the node's memory budget is killed by the
     memory monitor and its task fails with an OOM-labelled error; the rest
@@ -129,6 +132,7 @@ def test_memory_monitor_kills_runaway_worker(monkeypatch):
         rt_config._reset_cache_for_tests()
 
 
+@pytest.mark.chaos
 def test_memory_monitor_retries_then_succeeds(monkeypatch):
     """An OOM-killed task with retries left is retried (and can succeed if
     the pressure was transient — modelled by a marker file)."""

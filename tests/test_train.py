@@ -1,5 +1,7 @@
 """ray_tpu.train tests (reference analog: `python/ray/train/tests`)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -120,6 +122,135 @@ def test_error_surfaces_after_max_failures(tmp_path):
     )
     result = trainer.fit()
     assert result.error is not None and "always fails" in result.error
+
+
+def test_start_failure_raises_deterministic_error(tmp_path):
+    """A gang that never came up (deterministic start error, zero training
+    progress, budget exhausted) must raise the ORIGINAL exception out of
+    fit() — a config bug folded into Result.error is too easy to miss."""
+    from ray_tpu.train.backend_executor import Backend
+
+    class BrokenBackend(Backend):
+        def on_start(self, worker_group, scaling):
+            raise ValueError("bad backend config")
+
+    trainer = DataParallelTrainer(
+        lambda config: None,
+        backend=BrokenBackend(),
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    )
+    with pytest.raises(ValueError, match="bad backend config"):
+        trainer.fit()
+
+
+class TestCheckpointManager:
+    """ISSUE 4 satellites: crash-safe registration + resume-latest."""
+
+    @staticmethod
+    def _mgr(tmp_path, **kw):
+        from ray_tpu.train.checkpoint import CheckpointManager
+
+        return CheckpointManager(str(tmp_path / "managed"), **kw)
+
+    def test_register_is_crash_safe(self, tmp_path):
+        from ray_tpu.train.checkpoint import MANAGER_COMMIT_MARKER
+
+        mgr = self._mgr(tmp_path)
+        dest = mgr.register(Checkpoint.from_dict({"step": 1}), {"step": 1})
+        # Commit discipline: no stray .tmp staging dir, marker present.
+        assert os.path.exists(os.path.join(dest, MANAGER_COMMIT_MARKER))
+        assert not os.path.exists(dest + ".tmp")
+        assert Checkpoint(dest).to_dict()["step"] == 1
+
+    def test_topk_eviction_tie_keeps_newest(self, tmp_path):
+        mgr = self._mgr(
+            tmp_path, num_to_keep=2, score_attribute="score", score_order="max"
+        )
+        p1 = mgr.register(Checkpoint.from_dict({"v": 1}), {"score": 1.0})
+        p2 = mgr.register(Checkpoint.from_dict({"v": 2}), {"score": 1.0})
+        p3 = mgr.register(Checkpoint.from_dict({"v": 3}), {"score": 1.0})
+        # All scores tie: the OLDEST registration is evicted, never the
+        # most recent (resume paths want the newest checkpoint).
+        assert not os.path.exists(p1)
+        assert os.path.exists(p2) and os.path.exists(p3)
+        assert mgr.latest().path == p3
+        assert mgr.best().path == p3  # ties rank newer-first too
+
+    def test_adopted_entry_never_evicts_latest_own(self, tmp_path):
+        """A better-scored checkpoint ADOPTED from a previous process must
+        not evict this run's only registration: latest()/best() exclude
+        adopted entries, so that eviction would leave the manager with no
+        checkpoint at all (and register() returning a deleted path)."""
+        mgr1 = self._mgr(
+            tmp_path, num_to_keep=1, score_attribute="score", score_order="max"
+        )
+        adopted = mgr1.register(Checkpoint.from_dict({"v": 1}), {"score": 0.9})
+        mgr2 = self._mgr(
+            tmp_path, num_to_keep=1, score_attribute="score", score_order="max"
+        )
+        own = mgr2.register(Checkpoint.from_dict({"v": 2}), {"score": 0.5})
+        assert os.path.exists(own)
+        assert not os.path.exists(adopted)  # displaced despite higher score
+        assert mgr2.latest() is not None and mgr2.latest().path == own
+
+    def test_topk_eviction_tie_keeps_newest_min_order(self, tmp_path):
+        mgr = self._mgr(
+            tmp_path, num_to_keep=1, score_attribute="score", score_order="min"
+        )
+        p1 = mgr.register(Checkpoint.from_dict({"v": 1}), {"score": 5.0})
+        p2 = mgr.register(Checkpoint.from_dict({"v": 2}), {"score": 5.0})
+        assert not os.path.exists(p1) and os.path.exists(p2)
+
+    def test_resume_latest_skips_uncommitted(self, tmp_path):
+        import shutil
+
+        from ray_tpu.train.checkpoint import MANAGER_COMMIT_MARKER, resume_latest
+
+        mgr = self._mgr(tmp_path)
+        mgr.register(Checkpoint.from_dict({"step": 1}), {"step": 1})
+        p2 = mgr.register(Checkpoint.from_dict({"step": 2}), {"step": 2})
+        # Fake a crash mid-registration of checkpoint 3: dir exists, marker
+        # doesn't. And a stale staging dir from an even earlier crash.
+        crashed = os.path.join(mgr.directory, "checkpoint_000003")
+        shutil.copytree(p2, crashed)
+        os.remove(os.path.join(crashed, MANAGER_COMMIT_MARKER))
+        os.makedirs(os.path.join(mgr.directory, "checkpoint_000004.tmp"))
+        resumed = resume_latest(mgr.directory)
+        assert resumed is not None and resumed.path == p2
+        assert resumed.to_dict()["step"] == 2
+
+    def test_fresh_manager_adopts_existing_numbering(self, tmp_path):
+        from ray_tpu.train.checkpoint import resume_latest
+
+        mgr = self._mgr(tmp_path)
+        mgr.register(Checkpoint.from_dict({"step": 1}), {})
+        mgr.register(Checkpoint.from_dict({"step": 2}), {})
+        # A resumed process's fresh manager continues the sequence — it
+        # must not restart at 1 (clobbering the committed checkpoint) nor
+        # leave the dead run's higher numbers shadowing new saves.
+        mgr2 = self._mgr(tmp_path)
+        p3 = mgr2.register(Checkpoint.from_dict({"step": 3}), {})
+        assert p3.endswith("checkpoint_000003")
+        assert resume_latest(mgr2.directory).to_dict()["step"] == 3
+
+    def test_fresh_manager_enforces_num_to_keep_across_restart(self, tmp_path):
+        mgr = self._mgr(tmp_path, num_to_keep=2)
+        p1 = mgr.register(Checkpoint.from_dict({"step": 1}), {})
+        p2 = mgr.register(Checkpoint.from_dict({"step": 2}), {})
+        # The resumed manager ADOPTS the old run's entries, so its evictions
+        # see them — otherwise each restart would strand num_to_keep dirs.
+        mgr2 = self._mgr(tmp_path, num_to_keep=2)
+        p3 = mgr2.register(Checkpoint.from_dict({"step": 3}), {})
+        assert not os.path.exists(p1)
+        assert os.path.exists(p2) and os.path.exists(p3)
+        assert mgr2.latest().path == p3
+
+    def test_resume_latest_empty_dir(self, tmp_path):
+        from ray_tpu.train.checkpoint import resume_latest
+
+        assert resume_latest(str(tmp_path)) is None
+        assert resume_latest(str(tmp_path / "missing")) is None
 
 
 def test_jax_trainer_pytree_checkpoint(tmp_path):

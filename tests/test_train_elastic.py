@@ -1,0 +1,670 @@
+"""Elastic training tests (ISSUE 4 / VERDICT item 4).
+
+Quick variants (unmarked, tier-1-safe) cover the checkpoint plane, the
+supervisor policy, and a local-mode gang restart with deterministic resume.
+The `chaos`-marked tests boot the multiprocess cluster and SIGKILL gang
+members mid-step — the acceptance criterion: the whole mesh aborts within
+the supervisor deadline (no wedged barrier), the gang restarts, and
+training resumes from the last committed checkpoint with a continuous step
+counter and a loss trajectory matching an unkilled run.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.train import (
+    DataParallelTrainer,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.elastic import (
+    COMMIT_MARKER,
+    AsyncShardWriter,
+    ElasticState,
+    GangSupervisor,
+    ShardedCheckpoint,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --------------------------------------------------------------------------
+# Checkpoint plane (no runtime needed)
+# --------------------------------------------------------------------------
+class TestShardedCheckpoint:
+    def test_save_commit_restore_roundtrip(self, tmp_path):
+        root = str(tmp_path)
+        w0 = AsyncShardWriter(root, 0, 2, gen="g1")
+        w1 = AsyncShardWriter(root, 1, 2, gen="g1")
+        st = ElasticState(step=3, data_offsets={"train": 6})
+        w0.save(3, {"x": np.arange(4.0)}, st)
+        w1.save(3, {"x": np.arange(4.0) + 10}, st)
+        assert w0.flush() and w1.flush()
+        step, ckpt_dir = ShardedCheckpoint.latest_committed(root)
+        assert step == 3 and os.path.exists(os.path.join(ckpt_dir, COMMIT_MARKER))
+        state, tree = ShardedCheckpoint.restore(root, 1, 2)
+        assert state.step == 3 and state.data_offsets["train"] == 6
+        np.testing.assert_array_equal(tree["x"], np.arange(4.0) + 10)
+        w0.close()
+        w1.close()
+
+    def test_uncommitted_dir_is_skipped(self, tmp_path):
+        root = str(tmp_path)
+        w0 = AsyncShardWriter(root, 0, 2, gen="g1")
+        w1 = AsyncShardWriter(root, 1, 2, gen="g1")
+        w0.save(1, {"x": np.ones(2)}, ElasticState(step=1))
+        w1.save(1, {"x": np.ones(2)}, ElasticState(step=1))
+        assert w0.flush() and w1.flush()
+        # Step 2: only ONE rank's shard lands (the other "crashed") — the
+        # group commit never fires, so step 1 stays the restorable truth.
+        w0.save(2, {"x": np.ones(2) * 2}, ElasticState(step=2))
+        w0.flush()
+        assert ShardedCheckpoint.latest_committed(root)[0] == 1
+        state, _ = ShardedCheckpoint.restore(root, 0, 2)
+        assert state.step == 1
+
+    def test_restore_reshards_on_world_change(self, tmp_path):
+        root = str(tmp_path)
+        writers = [AsyncShardWriter(root, r, 2, gen="a") for r in range(2)]
+        shards = [np.arange(4.0), np.arange(4.0) + 10]
+        for r, w in enumerate(writers):
+            w.save(1, {"x": shards[r], "lr": np.float64(0.1)}, ElasticState(step=1))
+        assert all(w.flush() for w in writers)
+        # 2 -> 4: each new rank gets a quarter of the concatenation.
+        _, t = ShardedCheckpoint.restore(root, 3, 4)
+        np.testing.assert_array_equal(t["x"], np.array([12.0, 13.0]))
+        assert float(t["lr"]) == 0.1  # 0-d leaves are replicated
+        # 2 -> 1: the full concatenation.
+        _, t = ShardedCheckpoint.restore(root, 0, 1)
+        np.testing.assert_array_equal(t["x"], np.concatenate(shards))
+        for w in writers:
+            w.close()
+
+    def test_incarnations_never_mix(self, tmp_path):
+        """Shards from a dead incarnation must not combine with a new one's
+        into a committed checkpoint: the gen token keys the directory."""
+        root = str(tmp_path)
+        # Incarnation A: rank 0 of world 2 saves step 2, rank 1 "died".
+        wa = AsyncShardWriter(root, 0, 2, gen="aa")
+        wa.save(2, {"x": np.zeros(2)}, ElasticState(step=2))
+        wa.flush()
+        # Incarnation B re-runs step 2; only rank 1 has landed so far.
+        wb = AsyncShardWriter(root, 1, 2, gen="bb")
+        wb.save(2, {"x": np.ones(2)}, ElasticState(step=2))
+        wb.flush()
+        # A's shard_0 + B's shard_1 both exist for step 2 — but in
+        # DIFFERENT dirs, so neither commits.
+        assert ShardedCheckpoint.latest_committed(root) is None
+        wa.close()
+        wb.close()
+
+    def test_retention_prunes_old_checkpoints(self, tmp_path):
+        root = str(tmp_path)
+        # A stale marker-less partial from a dead incarnation, older than
+        # everything the live run will keep.
+        stale = os.path.join(root, "step_00000001.dead")
+        os.makedirs(stale)
+        open(os.path.join(stale, "shard_00000.pkl"), "wb").close()
+        w = AsyncShardWriter(root, 0, 1, gen="g", keep=2)
+        for step in (2, 3, 4, 5):
+            w.save(step, {"x": np.full(2, float(step))}, ElasticState(step=step))
+            assert w.flush()
+        steps = [s for s, _ in ShardedCheckpoint.list_checkpoints(root)]
+        assert steps == [4, 5], "older dirs (incl. the stale partial) pruned"
+        assert ShardedCheckpoint.latest_committed(root)[0] == 5
+        w.close()
+
+    def test_reshard_uses_lens_sidecars_not_full_shards(
+        self, tmp_path, monkeypatch
+    ):
+        """Pass 1 of a world-size-changed restore reads the tiny lens
+        sidecars, not every full shard: for 4 saved shards and a rank
+        whose slice overlaps only shard 3, exactly shard 0 (structure +
+        replicated leaves) and shard 3 (the data) get unpickled. Deleting
+        the sidecars falls back to unpickling with the same result."""
+        root = str(tmp_path)
+        writers = [AsyncShardWriter(root, r, 4, gen="a") for r in range(4)]
+        for r, w in enumerate(writers):
+            w.save(1, {"x": np.arange(2.0) + 2 * r}, ElasticState(step=1))
+        assert all(w.flush() for w in writers)
+        for w in writers:
+            w.close()
+
+        loads = []
+        real = ShardedCheckpoint.load_shard
+
+        def counting(ckpt_dir, rank):
+            loads.append(rank)
+            return real(ckpt_dir, rank)
+
+        monkeypatch.setattr(ShardedCheckpoint, "load_shard", counting)
+        # 4 -> 4 would be the same-world path; ask for rank 3 of 4 -> 2:
+        # rank 1 of 2 owns rows 4..7 = shards 2 and 3.
+        _, t = ShardedCheckpoint.restore(root, 1, 2)
+        np.testing.assert_array_equal(t["x"], np.arange(4.0) + 4)
+        assert sorted(set(loads)) == [0, 2, 3], loads
+
+        loads.clear()
+        _, ckpt_dir = ShardedCheckpoint.latest_committed(root)
+        for r in range(4):
+            os.remove(os.path.join(ckpt_dir, f"shard_{r:05d}.lens.json"))
+        _, t = ShardedCheckpoint.restore(root, 1, 2)
+        np.testing.assert_array_equal(t["x"], np.arange(4.0) + 4)
+        assert sorted(set(loads)) == [0, 1, 2, 3], loads
+
+    def test_data_offsets_are_world_size_independent(self):
+        st = ElasticState(step=1, data_offsets={"train": 7})
+        # Global sample 7 is the next unconsumed; ranks stride the world.
+        assert [st.local_offset("train", r, 3) for r in range(3)] == [9, 7, 8]
+        assert [st.local_offset("train", r, 2) for r in range(2)] == [8, 7]
+
+
+def test_async_save_does_not_block_step(tmp_path, monkeypatch):
+    """The overlap guarantee: save() returns after the host snapshot even
+    when the backing store is slow — the write happens behind the step."""
+    from ray_tpu.train.elastic import ckpt as ckpt_mod
+
+    real_write = ckpt_mod._write_atomic
+    write_s = 0.4
+
+    def slow_write(path, data, tmp=None):
+        time.sleep(write_s)
+        real_write(path, data, tmp=tmp)
+
+    monkeypatch.setattr(ckpt_mod, "_write_atomic", slow_write)
+    w = AsyncShardWriter(str(tmp_path), 0, 1, gen="g")
+    tree = {"x": np.zeros(1 << 16)}
+    t0 = time.monotonic()
+    w.save(1, tree, ElasticState(step=1))
+    blocked = time.monotonic() - t0
+    assert blocked < write_s / 2, f"save() blocked {blocked:.3f}s on the write"
+    assert w.flush(timeout=30.0)
+    assert w.last_write_s >= write_s  # the hidden (overlapped) work
+    assert ShardedCheckpoint.latest_committed(str(tmp_path))[0] == 1
+    w.close()
+
+
+def test_kill_during_async_save_preserves_previous_commit(tmp_path):
+    """A SIGKILL landing mid-shard-write must leave the previous committed
+    checkpoint restorable (atomicity acceptance test): the victim commits
+    step 1, then is killed halfway through step 2's shard bytes."""
+    root = str(tmp_path)
+    child_src = f"""
+import os, sys, time
+sys.path.insert(0, {REPO!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np
+from ray_tpu.train.elastic import AsyncShardWriter, ElasticState
+from ray_tpu.train.elastic import ckpt as ckpt_mod
+
+root = sys.argv[1]
+w = AsyncShardWriter(root, 0, 1, gen="a")
+w.save(1, {{"x": np.arange(8.0)}}, ElasticState(step=1))
+assert w.flush()
+
+real = ckpt_mod._write_atomic
+def half_then_hang(path, data):
+    with open(path + ".tmp", "wb") as f:
+        f.write(data[: len(data) // 2])
+        f.flush(); os.fsync(f.fileno())
+    print("MIDWRITE", flush=True)
+    time.sleep(120)
+ckpt_mod._write_atomic = half_then_hang
+w2 = AsyncShardWriter(root, 0, 1, gen="b")
+w2.save(2, {{"x": np.arange(8.0) + 1}}, ElasticState(step=2))
+time.sleep(120)
+"""
+    proc = subprocess.Popen(
+        [sys.executable, "-c", child_src, root],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        deadline = time.monotonic() + 120
+        saw_midwrite = False
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                break
+            if "MIDWRITE" in line:
+                saw_midwrite = True
+                break
+        assert saw_midwrite, "child never reached the mid-write point"
+        os.kill(proc.pid, signal.SIGKILL)
+    finally:
+        proc.kill()
+        proc.wait(timeout=30)
+    step, _ = ShardedCheckpoint.latest_committed(root)
+    assert step == 1, "the partial step-2 save must not be visible"
+    state, tree = ShardedCheckpoint.restore(root, 0, 1)
+    assert state.step == 1
+    np.testing.assert_array_equal(tree["x"], np.arange(8.0))
+
+
+# --------------------------------------------------------------------------
+# Supervisor policy (no runtime needed)
+# --------------------------------------------------------------------------
+class TestSupervisorPolicy:
+    def test_budget_and_backoff(self):
+        sup = GangSupervisor(
+            ScalingConfig(num_workers=4),
+            FailureConfig(max_failures=3, backoff_base_s=0.5, backoff_max_s=2.0),
+        )
+        backoffs = []
+        for _ in range(3):
+            d = sup.on_failure("boom")
+            assert not d.stop
+            backoffs.append(d.backoff_s)
+        assert backoffs == [0.5, 1.0, 2.0]  # exponential, capped
+        # No backend: capacity unknowable → plan demands the full size.
+        assert sup.plan_world_size() == 4
+        assert sup.on_failure("boom").stop  # budget exhausted
+
+    def test_budget_zero_keeps_legacy_fail_fast(self):
+        sup = GangSupervisor(ScalingConfig(num_workers=2), FailureConfig())
+        assert sup.on_failure("boom").stop
+
+    def test_elasticity_band(self):
+        s = ScalingConfig(num_workers=4, min_workers=2, max_workers=4)
+        assert s.pick_world_size(None) == 4  # unknown capacity: demand full
+        assert s.pick_world_size(3) == 3     # shrink within the band
+        assert s.pick_world_size(1) == 2     # never below min_workers
+        assert s.pick_world_size(9) == 4     # never above max_workers
+        # Band disabled: restarts always demand the original world size.
+        rigid = ScalingConfig(num_workers=4)
+        assert rigid.pick_world_size(1) == 4
+
+
+# --------------------------------------------------------------------------
+# Local-mode gang restart: deterministic resume (tier-1-safe quick variant)
+# --------------------------------------------------------------------------
+def _deterministic_loop(config):
+    """x += (step+1)*0.5 each step; rank 0 dies once at fail_at (pre-report)
+    in its first incarnation. Per-step values depend only on (step, restored
+    x), so a restart that resumes from the committed state reproduces the
+    unkilled trajectory exactly."""
+    import os as _os
+
+    import numpy as _np
+
+    from ray_tpu import train as _train
+    from ray_tpu.train import elastic as _elastic
+
+    ctx = _train.get_context()
+    sess = _elastic.elastic_session()
+    tree = sess.restore()
+    x = tree["x"] if tree is not None else _np.zeros(4)
+    for step in range(sess.state.step, config["total_steps"]):
+        fail_at = config.get("fail_at")
+        if (
+            fail_at is not None
+            and ctx.get_world_rank() == 0
+            and step == fail_at
+            and not _os.path.exists(config["marker"])
+        ):
+            open(config["marker"], "w").close()
+            raise RuntimeError("injected gang failure")
+        x = x + (step + 1) * 0.5
+        _train.report({"step": step, "x0": float(x[0]), "rank": ctx.get_world_rank()})
+        sess.save(
+            step + 1,
+            {"x": x},
+            data_offsets={"train": (step + 1) * ctx.get_world_size()},
+        )
+    sess.flush()
+
+
+def _last_value_per_step(history):
+    out = {}
+    for m in history:
+        out[int(m["step"])] = m["x0"]
+    return out
+
+
+def test_gang_restart_resumes_deterministically(tmp_path, local_runtime):
+    total = 6
+    kill_cfg = {
+        "total_steps": total,
+        "fail_at": 3,
+        "marker": str(tmp_path / "died_once"),
+    }
+    killed = DataParallelTrainer(
+        _deterministic_loop,
+        train_loop_config=kill_cfg,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(
+            storage_path=str(tmp_path / "killed"),
+            failure_config=FailureConfig(max_failures=1, backoff_base_s=0.01),
+        ),
+    ).fit()
+    assert killed.error is None, killed.error
+    assert os.path.exists(kill_cfg["marker"]), "failure was never injected"
+
+    clean = DataParallelTrainer(
+        _deterministic_loop,
+        train_loop_config={"total_steps": total},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(storage_path=str(tmp_path / "clean")),
+    ).fit()
+    assert clean.error is None, clean.error
+
+    got = _last_value_per_step(killed.metrics_history)
+    want = _last_value_per_step(clean.metrics_history)
+    assert sorted(got) == list(range(total)), "step counter not continuous"
+    for step in range(total):
+        assert got[step] == pytest.approx(want[step]), (
+            f"trajectory diverged at step {step}: {got[step]} != {want[step]}"
+        )
+    # The resumed run restored global data offsets too. The elastic root
+    # carries a per-run namespace level (unnamed run → one anon token dir).
+    ns_parent = os.path.join(str(tmp_path / "killed"), "run", "elastic")
+    (run_ns,) = os.listdir(ns_parent)
+    root = os.path.join(ns_parent, run_ns)
+    state, _ = ShardedCheckpoint.restore(root, 0, 2)
+    assert state.step == total
+    assert state.data_offsets["train"] == total * 2
+
+
+def test_elastic_session_kwargs_conflict_is_loud(tmp_path, local_runtime):
+    """A cached session cannot honor different construction kwargs — a
+    mode='sharded' caller silently handed the cached replicated-mode
+    session would get rank-0-overwrites-everyone restores after an elastic
+    reshard. The second call must raise, and matching kwargs must not."""
+
+    def loop(config):
+        from ray_tpu.train import elastic as _elastic
+
+        sess = _elastic.elastic_session()
+        assert _elastic.elastic_session(mode="replicated") is sess
+        try:
+            _elastic.elastic_session(mode="sharded")
+        except RuntimeError as e:
+            assert "conflicts" in str(e)
+        else:
+            raise AssertionError("conflicting kwargs silently accepted")
+
+    result = DataParallelTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    ).fit()
+    assert result.error is None, result.error
+
+
+# --------------------------------------------------------------------------
+# Controller death-event feed (the supervisor's subscription path)
+# --------------------------------------------------------------------------
+@pytest.mark.cluster
+def test_poll_events_reports_gang_member_death():
+    from ray_tpu.core import api
+
+    ray_tpu.init(num_cpus=2)
+    try:
+        backend = api._global_runtime().backend
+        cursor = backend.poll_events(cursor=-1)["cursor"]
+
+        @ray_tpu.remote
+        class Member:
+            def ping(self):
+                return True
+
+        a = Member.remote()
+        ray_tpu.get(a.ping.remote())
+        workers = backend._request({"type": "list_workers"})["workers"]
+        wid = next(
+            w["worker_id"] for w in workers if w.get("actor") == a._id.hex()
+        )
+        backend._request({"type": "kill_worker", "worker_id": wid})
+
+        seen = set()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and "actor_death" not in seen:
+            resp = backend.poll_events(
+                cursor=cursor, kinds=("actor_death", "chaos_worker_killed")
+            )
+            cursor = resp["cursor"]
+            for ev in resp["events"]:
+                if ev.get("event") == "actor_death" and ev.get("actor") == a._id.hex():
+                    seen.add("actor_death")
+                if ev.get("event") == "chaos_worker_killed":
+                    seen.add("chaos_worker_killed")
+            time.sleep(0.05)
+        assert "actor_death" in seen, "death event never reached the feed"
+    finally:
+        ray_tpu.shutdown()
+
+
+# --------------------------------------------------------------------------
+# Chaos acceptance: SIGKILL a gang member mid-step (VERDICT item 4)
+# --------------------------------------------------------------------------
+def _make_gang_loop():
+    # A closure (not a module-level function): cloudpickle ships it by
+    # VALUE — gang workers cannot import the test module by name.
+    def _gang_loop(config):
+        import time as _t
+
+        import numpy as _np
+
+        from ray_tpu import collective as _coll
+        from ray_tpu import train as _train
+        from ray_tpu.train import elastic as _elastic
+
+        ctx = _train.get_context()
+        sess = _elastic.elastic_session()
+        tree = sess.restore()
+        x = tree["x"] if tree is not None else _np.zeros(2)
+        for step in range(sess.state.step, config["total_steps"]):
+            # Cross-worker coupling every step: a dead peer leaves the
+            # survivor blocked HERE — the wedge the supervisor must break.
+            g = _coll.allreduce(
+                _np.full(2, float(step + 1)),
+                group_name=config["collective_group"],
+            )
+            x = x + 0.1 * g
+            _train.report(
+                {"step": step, "x0": float(x[0]), "rank": ctx.get_world_rank()}
+            )
+            sess.save(step + 1, {"x": x})
+            _t.sleep(config.get("step_sleep", 0.0))
+        sess.flush()
+
+    return _gang_loop
+
+
+@pytest.mark.chaos
+@pytest.mark.cluster
+def test_sigkill_gang_worker_mesh_aborts_and_resumes(tmp_path):
+    """SIGKILL one gang worker mid-step → the whole mesh aborts cleanly
+    within the supervisor deadline (the survivor is released from the
+    collective, no wedged barrier), the gang restarts, and training resumes
+    from the last committed checkpoint with a continuous step counter and
+    the exact unkilled trajectory."""
+    from ray_tpu.core import api
+    from ray_tpu.train.backend_executor import BackendExecutor
+    from ray_tpu.train.data_parallel_trainer import CollectiveBackend
+
+    total = 14  # wide enough that the killer always lands mid-run, even
+    # with the driver thread starved on a loaded box
+    ray_tpu.init(num_cpus=4)
+    try:
+        backend = CollectiveBackend()
+        run_cfg = RunConfig(
+            storage_path=str(tmp_path / "killed"),
+            failure_config=FailureConfig(max_failures=2, backoff_base_s=0.05),
+        )
+        ex = BackendExecutor(
+            backend, ScalingConfig(num_workers=2), run_cfg,
+            experiment_name="chaos",
+        )
+        ex.start()
+        victim_hex = ex.worker_group.actor_ids()[1]
+        elastic_root = os.path.join(
+            run_cfg.resolve_storage(), "elastic", ex.elastic_run_ns
+        )
+        killed = {}
+
+        def killer():
+            deadline = time.monotonic() + 90
+            while time.monotonic() < deadline:
+                found = ShardedCheckpoint.latest_committed(elastic_root)
+                if found is not None and found[0] >= 2:
+                    break
+                time.sleep(0.02)
+            rt = api._global_runtime().backend
+            workers = rt._request({"type": "list_workers"})["workers"]
+            pid = next(
+                (w.get("pid") for w in workers if w.get("actor") == victim_hex),
+                0,
+            )
+            if pid:
+                os.kill(pid, signal.SIGKILL)
+                killed["t"] = time.monotonic()
+                killed["pid"] = pid
+
+        th = threading.Thread(target=killer, daemon=True)
+        th.start()
+        result = ex.run(
+            _make_gang_loop(),
+            {
+                "collective_group": backend.group_name,
+                "total_steps": total,
+                "step_sleep": 0.05,
+            },
+        )
+        t_done = time.monotonic()
+        sup = ex._supervisor
+        ex.shutdown()
+
+        assert killed.get("pid"), "killer thread never fired"
+        assert result.error is None, result.error
+        assert sup.attempts >= 1, "the gang never restarted"
+        # Mesh abort + re-form happened within a bounded window — no
+        # barrier waited out its 300s round timeout.
+        assert sup.last_recovery_s is not None and sup.last_recovery_s < 60
+        assert t_done - killed["t"] < 90
+
+        got = _last_value_per_step(result.metrics_history)
+        assert sorted(got) == list(range(total)), "step counter not continuous"
+        # Unkilled trajectory, exactly: x0 after step s = 0.2 * sum_{i<=s}(i+1)
+        for s in range(total):
+            want = 0.2 * sum(i + 1 for i in range(s + 1))
+            assert got[s] == pytest.approx(want), f"diverged at step {s}"
+    finally:
+        ray_tpu.shutdown()
+
+
+@pytest.mark.chaos
+@pytest.mark.cluster
+def test_sigkill_rank0_history_backfilled_from_survivor(tmp_path):
+    """SIGKILL specifically RANK 0 — the canonical metrics source. Its
+    reported-but-unpolled steps die with its process; the salvage pass must
+    backfill them from the surviving rank so the run's step trajectory
+    stays continuous (the hole the rank-1-kill acceptance test can't see)."""
+    from ray_tpu.core import api
+    from ray_tpu.train.backend_executor import BackendExecutor
+    from ray_tpu.train.data_parallel_trainer import CollectiveBackend
+
+    total = 14
+    ray_tpu.init(num_cpus=4)
+    try:
+        backend = CollectiveBackend()
+        run_cfg = RunConfig(
+            storage_path=str(tmp_path / "killed0"),
+            failure_config=FailureConfig(max_failures=2, backoff_base_s=0.05),
+        )
+        ex = BackendExecutor(
+            backend, ScalingConfig(num_workers=2), run_cfg,
+            experiment_name="chaos-rank0",
+        )
+        ex.start()
+        victim_hex = ex.worker_group.actor_ids()[0]  # rank 0
+        elastic_root = os.path.join(
+            run_cfg.resolve_storage(), "elastic", ex.elastic_run_ns
+        )
+        killed = {}
+
+        def killer():
+            deadline = time.monotonic() + 90
+            while time.monotonic() < deadline:
+                found = ShardedCheckpoint.latest_committed(elastic_root)
+                if found is not None and found[0] >= 2:
+                    break
+                time.sleep(0.02)
+            rt = api._global_runtime().backend
+            workers = rt._request({"type": "list_workers"})["workers"]
+            pid = next(
+                (w.get("pid") for w in workers if w.get("actor") == victim_hex),
+                0,
+            )
+            if pid:
+                os.kill(pid, signal.SIGKILL)
+                killed["pid"] = pid
+
+        th = threading.Thread(target=killer, daemon=True)
+        th.start()
+        result = ex.run(
+            _make_gang_loop(),
+            {
+                "collective_group": backend.group_name,
+                "total_steps": total,
+                "step_sleep": 0.05,
+            },
+        )
+        sup = ex._supervisor
+        ex.shutdown()
+
+        assert killed.get("pid"), "killer thread never fired"
+        assert result.error is None, result.error
+        assert sup.attempts >= 1, "the gang never restarted"
+        got = _last_value_per_step(result.metrics_history)
+        assert sorted(got) == list(range(total)), (
+            f"step counter not continuous after rank-0 kill: {sorted(got)}"
+        )
+        for s in range(total):
+            want = 0.2 * sum(i + 1 for i in range(s + 1))
+            assert got[s] == pytest.approx(want), f"diverged at step {s}"
+    finally:
+        ray_tpu.shutdown()
+
+
+@pytest.mark.chaos
+@pytest.mark.cluster
+def test_gang_killer_kills_only_targets():
+    """GangKiller SIGKILLs exactly the targeted gang members' processes."""
+    from ray_tpu.util.chaos import GangKiller
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        @ray_tpu.remote
+        class Member:
+            def ping(self):
+                return os.getpid()
+
+        a, b = Member.remote(), Member.remote()
+        ray_tpu.get([a.ping.remote(), b.ping.remote()])
+
+        Killer = ray_tpu.remote(GangKiller)
+        killer = Killer.remote(
+            interval_s=0.2, max_kills=1, actor_ids=[a._id.hex()]
+        )
+        killer.run.remote()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if ray_tpu.get(killer.kills.remote()):
+                break
+            time.sleep(0.2)
+        kills = ray_tpu.get(killer.kills.remote())
+        assert len(kills) == 1, "GangKiller never fired"
+        with pytest.raises(Exception):
+            ray_tpu.get(a.ping.remote(), timeout=30)
+        assert ray_tpu.get(b.ping.remote(), timeout=30)  # bystander survives
+    finally:
+        ray_tpu.shutdown()
