@@ -71,7 +71,7 @@ def ppermute(x, axis: AxisName, perm: Sequence[tuple]):
 
 def ring_shift(x, axis: str, shift: int = 1):
     """Send x to (rank+shift) mod n along `axis`; returns the received block."""
-    n = jax.lax.axis_size(axis)
+    n = axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return jax.lax.ppermute(x, axis_name=axis, perm=perm)
 
@@ -81,7 +81,10 @@ def axis_index(axis: AxisName):
 
 
 def axis_size(axis: str) -> int:
-    return jax.lax.axis_size(axis)
+    # jax.lax.axis_size only exists in newer JAX; psum of a Python constant
+    # over a named axis constant-folds to the axis size at trace time, so
+    # the result stays a static int (ppermute tables need it).
+    return jax.lax.psum(1, axis)
 
 
 def barrier_jit(axis: AxisName):

@@ -914,53 +914,103 @@ def _rope_qk(cfg: GPTConfig, q, k, rope_tables, positions):
     return _rope_rotate(q, c, s), _rope_rotate(k, c, s)
 
 
-def prefill_paged(params, tokens, real_len, block_table, kv, cfg: GPTConfig):
-    """Prompt prefill into the paged cache, one sequence per call.
+def prefill_paged(params, tokens, real_len, pos_offset, block_table, kv,
+                  cfg: GPTConfig):
+    """Prompt prefill into the paged cache, one CHUNK of one sequence per
+    call (chunked prefill: a long prompt lands a slice per engine step so
+    decode streams keep emitting between slices).
 
-    tokens [1, Sp] right-padded to the shape bucket; `real_len` (traced
-    scalar) marks the prompt's true length; `block_table` [W] int32 maps its
-    blocks. K/V of padded positions scatter to the null block. Returns
-    (next-token logits [V] f32, kv) — logits are read at real_len-1, not at
-    the padded tail.
+    tokens [1, Sp] right-padded to the shape bucket holds
+    prompt[pos_offset : pos_offset + real_len]; `real_len` / `pos_offset`
+    are traced scalars (one compiled program per (Sp, W) bucket pair covers
+    every chunk length and offset); `block_table` [W] int32 maps the
+    sequence's blocks. Each layer scatters the chunk's K/V to its (block,
+    offset) slots FIRST, then attends over the gathered table history —
+    prefix-cache hits and earlier chunks' KV below `pos_offset` are read
+    from the cache, never recomputed, and a monolithic prefill is just the
+    pos_offset=0 chunk covering the whole prompt. K/V of padded positions
+    scatter to the null block. Returns (next-token logits [V] f32 at global
+    position pos_offset + real_len - 1, kv) — only meaningful on the FINAL
+    chunk of a prompt.
     """
     if cfg.mlp_type == "moe":
         raise NotImplementedError("paged decode does not support MoE yet")
     _, Sp = tokens.shape
     BS = kv["k"].shape[3]
     W = block_table.shape[0]
-    positions = jnp.arange(Sp)
-    x = params["tok_embed"][tokens].astype(cfg.dtype)
+    M = W * BS
+    H, Dh = cfg.n_heads, cfg.d_head
+    scale = 1.0 / math.sqrt(cfg.d_head)
+    rel = jnp.arange(Sp)
+    positions = pos_offset + rel                 # global token positions [Sp]
+    x = params["tok_embed"][tokens].astype(cfg.dtype)  # [1, Sp, E]
     if cfg.pos == "learned":
         x = x + params["pos_embed"][positions].astype(cfg.dtype)
     rope_tables = None
     if cfg.pos == "rotary":
         rd = min(cfg.rotary_dim, cfg.d_head)
         rope_tables = rope_frequencies(rd, cfg.max_seq, dtype=jnp.float32)
-    layer_stack = {k: params[k] for k in _LAYER_KEYS if k in params}
-    icfg = dataclasses.replace(cfg, remat=False, remat_policy=None)
-
-    def scan_body(x, layer_params):
-        x, (aux, k, v) = _block(
-            icfg, rope_tables, None, x, layer_params, positions, return_kv=True
-        )
-        return x, (k, v)
-
-    x, (ks, vs) = jax.lax.scan(scan_body, x, layer_stack)  # [L, 1, H, Sp, Dh]
-
-    valid = positions < real_len
+    valid = rel < real_len
     phys = jnp.where(valid, block_table[jnp.minimum(positions // BS, W - 1)], 0)
     off = positions % BS
-    # kv[:, phys, :, off] — advanced dims lead: [Sp, L, H, Dh].
-    kv = {
-        "k": kv["k"].at[:, phys, :, off].set(
-            ks[:, 0].transpose(2, 0, 1, 3).astype(kv["k"].dtype)
-        ),
-        "v": kv["v"].at[:, phys, :, off].set(
-            vs[:, 0].transpose(2, 0, 1, 3).astype(kv["v"].dtype)
-        ),
-    }
+    cols = jnp.arange(M)
+    layer_stack = {k: params[k] for k in _LAYER_KEYS if k in params}
+
+    def scan_body(x, inp):
+        layer_params, kk, vv = inp  # kk/vv: [NB, H, BS, Dh]
+        p = jax.tree_util.tree_map(lambda a: a.astype(cfg.dtype), layer_params)
+        h = _norm(x, p["ln1_w"], p["ln1_b"], cfg.norm)
+        qkv = jnp.einsum("bse,ethd->btshd", h, p["w_qkv"]) + p["b_qkv"][:, None]
+        q, k, v = (
+            qkv[:, i].transpose(0, 2, 1, 3).reshape(1, H, Sp, Dh)
+            for i in range(3)
+        )
+        if cfg.pos == "rotary":
+            cos, sin = rope_tables
+            rd = min(cfg.rotary_dim, Dh)
+            c, s = cos[positions], sin[positions]
+            q = jnp.concatenate(
+                [apply_rope(q[..., :rd], c, s, None), q[..., rd:]], -1
+            ) if rd < Dh else apply_rope(q, c, s, None)
+            k = jnp.concatenate(
+                [apply_rope(k[..., :rd], c, s, None), k[..., rd:]], -1
+            ) if rd < Dh else apply_rope(k, c, s, None)
+        # Scatter the chunk's K/V to each position's (block, offset) slot,
+        # then gather the WHOLE table history — cached prefix, earlier
+        # chunks, and this chunk all come back through one path.
+        kk = kk.at[phys, :, off].set(k[0].transpose(1, 0, 2).astype(kk.dtype))
+        vv = vv.at[phys, :, off].set(v[0].transpose(1, 0, 2).astype(vv.dtype))
+        gk = kk[block_table].transpose(1, 0, 2, 3).reshape(H, M, Dh)
+        gv = vv[block_table].transpose(1, 0, 2, 3).reshape(H, M, Dh)
+        scores = jnp.einsum(
+            "hsd,htd->hst", q[0], gk, preferred_element_type=jnp.float32
+        ) * scale                                    # [H, Sp, M]
+        scores = jnp.where(
+            cols[None, None, :] <= positions[None, :, None], scores, -1e30
+        )
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("hst,htd->hsd", probs.astype(gv.dtype), gv)
+        attn_out = jnp.einsum("bhsd,hde->bse", attn[None], p["w_o"]) + p["b_o"]
+
+        if cfg.parallel_block:
+            mlp_in = h
+        else:
+            x = x + attn_out
+            mlp_in = _norm(x, p["ln2_w"], p["ln2_b"], cfg.norm)
+        u = jnp.einsum("bse,ef->bsf", mlp_in, p["w_in"]) + p["b_in"]
+        if cfg.activation == "swiglu":
+            g = jnp.einsum("bse,ef->bsf", mlp_in, p["w_gate"])
+            u = jax.nn.silu(g) * u
+        else:
+            u = jax.nn.gelu(u)
+        mlp_out = jnp.einsum("bsf,fe->bse", u, p["w_out"]) + p["b_out"]
+        out = x + attn_out + mlp_out if cfg.parallel_block else x + mlp_out
+        return out, (kk, vv)
+
+    x, (ks, vs) = jax.lax.scan(scan_body, x, (layer_stack, kv["k"], kv["v"]))
+    kv = {"k": ks, "v": vs}
     x = _norm(x, params["ln_f_w"], params["ln_f_b"], cfg.norm)
-    h = x[0, jnp.maximum(real_len - 1, 0)]  # [E] — last REAL position
+    h = x[0, jnp.maximum(real_len - 1, 0)]  # [E] — last REAL chunk position
     head = params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = jnp.einsum("e,ev->v", h, head.astype(cfg.dtype))
     return logits.astype(jnp.float32), kv

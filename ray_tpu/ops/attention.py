@@ -694,7 +694,10 @@ def ring_attention(
     neighbor ICI transfers, overlapped by XLA with the matmuls). Causal
     masking uses global positions, so fully-masked steps contribute nothing.
     """
-    n = jax.lax.axis_size(axis)
+    # jax.lax.axis_size only exists in newer JAX; psum of a Python constant
+    # over a named axis constant-folds to the axis size at trace time, so `n`
+    # stays a static int (the scan length and ppermute table need it).
+    n = jax.lax.psum(1, axis)
     my = jax.lax.axis_index(axis)
     B, H, S_local, D = q.shape
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)

@@ -160,7 +160,9 @@ class ShardingRules:
                 cleaned.append(None)
             elif isinstance(entry, tuple):
                 kept = tuple(a for a in entry if mesh.shape.get(a, 1) > 1)
-                cleaned.append(kept if kept else None)
+                # Unwrap singletons like spec() does — this jax's
+                # PartitionSpec treats ('dp',) and 'dp' as distinct.
+                cleaned.append(kept[0] if len(kept) == 1 else (kept or None))
             else:
                 cleaned.append(entry if mesh.shape.get(entry, 1) > 1 else None)
         from jax.sharding import PartitionSpec

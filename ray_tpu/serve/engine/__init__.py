@@ -16,12 +16,13 @@ resolve lazily; the schedulers stay importable in lightweight contexts.
 """
 
 from .kv_manager import KVBlockManager, KVCacheExhausted, KVStats
-from .scheduler import Scheduler, SchedulerOutput, Sequence
+from .scheduler import PrefillChunk, Scheduler, SchedulerOutput, Sequence
 
 __all__ = [
     "KVBlockManager",
     "KVCacheExhausted",
     "KVStats",
+    "PrefillChunk",
     "Scheduler",
     "SchedulerOutput",
     "Sequence",

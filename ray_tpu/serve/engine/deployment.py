@@ -12,6 +12,13 @@ exits first. Use `@serve.batch` for stateless fixed-shape scoring; use
 The replica runs with max_concurrency > 1: a `generate` call blocked
 draining its stream must not gate another caller's `submit` — the actual
 compute all happens on the engine's single driver thread regardless.
+
+`engine_options` accepts every `EngineOptions` field; the serving-throughput
+knobs (see serve/README.md "Prefix caching + chunked prefill"):
+`enable_prefix_caching` (default on — repeated system prompts skip straight
+to their first cold KV block), `max_step_tokens` / `prefill_chunk_tokens`
+(chunked prefill: long prompts land a bounded slice per iteration instead
+of stalling the decode streams).
 """
 
 from __future__ import annotations

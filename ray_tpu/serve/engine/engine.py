@@ -5,13 +5,19 @@ PagedAttention cache, here driving `models/gpt.py`'s paged decode path).
 One `step()` is one model iteration:
 
     1. `Scheduler.schedule()` re-forms the working set — admits queued
-       prompts the moment the KV free list covers them, preempts on
-       exhaustion (finished sequences were already retired and their blocks
-       freed at the END of the previous step).
-    2. Admitted prompts prefill (one jitted program per prompt, prompt
-       length padded to a power-of-two bucket) and emit their first token —
-       that's TTFT, decoupled from everything else in flight.
-    3. All RUNNING sequences advance one token through ONE jitted
+       prompts the moment the KV budget (free + reclaimable cached blocks)
+       covers them, preempts on exhaustion (finished sequences were already
+       retired and their blocks freed at the END of the previous step).
+       Admission allocates by PREFIX-CACHE lookup first: a prompt whose
+       leading full blocks are already resident skips straight to the first
+       cold token.
+    2. Prefill advances in CHUNKS under a per-step token budget (one jitted
+       program per (chunk, width) bucket): each step lands at most
+       `prefill_chunk_tokens` of one prompt, so a long prompt never stalls
+       the decode streams for a monolithic prefill. The final chunk emits
+       the first token — that's TTFT, decoupled from everything else in
+       flight.
+    3. All fully-prefilled sequences advance one token through ONE jitted
        `decode_step_paged` call — batch padded to a power-of-two lane
        bucket and block-table width bucket, so XLA compiles a bounded set
        of programs no matter how the working set churns.
@@ -55,7 +61,7 @@ def _paged_jits():
         from ...models.gpt import decode_step_paged, prefill_paged
 
         _JITS = (
-            jax.jit(prefill_paged, static_argnums=(5,), donate_argnums=(4,)),
+            jax.jit(prefill_paged, static_argnums=(6,), donate_argnums=(5,)),
             jax.jit(decode_step_paged, static_argnums=(5,), donate_argnums=(4,)),
         )
     return _JITS
@@ -67,6 +73,16 @@ class EngineOptions:
     block_size: int = 16          # token slots per block
     max_num_seqs: int = 8         # decode-batch lane ceiling
     max_prefills_per_step: int = 1
+    # Chunked prefill: per-step token budget (decode lanes cost 1 each,
+    # prefill chunks spend the rest) and the per-chunk length cap — a long
+    # prompt lands `prefill_chunk_tokens` per step instead of stalling every
+    # decode stream for one monolithic prefill.
+    max_step_tokens: int = 256
+    prefill_chunk_tokens: int = 64
+    # Automatic prefix caching: full KV blocks are content-hashed and
+    # shared; a prompt whose prefix is cached skips straight to the first
+    # cold block. Freed blocks are retained (reclaimable, LRU-evicted).
+    enable_prefix_caching: bool = True
     temperature: float = 0.0      # 0 = greedy
     seed: int = 0
 
@@ -117,12 +133,16 @@ class InferenceEngine:
             self.cfg, self.opts.num_blocks, self.opts.block_size
         )
         self.block_manager = KVBlockManager(
-            self.opts.num_blocks, self.opts.block_size
+            self.opts.num_blocks,
+            self.opts.block_size,
+            enable_prefix_caching=self.opts.enable_prefix_caching,
         )
         self.scheduler = Scheduler(
             self.block_manager,
             max_num_seqs=self.opts.max_num_seqs,
             max_prefills_per_step=self.opts.max_prefills_per_step,
+            max_step_tokens=self.opts.max_step_tokens,
+            prefill_chunk=self.opts.prefill_chunk_tokens,
         )
         # cfg is static (hashable frozen dataclass); kv buffers are donated
         # — each call consumes self.kv and hands back its successor.
@@ -183,6 +203,27 @@ class InferenceEngine:
             self._m_tpot = Histogram(
                 "serve_engine_tpot_s", "time per output token after the first"
             )
+            self._m_pc_hits = Counter(
+                "serve_engine_prefix_cache_hits_total",
+                "KV blocks served from the prefix cache",
+            )
+            self._m_pc_misses = Counter(
+                "serve_engine_prefix_cache_misses_total",
+                "cacheable KV blocks that had to be computed",
+            )
+            self._m_pc_evict = Counter(
+                "serve_engine_prefix_cache_evictions_total",
+                "cached KV blocks reclaimed for new allocations",
+            )
+            self._m_step_tokens = Histogram(
+                "serve_engine_step_budget_tokens",
+                "tokens scheduled per engine step "
+                "(decode lanes + prefill chunk tokens)",
+                boundaries=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
+            )
+            # Counters export monotonic increments; the KV manager keeps
+            # lifetime totals — ship deltas since the last step.
+            self._kv_exported = {"hits": 0, "misses": 0, "evictions": 0}
             try:
                 # Under Serve, tag every series with its replica so scrapes
                 # distinguish replicas and the controller can prune a
@@ -194,7 +235,9 @@ class InferenceEngine:
                         "replica": ctx.replica_tag}
                 for m in (self._m_queue, self._m_running, self._m_kv,
                           self._m_tps, self._m_tokens, self._m_preempt,
-                          self._m_ttft, self._m_tpot):
+                          self._m_ttft, self._m_tpot, self._m_pc_hits,
+                          self._m_pc_misses, self._m_pc_evict,
+                          self._m_step_tokens):
                     m.set_default_tags(tags)
             except Exception:  # noqa: BLE001 — engine used outside Serve
                 pass
@@ -217,6 +260,17 @@ class InferenceEngine:
                 self._m_ttft.observe(t)
             for t in stats["step_tpots"]:
                 self._m_tpot.observe(t)
+            for key, counter in (
+                ("hits", self._m_pc_hits),
+                ("misses", self._m_pc_misses),
+                ("evictions", self._m_pc_evict),
+            ):
+                delta = stats[f"prefix_cache_{key}"] - self._kv_exported[key]
+                if delta > 0:
+                    counter.inc(delta)
+                    self._kv_exported[key] += delta
+            if stats["step_budget_tokens"]:
+                self._m_step_tokens.observe(stats["step_budget_tokens"])
         except Exception:  # noqa: BLE001 — no runtime in unit tests
             pass
 
@@ -373,35 +427,61 @@ class InferenceEngine:
         except Exception:  # noqa: BLE001 — tracing is never load-bearing
             pass
 
-    def _run_prefill(self, seq: Sequence):
+    def _apply_cow(self):
+        """Land queued copy-on-write block copies (shared block forked by
+        the scheduler) on the physical KV arrays before any kernel reads
+        them. Rare — only fork-shared partial blocks ever trigger it."""
+        copies = self.block_manager.drain_cow()
+        if not copies:
+            return
+        jnp = self._jnp
+        src = jnp.asarray([s for s, _ in copies])
+        dst = jnp.asarray([d for _, d in copies])
+        self.kv = {
+            name: arr.at[:, dst].set(arr[:, src])
+            for name, arr in self.kv.items()
+        }
+
+    def _run_prefill(self, chunk):
+        """One prefill chunk: compute prompt[start : start+n] into the paged
+        cache. Only the FINAL chunk samples the first token (TTFT)."""
+        seq = chunk.seq
         rec = self._trace_info.get(seq.request_id)
         if rec is not None and "admit_t" not in rec:
             rec["admit_t"] = time.time()
         jnp = self._jnp
         np = self._np
         table = self.block_manager.block_table(seq.request_id)
-        P = len(seq.prompt)
+        L = chunk.num_tokens
         # Same bucketing primitive as the scheduler's decode shapes —
         # agreement between the two is what bounds the XLA program set.
-        Sp = _next_pow2(P)
+        Sp = _next_pow2(L)
         W = _next_pow2(len(table))
         tokens = np.zeros((1, Sp), np.int32)
-        tokens[0, :P] = seq.prompt
+        tokens[0, :L] = seq.prompt[chunk.start:chunk.start + L]
         bt = np.zeros((W,), np.int32)
         bt[: len(table)] = table
         logits, self.kv = self._prefill(
             self.params,
             jnp.asarray(tokens),
-            jnp.asarray(P, jnp.int32),
+            jnp.asarray(L, jnp.int32),
+            jnp.asarray(chunk.start, jnp.int32),
             jnp.asarray(bt),
             self.kv,
             self.cfg,
         )
-        tok = self._sample(np.asarray(logits))
-        self._emit(seq, tok)
-        if rec is not None:
-            rec.setdefault("first_t", time.time())
-        self._maybe_finish(seq)
+        seq.num_computed = chunk.start + L
+        # The chunk's KV is landed — its newly-FULL blocks are now safe to
+        # serve as prefix-cache hits for later prompts.
+        self.block_manager.register_computed(
+            seq.request_id, seq.prompt, seq.num_computed
+        )
+        if chunk.last:
+            tok = self._sample(np.asarray(logits))
+            self._emit(seq, tok)
+            if rec is not None:
+                rec.setdefault("first_t", time.time())
+            self._maybe_finish(seq)
 
     def _run_decode(self, out: SchedulerOutput):
         jnp = self._jnp
@@ -447,8 +527,9 @@ class InferenceEngine:
             if rec is not None:
                 rec.pop("admit_t", None)
                 rec.pop("first_t", None)
-        for seq in out.prefills:
-            self._run_prefill(seq)
+        self._apply_cow()
+        for chunk in out.prefills:
+            self._run_prefill(chunk)
         if out.decodes:
             self._run_decode(out)
 
@@ -460,6 +541,11 @@ class InferenceEngine:
             "running": self.scheduler.num_running,
             "kv_utilization": kv_stats.utilization,
             "kv_free_blocks": kv_stats.free_blocks,
+            "kv_cached_blocks": kv_stats.cached_blocks,
+            "prefix_cache_hits": kv_stats.hits,
+            "prefix_cache_misses": kv_stats.misses,
+            "prefix_cache_evictions": kv_stats.evictions,
+            "step_budget_tokens": out.step_tokens,
             "tokens_per_s": (
                 len(self._tok_window) / max(now - self._tok_window[0], 1e-3)
                 if self._tok_window
@@ -486,6 +572,10 @@ class InferenceEngine:
             "queue_depth": self.scheduler.queue_depth,
             "running": self.scheduler.num_running,
             "kv_utilization": kv_stats.utilization,
+            "kv_cached_blocks": kv_stats.cached_blocks,
+            "prefix_cache_hits": kv_stats.hits,
+            "prefix_cache_misses": kv_stats.misses,
+            "prefix_cache_evictions": kv_stats.evictions,
             "total_tokens": self.total_tokens,
             "total_finished": self.total_finished,
             "total_preemptions": self.total_preemptions,

@@ -1,4 +1,5 @@
-"""Paged KV-cache block manager (reference-era analog: vLLM's BlockManager,
+"""Paged KV-cache block manager with automatic prefix caching
+(reference-era analog: vLLM's BlockManager + its hash-based prefix cache,
 `vllm/core/block_manager.py` — the PagedAttention half of iteration-level
 scheduling).
 
@@ -6,15 +7,33 @@ The physical KV cache is a fixed pool of `num_blocks` blocks of
 `block_size` token slots each (the engine owns the actual [L, NB, H, BS, Dh]
 arrays; this class owns only the *map*). Each live sequence holds an ordered
 block table — logical token position `p` lives in physical block
-`table[p // block_size]` at offset `p % block_size`. Blocks are never
-shared (no prefix caching yet) and never compacted: fragmentation is
-internal to the last block of each sequence only, so utilization accounting
-distinguishes *allocated* slots from *used* token slots.
+`table[p // block_size]` at offset `p % block_size`.
+
+Prefix caching: every FULL block whose KV has been computed is registered
+under a content hash CHAINED over token ids (block i's key commits to every
+token in blocks 0..i, so two sequences share a block only when their entire
+prefixes match). Blocks are refcounted; `allocate_cached` walks a new
+prompt's chain through the hash index and reuses every leading hit — the
+prefill skips straight to the first cold block. Freed blocks whose content
+is registered are RETAINED on an LRU "cached" list instead of being blanked:
+they serve future hits, yet remain reclaimable — the free list exhausting
+falls back to evicting the coldest cached block. Admission math
+(`can_allocate` / `free_blocks`) therefore counts blank + cached blocks;
+`KVStats.utilization` counts only live (referenced) blocks.
+
+Invariants (enforced by `check_invariants`):
+  * every block is blank (free list) XOR cached (ref 0, content retained)
+    XOR live (ref >= 1) — never two at once, none lost;
+  * a block's refcount equals its number of table references;
+  * a refcounted-shared block is NEVER written in place: extending a
+    sequence into a shared block forks it copy-on-write — the manager
+    rewrites the table and queues a (src, dst) physical copy for the engine
+    (`drain_cow`); only full, immutable blocks are ever hash-shared.
 
 Admission control rides on `can_allocate`: the scheduler refuses (queues,
-never crashes) a prefill whose prompt + first token doesn't fit the free
-list, and preempts the youngest running sequence when decode growth hits
-the budget mid-flight.
+never crashes) a prefill whose prompt + first token doesn't fit
+blank + reclaimable blocks, and preempts the youngest running sequence when
+decode growth hits the budget mid-flight.
 
 Block 0 is RESERVED as the null/scratch block: the engine pads decode
 batches to bucket shapes by pointing dummy lanes' block tables at block 0,
@@ -24,54 +43,102 @@ so their writes land somewhere harmless. It is never handed out.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List
+import hashlib
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
 
 
 class KVCacheExhausted(RuntimeError):
-    """Raised by allocate/grow when the free list cannot cover the request.
+    """Raised by allocate/grow when blank + evictable blocks cannot cover
+    the request.
 
     The scheduler treats this as back-pressure (requeue/preempt), never as a
     crash — it reaches user code only on programming errors (e.g. a prompt
     longer than the whole pool, which `fits_ever` screens at submit)."""
 
 
+def _chain_hash(prev: bytes, tokens: Sequence[int]) -> bytes:
+    """Content key of one full block given its predecessor's key — collision
+    resistance matters (a collision would silently serve another prompt's
+    KV), so this is a real hash, not Python's."""
+    h = hashlib.blake2b(prev, digest_size=16)
+    h.update(b",".join(str(int(t)).encode() for t in tokens))
+    return h.digest()
+
+
 @dataclasses.dataclass(frozen=True)
 class KVStats:
     num_blocks: int          # allocatable blocks (excludes the null block)
-    free_blocks: int
-    used_blocks: int
+    free_blocks: int         # allocatable NOW: blank + reclaimable cached
+    used_blocks: int         # referenced by >= 1 live sequence
+    cached_blocks: int       # ref == 0 but content retained (subset of free)
     num_seqs: int
-    utilization: float       # allocated fraction of the pool, 0..1
+    utilization: float       # LIVE fraction of the pool, 0..1
+    hits: int = 0            # full blocks reused from the prefix cache
+    misses: int = 0          # cacheable full blocks that had to be computed
+    evictions: int = 0       # cached blocks reclaimed for new allocations
+    cow_copies: int = 0      # copy-on-write forks of shared blocks
 
 
 class KVBlockManager:
-    """Free-list allocator mapping sequence ids to ordered block tables."""
+    """Refcounting free-list allocator mapping sequence ids to ordered block
+    tables, with a chained-hash prefix cache over full blocks."""
 
     NULL_BLOCK = 0
 
-    def __init__(self, num_blocks: int, block_size: int):
+    def __init__(
+        self,
+        num_blocks: int,
+        block_size: int,
+        enable_prefix_caching: bool = True,
+    ):
         if num_blocks < 2:
             raise ValueError("need >= 2 blocks (block 0 is the null block)")
         if block_size < 1:
             raise ValueError("block_size must be >= 1")
         self.block_size = block_size
         self.num_blocks = num_blocks
+        self.caching = enable_prefix_caching
         # Block 0 reserved; LIFO free list so recently-freed (cache-warm)
         # blocks are reused first.
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        # ref == 0 blocks whose content is still registered: insertion order
+        # is recency (oldest first = LRU eviction order).
+        self._cached: "OrderedDict[int, None]" = OrderedDict()
+        self._ref: Dict[int, int] = {}            # live blocks only
         self._tables: Dict[str, List[int]] = {}
-        self._lens: Dict[str, int] = {}   # tokens stored per sequence
+        self._lens: Dict[str, int] = {}           # tokens stored per sequence
+        self._hash_of: Dict[int, bytes] = {}      # registered block -> key
+        self._index: Dict[bytes, int] = {}        # key -> canonical block
+        self._chain: Dict[str, List[bytes]] = {}  # per-seq registered keys
+        # (src, dst) physical copies the ENGINE must apply before the next
+        # kernel launch — the manager owns only the map.
+        self._pending_copies: List[Tuple[int, int]] = []
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.cow_copies = 0
 
     # ------------------------------------------------------------- queries
     @property
     def free_blocks(self) -> int:
-        return len(self._free)
+        """Blocks allocatable right now (blank + evictable cached)."""
+        return len(self._free) + self._evictable()
+
+    def _evictable(self) -> int:
+        # Cached blocks that are the source of a still-pending COW copy must
+        # survive until the engine applies it; they drop out of the
+        # reclaimable count until drain_cow().
+        if not self._pending_copies:
+            return len(self._cached)
+        protected = {s for s, _ in self._pending_copies}
+        return sum(1 for b in self._cached if b not in protected)
 
     def blocks_for(self, num_tokens: int) -> int:
         return -(-num_tokens // self.block_size)  # ceil div
 
     def can_allocate(self, num_tokens: int) -> bool:
-        return self.blocks_for(num_tokens) <= len(self._free)
+        return self.blocks_for(num_tokens) <= self.free_blocks
 
     def fits_ever(self, num_tokens: int) -> bool:
         """Could this many tokens fit an EMPTY pool? (submit-time sanity)"""
@@ -85,80 +152,284 @@ class KVBlockManager:
 
     def stats(self) -> KVStats:
         total = self.num_blocks - 1
-        used = total - len(self._free)
+        live = len(self._ref)
         return KVStats(
             num_blocks=total,
-            free_blocks=len(self._free),
-            used_blocks=used,
+            free_blocks=len(self._free) + self._evictable(),
+            used_blocks=live,
+            cached_blocks=len(self._cached),
             num_seqs=len(self._tables),
-            utilization=used / total if total else 0.0,
+            utilization=live / total if total else 0.0,
+            hits=self.hits,
+            misses=self.misses,
+            evictions=self.evictions,
+            cow_copies=self.cow_copies,
         )
+
+    # ------------------------------------------------------- block plumbing
+    def _acquire(self) -> int:
+        """One blank block: the free list first, then LRU-evict the coldest
+        cached block (its index entry dies with it)."""
+        if self._free:
+            return self._free.pop()
+        protected = {s for s, _ in self._pending_copies}
+        for b in self._cached:
+            if b not in protected:
+                del self._cached[b]
+                h = self._hash_of.pop(b)
+                del self._index[h]
+                self.evictions += 1
+                return b
+        raise KVCacheExhausted("KV pool exhausted (no blank or evictable blocks)")
+
+    def _incref(self, b: int) -> None:
+        if b in self._ref:
+            self._ref[b] += 1
+        else:  # reviving a cached (ref 0) block
+            del self._cached[b]
+            self._ref[b] = 1
+
+    def _release_one(self, b: int) -> None:
+        r = self._ref[b] - 1
+        if r > 0:
+            self._ref[b] = r
+            return
+        del self._ref[b]
+        if b in self._hash_of:
+            # Content stays findable: most-recently-freed lands at the LRU
+            # tail, so eviction takes the coldest prefix first.
+            self._cached[b] = None
+        else:
+            assert b != self.NULL_BLOCK and b not in self._free, (
+                f"block {b} double-freed"
+            )
+            self._free.append(b)
 
     # --------------------------------------------------------- allocation
     def allocate(self, seq_id: str, num_tokens: int) -> List[int]:
-        """Claim blocks for a new sequence of `num_tokens` tokens.
+        """Claim blocks for a new sequence of `num_tokens` tokens, with no
+        cache lookup (token ids unknown). Raises KVCacheExhausted when
+        blank + evictable blocks can't cover it (the caller keeps the
+        request queued) and ValueError on reuse of a live seq_id."""
+        table, _ = self.allocate_cached(seq_id, None, num_tokens)
+        return table
 
-        Raises KVCacheExhausted when the free list can't cover it (the
-        caller keeps the request queued) and ValueError on reuse of a live
-        seq_id (a scheduler bug, not back-pressure)."""
+    def allocate_cached(
+        self,
+        seq_id: str,
+        token_ids: Optional[Sequence[int]],
+        num_tokens: int,
+    ) -> Tuple[List[int], int]:
+        """Claim blocks for a new sequence, reusing every leading full block
+        whose chained content hash is already registered.
+
+        `token_ids` is the prompt (length <= num_tokens; the surplus covers
+        generated tokens). Returns (block_table, cached_tokens):
+        `cached_tokens` prompt positions already hold valid KV — the prefill
+        starts at that offset. At least one prompt token is always left cold
+        so the engine has a real position to read next-token logits from.
+        """
         if seq_id in self._tables:
             raise ValueError(f"sequence {seq_id!r} already has an allocation")
         if num_tokens < 1:
             raise ValueError("allocate needs >= 1 token")
-        need = self.blocks_for(num_tokens)
-        if need > len(self._free):
+        if token_ids is not None and len(token_ids) > num_tokens:
+            raise ValueError("token_ids longer than the allocation")
+        need_total = self.blocks_for(num_tokens)
+        hit_blocks: List[int] = []
+        chain: List[bytes] = []
+        if self.caching and token_ids is not None and len(token_ids) > 1:
+            # Cap: never serve the WHOLE prompt from cache — the last
+            # position must be recomputed to produce first-token logits.
+            cacheable = (len(token_ids) - 1) // self.block_size
+            prev = b""
+            for i in range(cacheable):
+                h = _chain_hash(
+                    prev,
+                    token_ids[i * self.block_size:(i + 1) * self.block_size],
+                )
+                b = self._index.get(h)
+                if b is None:
+                    break
+                hit_blocks.append(b)
+                chain.append(h)
+                prev = h
+            self.hits += len(hit_blocks)
+            self.misses += cacheable - len(hit_blocks)
+        # Hits currently resting on the cached list are about to be revived —
+        # they can't double as eviction fodder for our own fresh blocks
+        # (COW-protected ones were never counted evictable to begin with).
+        protected = {s for s, _ in self._pending_copies}
+        reviving = sum(
+            1 for b in hit_blocks
+            if b not in self._ref and b not in protected
+        )
+        need_new = need_total - len(hit_blocks)
+        if need_new > len(self._free) + self._evictable() - reviving:
             raise KVCacheExhausted(
-                f"{need} blocks needed, {len(self._free)} free"
+                f"{need_new} blocks needed, "
+                f"{len(self._free) + self._evictable() - reviving} available"
             )
-        table = [self._free.pop() for _ in range(need)]
-        self._tables[seq_id] = table
+        for b in hit_blocks:   # revive/share before _acquire can evict them
+            self._incref(b)
+        fresh = []
+        for _ in range(need_new):
+            nb = self._acquire()
+            self._ref[nb] = 1
+            fresh.append(nb)
+        self._tables[seq_id] = hit_blocks + fresh
         self._lens[seq_id] = num_tokens
+        self._chain[seq_id] = chain
+        return list(self._tables[seq_id]), len(hit_blocks) * self.block_size
+
+    def fork(self, parent_id: str, child_id: str) -> List[int]:
+        """Share `parent_id`'s entire table with a new sequence (beam /
+        n-best style). Every block increfs; whichever sequence later extends
+        into the shared last partial block triggers copy-on-write there."""
+        if child_id in self._tables:
+            raise ValueError(f"sequence {child_id!r} already has an allocation")
+        table = self._tables[parent_id]  # KeyError = unknown parent
+        for b in table:
+            self._incref(b)
+        self._tables[child_id] = list(table)
+        self._lens[child_id] = self._lens[parent_id]
+        self._chain[child_id] = list(self._chain.get(parent_id, ()))
         return list(table)
 
-    def grow(self, seq_id: str, new_len: int) -> List[int]:
+    def grow(
+        self,
+        seq_id: str,
+        new_len: int,
+        token_ids: Optional[Sequence[int]] = None,
+        num_computed: Optional[int] = None,
+    ) -> List[int]:
         """Extend `seq_id`'s table to cover `new_len` tokens (decode append).
 
-        Returns the (possibly extended) block table. KVCacheExhausted when a
-        new block is needed but the pool is dry — the scheduler preempts."""
+        If the next write position falls inside a SHARED block (fork), that
+        block is forked copy-on-write first: the table is rewritten and a
+        (src, dst) physical copy is queued for `drain_cow`. With `token_ids`
+        (the sequence's full token list) and `num_computed` (tokens whose KV
+        is actually written), newly-completed full blocks are registered in
+        the prefix index. Returns the (possibly extended) block table;
+        KVCacheExhausted when the pool is dry — the scheduler preempts."""
         table = self._tables[seq_id]
         cur = self._lens[seq_id]
         if new_len < cur:
             raise ValueError(f"cannot shrink {seq_id!r}: {cur} -> {new_len}")
         need = self.blocks_for(new_len) - len(table)
-        if need > len(self._free):
+        wi = cur // self.block_size      # block the next write lands in
+        need_cow = int(
+            wi < len(table) and self._ref[table[wi]] > 1
+        )
+        if need + need_cow > len(self._free) + self._evictable():
             raise KVCacheExhausted(
-                f"{need} blocks needed, {len(self._free)} free"
+                f"{need + need_cow} blocks needed, "
+                f"{len(self._free) + self._evictable()} free"
             )
+        if need_cow:
+            src = table[wi]
+            dst = self._acquire()
+            self._ref[dst] = 1
+            self._pending_copies.append((src, dst))
+            table[wi] = dst
+            self._release_one(src)   # still held by the other owner(s)
+            self.cow_copies += 1
         for _ in range(need):
-            table.append(self._free.pop())
+            nb = self._acquire()
+            self._ref[nb] = 1
+            table.append(nb)
         self._lens[seq_id] = new_len
+        if token_ids is not None and num_computed is not None:
+            self.register_computed(seq_id, token_ids, num_computed)
         return list(table)
 
-    def free(self, seq_id: str) -> int:
-        """Return a finished/preempted sequence's blocks to the free list.
+    def register_computed(
+        self,
+        seq_id: str,
+        token_ids: Sequence[int],
+        num_computed: int,
+    ) -> None:
+        """Register every newly-FULL block whose KV is written (positions
+        < `num_computed`) in the prefix index. Must only be called after the
+        engine has actually landed those positions' K/V — registering ahead
+        of the compute would serve garbage to the next prompt.
 
-        Raises KeyError on an unknown (or already-freed) seq_id — the
-        double-free guard; freed block ids are asserted absent from the
-        free list before reinsertion."""
+        If a block's key already has a canonical twin (same content computed
+        by an earlier sequence), this table adopts the twin and releases its
+        own copy — identical prefixes converge to identical tables."""
+        if not self.caching:
+            return
+        chain = self._chain.setdefault(seq_id, [])
+        table = self._tables[seq_id]
+        full = min(num_computed, len(token_ids)) // self.block_size
+        while len(chain) < full:
+            i = len(chain)
+            prev = chain[-1] if chain else b""
+            h = _chain_hash(
+                prev, token_ids[i * self.block_size:(i + 1) * self.block_size]
+            )
+            b = table[i]
+            canon = self._index.get(h)
+            if canon is not None and canon != b:
+                self._incref(canon)
+                table[i] = canon
+                self._release_one(b)
+            elif canon is None:
+                self._index[h] = b
+                self._hash_of[b] = h
+            chain.append(h)
+
+    def drain_cow(self) -> List[Tuple[int, int]]:
+        """(src, dst) physical block copies queued by copy-on-write forks.
+        The engine MUST apply these to the KV arrays before its next kernel
+        launch; draining also re-exposes the sources to eviction."""
+        out, self._pending_copies = self._pending_copies, []
+        return out
+
+    def free(self, seq_id: str) -> int:
+        """Release a finished/preempted sequence's references. Blocks
+        reaching refcount 0 return to the free list — except registered
+        (full, hashed) blocks, which are RETAINED on the cached LRU list to
+        serve future prefix hits until evicted. Raises KeyError on an
+        unknown (or already-freed) seq_id — the double-free guard."""
         table = self._tables.pop(seq_id)  # KeyError = double free
         del self._lens[seq_id]
+        self._chain.pop(seq_id, None)
         for b in table:
-            assert b != self.NULL_BLOCK and b not in self._free, (
-                f"block {b} double-freed (seq {seq_id!r})"
-            )
-            self._free.append(b)
+            self._release_one(b)
         return len(table)
 
     def check_invariants(self) -> None:
-        """Every block is in exactly one place: free list xor one table."""
+        """Every block is in exactly one place (free xor cached xor live),
+        refcounts match table references, and the hash index is bijective
+        over registered blocks."""
         seen = set(self._free)
         assert len(seen) == len(self._free), "free list has duplicates"
         assert self.NULL_BLOCK not in seen, "null block on the free list"
+        for b in self._cached:
+            assert b not in seen, f"block {b} free AND cached"
+            assert b in self._hash_of, f"cached block {b} has no registered hash"
+            assert b not in self._ref, f"cached block {b} has live refs"
+            seen.add(b)
+        refs: Dict[int, int] = {}
         for sid, table in self._tables.items():
             assert len(table) == self.blocks_for(self._lens[sid]), (
                 f"{sid!r}: table/len mismatch"
             )
+            assert len(self._chain.get(sid, ())) <= len(table), (
+                f"{sid!r}: more registered blocks than table entries"
+            )
             for b in table:
-                assert b not in seen, f"block {b} owned twice"
-                seen.add(b)
+                assert b not in self._free and b not in self._cached, (
+                    f"block {b} live AND free/cached"
+                )
+                refs[b] = refs.get(b, 0) + 1
+        assert refs == self._ref, (
+            f"refcount drift: counted {refs}, recorded {self._ref}"
+        )
+        seen.update(refs)
         assert len(seen) == self.num_blocks - 1, "lost/leaked blocks"
+        for h, b in self._index.items():
+            assert self._hash_of.get(b) == h, f"index/hash_of drift on block {b}"
+        for b, h in self._hash_of.items():
+            assert self._index.get(h) == b, f"hash_of/index drift on block {b}"

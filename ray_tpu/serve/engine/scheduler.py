@@ -1,5 +1,6 @@
 """Iteration-level scheduler (reference-era analog: Orca's iteration-level
-scheduling as productized by vLLM's `core/scheduler.py`).
+scheduling as productized by vLLM's `core/scheduler.py`, including its
+chunked-prefill step budget).
 
 The unit of scheduling is ONE decode iteration, not one request: every call
 to `schedule()` re-forms the working set — finished sequences were retired
@@ -10,17 +11,31 @@ therefore never gates a short one behind it: the short request joins the
 batch at the next iteration boundary and exits as soon as it hits its stop
 condition.
 
+Chunked prefill: a prompt no longer runs as one monolithic prefill. Every
+step has a TOKEN budget (`max_step_tokens`); decode lanes spend one token
+each and the remainder funds prefill CHUNKS (`PrefillChunk`) of at most
+`prefill_chunk` tokens, so a 4k-token prompt advances a slice per step
+while every decode stream keeps emitting. A sequence mid-prefill is RUNNING
+but not yet decoding (`Sequence.num_computed` tracks its prefill cursor —
+prefix-cache hits start it past zero); in-flight prefills continue before
+new admissions so held blocks convert to tokens ASAP. Decode lanes are
+funded first: chunking bounds prefill's intrusion on inter-token latency,
+never the reverse.
+
 Batch-shape discipline for XLA: decode batches are padded up to a bucket
 size (powers of two up to `max_num_seqs`) and block-table widths to a
 bucket width, so the jitted paged-decode program compiles once per
 (batch_bucket, width_bucket) pair instead of once per working-set shape.
-Bucketing lives here (scheduler policy); padding lives in the engine
-(tensor mechanics).
+Prefill chunk lengths are capped at `prefill_chunk` and padded to powers of
+two by the engine for the same reason. Bucketing lives here (scheduler
+policy); padding lives in the engine (tensor mechanics).
 
 Preemption: when decode growth exhausts the pool, the YOUNGEST running
 sequence (last admitted — minimizes wasted work) is preempted by recompute:
 its blocks are freed and it re-enters the wait queue with prompt+generated
-as the new prompt, vLLM's recompute-style preemption.
+as the new prompt, vLLM's recompute-style preemption. With prefix caching
+on, its freed full blocks stay cached, so the recompute usually costs one
+cache-hit re-admission rather than a real re-prefill.
 """
 
 from __future__ import annotations
@@ -48,6 +63,11 @@ class Sequence:
     arrival_t: float = dataclasses.field(default_factory=time.monotonic)
     output: List[int] = dataclasses.field(default_factory=list)
     state: str = WAITING
+    # Prefill cursor: prompt positions with KV already landed (cache hits +
+    # completed chunks). Decoding begins once it reaches len(prompt).
+    num_computed: int = 0
+    # Prompt tokens served straight from the prefix cache at last admission.
+    num_cached: int = 0
     # Lifetime token count: unlike len(output) it survives preemption's
     # output→prompt fold, so per-token latency (TPOT) stays honest.
     num_generated: int = 0
@@ -59,6 +79,11 @@ class Sequence:
     @property
     def num_tokens(self) -> int:
         return len(self.prompt) + len(self.output)
+
+    @property
+    def is_decoding(self) -> bool:
+        """Prefill complete — this sequence rides the decode batch."""
+        return self.num_computed >= len(self.prompt)
 
     def append_token(self, tok: int) -> None:
         if self.first_token_t is None:
@@ -76,14 +101,29 @@ class Sequence:
 
 
 @dataclasses.dataclass
+class PrefillChunk:
+    """One step's slice of one prompt's prefill."""
+
+    seq: Sequence
+    start: int        # first prompt position this chunk computes
+    num_tokens: int   # chunk length (<= scheduler.prefill_chunk)
+    last: bool        # final chunk: the engine samples token 0 after it
+
+
+@dataclasses.dataclass
 class SchedulerOutput:
     """One iteration's work order for the engine."""
 
-    prefills: List[Sequence]       # admitted this step: run prompt, emit tok 0
+    prefills: List[PrefillChunk]   # chunk work: compute prompt[start:start+n]
     decodes: List[Sequence]        # running: one decode_step token each
     preempted: List[Sequence]      # freed + requeued this step (for logging)
     batch_bucket: int              # padded decode batch size (0 = no decode)
     width_bucket: int              # padded block-table width (blocks)
+
+    @property
+    def step_tokens(self) -> int:
+        """Token budget actually spent this step (1/decode lane + chunks)."""
+        return len(self.decodes) + sum(c.num_tokens for c in self.prefills)
 
 
 def _next_pow2(n: int) -> int:
@@ -99,10 +139,21 @@ class Scheduler:
         kv: KVBlockManager,
         max_num_seqs: int = 8,
         max_prefills_per_step: int = 1,
+        max_step_tokens: int = 256,
+        prefill_chunk: int = 64,
     ):
+        if max_step_tokens <= max_num_seqs:
+            raise ValueError(
+                "max_step_tokens must exceed max_num_seqs or a full decode "
+                "batch starves prefill forever"
+            )
+        if prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
         self.kv = kv
         self.max_num_seqs = max_num_seqs
         self.max_prefills_per_step = max_prefills_per_step
+        self.max_step_tokens = max_step_tokens
+        self.prefill_chunk = prefill_chunk
         self.waiting: Deque[Sequence] = deque()
         self.running: List[Sequence] = []
         self._seqs: Dict[str, Sequence] = {}
@@ -147,18 +198,39 @@ class Scheduler:
             self.kv.free(seq.request_id)
         del self._seqs[seq.request_id]
 
+    def _chunk_for(self, seq: Sequence, budget: int) -> PrefillChunk:
+        n = min(len(seq.prompt) - seq.num_computed, budget, self.prefill_chunk)
+        return PrefillChunk(
+            seq=seq,
+            start=seq.num_computed,
+            num_tokens=n,
+            last=seq.num_computed + n >= len(seq.prompt),
+        )
+
     def schedule(self) -> SchedulerOutput:
-        prefills: List[Sequence] = []
+        prefills: List[PrefillChunk] = []
         preempted: List[Sequence] = []
 
-        # 1. Grow every running sequence's table for the token this
+        # 1. Grow every DECODING sequence's table for the token this
         # iteration will append; preempt the youngest on exhaustion.
+        # token_ids + the computed watermark let the KV manager register
+        # newly-full blocks in the prefix index (KV for the latest token is
+        # not landed until the step consumes it, hence num_tokens - 1).
+        # Registration can only progress when the landed watermark fills a
+        # block, so the O(context) token-list concat is built only then —
+        # the register loop catches up on every missing block at once.
         for seq in list(self.running):
-            if seq.state != RUNNING:
-                continue  # preempted as a victim earlier in this loop
+            if seq.state != RUNNING or not seq.is_decoding:
+                continue  # mid-prefill, or preempted as a victim this loop
+            landed = seq.num_tokens - 1
+            reg = {}
+            if landed > 0 and landed % self.kv.block_size == 0:
+                reg = dict(
+                    token_ids=seq.prompt + seq.output, num_computed=landed
+                )
             while True:
                 try:
-                    self.kv.grow(seq.request_id, seq.num_tokens + 1)
+                    self.kv.grow(seq.request_id, seq.num_tokens + 1, **reg)
                     break
                 except KVCacheExhausted:
                     victim = self._pick_victim(exclude=seq)
@@ -170,30 +242,51 @@ class Scheduler:
                     self._preempt(victim)
                     preempted.append(victim)
 
-        # 2. Admit queued prefills while the batch and KV budget allow.
+        decodes = [
+            s for s in self.running if s.state == RUNNING and s.is_decoding
+        ]
+        # Decode lanes are funded first; prefill chunks spend the remainder.
+        budget = self.max_step_tokens - len(decodes)
+
+        # 2. Continue in-flight partial prefills (admission order) before
+        # admitting anyone new — their blocks are already committed.
+        for seq in self.running:
+            if len(prefills) >= self.max_prefills_per_step or budget <= 0:
+                break
+            if seq.state != RUNNING or seq.is_decoding:
+                continue
+            chunk = self._chunk_for(seq, budget)
+            prefills.append(chunk)
+            budget -= chunk.num_tokens
+
+        # 3. Admit queued prompts while lanes, KV, and budget allow.
         # FCFS: head-of-line blocking on the QUEUE is fine (arrival order is
         # fair); what iteration-level scheduling removes is blocking on the
-        # multi-second decode of earlier admissions.
+        # multi-second decode of earlier admissions. Admission allocates the
+        # WHOLE prompt (+1 for the first generated token) by prefix-cache
+        # lookup first — a cached prefix starts the cursor past zero.
         while (
             self.waiting
             and len(prefills) < self.max_prefills_per_step
-            # running already includes this step's admissions (appended
-            # below) — adding len(prefills) would double-count them.
+            and budget > 0
             and len(self.running) < self.max_num_seqs
         ):
             seq = self.waiting[0]
             try:
-                # Prompt + the first generated token, so admission never
-                # immediately re-triggers a preemption cycle.
-                self.kv.allocate(seq.request_id, len(seq.prompt) + 1)
+                _, cached = self.kv.allocate_cached(
+                    seq.request_id, seq.prompt, len(seq.prompt) + 1
+                )
             except KVCacheExhausted:
                 break  # stays queued — refusal, not failure
             self.waiting.popleft()
             seq.state = RUNNING
-            prefills.append(seq)
+            seq.num_computed = cached
+            seq.num_cached = cached
             self.running.append(seq)
+            chunk = self._chunk_for(seq, budget)
+            prefills.append(chunk)
+            budget -= chunk.num_tokens
 
-        decodes = [s for s in self.running if s not in prefills]
         bb = _next_pow2(len(decodes)) if decodes else 0
         max_w = max(
             (len(self.kv.block_table(s.request_id)) for s in decodes),
@@ -209,13 +302,15 @@ class Scheduler:
 
     def _pick_victim(self, exclude: Sequence) -> Optional[Sequence]:
         for seq in reversed(self.running):  # youngest first
-            if seq is not exclude:
+            if seq is not exclude and seq.state == RUNNING:
                 return seq
         return None
 
     def _preempt(self, seq: Sequence) -> None:
         """Recompute-style preemption: fold generated tokens into the prompt
-        and requeue at the FRONT (it has seniority over never-run arrivals)."""
+        and requeue at the FRONT (it has seniority over never-run arrivals).
+        With prefix caching, the freed full blocks stay cached — the
+        "recompute" usually re-admits as cache hits."""
         self.running.remove(seq)
         self.kv.free(seq.request_id)
         # Already-generated tokens were already streamed out; fold them into
@@ -224,5 +319,6 @@ class Scheduler:
         seq.prompt = seq.prompt + seq.output
         seq.output = []
         seq.state = WAITING
+        seq.num_computed = 0
         seq.preemptions += 1
         self.waiting.appendleft(seq)

@@ -23,6 +23,15 @@ Run (CPU, records BENCH_SERVE_engine.json):
         --out BENCH_SERVE_engine.json
 Single mode: --mode engine | --mode static. The r5 TPU batch bench is
 `--model gpt2-large --tpu --mode static`.
+
+Two further workloads compare the engine against ITSELF at equal KV budget:
+
+  * --workload prefix (records BENCH_SERVE_prefix.json): shared system
+    prompt + varied tails under Poisson arrivals, prefix caching on vs off
+    — the mixed-arrival re-bench of VERDICT open item 5.
+  * --workload longprompt: long prompts interleaved with short ones,
+    chunked vs monolithic prefill — measures how much a monolithic prefill
+    stalls the short-request tail.
 """
 
 from __future__ import annotations
@@ -101,13 +110,13 @@ def build_static_app(serve, model_kwargs, batch, new_tokens, tpu):
     return GPTStatic.bind()
 
 
-def build_engine_app(serve, model_kwargs, max_num_seqs):
+def build_engine_app(serve, model_kwargs, max_num_seqs, engine_overrides=None):
+    opts = dict(num_blocks=129, block_size=16, max_num_seqs=max_num_seqs)
+    opts.update(engine_overrides or {})
     return serve.LLMDeployment.options(max_ongoing_requests=256).bind(
         model="gpt2-small",
         model_overrides=model_kwargs,
-        engine_options=dict(
-            num_blocks=129, block_size=16, max_num_seqs=max_num_seqs
-        ),
+        engine_options=opts,
     )
 
 
@@ -229,10 +238,193 @@ def bench_mode(mode, args, model_kwargs):
     return out
 
 
+def _summarize(lats, kinds, reqs, wall, args):
+    useful = sum(r["max_new_tokens"] for r in reqs)
+    short_l = [l for l, k in zip(lats, kinds) if not k]
+    long_l = [l for l, k in zip(lats, kinds) if k]
+    return {
+        "requests": len(reqs),
+        "wall_s": round(wall, 2),
+        "useful_tokens_per_s": round(useful / wall, 1),
+        "short": {
+            "n": len(short_l),
+            "new_tokens": args.short,
+            "p50_s": percentile(short_l, 0.50),
+            "p99_s": percentile(short_l, 0.99),
+        },
+        "long": {
+            "n": len(long_l),
+            "new_tokens": args.long,
+            "p50_s": percentile(long_l, 0.50),
+            "p99_s": percentile(long_l, 0.99),
+        },
+    }
+
+
+def _bench_engine_config(label, args, model_kwargs, engine_overrides, reqs,
+                         kinds, warm):
+    """One engine app under one EngineOptions config, Poisson load."""
+    from ray_tpu import serve
+
+    serve.start(http_options={"host": "127.0.0.1", "port": 0})
+    app = build_engine_app(serve, model_kwargs, args.batch, engine_overrides)
+    serve.run(app, name=f"bench_{label}", route_prefix=f"/{label}",
+              timeout_s=2400)
+    base = f"http://127.0.0.1:{serve.http_port()}/{label}"
+    # Warm every shape bucket (XLA compiles) outside the timed window; for
+    # cache-on configs this also steadies the prefix cache — the scenario
+    # being measured is the steady state, not the first-ever request.
+    run_load(base, warm, rate=1000.0, seed=0)
+    lats, wall = run_load(base, reqs, args.rate, args.seed + 1)
+    out = _summarize(lats, kinds, reqs, wall, args)
+    out["engine_options"] = dict(engine_overrides)
+    h = serve.get_app_handle(f"bench_{label}")
+    stats = h.engine_stats.remote().result(timeout_s=30)
+    out["engine_stats"] = stats
+    out["ttft_p50_s"] = stats.get("ttft_p50_s")
+    serve.delete(f"bench_{label}")
+    print(json.dumps({label: out}), flush=True)
+    return out
+
+
+def bench_prefix(args, model_kwargs):
+    """Shared-prefix Poisson workload (VERDICT open item 5's mixed-arrival
+    re-bench): one common system prompt + per-request varied tails, mixed
+    output lengths, engine-vs-engine with prefix caching on vs off at EQUAL
+    KV budget."""
+    import numpy as np
+
+    rng = np.random.default_rng(args.seed)
+    V = model_kwargs["vocab_size"]
+    system = rng.integers(1, V, args.prefix_len).tolist()
+    kinds = rng.random(args.requests) < args.p_long
+    reqs = [
+        {
+            "prompt": system + rng.integers(1, V, args.tail_len).tolist(),
+            "max_new_tokens": args.long if kinds[i] else args.short,
+        }
+        for i in range(args.requests)
+    ]
+    warm = [
+        {"prompt": system + rng.integers(1, V, args.tail_len).tolist(),
+         "max_new_tokens": args.long if i % 2 else args.short}
+        for i in range(args.batch)
+    ]
+    rows = {}
+    for label, overrides in (
+        ("cache_on", {"enable_prefix_caching": True}),
+        ("cache_off", {"enable_prefix_caching": False}),
+    ):
+        rows[label] = _bench_engine_config(
+            label, args, model_kwargs, overrides, reqs, kinds, warm
+        )
+    on, off = rows["cache_on"], rows["cache_off"]
+    comparison = {
+        "useful_tokens_per_s_ratio": round(
+            on["useful_tokens_per_s"] / off["useful_tokens_per_s"], 2
+        ),
+    }
+    if on["ttft_p50_s"] and off["ttft_p50_s"]:
+        comparison["ttft_p50_ratio_off_over_on"] = round(
+            off["ttft_p50_s"] / on["ttft_p50_s"], 2
+        )
+    return {
+        "metric": "serve_shared_prefix_cache_on_vs_off",
+        "config": {
+            "model": args.model,
+            "rate_req_s": args.rate,
+            "prefix_len": args.prefix_len,
+            "tail_len": args.tail_len,
+            "short": args.short,
+            "long": args.long,
+            "p_long": args.p_long,
+            "batch": args.batch,
+            "kv_budget_blocks": 129,
+            "platform": "tpu" if args.tpu else "cpu",
+        },
+        "results": rows,
+        "comparison": comparison,
+    }
+
+
+def bench_longprompt(args, model_kwargs):
+    """Long-prompt interference: long prompts (``--prefix-len`` tokens,
+    unshared) arrive alongside short ones; chunked prefill (small chunk)
+    vs monolithic (chunk >= prompt) at equal KV budget. The number to watch
+    is the SHORT-request tail — monolithic prefills stall every decode
+    stream for the whole long prompt."""
+    import numpy as np
+
+    rng = np.random.default_rng(args.seed)
+    V = model_kwargs["vocab_size"]
+    kinds = rng.random(args.requests) < args.p_long  # long = long PROMPT
+    reqs = [
+        {
+            "prompt": rng.integers(
+                1, V, args.prefix_len if kinds[i] else args.tail_len
+            ).tolist(),
+            "max_new_tokens": args.short,
+        }
+        for i in range(args.requests)
+    ]
+    warm = [
+        {"prompt": rng.integers(
+            1, V, args.prefix_len if i % 2 else args.tail_len).tolist(),
+         "max_new_tokens": args.short}
+        for i in range(args.batch)
+    ]
+    budget = 1 << (args.prefix_len - 1).bit_length()
+    rows = {}
+    for label, overrides in (
+        ("chunked", {"prefill_chunk_tokens": 32,
+                     "max_step_tokens": 64,
+                     "enable_prefix_caching": False}),
+        ("monolithic", {"prefill_chunk_tokens": budget,
+                        "max_step_tokens": budget + args.batch + 1,
+                        "enable_prefix_caching": False}),
+    ):
+        rows[label] = _bench_engine_config(
+            label, args, model_kwargs, overrides, reqs, kinds, warm
+        )
+    ch, mono = rows["chunked"], rows["monolithic"]
+    comparison = {}
+    if ch["short"]["p99_s"] and mono["short"]["p99_s"]:
+        comparison["short_p99_ratio_mono_over_chunked"] = round(
+            mono["short"]["p99_s"] / ch["short"]["p99_s"], 2
+        )
+    return {
+        "metric": "serve_longprompt_chunked_vs_monolithic_prefill",
+        "config": {
+            "model": args.model,
+            "rate_req_s": args.rate,
+            "long_prompt_len": args.prefix_len,
+            "short_prompt_len": args.tail_len,
+            "new_tokens": args.short,
+            "p_long_prompt": args.p_long,
+            "batch": args.batch,
+            "platform": "tpu" if args.tpu else "cpu",
+        },
+        "results": rows,
+        "comparison": comparison,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", choices=["static", "engine", "both"],
                     default="both")
+    ap.add_argument("--workload", choices=["mixed", "prefix", "longprompt"],
+                    default="mixed",
+                    help="mixed: static-vs-engine continuous load (r5); "
+                         "prefix: shared-system-prompt Poisson load, prefix "
+                         "cache on vs off; longprompt: chunked vs monolithic "
+                         "prefill under long-prompt interference")
+    ap.add_argument("--prefix-len", type=int, default=96,
+                    help="shared system-prompt length (prefix workload) / "
+                         "long prompt length (longprompt workload)")
+    ap.add_argument("--tail-len", type=int, default=8,
+                    help="per-request varied tail length (prefix workload) / "
+                         "short prompt length (longprompt workload)")
     ap.add_argument("--model", choices=["tiny", "gpt2-large"], default="tiny")
     ap.add_argument("--tpu", action="store_true",
                     help="TPU replica (flash attention, num_tpus=1)")
@@ -265,6 +457,19 @@ def main():
     import ray_tpu
 
     ray_tpu.init()
+    if args.workload != "mixed":
+        bench = bench_prefix if args.workload == "prefix" else bench_longprompt
+        report = bench(args, model_kwargs)
+        print(json.dumps(report), flush=True)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(report, f, indent=2)
+        from ray_tpu import serve
+
+        serve.shutdown()
+        ray_tpu.shutdown()
+        return
+
     modes = ["static", "engine"] if args.mode == "both" else [args.mode]
     results = {}
     for mode in modes:

@@ -151,11 +151,193 @@ class TestKVBlockManager:
         assert st.used_blocks == 4 and st.utilization == pytest.approx(0.5)
 
 
+# ----------------------------------------------------- prefix cache + COW
+class TestPrefixCache:
+    def test_identical_prefix_returns_identical_blocks(self):
+        """Cache-hit allocation: a second prompt sharing a prefix reuses the
+        first's registered blocks — identical table prefix, refcounted."""
+        kv = KVBlockManager(num_blocks=32, block_size=4)
+        toks = list(range(12))
+        ta, cached = kv.allocate_cached("a", toks, 13)
+        assert cached == 0  # cold cache
+        kv.register_computed("a", toks, 12)  # engine landed the KV
+        tb, cached = kv.allocate_cached("b", toks, 13)
+        # 12 tokens = 3 full blocks, but the LAST one stays cold so the
+        # engine has a real position to read first-token logits from.
+        assert cached == 8
+        assert tb[:2] == ta[:2] and tb[2] != ta[2]
+        assert kv.stats().hits == 2
+        kv.check_invariants()
+        kv.free("a")
+        kv.free("b")
+        kv.check_invariants()
+
+    def test_divergent_tail_shares_only_common_prefix(self):
+        kv = KVBlockManager(num_blocks=32, block_size=4)
+        sys = list(range(100, 108))  # 2 full blocks of shared system prompt
+        a = sys + [1, 2, 3, 4]
+        b = sys + [5, 6, 7, 8]
+        kv.allocate_cached("a", a, len(a) + 1)
+        kv.register_computed("a", a, len(a))
+        tb, cached = kv.allocate_cached("b", b, len(b) + 1)
+        assert cached == 8  # the shared system prompt only
+        assert tb[:2] == kv.block_table("a")[:2]
+        assert tb[2] != kv.block_table("a")[2]
+        kv.check_invariants()
+
+    def test_freed_blocks_serve_hits_until_evicted(self):
+        """Retention: a finished sequence's registered blocks stay findable
+        (free_blocks still counts them); exhaustion evicts them LRU."""
+        kv = KVBlockManager(num_blocks=9, block_size=4)  # 8 usable
+        toks = list(range(16))
+        kv.allocate_cached("a", toks, 16)     # 4 blocks
+        kv.register_computed("a", toks, 16)
+        kv.free("a")
+        st = kv.stats()
+        assert st.free_blocks == 8 and st.cached_blocks == 4
+        # Hit after free: content retained.
+        tb, cached = kv.allocate_cached("b", toks, 17)
+        assert cached == 12  # 3 of 4 full blocks (last stays cold)
+        kv.free("b")
+        # Exhaustion evicts cached blocks instead of failing.
+        kv.allocate("big", 32)  # all 8 blocks
+        st = kv.stats()
+        assert st.evictions > 0 and st.cached_blocks == 0
+        kv.check_invariants()
+        # Evicted content no longer hits.
+        kv.free("big")
+        _, cached = kv.allocate_cached("c", toks, 16)
+        assert cached == 0
+
+    def test_cache_off_retains_nothing(self):
+        kv = KVBlockManager(num_blocks=9, block_size=4,
+                            enable_prefix_caching=False)
+        toks = list(range(16))
+        kv.allocate_cached("a", toks, 16)
+        kv.register_computed("a", toks, 16)
+        kv.free("a")
+        assert kv.stats().cached_blocks == 0
+        _, cached = kv.allocate_cached("b", toks, 16)
+        assert cached == 0 and kv.stats().hits == 0
+        kv.check_invariants()
+
+    def test_fork_cow_never_mutates_shared_block(self):
+        """fork shares every block; extending into the shared partial last
+        block forks it copy-on-write — the table rewrites to a FRESH block
+        and a physical (src, dst) copy is queued for the engine."""
+        kv = KVBlockManager(num_blocks=16, block_size=4)
+        kv.allocate("parent", 6)  # blocks [b0, b1], b1 half full
+        pt = kv.block_table("parent")
+        kv.fork("parent", "child")
+        assert kv.block_table("child") == pt
+        kv.check_invariants()
+        # Child extends: position 6 lands in shared b1 -> COW.
+        ct = kv.grow("child", 7)
+        assert ct[0] == pt[0], "full shared block must stay shared"
+        assert ct[1] != pt[1], "shared partial block extended IN PLACE"
+        copies = kv.drain_cow()
+        assert copies == [(pt[1], ct[1])]
+        assert kv.stats().cow_copies == 1
+        assert kv.block_table("parent") == pt  # parent untouched
+        kv.check_invariants()
+        # Parent can now extend its own (no longer shared) last block freely.
+        assert kv.grow("parent", 8)[1] == pt[1]
+        assert kv.drain_cow() == []
+        kv.free("parent")
+        kv.free("child")
+        kv.check_invariants()
+
+    def test_randomized_alloc_fork_extend_free_stress(self):
+        """Free-list conservation, no double-free, COW-not-in-place, and
+        table/len consistency under a randomized op soup (the invariants
+        check runs after EVERY op)."""
+        import random
+
+        rng = random.Random(1234)
+        kv = KVBlockManager(num_blocks=33, block_size=4)
+        live = {}   # seq_id -> token list
+        nid = 0
+        shared_full = set()  # (block at moment of registration) snapshots
+        for i in range(600):
+            op = rng.random()
+            kv.check_invariants()
+            if i % 5 == 0:
+                # The engine applies queued COW copies before every kernel
+                # launch; draining also re-exposes the sources to eviction.
+                kv.drain_cow()
+            if op < 0.35 or not live:
+                nid += 1
+                sid = f"s{nid}"
+                n = rng.randint(1, 24)
+                toks = [rng.randint(0, 7) for _ in range(n)]
+                try:
+                    _, cached = kv.allocate_cached(sid, toks, n)
+                    assert cached % kv.block_size == 0
+                    assert cached <= max(0, n - 1)
+                    live[sid] = toks
+                    kv.register_computed(sid, toks, n)
+                except KVCacheExhausted:
+                    pass
+            elif op < 0.55:
+                sid = rng.choice(list(live))
+                nid += 1
+                cid = f"s{nid}"
+                try:
+                    kv.fork(sid, cid)
+                    live[cid] = list(live[sid])
+                except (KVCacheExhausted, ValueError):
+                    pass
+            elif op < 0.8:
+                sid = rng.choice(list(live))
+                toks = live[sid]
+                cur = len(toks)
+                add = rng.randint(1, 6)
+                old_table = kv.block_table(sid)
+                refs = {b: kv._ref[b] for b in old_table}
+                try:
+                    table = kv.grow(
+                        sid, cur + add, token_ids=toks, num_computed=cur
+                    )
+                except KVCacheExhausted:
+                    continue
+                toks.extend(rng.randint(0, 7) for _ in range(add))
+                # COW check: the block this grow writes into (position `cur`)
+                # must be swapped out of the table if it was shared.
+                wi = cur // kv.block_size
+                if wi < len(old_table) and refs[old_table[wi]] > 1:
+                    assert table[wi] != old_table[wi], (
+                        "shared block mutated in place"
+                    )
+            else:
+                sid = rng.choice(list(live))
+                kv.free(sid)
+                del live[sid]
+                with pytest.raises(KeyError):
+                    kv.free(sid)  # double free must raise
+        for sid in list(live):
+            kv.free(sid)
+        kv.drain_cow()  # what the engine does before its next launch
+        kv.check_invariants()
+        # Conservation: every block ends blank or cached (all reclaimable
+        # once no copies are pending), none lost.
+        st = kv.stats()
+        assert st.free_blocks == 32 and st.used_blocks == 0
+
+
 # -------------------------------------------------------------- scheduler
+def _sched_step(sched):
+    """schedule() + simulate the engine landing every chunk's KV (advance
+    the prefill cursor) — scheduler-only tests have no engine."""
+    out = sched.schedule()
+    for c in out.prefills:
+        c.seq.num_computed = c.start + c.num_tokens
+    return out
+
+
 class TestScheduler:
-    def _seq(self, rid, prompt_len=4, max_new=8):
+    def _seq(self, rid, prompt_len=4, max_new=8, fill=1):
         return Sequence(
-            request_id=rid, prompt=[1] * prompt_len, max_new_tokens=max_new
+            request_id=rid, prompt=[fill] * prompt_len, max_new_tokens=max_new
         )
 
     def test_admission_mid_decode(self):
@@ -163,17 +345,18 @@ class TestScheduler:
         sched = Scheduler(kv, max_num_seqs=4)
         a = self._seq("a", max_new=50)
         sched.add(a)
-        out = sched.schedule()
-        assert out.prefills == [a] and out.decodes == []
+        out = _sched_step(sched)
+        assert [c.seq for c in out.prefills] == [a] and out.decodes == []
+        assert out.prefills[0].last  # short prompt: one chunk covers it
         a.append_token(1)
-        out = sched.schedule()
+        out = _sched_step(sched)
         assert out.decodes == [a]
         # New arrival joins the NEXT iteration, not after "a" finishes.
         b = self._seq("b", max_new=2)
         sched.add(b)
         a.append_token(1)
-        out = sched.schedule()
-        assert b in out.prefills and a in out.decodes
+        out = _sched_step(sched)
+        assert b in [c.seq for c in out.prefills] and a in out.decodes
 
     def test_admission_refused_queues(self):
         kv = KVBlockManager(num_blocks=5, block_size=4)  # 16 usable slots
@@ -182,34 +365,38 @@ class TestScheduler:
         b = self._seq("b", prompt_len=12, max_new=3)
         sched.add(a)
         sched.add(b)
-        out = sched.schedule()
-        assert out.prefills == [a]
+        out = _sched_step(sched)
+        assert [c.seq for c in out.prefills] == [a]
         assert sched.queue_depth == 1  # b queued, not crashed
         a.append_token(1)
         sched.finish(a, "length")  # blocks freed...
-        out = sched.schedule()
-        assert out.prefills == [b]  # ...and b admitted the very next step
+        out = _sched_step(sched)
+        # ...and b admitted the very next step
+        assert [c.seq for c in out.prefills] == [b]
 
     def test_preemption_recompute(self):
         kv = KVBlockManager(num_blocks=7, block_size=2)  # 6 usable blocks
         sched = Scheduler(kv, max_num_seqs=4)
+        # Distinct prompts: identical ones would prefix-cache-SHARE their
+        # first full block and the pool would never fill.
         a = self._seq("a", prompt_len=3, max_new=5)
-        b = self._seq("b", prompt_len=3, max_new=5)
+        b = self._seq("b", prompt_len=3, max_new=5, fill=2)
         sched.add(a)
         sched.add(b)
-        sched.schedule()        # admits a: 2 blocks
+        _sched_step(sched)      # admits a: 2 blocks
         a.append_token(7)
-        sched.schedule()        # a grows to 3 blocks; admits b: 2 blocks
-        a.append_token(7)
-        b.append_token(8)
-        sched.schedule()        # b grows to 3 blocks — pool now full
+        _sched_step(sched)      # a grows to 3 blocks; admits b: 2 blocks
         a.append_token(7)
         b.append_token(8)
-        out = sched.schedule()  # a needs a 4th block — b (youngest) preempted
+        _sched_step(sched)      # b grows to 3 blocks — pool now full
+        a.append_token(7)
+        b.append_token(8)
+        out = _sched_step(sched)  # a needs a 4th block — b (youngest) preempted
         assert out.preempted == [b]
         assert b.state == "WAITING"
-        assert b.prompt == [1, 1, 1, 8, 8]  # generated tokens folded in
+        assert b.prompt == [2, 2, 2, 8, 8]  # generated tokens folded in
         assert b.max_new_tokens == 3        # generation budget shrunk to match
+        assert b.num_computed == 0          # prefill restarts (cache may hit)
         kv.check_invariants()
 
     def test_oversized_request_rejected_at_add(self):
@@ -217,6 +404,40 @@ class TestScheduler:
         sched = Scheduler(kv, max_num_seqs=4)
         with pytest.raises(KVCacheExhausted):
             sched.add(self._seq("big", prompt_len=20, max_new=20))
+
+    def test_chunked_prefill_budget_and_decode_mix(self):
+        """A long prompt advances `prefill_chunk` tokens per step while the
+        decode lane keeps emitting every step — the chunked-prefill
+        property, plus the per-step token budget cap."""
+        kv = KVBlockManager(num_blocks=64, block_size=4)
+        sched = Scheduler(
+            kv, max_num_seqs=4, max_step_tokens=12, prefill_chunk=8
+        )
+        short = self._seq("short", prompt_len=4, max_new=20)
+        sched.add(short)
+        out = _sched_step(sched)
+        assert out.prefills[0].last
+        short.append_token(1)
+        # fill=3: a [1]-filled prompt would prefix-hit short's cached block
+        # and start the cursor at 4 instead of 0.
+        long = self._seq("long", prompt_len=30, max_new=4, fill=3)
+        sched.add(long)
+        starts = []
+        for _ in range(4):  # 30 tokens / chunk 8 (budget 12-1=11) -> 4 steps
+            out = _sched_step(sched)
+            assert out.decodes == [short], "decode stalled by a prefill chunk"
+            assert len(out.prefills) == 1 and out.prefills[0].seq is long
+            assert out.step_tokens <= 12
+            starts.append(out.prefills[0].start)
+            short.append_token(1)
+        assert starts == [0, 8, 16, 24]
+        assert out.prefills[0].last and long.num_computed == 30
+        out = _sched_step(sched)  # fully prefilled; no token emitted yet
+        assert out.prefills == []
+        long.append_token(1)      # engine samples token 0 off the last chunk
+        out = _sched_step(sched)
+        assert long in out.decodes and short in out.decodes
+        kv.check_invariants()
 
 
 # ------------------------------------------------------------ engine core
@@ -321,6 +542,126 @@ class TestEngineDecode:
         assert len(toks) == 2 and out.finish_reason == "length"
         with pytest.raises(KeyError):
             eng.stream(rid)  # single-consumer: claimed streams are gone
+
+    def test_chunked_prefill_parity_with_monolithic(self, tiny_engine_parts):
+        """ACCEPTANCE: chunked and monolithic prefill produce token-identical
+        outputs. Same 30-token prompt through (a) one monolithic prefill,
+        (b) 8-token chunks, (c) 8-token chunks with the prefix pre-cached by
+        an earlier identical request — all three must match the dense-cache
+        reference exactly."""
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models.gpt import make_generate
+
+        cfg, params = tiny_engine_parts
+        prompt = [int(t) for t in
+                  jax.random.randint(jax.random.PRNGKey(9), (30,), 0, 64)]
+        N = 10
+        ref = jax.jit(make_generate(cfg, N))(
+            params, jnp.asarray([prompt], jnp.int32), jax.random.PRNGKey(0)
+        )[0].tolist()
+        assert len(set(ref)) > 3, "degenerate decode — parity proves nothing"
+
+        def run(eng):
+            rid = eng.submit(prompt, max_new_tokens=N)
+            res = {}
+            t = threading.Thread(
+                target=lambda: res.setdefault("t", list(eng.stream(rid)))
+            )
+            t.start()
+            _drive(eng)
+            t.join(10)
+            return res["t"]
+
+        mono = _make_engine(cfg, params, prefill_chunk_tokens=256)
+        assert run(mono) == ref
+        chunked = _make_engine(cfg, params, prefill_chunk_tokens=8,
+                               max_step_tokens=16)
+        assert run(chunked) == ref
+        # 30 tokens / 8-token chunks -> starts 0, 8, 16, 24
+        assert run(chunked) == ref  # second pass rides the prefix cache
+        assert chunked.block_manager.stats().hits > 0
+        chunked.block_manager.check_invariants()
+
+    def test_prefix_cache_speeds_identical_prompts(self, tiny_engine_parts):
+        """Two requests sharing a 24-token prefix: the second admission
+        starts its prefill cursor past the shared blocks (cache hits), and
+        outputs are unaffected by riding cached KV."""
+        cfg, params = tiny_engine_parts
+        shared = [11, 7, 3, 60, 2, 9, 1, 44] * 3   # 24 tokens = 6 blocks
+        a_prompt = shared + [5, 6]
+        b_prompt = shared + [8, 9]
+        eng = _make_engine(cfg, params)
+        base = _make_engine(cfg, params, enable_prefix_caching=False)
+
+        def run(e, p):
+            rid = e.submit(p, max_new_tokens=6)
+            out = e.stream(rid)
+            res = {}
+            t = threading.Thread(target=lambda: res.setdefault("t", list(out)))
+            t.start()
+            _drive(e)
+            t.join(10)
+            return res["t"]
+
+        assert run(eng, a_prompt) == run(base, a_prompt)
+        st0 = eng.block_manager.stats()
+        toks_b = run(eng, b_prompt)
+        st1 = eng.block_manager.stats()
+        assert st1.hits - st0.hits == 6, "shared 24-token prefix = 6 blocks"
+        assert toks_b == run(base, b_prompt), (
+            "cache-hit decode diverged from cold decode"
+        )
+        b_seq_cached = eng.stats()["prefix_cache_hits"]
+        assert b_seq_cached >= 6
+        eng.block_manager.check_invariants()
+
+    def test_paged_kernels_compile_once_per_bucket(self, tiny_engine_parts):
+        """CI guard: across a mixed workload (varied prompt/output lengths,
+        concurrent lanes), the jitted paged programs compile once per
+        (batch-bucket, width-bucket) / (chunk-bucket, width-bucket) pair —
+        a bucket-policy regression that recompiles per step trips this."""
+        cfg, params = tiny_engine_parts
+        eng = _make_engine(cfg, params, num_blocks=128, block_size=4,
+                           max_num_seqs=4, prefill_chunk_tokens=8,
+                           max_step_tokens=32)
+        pre0 = eng._prefill._cache_size()
+        dec0 = eng._decode._cache_size()
+        import jax
+
+        key = jax.random.PRNGKey(5)
+        lens = [3, 7, 9, 14, 22, 30, 5, 17, 11, 26]
+        for i, L in enumerate(lens):
+            toks = [int(t) for t in
+                    jax.random.randint(jax.random.PRNGKey(i), (L,), 0, 64)]
+            eng.submit(toks, max_new_tokens=4 + (i % 9))
+            if i % 2:
+                _drive(eng)  # drain sometimes -> batch sizes churn
+        _drive(eng)
+        # Distinct shape buckets actually reachable here: prefill chunks pad
+        # to pow2 <= 8 (4 buckets) x width buckets; decode batches pad to
+        # pow2 <= 4 (3) x widths. Bound them, with slack for width buckets.
+        d_pre = eng._prefill._cache_size() - pre0
+        d_dec = eng._decode._cache_size() - dec0
+        assert d_pre <= 4 * 4, f"prefill compiled {d_pre} programs"
+        assert d_dec <= 3 * 4, f"decode compiled {d_dec} programs"
+        # Steady state: the SECOND pass may add a few smaller chunk buckets
+        # (prefix-cache hits shrink the first chunk), but by the THIRD pass
+        # every reachable bucket is warm — zero new compiles.
+        def rerun():
+            for i, L in enumerate(lens):
+                toks = [int(t) for t in
+                        jax.random.randint(jax.random.PRNGKey(i), (L,), 0, 64)]
+                eng.submit(toks, max_new_tokens=4 + (i % 9))
+            _drive(eng, max_steps=600)
+
+        rerun()
+        pre1, dec1 = eng._prefill._cache_size(), eng._decode._cache_size()
+        rerun()
+        assert eng._prefill._cache_size() == pre1, "prefill recompiled"
+        assert eng._decode._cache_size() == dec1, "decode recompiled"
+        eng.block_manager.check_invariants()
 
     def test_eos_stops_early(self, tiny_engine_parts):
         cfg, params = tiny_engine_parts
