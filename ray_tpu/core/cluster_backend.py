@@ -47,6 +47,13 @@ class ClusterBackend(RuntimeBackend):
         # Direct call plane (leases + actor channels) — attached on connect
         # for shm-local drivers/workers (core/direct.py).
         self.direct = None
+        # Anonymous actor-creation coalescing: creations buffer here and
+        # ship as ONE create_actor_batch frame (flushed before any other
+        # outbound message on this conn, so FIFO with the first method
+        # call is preserved; a timer covers create-then-idle drivers).
+        self._create_buf: list = []
+        self._create_lock = __import__("threading").Lock()
+        self._create_flush_scheduled = False
 
     def set_runtime(self, runtime):
         self._runtime = runtime
@@ -235,9 +242,58 @@ class ClusterBackend(RuntimeBackend):
         if msg.get("type") == "revoke_lease" and self.direct is not None:
             self.direct.on_revoke(msg["worker_id"])
 
+    # ------------------------------------------- actor-creation coalescing
+    def _buffer_create(self, msg: dict):
+        """Queue an anonymous creation; ships batched. Every other outbound
+        path flushes this buffer FIRST, so controller-observed order is
+        identical to per-message sends."""
+        with self._create_lock:
+            self._create_buf.append(msg)
+            schedule = not self._create_flush_scheduled
+            self._create_flush_scheduled = True  # latched; flush resets it
+            deep = len(self._create_buf) >= 512
+        if deep:
+            self._flush_creates()
+        elif schedule:
+            # Timer backstop for create-then-idle drivers (3ms ≈ one loop
+            # wake-up; a creation burst flushes far earlier via the next
+            # submit/get on this conn).
+            def flush_safe():
+                try:
+                    self._flush_creates()
+                except Exception:  # noqa: BLE001 — conn died; the NEXT
+                    pass  # user-thread call surfaces the loss at its site
+
+            def arm():
+                self.io.loop.call_later(0.003, flush_safe)
+
+            try:
+                self.io.loop.call_soon_threadsafe(arm)
+            except RuntimeError:
+                self._flush_creates()
+
+    def _flush_creates(self):
+        with self._create_lock:
+            if not self._create_buf:
+                self._create_flush_scheduled = False
+                return
+            items, self._create_buf = self._create_buf, []
+            self._create_flush_scheduled = False
+        if self.conn is None or self.conn._closed:
+            raise RayTpuError("Lost connection to controller (connection closed)")
+        try:
+            if len(items) == 1:
+                self.conn.post(dict(items[0], type="create_actor"))
+            else:
+                self.conn.post({"type": "create_actor_batch", "items": items})
+        except ConnectionError as e:
+            raise RayTpuError(f"Lost connection to controller: {e}") from e
+
     def _request(self, msg: dict, timeout: Optional[float] = None) -> Any:
         # Leave generous slack over the server-side timeout.
         client_timeout = None if timeout is None else timeout + 30
+        if self._create_buf:
+            self._flush_creates()
         try:
             return self.io.call(self.conn.request(msg, timeout), client_timeout)
         except ConnectionError as e:
@@ -246,6 +302,8 @@ class ClusterBackend(RuntimeBackend):
     def _send(self, msg: dict):
         """Blocking one-way send — user-thread paths (submit, metrics) get an
         immediate 'Lost connection' at the call site."""
+        if self._create_buf:
+            self._flush_creates()
         try:
             self.io.call(self.conn.send(msg))
         except ConnectionError as e:
@@ -266,6 +324,8 @@ class ClusterBackend(RuntimeBackend):
         instead of a 300s get timeout)."""
         if self.conn is None or self.conn._closed:
             raise RayTpuError("Lost connection to controller (connection closed)")
+        if self._create_buf:
+            self._flush_creates()
         try:
             self.conn.post(msg)  # batched; a dead conn raises on the NEXT call
         except ConnectionError as e:
@@ -503,11 +563,13 @@ class ClusterBackend(RuntimeBackend):
             return
         # Anonymous creation is fire-and-forget (reference semantics: actor
         # creation is async; errors — infeasibility, init failure — surface
-        # on the first method call via the actor's error state). FIFO with
-        # the subsequent submit_actor_task posts on this connection. This
-        # keeps a creation burst pipelined instead of paying one controller
-        # round trip per actor while the controller is busy booting workers.
-        self._send_pipelined(msg)
+        # on the first method call via the actor's error state) AND
+        # coalesced: a creation burst ships as create_actor_batch frames —
+        # one controller handler + one scheduling round per batch instead
+        # of per actor. FIFO with subsequent submits is preserved because
+        # every other outbound path flushes the buffer first.
+        msg.pop("type", None)
+        self._buffer_create(msg)
 
     def submit_actor_task(self, spec: TaskSpec) -> None:
         from .task_spec import spec_to_proto_bytes
@@ -519,7 +581,14 @@ class ClusterBackend(RuntimeBackend):
         )
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool) -> None:
-        self._request({"type": "kill_actor", "actor": actor_id.hex(), "no_restart": no_restart})
+        # Pipelined (reference semantics: ray.kill is asynchronous). Rides
+        # the same conn FIFO as submits, so kill-then-call still errors the
+        # call; a 5,000-actor teardown wave is one coalesced write instead
+        # of 5,000 round trips against a loaded controller.
+        self._send_pipelined(
+            {"type": "kill_actor", "actor": actor_id.hex(),
+             "no_restart": no_restart}
+        )
 
     def cancel(self, ref: ObjectRef, force: bool, recursive: bool) -> None:
         if self.direct is not None and self.direct.cancel(ref.id.task_id().hex()):
@@ -718,6 +787,21 @@ class ClusterBackend(RuntimeBackend):
             except subprocess.TimeoutExpired:
                 self._controller_proc.terminate()
         if self.conn is not None:
+            # Drain the post pipeline before closing: coalesced frames
+            # (pipelined kills, buffered creations) sit in _post_buf until
+            # the loop turns — close() first would discard them (a killed
+            # detached actor would survive its kill).
+            try:
+                if self._create_buf:
+                    self._flush_creates()
+
+                async def drain():
+                    self.conn._flush_posts()
+                    await self.conn.writer.drain()
+
+                self.io.call(drain(), timeout=2)
+            except Exception:  # noqa: BLE001 — conn already dead
+                pass
             self.conn.close()
         self.local_store.close_all()
         self.io.stop()

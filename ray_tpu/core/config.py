@@ -90,6 +90,15 @@ define("spawn_burst_cap", 4, doc="Max workers spawned per node per pass")
 define("worker_boot_concurrency", 16,
        doc="Cluster-wide cap on simultaneously BOOTING workers — interpreter "
            "start is ~2s of CPU; unbounded bursts thrash the machine")
+# Sharded control plane (control_shards.py).
+define("controller_shards", 4,
+       doc="Partitions of the hot actor/lease/worker directories (crc32 of "
+           "the id, mod this); each shard's event loop owns its actors' "
+           "delivery plane")
+define("controller_shard_threads", True,
+       doc="Run each shard's loop on its own thread; off = inline mode "
+           "(all shards execute on the controller's main loop — same "
+           "partitioning, single executor)")
 # Persistence.
 define("snapshot_interval_s", 1.0, doc="Controller state snapshot period")
 define("gcs_storage", "file",

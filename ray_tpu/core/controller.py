@@ -1,15 +1,26 @@
 """Controller — head process combining the reference's GCS + raylet roles.
 
 Reference analogs:
-  * cluster/actor/PG/object directories — GCS (`src/ray/gcs/gcs_server`)
+  * cluster/actor/PG/object directories — GCS (`src/ray/gcs/gcs_server`),
+    whose hot tables are INDEPENDENT SHARDED TABLES — mirrored here by
+    `control_shards.py`
   * task queueing, dispatch, worker pool  — raylet (`src/ray/raylet/node_manager.cc`,
     `worker_pool.h:156`, `local_task_manager.cc`)
   * object lifetime/spill — `LocalObjectManager` + plasma eviction
 
-Redesign rationale (TPU-first): one asyncio process owns all cluster state —
-no cross-process GCS↔raylet protocol on a single machine; the multi-node seam
-is the node-registration handler (`register_node`), which remote node daemons
-use, keeping scheduler state per-node the way `ClusterResourceManager` does.
+Redesign rationale (TPU-first): ONE head process, MANY event loops. The hot
+actor/lease/worker directories are partitioned by ID hash into N shards
+(`controller_shards`, crc32 % N); each shard's own event loop is the single
+writer for its actors' delivery plane (send queues, pumps, inflight maps),
+so a 2,000-actor wave's per-call bookkeeping never serializes behind the
+scheduler. The MAIN loop keeps what is inherently global: scheduling +
+node capacity, the object directory, placement groups, and the thin
+cross-shard coordination layer (named-actor registry, FT snapshots,
+timeline). Cross-loop traffic is marshaled, never locked-and-shared — see
+docs/SHARDED_CONTROL_PLANE.md for the ownership rules and invariants.
+The multi-node seam is unchanged: remote node daemons join through
+`register_node`, keeping scheduler state per-node the way
+`ClusterResourceManager` does.
 
 Data plane stays OUT of this process: objects ride named shm segments
 (store.py); the controller holds only locations, sizes, refstate, and waiters.
@@ -255,14 +266,32 @@ class ActorState:
     # Submission-ordered calls not yet delivered to the worker. A single pump
     # coroutine drains this FIFO so per-actor call order is preserved even
     # when some calls wait on unready args (reference analog: the ordered
-    # `ActorSchedulingQueue`).
+    # `ActorSchedulingQueue`). OWNED BY THE ACTOR'S SHARD LOOP: appends and
+    # pops are marshaled there (control_shards.py ownership rules).
     send_queue: deque = field(default_factory=deque)
     # Calls delivered to the worker and not yet completed: task hex -> spec.
+    # Written by the shard pump, popped by main-loop completion handlers —
+    # multi-step sequences take `lock`.
     inflight: Dict[str, TaskSpec] = field(default_factory=dict)
     pump_active: bool = False
+    # Awaited on the shard loop; main-loop state transitions wake it via
+    # wake() (cross-loop marshal).
     state_event: asyncio.Event = field(default_factory=asyncio.Event)
     detached: bool = False
     init_error: Optional[TaskError] = None
+    # Owning shard (set at insert; None only in unit tests that poke state).
+    shard: Any = None
+    lock: Any = field(default_factory=__import__("threading").Lock)
+
+    def wake(self):
+        """Wake a pump blocked on state_event, from any thread."""
+        if self.shard is not None and self.shard.loop is not None:
+            try:
+                self.shard.loop.call_soon_threadsafe(self.state_event.set)
+                return
+            except RuntimeError:
+                pass  # shard loop stopped (shutdown)
+        self.state_event.set()
 
 
 @dataclass
@@ -361,6 +390,14 @@ class Controller:
         self._handoff_waiters: Dict[str, asyncio.Future] = {}
         # Unsatisfied lease requests → autoscaler demand (expires in 5s).
         self._lease_backlog: Dict[tuple, tuple] = {}
+        # Worker ids currently LEASED — lets the backlog revoke sweep touch
+        # only lease holders instead of scanning the whole worker table
+        # every pass (O(W·passes) measured on actor waves).
+        self._leased_ids: Set[str] = set()
+        # Worker ids with a prefetched task queued (same pattern: the
+        # stranded-prefetch sweep is per-pass; self-cleaning against
+        # ws.prefetch_task, so a missed clear is harmless).
+        self._prefetch_ids: Set[str] = set()
         # Pulsed on every worker registration — parked lease requests and
         # other capacity waiters re-check on it.
         self._worker_arrival = asyncio.Event()
@@ -384,11 +421,23 @@ class Controller:
         self._metrics_server: Optional[asyncio.base_events.Server] = None
 
         self.objects: Dict[str, ObjectState] = {}
-        self.workers: Dict[str, WorkerState] = {}
+        # Hot directories, partitioned by ID hash into independent shards
+        # (control_shards.py — the GCS-table split): each shard's event
+        # loop owns its actors' delivery plane; the tables themselves are
+        # structurally mutated only on this (main) loop.
+        from .control_shards import ControlShard, ShardedDict
+
+        n_shards = max(1, int(rt_config.get("controller_shards")))
+        threaded = bool(rt_config.get("controller_shard_threads"))
+        self.shards = [ControlShard(i, threaded=threaded) for i in range(n_shards)]
+        self.workers: "ShardedDict" = ShardedDict(self.shards, "workers")
         self.jobs: Dict[str, dict] = {}
         self.streams: Dict[str, dict] = {}  # streaming-generator progress
         self._spec_blobs: Dict[str, bytes] = {}  # snapshot pickle cache
-        self.actors: Dict[str, ActorState] = {}
+        self.actors: "ShardedDict" = ShardedDict(self.shards, "actors")
+        # Cross-shard coordination state (main-loop-owned): the name
+        # registry spans shards — exactly one (namespace, name) → one
+        # actor in one shard.
         self.named_actors: Dict[Tuple[str, str], str] = {}
         self.pgs: Dict[str, dict] = {}
         self.ready_queue: deque = deque()  # PendingTask with no deps
@@ -423,6 +472,12 @@ class Controller:
         self._server: Optional[asyncio.base_events.Server] = None
         self._scheduling = False
         self._schedule_again = False
+        # Deferred-scheduling coalescing: _schedule() marks a pass pending
+        # and runs it once per event-loop drain (see _schedule_tick) — a
+        # 2,000-worker registration storm triggers a handful of passes
+        # instead of one full pass per message (r6: 1,564 passes for a
+        # 300-actor wave, ~2s of pure pass overhead).
+        self._schedule_soon = False
         self._shutdown_event = asyncio.Event()
         self._worker_procs: Dict[str, subprocess.Popen] = {}
         self._forkserver = None  # set in start()
@@ -445,6 +500,11 @@ class Controller:
         return self._gcs_store_client
 
     async def start(self, restore: bool = False):
+        # Shard plumbing: inline shards execute on this loop; threaded
+        # shards already run their own (control_shards.py).
+        self._main_loop = asyncio.get_running_loop()
+        for sh in self.shards:
+            sh.attach_main_loop(self._main_loop)
         # _load_snapshot handles missing/corrupt state itself — one read.
         restored = restore
         if restored:
@@ -525,8 +585,21 @@ class Controller:
         return blob
 
     def _snapshot_state(self) -> dict:
+        from .control_shards import HASH_NAME
+
         return {
             "session_tag": store.SESSION_TAG,
+            # Shard layout at snapshot time (forensics + the FT test's
+            # cross-shard invariant: the per-shard id lists are disjoint and
+            # their union is exactly the actor table). Restore re-routes by
+            # the restoring controller's OWN layout, so this is a record,
+            # not a constraint.
+            "shard_layout": {
+                "n": len(self.shards),
+                "hash": HASH_NAME,
+                "actor_shards": [sorted(sh.actors) for sh in self.shards],
+                "worker_shards": [sorted(sh.workers) for sh in self.shards],
+            },
             "port": self.port,
             "object_store_memory": self.object_store_memory,
             "store_bytes_used": self.store_bytes_used,
@@ -615,7 +688,10 @@ class Controller:
             # Until its worker reconnects, the actor is "restarting": calls
             # queue instead of failing (reference: actor restart states).
             astate.state = "restarting" if a["state"] in ("alive", "pending", "restarting") else a["state"]
+            # Insertion re-routes by the CURRENT shard layout — a restore
+            # with a different controller_shards repartitions cleanly.
             self.actors[h] = astate
+            astate.shard = self.actors.shard_for(h)
         for k, v in snap["pgs"].items():
             self.pgs[k] = dict(v)
             # Bundles were reserved against head capacity pre-crash; re-apply.
@@ -733,6 +809,8 @@ class Controller:
             self._bulk_server.stop()
         if getattr(self, "_forkserver", None) is not None:
             self._forkserver.stop()
+        for sh in self.shards:
+            sh.stop()
 
     # ------------------------------------------------------------- workers
     def _spawn_worker(
@@ -758,9 +836,11 @@ class Controller:
         # machine until registrations time out. Deferral is safe — every
         # registration fires _schedule, which re-flushes pending spawn
         # demand until it drains.
-        booting = sum(
-            1 for w in self.workers.values() if w.state == STARTING
-        ) + sum(n.spawning for n in self.nodes.values())
+        # The spawn ledger IS the in-flight boot set (one entry per spawn,
+        # removed at registration/expiry) — counting it is O(1)-ish where
+        # the old per-call worker-table scan was O(workers) and went
+        # quadratic across a 2,000-spawn wave.
+        booting = len(self._spawn_ledger)
         boot_cap = rt_config.get("worker_boot_concurrency")
         if self._forkserver is not None and self._forkserver.usable:
             # Forked workers skip the ~2s interpreter boot the cap was sized
@@ -796,22 +876,31 @@ class Controller:
                 time.monotonic(), worker_id,
             )
         if node.conn is not None:
-            asyncio.ensure_future(
-                node.conn.send({
+            try:
+                node.conn.post({
                     "type": "spawn_worker", "worker_id": worker_id,
                     "tpu": tpu, "isolation": isolation,
                 })
-            )
+            except ConnectionError:
+                pass  # node dying — ledger expiry reclaims the boot budget
             return
-        env = dict(os.environ)
+        # Spawn-env template, built once: dict(os.environ) iterates the
+        # environ Mapping in Python (a decode per key per spawn — measured
+        # ~2.5s per 1,000-spawn wave); a plain dict copy is C-speed.
+        base = getattr(self, "_spawn_env_base", None)
+        if base is None:
+            base = dict(os.environ)
+            pkg_root0 = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+            base["PYTHONPATH"] = pkg_root0 + os.pathsep + base.get("PYTHONPATH", "")
+            base["RAY_TPU_ADDRESS"] = f"{self.node_ip}:{self.port}"
+            base["RAY_TPU_NODE_IP"] = self.node_ip  # workers bind/advertise here
+            base["RAY_TPU_SESSION_DIR"] = self.session_dir
+            base["RAY_TPU_SESSION_TAG"] = store.SESSION_TAG
+            base["PYTHONUNBUFFERED"] = "1"  # log tailing needs unbuffered stdout
+            self._spawn_env_base = base
+        env = dict(base)
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
         env["RAY_TPU_WORKER_ID"] = worker_id
-        env["RAY_TPU_ADDRESS"] = f"{self.node_ip}:{self.port}"
-        env["RAY_TPU_NODE_IP"] = self.node_ip  # workers bind/advertise here
-        env["RAY_TPU_SESSION_DIR"] = self.session_dir
-        env["RAY_TPU_SESSION_TAG"] = store.SESSION_TAG
-        env["PYTHONUNBUFFERED"] = "1"  # log tailing needs unbuffered stdout
         if tpu:
             env["RAY_TPU_WORKER_TPU"] = "1"
         else:
@@ -1047,7 +1136,7 @@ class Controller:
         async def run():
             result = await handler(conn, meta, msg)
             if msg.get("req_id") is not None:
-                await conn.respond(msg["req_id"], result)
+                conn.respond_nowait(msg["req_id"], result)
 
         if mtype in self._LONG_POLL:
             asyncio.ensure_future(run())
@@ -2001,7 +2090,11 @@ class Controller:
             return
         kind = "tpu" if ws.has_tpu else "cpu"
         lst = idx[kind].get((ws.node_id, ws.env_key))
-        if lst and ws in lst:
+        if not lst:
+            return
+        if lst[-1] is ws:  # grants take from the tail — O(1) common case
+            lst.pop()
+        elif ws in lst:
             lst.remove(ws)
 
     def _candidate_nodes(
@@ -2122,13 +2215,19 @@ class Controller:
             if spec.task_type == TaskType.ACTOR_CREATION_TASK
             else "execute_task"
         )
-        await ws.conn.send(
-            {
-                "type": msg_type,
-                "spec": spec_to_proto_bytes(spec),
-                "deps": self._deps_payload(spec, node.node_id),
-            }
-        )
+        try:
+            # post(): batched fire-and-forget — a dispatch burst rides one
+            # writer wake-up; a dead conn raises and the worker-death path
+            # (already in flight via on_close) requeues from self.running.
+            ws.conn.post(
+                {
+                    "type": msg_type,
+                    "spec": spec_to_proto_bytes(spec),
+                    "deps": self._deps_payload(spec, node.node_id),
+                }
+            )
+        except ConnectionError:
+            return
         self._event("task_dispatched", task=task_hex, worker=ws.worker_id,
                      node=node.node_id)
 
@@ -2350,7 +2449,31 @@ class Controller:
         return None
 
     def _schedule(self):
-        """Dispatch as many ready tasks as resources + workers allow.
+        """Request a scheduling pass, coalesced per event-loop drain.
+
+        Deferral is the controller's lifecycle batching: every message in
+        one socket read burst (a registration storm, a task_done wave) maps
+        to ONE pass via call_soon instead of a pass per message. Callers
+        observe the same semantics — handlers are async, so dispatch was
+        never synchronous with the triggering message anyway.
+        """
+        if self._schedule_soon:
+            return
+        self._schedule_soon = True
+        try:
+            asyncio.get_running_loop().call_soon(self._schedule_tick)
+        except RuntimeError:
+            # No running loop (unit tests poking controller state
+            # synchronously) — run the pass inline like the old path did.
+            self._schedule_soon = False
+            self._schedule_now()
+
+    def _schedule_tick(self):
+        self._schedule_soon = False
+        self._schedule_now()
+
+    def _schedule_now(self):
+        """Run scheduling passes until quiescent.
 
         NON-REENTRANT: failure paths inside a pass (_fail_task →
         _mark_ready) call _schedule again; a nested pass would grant workers
@@ -2582,19 +2705,15 @@ class Controller:
                 made_progress = True
         # One pass over the worker table serves every spawn decision below
         # (per-call scans dominated profiles at 58k _spawn_worker calls).
-        starting_by_node: Dict[str, int] = {}
+        # In-flight boots are tracked by node.spawning / the spawn ledger —
+        # registered workers are never in STARTING state, so only the live
+        # count needs the table walk.
         live_by_node: Dict[str, int] = {}
-        starting_total = 0
         if spawn_wanted or spawn_wanted_actors or self.ready_queue:
             for w in self.workers.values():
                 if w.state in (DEAD, ACTOR):
                     continue  # task-pool occupancy only (see _spawn_worker)
                 live_by_node[w.node_id] = live_by_node.get(w.node_id, 0) + 1
-                if w.state == STARTING:
-                    starting_by_node[w.node_id] = (
-                        starting_by_node.get(w.node_id, 0) + 1
-                    )
-                    starting_total += 1
         # Flush per-node spawn demand, net of workers already booting there
         # (reference analog: worker_pool PrestartWorkers on backlog hints,
         # `worker_pool.h:354` — backlog-sized, not one-per-event).
@@ -2603,7 +2722,7 @@ class Controller:
                 node = self.nodes.get(node_id)
                 if node is None or not node.alive:
                     continue
-                booting = node.spawning + starting_by_node.get(node_id, 0)
+                booting = node.spawning
                 for _ in range(
                     max(0, min(wanted - booting, rt_config.get("spawn_burst_cap")))
                 ):
@@ -2615,7 +2734,7 @@ class Controller:
                         force=forced,
                     )
         # Top the head pool up to the queue depth.
-        starting = self.head.spawning + starting_total
+        starting = self.head.spawning
         # Exact CPU-backlog count is O(queue); bound the scan to the first
         # 256 entries — an UNDERestimate for deeper queues (spawning catches
         # up as the queue drains), and still exactly 0 for TPU-only queues
@@ -2645,11 +2764,16 @@ class Controller:
         answers with its own `task_dropped` push only if the drop beat
         execution (h_task_dropped requeues), else its `task_done` arrives as
         usual and the reclaim dissolves."""
-        pending = [
-            ws for ws in self.workers.values()
-            if ws.prefetch_task is not None and ws.reclaiming_task is None
-            and ws.conn is not None
-        ]
+        if not self._prefetch_ids:
+            return
+        pending = []
+        for wid in list(self._prefetch_ids):
+            ws = self.workers.get(wid)
+            if ws is None or ws.prefetch_task is None:
+                self._prefetch_ids.discard(wid)  # self-cleaning
+                continue
+            if ws.reclaiming_task is None and ws.conn is not None:
+                pending.append(ws)
         if not pending:
             return
         idle = [
@@ -2771,6 +2895,10 @@ class Controller:
                           node_filter=None):
         grants = []
         spawn_hint: Optional[NodeState] = None
+        # One idle-worker index per grant call: the uncached scan was
+        # O(workers) per requested lease — a wave of 5k resident actor
+        # workers made every lease request pay a full table walk.
+        cache: Dict[str, Any] = {}
         for _ in range(count):
             got = None
             for node in self.nodes.values():
@@ -2778,7 +2906,7 @@ class Controller:
                     continue
                 if not self._fits_node(node, demand):
                     continue
-                ws = self._idle_worker(node.node_id, need_tpu)
+                ws = self._idle_worker(node.node_id, need_tpu, cache)
                 if ws is None:
                     spawn_hint = spawn_hint or node
                     continue
@@ -2792,6 +2920,7 @@ class Controller:
             self._acquire(node, demand)
             ws.assigned = dict(demand)
             ws.state = LEASED
+            self._leased_ids.add(ws.worker_id)
             ws.leased_to = meta.get("conn_id")
             meta.setdefault("leases", set()).add(ws.worker_id)
             grants.append({"worker_id": ws.worker_id, "addr": ws.direct_addr})
@@ -2809,6 +2938,7 @@ class Controller:
     def _release_lease(self, ws: WorkerState, requeue: bool = True):
         if ws.state != LEASED:
             return
+        self._leased_ids.discard(ws.worker_id)
         if ws.blocked:
             # Capacity already released at block time (h_worker_blocked) —
             # releasing again would double-credit the node.
@@ -2824,22 +2954,33 @@ class Controller:
             self._schedule()
 
     async def h_return_lease(self, conn, meta, msg):
-        ws = self.workers.get(msg["worker_id"])
+        self._return_one_lease(meta, msg["worker_id"])
+        return {"ok": True}
+
+    async def h_return_lease_batch(self, conn, meta, msg):
+        """Batched give-back from a holder's idle sweep — one frame, one
+        scheduling request for the whole set."""
+        for worker_id in msg.get("worker_ids", ()):
+            self._return_one_lease(meta, worker_id)
+        return None
+
+    def _return_one_lease(self, meta, worker_id: str):
+        ws = self.workers.get(worker_id)
         leases = meta.get("leases")
         if leases is not None:
-            leases.discard(msg["worker_id"])
+            leases.discard(worker_id)
         if ws is not None and ws.leased_to == meta.get("conn_id"):
             self._release_lease(ws)
-        return {"ok": True}
 
     def _revoke_leases_for_backlog(self):
         """Queued work + zero placement → pull leases back (the holder
         drains in-flight pushes and returns). Prevents idle-leased workers
         from starving the queued path."""
-        if not self.ready_queue:
+        if not self.ready_queue or not self._leased_ids:
             return
-        for ws in self.workers.values():
-            if ws.state != LEASED or ws.revoking or ws.leased_to is None:
+        for wid in list(self._leased_ids):
+            ws = self.workers.get(wid)
+            if ws is None or ws.state != LEASED or ws.revoking or ws.leased_to is None:
                 continue
             holder = self._conns_by_id.get(ws.leased_to)
             if holder is None:
@@ -2870,8 +3011,7 @@ class Controller:
         # The fence rides the actor's ORDERED send queue (_pump_actor), so
         # every classic call submitted before it — including calls still
         # waiting on args or on actor creation — reaches the worker first.
-        astate.send_queue.append(_HandoffFence(token))
-        asyncio.ensure_future(self._pump_actor(astate))
+        self._shard_enqueue(astate, _HandoffFence(token))
         try:
             await asyncio.wait_for(fut, timeout=msg.get("timeout", 30))
         except Exception:  # noqa: BLE001 — worker busy/dead; caller stays classic
@@ -2888,6 +3028,14 @@ class Controller:
         if fut is not None and not fut.done():
             fut.set_result(True)
         return None
+
+    def _resolve_handoff_failed(self, token: str):
+        """Main-loop: answer a handoff waiter whose fence met a dead actor
+        (h_actor_handoff re-checks liveness after the future resolves, so a
+        False here yields its not-alive reply)."""
+        fut = self._handoff_waiters.get(token)
+        if fut is not None and not fut.done():
+            fut.set_result(False)
 
     def _maybe_prefetch(
         self,
@@ -2938,12 +3086,13 @@ class Controller:
         task_hex = hspec.task_id.hex()
         self.running[task_hex] = (ws.worker_id, head)
         ws.prefetch_task = task_hex
+        self._prefetch_ids.add(ws.worker_id)
         asyncio.ensure_future(self._dispatch_prefetch(ws, head))
 
     async def _dispatch_prefetch(self, ws: WorkerState, pt: PendingTask):
         spec = pt.spec
         try:
-            await ws.conn.send(
+            ws.conn.post(
                 {
                     "type": "execute_task",
                     "spec": spec_to_proto_bytes(spec),
@@ -3073,7 +3222,8 @@ class Controller:
         if ws is not None and ws.actor_hex:
             astate = self.actors.get(ws.actor_hex)
             if astate is not None:
-                ispec = astate.inflight.pop(task_hex, None)
+                with astate.lock:  # pump (shard loop) writes concurrently
+                    ispec = astate.inflight.pop(task_hex, None)
                 if ispec is not None:
                     self._unpin_args(ispec)
         for item in msg["results"]:
@@ -3121,21 +3271,57 @@ class Controller:
 
     def _set_actor_state(self, astate: ActorState, state: str):
         astate.state = state
-        astate.state_event.set()
+        astate.wake()  # pump waits on the SHARD loop — marshal the set
 
     def _drain_actor_queue(self, astate: ActorState, err: TaskError):
-        while astate.send_queue:
-            spec = astate.send_queue.popleft()
-            self._unpin_args(spec)
-            if spec.num_returns == -1:
-                # Queued streaming call: end its stream with the error so the
-                # consumer's generator raises instead of long-polling forever.
-                self._fail_stream(spec, err)
-            for oid in spec.return_ids:
-                self._store_error_object(oid.hex(), err)
+        """Fail every queued (undelivered) call. The send queue is owned by
+        the actor's shard loop — pop there, then store the error returns on
+        the main loop (object directory). Calls racing this drain land on
+        the shard loop in marshal order, so they are either drained here or
+        see state == dead in the pump."""
+
+        def drain():
+            specs = []
+            while astate.send_queue:
+                spec = astate.send_queue.popleft()
+                if isinstance(spec, _HandoffFence):
+                    # Fail the waiter promptly; caller stays classic.
+                    self._main_call_soon(
+                        self._resolve_handoff_failed, spec.token
+                    )
+                    continue
+                specs.append(spec)
+            if not specs:
+                return
+
+            def store():
+                for spec in specs:
+                    self._unpin_args(spec)
+                    if spec.num_returns == -1:
+                        # Queued streaming call: end its stream with the
+                        # error so the consumer's generator raises instead
+                        # of long-polling forever.
+                        self._fail_stream(spec, err)
+                    for oid in spec.return_ids:
+                        self._store_error_object(oid.hex(), err)
+
+            self._main_call_soon(store)
+
+        sh = astate.shard
+        if sh is not None and sh.loop is not None:
+            try:
+                sh.loop.call_soon_threadsafe(drain)
+                return
+            except RuntimeError:
+                pass
+        drain()
 
     # -------------------------------------------------------------- actors
-    async def h_create_actor(self, conn, meta, msg):
+    def _register_actor(self, msg: dict) -> dict:
+        """Register one actor creation (shared by the single and batched
+        frames): directory entry in its shard, name claim through the
+        coordination layer, creation task enqueued. One _schedule per
+        BATCH happens at the caller (deferred coalescing absorbs it)."""
         spec: TaskSpec = spec_from_proto_bytes(msg["spec"])
         actor_hex = spec.actor_id.hex()
         bad = self._infeasible(spec.resources)
@@ -3150,6 +3336,7 @@ class Controller:
                 spec.name,
             )
             self.actors[actor_hex] = astate
+            astate.shard = self.actors.shard_for(actor_hex)
             return {"ok": False}
         astate = ActorState(
             actor_hex=actor_hex,
@@ -3160,6 +3347,7 @@ class Controller:
             detached=spec.options.lifetime == "detached",
         )
         self.actors[actor_hex] = astate
+        astate.shard = self.actors.shard_for(actor_hex)
         if astate.name:
             key = (astate.namespace, astate.name)
             if key in self.named_actors:
@@ -3169,12 +3357,28 @@ class Controller:
         pt = PendingTask(spec=spec, retries_left=0)
         self._event("actor_created", actor=actor_hex, name=astate.name)
         self._enqueue(pt)
-        self._schedule()
         return {"ok": True}
+
+    async def h_create_actor(self, conn, meta, msg):
+        out = self._register_actor(msg)
+        self._schedule()
+        return out
+
+    async def h_create_actor_batch(self, conn, meta, msg):
+        """Coalesced creation frames from one client (cluster_backend
+        batches anonymous creations): N directory registrations, ONE
+        scheduling request — a 2,000-actor wave is a handful of passes
+        instead of 2,000 (reference analog: the GCS's batched actor
+        registration RPCs feeding one scheduling round)."""
+        for item in msg["items"]:
+            self._register_actor(item)
+        self._schedule()
+        return None
 
     async def _send_actor_task(self, astate: ActorState, spec: TaskSpec):
         def fail(err: TaskError):
-            astate.inflight.pop(spec.task_id.hex(), None)
+            with astate.lock:
+                astate.inflight.pop(spec.task_id.hex(), None)
             self._unpin_args(spec)
             for oid in spec.return_ids:
                 self._store_error_object(oid.hex(), err)
@@ -3190,13 +3394,16 @@ class Controller:
         except Exception as e:  # noqa: BLE001
             fail(TaskError(RuntimeError(f"dependency transfer failed: {e}"), "", spec.name))
             return
-        await ws.conn.send(
-            {
-                "type": "execute_actor_task",
-                "spec": spec_to_proto_bytes(spec),
-                "deps": self._deps_payload_safe(spec, ws.node_id),
-            }
-        )
+        try:
+            ws.conn.post(
+                {
+                    "type": "execute_actor_task",
+                    "spec": spec_to_proto_bytes(spec),
+                    "deps": self._deps_payload_safe(spec, ws.node_id),
+                }
+            )
+        except ConnectionError:
+            fail(TaskError(ActorDiedError(), "", spec.name))
 
     def _deps_payload_safe(self, spec: TaskSpec, node_id: str) -> dict:
         locs = {}
@@ -3217,31 +3424,137 @@ class Controller:
         if astate is None or astate.state == "dead":
             err = astate.init_error if astate else None
             err = err or TaskError(ActorDiedError(), "", spec.name)
+            if spec.num_returns == -1:
+                # Streaming call to a dead actor: return_ids is EMPTY — only
+                # ending the stream itself stops the consumer's long-poll
+                # (observed: next() waiting out the full stream timeout).
+                self._fail_stream(spec, err)
             for oid in spec.return_ids:
                 self._store_error_object(oid.hex(), err)
             return {"ok": False}
         self._pin_args(spec)
         self._expect_returns(spec)
-        astate.send_queue.append(spec)
-        if not astate.pump_active:
-            asyncio.ensure_future(self._pump_actor(astate))
+        self._shard_enqueue(astate, spec)
         return {"ok": True}
 
-    async def _pump_actor(self, astate: ActorState):
-        """Deliver this actor's calls strictly in submission order: wait for
-        each call's args and for the actor to be alive before sending."""
-        if astate.pump_active:
+    # -------------------------------------------- shard delivery plane
+    # The actor send queue + pump live on the actor's SHARD loop
+    # (control_shards.py): the main loop marshals appends/drains there and
+    # the pump marshals object-directory work back. FIFO order per
+    # submitting thread is preserved by call_soon_threadsafe.
+    def _main_call_soon(self, fn, *args):
+        """Run fn on the main (scheduler/object-directory) loop; inline when
+        already there — shard-loop callers get a deferred, ordered call."""
+        loop = getattr(self, "_main_loop", None)
+        if loop is None:
+            fn(*args)
             return
-        astate.pump_active = True
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is loop:
+            fn(*args)
+            return
+        try:
+            loop.call_soon_threadsafe(fn, *args)
+        except RuntimeError:
+            pass  # main loop closed (shutdown)
+
+    async def _run_on_main(self, coro):
+        """Await a coroutine on the main loop from a shard loop (ordered
+        delivery steps that need scheduler/object state)."""
+        loop = asyncio.get_running_loop()
+        main = getattr(self, "_main_loop", None)
+        if main is None or loop is main:
+            return await coro
+        return await asyncio.wrap_future(
+            asyncio.run_coroutine_threadsafe(coro, main)
+        )
+
+    def _shard_enqueue(self, astate: ActorState, item):
+        """Append to the actor's ordered send queue and ensure its pump
+        runs — both on the owning shard's loop (single-writer)."""
+
+        def run():
+            astate.send_queue.append(item)
+            if not astate.pump_active:
+                astate.pump_active = True
+                asyncio.get_running_loop().create_task(self._pump_actor(astate))
+
+        sh = astate.shard
+        if sh is not None and sh.loop is not None:
+            try:
+                sh.loop.call_soon_threadsafe(run)
+                return
+            except RuntimeError:
+                pass  # shard loop stopped (shutdown) — fall through
+        # No shard loop (unit tests poking controller state directly).
+        astate.send_queue.append(item)
+        if not astate.pump_active:
+            astate.pump_active = True
+            asyncio.ensure_future(self._pump_actor(astate))
+
+    async def _shard_wait_ready(self, hex_id: str):
+        """Shard-side wait for an object's readiness. Registration happens
+        ON the main loop (the object directory's owner — a racy check-then
+        -append from this thread could miss the wake between _mark_ready's
+        event sweep and clear)."""
+        loop = asyncio.get_running_loop()
+        main = getattr(self, "_main_loop", None)
+        if main is None or loop is main:
+            obj = self._obj(hex_id)
+            while obj.status != "ready":
+                ev = asyncio.Event()
+                obj.events.append(ev)
+                await ev.wait()
+            return
+        from .control_shards import CrossLoopEvent
+
+        while True:
+            sev = asyncio.Event()
+
+            def reg():
+                obj = self._obj(hex_id)
+                if obj.status == "ready":
+                    try:
+                        loop.call_soon_threadsafe(sev.set)
+                    except RuntimeError:
+                        pass
+                else:
+                    obj.events.append(CrossLoopEvent(loop, sev))
+
+            self._main_call_soon(reg)
+            await sev.wait()
+            obj = self.objects.get(hex_id)
+            if obj is not None and obj.status == "ready":
+                return
+
+    def _fail_actor_call(self, spec: TaskSpec, err: Optional[TaskError]):
+        """Store error returns for an undeliverable actor call — on the
+        main loop (object directory owner); callable from shard loops."""
+        err = err or TaskError(ActorDiedError(), "", spec.name)
+
+        def run():
+            self._unpin_args(spec)
+            if spec.num_returns == -1:
+                self._fail_stream(spec, err)
+            for oid in spec.return_ids:
+                self._store_error_object(oid.hex(), err)
+
+        self._main_call_soon(run)
+
+    async def _pump_actor(self, astate: ActorState):
+        """Deliver this actor's calls strictly in submission order — runs on
+        the actor's SHARD loop. Argless calls to a live actor (the
+        steady-state hot path) are delivered entirely shard-side via the
+        thread-safe conn.post; calls needing the object directory
+        (arg deps, error returns) marshal through the main loop."""
         try:
             while astate.send_queue:
                 spec = astate.send_queue[0]
                 for oid in spec.arg_refs:
-                    obj = self._obj(oid.hex())
-                    while obj.status != "ready":
-                        ev = asyncio.Event()
-                        obj.events.append(ev)
-                        await ev.wait()
+                    await self._shard_wait_ready(oid.hex())
                 while astate.state in ("pending", "restarting"):
                     astate.state_event.clear()
                     await astate.state_event.wait()
@@ -3252,23 +3565,60 @@ class Controller:
                     ws = self.workers.get(astate.worker_id)
                     if astate.state == "alive" and ws is not None and ws.conn is not None:
                         try:
-                            await ws.conn.send(
+                            ws.conn.post(
                                 {"type": "actor_handoff", "token": spec.token}
                             )
                         except Exception:  # noqa: BLE001 — waiter times out
                             pass
-                    # dead/unreachable: waiter times out → caller stays classic
+                    else:
+                        # Dead/unreachable: answer the handoff waiter NOW —
+                        # the caller falls back to classic (and its buffered
+                        # calls fail fast) instead of waiting out the 30s
+                        # handoff timeout against a dead actor.
+                        self._main_call_soon(
+                            self._resolve_handoff_failed, spec.token
+                        )
                     continue
                 if astate.state == "dead":
-                    err = astate.init_error or TaskError(ActorDiedError(), "", spec.name)
-                    self._unpin_args(spec)
-                    for oid in spec.return_ids:
-                        self._store_error_object(oid.hex(), err)
+                    self._fail_actor_call(spec, astate.init_error)
                     continue
-                astate.inflight[spec.task_id.hex()] = spec
-                await self._send_actor_task(astate, spec)
+                task_hex = spec.task_id.hex()
+                with astate.lock:
+                    astate.inflight[task_hex] = spec
+                if not spec.arg_refs:
+                    ws = self.workers.get(astate.worker_id)
+                    if ws is None or ws.conn is None or ws.state == DEAD:
+                        with astate.lock:
+                            astate.inflight.pop(task_hex, None)
+                        self._fail_actor_call(
+                            spec, TaskError(ActorDiedError(), "", spec.name)
+                        )
+                        continue
+                    try:
+                        ws.conn.post(
+                            {
+                                "type": "execute_actor_task",
+                                "spec": spec_to_proto_bytes(spec),
+                                "deps": {},
+                            }
+                        )
+                    except ConnectionError:
+                        with astate.lock:
+                            astate.inflight.pop(task_hex, None)
+                        self._fail_actor_call(
+                            spec, TaskError(ActorDiedError(), "", spec.name)
+                        )
+                    continue
+                await self._run_on_main(self._send_actor_task(astate, spec))
         finally:
-            astate.pump_active = False
+            if astate.send_queue and not self._shutdown_event.is_set():
+                # A racer appended between our last check and this exit
+                # (same loop, so this check-and-restart is atomic). The
+                # shutdown guard keeps a closing main loop from turning a
+                # failing pump into a restart spin.
+                asyncio.get_running_loop().create_task(self._pump_actor(astate))
+            else:
+                astate.pump_active = False
 
     async def h_kill_actor(self, conn, meta, msg):
         actor_hex = msg["actor"]
@@ -3279,9 +3629,23 @@ class Controller:
         self._set_actor_state(astate, "dead")
         if no_restart:
             astate.spec = None
-        self._drain_actor_queue(
-            astate, TaskError(ActorDiedError("Actor was killed."), "", "actor task")
-        )
+        err = TaskError(ActorDiedError("Actor was killed."), "", "actor task")
+        self._drain_actor_queue(astate, err)
+        # Inflight (already-delivered) calls can never complete either — the
+        # worker is being terminated. Fail them NOW: a delivered streaming
+        # call otherwise leaves its consumer long-polling out the full
+        # stream timeout (observed: 300s for a one-line test). Results that
+        # raced ahead and completed are left alone (ready check below).
+        with astate.lock:  # pump (shard loop) writes concurrently
+            inflight = list(astate.inflight.values())
+            astate.inflight.clear()
+        for ispec in inflight:
+            self._unpin_args(ispec)
+            if ispec.num_returns == -1:
+                self._fail_stream(ispec, err)
+            for oid in ispec.return_ids:
+                if self._obj(oid.hex()).status != "ready":
+                    self._store_error_object(oid.hex(), err)
         for key, ah in list(self.named_actors.items()):
             if ah == actor_hex:
                 del self.named_actors[key]
@@ -3300,9 +3664,12 @@ class Controller:
             return
         node = self.nodes.get(ws.node_id)
         if node is not None and node.conn is not None and node.alive:
-            asyncio.ensure_future(
-                node.conn.send({"type": "kill_worker", "worker_id": ws.worker_id})
-            )
+            try:
+                node.conn.post(
+                    {"type": "kill_worker", "worker_id": ws.worker_id}
+                )
+            except ConnectionError:
+                pass  # node dying; its workers die with it
 
     async def h_get_named_actor(self, conn, meta, msg):
         key = (msg.get("namespace", "default"), msg["name"])
@@ -3319,6 +3686,7 @@ class Controller:
             return
         prev_state = ws.state
         ws.state = DEAD
+        self._leased_ids.discard(worker_id)
         ws.leased_to = None  # holder sees the direct conn close and recovers
         if ws.assigned:
             if not ws.blocked:
@@ -3355,9 +3723,12 @@ class Controller:
                     self._retry_or_fail(pt, task_hex, cause)
         if prev_state == ACTOR and ws.actor_hex:
             await self._on_actor_worker_death(ws.actor_hex)
-        # Keep the pool topped up.
-        alive = [w for w in self.workers.values() if w.state in (IDLE, STARTING)]
-        if not alive and (self.ready_queue or self.waiting_tasks):
+        # Keep the pool topped up. Queue-emptiness first: any() short-circuits
+        # on the first idle worker, so a 5,000-actor kill wave doesn't pay a
+        # full worker-table scan per death.
+        if (self.ready_queue or self.waiting_tasks) and not any(
+            w.state == IDLE for w in self.workers.values()
+        ):
             self._spawn_worker()
         self._schedule()
 
@@ -3378,14 +3749,16 @@ class Controller:
             err = TaskError(
                 ActorUnavailableError(f"actor {actor_hex[:12]} restarting"), "", "actor task"
             )
-            for ispec in astate.inflight.values():
+            with astate.lock:  # pump (shard loop) writes concurrently
+                inflight = list(astate.inflight.values())
+                astate.inflight.clear()
+            for ispec in inflight:
                 self._unpin_args(ispec)
                 if ispec.num_returns == -1:
                     self._fail_stream(ispec, err)  # streaming method call
                 for oid in ispec.return_ids:
                     if self._obj(oid.hex()).status != "ready":
                         self._store_error_object(oid.hex(), err)
-            astate.inflight.clear()
             self._pin_args(spec)  # restart creation re-reads its args
             pt = PendingTask(spec=spec, retries_left=0)
             self._enqueue(pt)
@@ -3396,14 +3769,16 @@ class Controller:
                         restarts_used=astate.restarts_used)
             err = TaskError(ActorDiedError(), "", f"actor {actor_hex[:12]}")
             self._drain_actor_queue(astate, err)
-            for ispec in astate.inflight.values():
+            with astate.lock:  # pump (shard loop) writes concurrently
+                inflight = list(astate.inflight.values())
+                astate.inflight.clear()
+            for ispec in inflight:
                 self._unpin_args(ispec)
                 if ispec.num_returns == -1:
                     self._fail_stream(ispec, err)  # streaming method call
                 for oid in ispec.return_ids:
                     if self._obj(oid.hex()).status != "ready":
                         self._store_error_object(oid.hex(), err)
-            astate.inflight.clear()
 
     # ---------------------------------------------------------- node death
     async def _health_check_loop(self):
@@ -4231,6 +4606,27 @@ class Controller:
             ]
         }
 
+    async def h_shard_info(self, conn, meta, msg):
+        """Shard-layout introspection (coordination layer): the per-shard
+        actor/worker partitions and lease holders. The FT test asserts the
+        cross-shard invariants on this surface — every id in exactly one
+        shard, shard routing matches the hash, no lease duplicated."""
+        from .control_shards import HASH_NAME, shard_of
+
+        shards = []
+        for i, sh in enumerate(self.shards):
+            shards.append({
+                "index": i,
+                "threaded": sh.threaded,
+                "actors": sorted(sh.actors),
+                "workers": sorted(sh.workers),
+                "leases": sorted(
+                    w.worker_id for w in list(sh.workers.values())
+                    if w.state == LEASED
+                ),
+            })
+        return {"n": len(self.shards), "hash": HASH_NAME, "shards": shards}
+
     async def h_list_workers(self, conn, meta, msg):
         return {
             "workers": [
@@ -4253,25 +4649,27 @@ class Controller:
         only = msg.get("worker_id")
         init = bool(msg.get("init"))
         out = {}
+        from .log_utils import read_log_chunk
+
+        def one_head(ws: WorkerState):
+            # Head-node files are read synchronously: spawning a coroutine
+            # per worker per poll cost ~10ms/s of pure gather overhead at
+            # 2,000 workers.
+            path = os.path.join(self.session_dir, f"worker-{ws.worker_id}.log")
+            if init:
+                try:
+                    out[ws.worker_id] = {"data": "", "offset": os.path.getsize(path)}
+                except OSError:
+                    pass
+                return
+            got = read_log_chunk(path, cursors.get(ws.worker_id, 0))
+            if got is not None:
+                data, offset = got
+                out[ws.worker_id] = {
+                    "data": data.decode(errors="replace"), "offset": offset
+                }
 
         async def one(ws: WorkerState):
-            path = os.path.join(self.session_dir, f"worker-{ws.worker_id}.log")
-            if ws.node_id == HEAD_NODE:
-                if init:
-                    try:
-                        out[ws.worker_id] = {"data": "", "offset": os.path.getsize(path)}
-                    except OSError:
-                        pass
-                    return
-                from .log_utils import read_log_chunk
-
-                got = read_log_chunk(path, cursors.get(ws.worker_id, 0))
-                if got is not None:
-                    data, offset = got
-                    out[ws.worker_id] = {
-                        "data": data.decode(errors="replace"), "offset": offset
-                    }
-                return
             node = self.nodes.get(ws.node_id)
             if node is None or not node.alive or node.conn is None:
                 return
@@ -4286,8 +4684,26 @@ class Controller:
             if resp and resp.get("offset") is not None:
                 out[ws.worker_id] = {"data": resp.get("data", ""), "offset": resp["offset"]}
 
-        await asyncio.gather(*(one(ws) for ws in list(self.workers.values())
-                               if not only or ws.worker_id == only))
+        remote = []
+        heads = []
+        for ws in list(self.workers.values()):
+            if only and ws.worker_id != only:
+                continue
+            if ws.node_id == HEAD_NODE:
+                heads.append(ws)
+            else:
+                remote.append(ws)
+        if heads:
+            # Off-loop: one stat per worker per poll blocked the event loop
+            # ~200ms at 1,000 workers (syscalls are slow on the virtualized
+            # bench hosts); the scheduler must not stall behind log tailing.
+            def scan():
+                for ws in heads:
+                    one_head(ws)
+
+            await asyncio.get_running_loop().run_in_executor(None, scan)
+        if remote:
+            await asyncio.gather(*(one(ws) for ws in remote))
         return {"logs": out}
 
     # -------------------------------------------------- prometheus metrics
@@ -4467,7 +4883,36 @@ class Controller:
             del self.timeline[:50_000]
             self._timeline_base += 50_000
 
+    # High-volume lifecycle kinds subject to the storm cap (the task-events
+    # 4096-cap pattern applied to the ACTOR lifecycle): a 10k-actor wave
+    # must not spend its controller time narrating itself into the
+    # timeline. Death/restart/failure kinds are EXEMPT — poll_events
+    # subscribers (the elastic-training gang supervisor) depend on them.
+    _STORM_KINDS = frozenset({
+        "worker_spawn", "worker_registered", "actor_created", "actor_alive",
+        "actor_readopted", "task_submitted", "task_dispatched", "task_done",
+        "task_handoff", "lease_granted",
+    })
+    _STORM_WINDOW_S = 1.0
+    _STORM_CAP = 4096
+
     def _event(self, kind: str, **fields):
+        if kind in self._STORM_KINDS:
+            now = time.monotonic()
+            st = getattr(self, "_storm_state", None)
+            if st is None:
+                st = self._storm_state = [now, 0, 0]  # window t0, count, dropped
+            if now - st[0] >= self._STORM_WINDOW_S:
+                if st[2]:
+                    self.timeline.append({
+                        "ts": time.time(), "event": "actor_events_dropped",
+                        "n": st[2],
+                    })
+                st[0], st[1], st[2] = now, 0, 0
+            st[1] += 1
+            if st[1] > self._STORM_CAP:
+                st[2] += 1
+                return
         self.timeline.append({"ts": time.time(), "event": kind, **fields})
         self._trim_timeline()
 

@@ -23,6 +23,18 @@ def main():
     # spawned workers/agents inherit it; drivers discover it in address.json.
     ensure_auth_token()
     args = cloudpickle.loads(bytes.fromhex(os.environ["RAY_TPU_CONTROLLER_ARGS"]))
+    # Surface the shard layout in the session log (stderr → controller.log):
+    # postmortems need to know which partitioning a session actually ran
+    # with (control_shards.py; the count is config, not snapshot, state).
+    import sys
+
+    from . import config as rt_config
+
+    print(
+        f"controller: shards={rt_config.get('controller_shards')} "
+        f"shard_threads={rt_config.get('controller_shard_threads')}",
+        file=sys.stderr, flush=True,
+    )
     profile_path = os.environ.get("RAY_TPU_CONTROLLER_PROFILE")
     if profile_path:
         # Control-plane profiling (dev tool): cProfile the whole event loop,

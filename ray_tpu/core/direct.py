@@ -702,6 +702,22 @@ class DirectCallManager:
         except Exception:  # noqa: BLE001
             pass
 
+    async def _return_lease_ids(self, worker_ids):
+        """Batched give-back (idle sweep / shutdown): one frame returns the
+        whole set — under lease churn the per-lease frames measurably
+        competed with the submit path on the controller conn."""
+        if not worker_ids:
+            return
+        if len(worker_ids) == 1:
+            await self._return_lease_id(worker_ids[0])
+            return
+        try:
+            await self.backend.conn.send(
+                {"type": "return_lease_batch", "worker_ids": list(worker_ids)}
+            )
+        except Exception:  # noqa: BLE001
+            pass
+
     # ---------------------------------------------------------- results
     def _make_on_result(self, lease: Optional[_Lease]):
         async def on_push(msg: dict):
@@ -1076,7 +1092,7 @@ class DirectCallManager:
             self.io.call_nowait(self._probe_stalled_lease(lease))
         for lease in give_back:
             lease.conn.close()
-            await self._return_lease_id(lease.worker_id)
+        await self._return_lease_ids([l.worker_id for l in give_back])
 
     async def _probe_stalled_lease(self, lease: _Lease):
         """Health-probe a lease that has inflight work but no completions:

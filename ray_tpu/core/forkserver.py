@@ -369,6 +369,74 @@ def template_main():
     except Exception:  # noqa: BLE001 — workers degrade to import-at-use
         pass
 
+    # Pre-WARM (not just pre-import) the child's boot paths: many stdlib /
+    # codec layers build caches on FIRST USE (asyncio's event-loop policy +
+    # selector machinery, pickle/cloudpickle dispatch tables, msgpack
+    # packer state, struct/re caches). Exercising each once HERE puts those
+    # caches on template pages every child shares copy-on-write, instead of
+    # each child privately rebuilding them — measured ~1.5 MB off per-child
+    # USS, which is what bounds how many workers one host can hold
+    # resident (the 10k-actor envelope wave).
+    try:
+        import asyncio
+
+        _loop = asyncio.new_event_loop()
+
+        async def _warm_srv():
+            s = await asyncio.start_server(
+                lambda r, w: None, host="127.0.0.1", port=0
+            )
+            s.close()
+            await s.wait_closed()
+
+        _loop.run_until_complete(_warm_srv())
+        _loop.close()
+        asyncio.set_event_loop(None)
+
+        import cloudpickle
+
+        class _Warm:
+            def ping(self):
+                return 1
+
+        cloudpickle.loads(cloudpickle.dumps((_Warm, (), {})))
+        del _Warm
+        from . import serialization as _ser
+
+        _ser.unpack(_ser.pack({"warm": 1}))
+        from .rpc import decode_msg as _dec, encode_msg as _enc
+
+        _dec(_enc({"type": "warm", "a": [1, 2.0, "s", b"b", (1, 2)],
+                   "d": {"k": 1}})[4:])
+        import collections  # noqa: F401
+        import concurrent.futures  # noqa: F401
+        import inspect  # noqa: F401
+        import queue  # noqa: F401
+        import traceback  # noqa: F401
+        # The protobuf stack (google.protobuf + upb + the generated pb2) is
+        # the single largest post-fork import — every child decodes its
+        # first TaskSpec through it. Import AND roundtrip once here so the
+        # descriptor pool / reflection caches live on shared pages.
+        from .task_spec import (  # noqa: F401
+            TaskOptions as _TO,
+            TaskSpec as _TS,
+            spec_from_proto_bytes as _sfpb,
+            spec_to_proto_bytes as _stpb,
+        )
+        from .ids import JobID as _JID, TaskID as _TID
+        from .task_spec import TaskType as _TT
+
+        _jid = _JID.from_int(1)
+        _tid = _TID.for_driver(_jid)
+        _sfpb(_stpb(_TS(
+            task_id=_tid, job_id=_jid, task_type=_TT.NORMAL_TASK,
+            func_payload=b"", arg_refs=[], num_returns=1, return_ids=[],
+            resources={}, options=_TO(), name="warm",
+        )))
+    except Exception:  # noqa: BLE001 — warming is best-effort; children
+        # simply rebuild whatever failed to warm
+        pass
+
     # Freeze the heap into the permanent generation: forked children never
     # GC-walk (and so never copy-on-write-fault) the template's ~100s of MB
     # of imported modules. On lazily-backed guests COW faults are extra

@@ -15,6 +15,13 @@ def read_log_chunk(path: str, offset: int, cap: Optional[int] = None) -> Optiona
 
         cap = rt_config.get("log_chunk_bytes")
     try:
+        import os
+
+        # One stat instead of open+seek+read for the (overwhelmingly
+        # common) unchanged file — thousands of idle workers are polled
+        # every second.
+        if os.path.getsize(path) <= offset:
+            return None
         with open(path, "rb") as f:
             f.seek(offset)
             data = f.read(cap)

@@ -405,18 +405,25 @@ class NodeAgent:
 
     def _spawn_worker(self, worker_id: str, tpu: bool = False,
                       isolation: Optional[dict] = None):
-        env = dict(os.environ)
+        # Spawn-env template, built once (same fix as the controller's
+        # _spawn_worker): dict(os.environ) iterates the environ Mapping in
+        # Python per spawn — a pure-overhead tax on registration storms.
+        base = getattr(self, "_spawn_env_base", None)
         pkg_root = os.path.dirname(
             os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         )
-        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        if base is None:
+            base = dict(os.environ)
+            base["PYTHONPATH"] = pkg_root + os.pathsep + base.get("PYTHONPATH", "")
+            base["RAY_TPU_ADDRESS"] = self.controller_address
+            base["RAY_TPU_NODE_IP"] = self.node_ip  # workers bind/advertise here
+            base["RAY_TPU_SESSION_DIR"] = self.session_dir
+            base["RAY_TPU_SESSION_TAG"] = store.SESSION_TAG  # this node's arena
+            base["RAY_TPU_NODE_ID"] = self.node_id
+            base["PYTHONUNBUFFERED"] = "1"  # log tailing needs unbuffered stdout
+            self._spawn_env_base = base
+        env = dict(base)
         env["RAY_TPU_WORKER_ID"] = worker_id
-        env["RAY_TPU_ADDRESS"] = self.controller_address
-        env["RAY_TPU_NODE_IP"] = self.node_ip  # workers bind/advertise here
-        env["RAY_TPU_SESSION_DIR"] = self.session_dir
-        env["RAY_TPU_SESSION_TAG"] = store.SESSION_TAG  # this node's arena
-        env["RAY_TPU_NODE_ID"] = self.node_id
-        env["PYTHONUNBUFFERED"] = "1"  # log tailing needs unbuffered stdout
         if tpu:
             env["RAY_TPU_WORKER_TPU"] = "1"
         else:

@@ -232,30 +232,44 @@ class Arena:
 
     def get(self, object_id: str) -> Optional[memoryview]:
         """Pin + return a read view of a sealed object; None if absent.
-        Balance every successful get with release()."""
-        size = ctypes.c_uint64()
-        off = self._lib.rt_arena_get(self._h, object_id.encode(), ctypes.byref(size))
-        if off == -1:
-            return None
-        if off == -2:
-            raise BlockingIOError(f"object {object_id} not sealed yet")
-        return self._view(off, size.value)
+        Balance every successful get with release(). Handle-lifetime safe:
+        the bulk server's serve threads call this concurrently with session
+        teardown's detach() (see _hlock note above — rt_arena_get on a
+        freed handle was a real segfault, observed from
+        bulk._serve_map → bulk_map_source during the chaos kill test)."""
+        with self._hlock:
+            if not self._h:
+                return None  # arena detached (session tearing down)
+            size = ctypes.c_uint64()
+            off = self._lib.rt_arena_get(self._h, object_id.encode(), ctypes.byref(size))
+            if off == -1:
+                return None
+            if off == -2:
+                raise BlockingIOError(f"object {object_id} not sealed yet")
+            return self._view(off, size.value)
 
     def locate(self, object_id: str):
         """Pin + return (file_offset, size) of a sealed object within the
         arena's backing file (object offsets are payload-relative; adding
         data_offset makes them file offsets — bulk.py sendfiles from them).
-        None if absent. Balance every successful locate with release()."""
-        size = ctypes.c_uint64()
-        off = self._lib.rt_arena_get(self._h, object_id.encode(), ctypes.byref(size))
-        if off == -1:
-            return None
-        if off == -2:
-            raise BlockingIOError(f"object {object_id} not sealed yet")
-        return off + self._lib.rt_arena_data_offset(self._h), size.value
+        None if absent. Balance every successful locate with release().
+        Handle-lifetime safe like get() — bulk serve threads race detach."""
+        with self._hlock:
+            if not self._h:
+                return None
+            size = ctypes.c_uint64()
+            off = self._lib.rt_arena_get(self._h, object_id.encode(), ctypes.byref(size))
+            if off == -1:
+                return None
+            if off == -2:
+                raise BlockingIOError(f"object {object_id} not sealed yet")
+            return off + self._lib.rt_arena_data_offset(self._h), size.value
 
     def release(self, object_id: str):
-        self._lib.rt_arena_release(self._h, object_id.encode())
+        with self._hlock:
+            if not self._h:
+                return  # arena already detached; the pin died with it
+            self._lib.rt_arena_release(self._h, object_id.encode())
 
     def delete(self, object_id: str) -> bool:
         return self._lib.rt_arena_delete(self._h, object_id.encode()) == 0

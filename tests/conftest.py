@@ -60,3 +60,9 @@ def pytest_configure(config):
         "chaos: kill-based fault-injection test (SIGKILL/OOM of live "
         "workers or nodes); tier-1-safe quick variants stay unmarked",
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 `-m 'not slow'` gate (long bench "
+        "or multi-minute integration runs; keep the gate under its 870s "
+        "window)",
+    )

@@ -1,0 +1,140 @@
+"""Unit tests for the sharded control-plane directories
+(core/control_shards.py): partition totality/disjointness, dict-facade
+fidelity, cross-loop marshaling, and the live cluster's shard_info
+invariants.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.control_shards import (
+    ControlShard,
+    CrossLoopEvent,
+    ShardedDict,
+    shard_of,
+)
+
+
+def test_shard_of_stable_and_total():
+    n = 4
+    ids = [f"{i:048x}" for i in range(500)] + [f"w{i}" for i in range(500)]
+    for h in ids:
+        s = shard_of(h, n)
+        assert 0 <= s < n
+        assert s == shard_of(h, n)  # stable
+    # Every shard gets a reasonable share (crc32 spreads hex ids).
+    counts = [0] * n
+    for h in ids:
+        counts[shard_of(h, n)] += 1
+    assert min(counts) > len(ids) // (n * 4)
+    assert shard_of("anything", 1) == 0
+
+
+def _make_table(n):
+    shards = [ControlShard(i, threaded=False) for i in range(n)]
+    return shards, ShardedDict(shards, "actors")
+
+
+def test_sharded_dict_facade():
+    shards, t = _make_table(4)
+    keys = [f"{i:048x}" for i in range(100)]
+    for i, k in enumerate(keys):
+        t[k] = i
+    assert len(t) == 100
+    assert set(t) == set(keys)
+    assert t[keys[7]] == 7
+    assert t.get(keys[3]) == 3
+    assert t.get("missing") is None
+    assert keys[5] in t and "missing" not in t
+    assert sorted(v for v in t.values()) == list(range(100))
+    assert dict(t.items()) == {k: i for i, k in enumerate(keys)}
+    assert t.pop(keys[0]) == 0
+    assert len(t) == 99
+    assert t.pop("missing", "d") == "d"
+    # Partition disjointness + totality: each key in exactly one shard,
+    # and in the shard the hash names.
+    seen = set()
+    for i, sh in enumerate(shards):
+        for k in sh.actors:
+            assert k not in seen
+            seen.add(k)
+            assert shard_of(k, 4) == i
+    assert seen == set(keys) - {keys[0]}
+    # snapshot_shards: atomic copies, union == table
+    snaps = t.snapshot_shards()
+    assert sum(len(s) for s in snaps) == len(t)
+    assert t.snapshot() == dict(t.items())
+
+
+def test_threaded_shard_marshaling():
+    sh = ControlShard(0, threaded=True)
+    try:
+        hits = []
+        sh.call_soon(hits.append, 1)
+        deadline = time.monotonic() + 5
+        while not hits and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert hits == [1]
+        # run_sync returns values and propagates exceptions
+        assert sh.run_sync(lambda: 42) == 42
+        with pytest.raises(ValueError):
+            sh.run_sync(lambda: (_ for _ in ()).throw(ValueError("x")))
+
+        # CrossLoopEvent: set() from this thread wakes a waiter on the
+        # shard loop.
+        async def wait_one():
+            ev = asyncio.Event()
+            loop = asyncio.get_running_loop()
+            loop.call_soon(CrossLoopEvent(loop, ev).set)
+            await asyncio.wait_for(ev.wait(), 2)
+            return "woke"
+
+        fut = asyncio.run_coroutine_threadsafe(wait_one(), sh.loop)
+        assert fut.result(5) == "woke"
+    finally:
+        sh.stop()
+
+
+@pytest.mark.cluster
+def test_live_cluster_shard_invariants():
+    """shard_info on a live cluster: every actor/worker in exactly one
+    shard, routing matches the hash, no lease duplicated across shards."""
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    try:
+        @ray_tpu.remote(num_cpus=0)
+        class A:
+            def ping(self):
+                return 1
+
+        actors = [A.remote() for _ in range(12)]
+        assert sum(ray_tpu.get([a.ping.remote() for a in actors], timeout=180)) == 12
+        from ray_tpu.core import api as _api
+
+        backend = _api._global_runtime().backend
+        info = backend._request({"type": "shard_info"})
+        n = info["n"]
+        assert n >= 1 and len(info["shards"]) == n
+        seen_actors, seen_workers, seen_leases = set(), set(), set()
+        for sh in info["shards"]:
+            for h in sh["actors"]:
+                assert h not in seen_actors, "actor duplicated across shards"
+                seen_actors.add(h)
+                assert shard_of(h, n) == sh["index"]
+            for w in sh["workers"]:
+                assert w not in seen_workers, "worker duplicated across shards"
+                seen_workers.add(w)
+                assert shard_of(w, n) == sh["index"]
+            for l in sh["leases"]:
+                assert l not in seen_leases, "lease duplicated across shards"
+                assert l in sh["workers"], "lease outside its owning shard"
+                seen_leases.add(l)
+        created = {a._actor_id.hex() for a in actors}
+        assert created <= seen_actors
+        for a in actors:
+            ray_tpu.kill(a)
+    finally:
+        ray_tpu.shutdown()

@@ -115,3 +115,48 @@ def test_cluster_actor_spawn_uses_forkserver():
         assert warm < 30
     finally:
         ray_tpu.shutdown()
+
+
+@pytest.mark.cluster
+def test_warm_worker_uss_under_budget():
+    """COW-sharing regression gate: a warm-forked worker's USS
+    (Private_Clean + Private_Dirty — the memory that is actually THIS
+    process's, unlike RSS which double-counts every shared template page)
+    must stay under budget. The r5 baseline was ~14 MB/worker, which is
+    what capped the 10k-actor envelope probe at 2k-resident waves; the
+    warm-template pre-import + first-use cache warming (protobuf stack,
+    asyncio/selector machinery, pickle dispatch tables — see
+    forkserver.template_main) measures ~5 MB. Budget 7 MB = the >=2x bar
+    with headroom for allocator noise."""
+
+    def uss_kb(pid: int) -> int:
+        total = 0
+        with open(f"/proc/{pid}/smaps_rollup") as f:
+            for line in f:
+                if line.startswith(("Private_Clean:", "Private_Dirty:")):
+                    total += int(line.split()[1])
+        return total
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8)
+    try:
+        @ray_tpu.remote(num_cpus=0)
+        class P:
+            def pid(self):
+                import os
+
+                return os.getpid()
+
+        # A few actors so at least some ride the warm fork path once the
+        # template is up (the first may boot cold while it imports).
+        actors = [P.remote() for _ in range(6)]
+        pids = ray_tpu.get([a.pid.remote() for a in actors], timeout=180)
+        time.sleep(1.0)  # let boot-time allocations settle
+        vals = sorted(uss_kb(p) for p in pids)
+        # The MEDIAN worker must be warm-forked and under budget (cold-boot
+        # stragglers from the template's import window are excluded by
+        # construction: they sit at the top of the sorted list).
+        median = vals[len(vals) // 2]
+        assert median < 7 * 1024, f"warm worker USS regressed: {vals} kB"
+    finally:
+        ray_tpu.shutdown()

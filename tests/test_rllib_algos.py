@@ -73,6 +73,8 @@ class TestLearningBars:
         best = _train_until(algo, 130, 250)
         assert best >= 130, f"APPO failed to learn CartPole: best={best}"
 
+    @pytest.mark.slow  # ~30s learning bench — tier-1 hygiene (870s gate);
+    # SAC construction/step coverage stays in the unmarked smoke tests
     def test_sac_pendulum_learning(self):
         algo = (
             SACConfig()
@@ -170,6 +172,7 @@ class TestMultiAgent:
 
 
 class TestTD3:
+    @pytest.mark.slow  # ~14s learning bench — tier-1 hygiene
     def test_td3_pendulum_learning(self):
         from ray_tpu.rllib import TD3Config
 
@@ -205,6 +208,7 @@ class TestA2C:
 
 
 class TestDDPG:
+    @pytest.mark.slow  # ~8s learning bench — tier-1 hygiene
     def test_ddpg_pendulum_learning(self):
         from ray_tpu.rllib import DDPGConfig
 

@@ -52,6 +52,8 @@ def test_dreamer_world_model_learns():
     assert recons[-1] < recons[0], f"world model not learning: {recons}"
 
 
+@pytest.mark.slow  # ~2 min learning bench — tier-1 hygiene (870s gate);
+# the world-model learning test above keeps quick Dreamer coverage
 def test_dreamer_cartpole_learning():
     algo = (
         DreamerV3Config()

@@ -41,6 +41,7 @@ class TestEpisodeDataset:
             EpisodeDataset([])
 
 
+@pytest.mark.slow  # ~17s learning bench — tier-1 hygiene (870s gate)
 def test_dt_learns_cartpole_from_offline_trajectories():
     """Learning bar: conditioned on a 190 target return, DT must hold the
     pole ≥150 steps — trained purely from offline expert episodes."""
