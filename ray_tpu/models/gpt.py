@@ -1098,6 +1098,116 @@ def decode_step_paged(params, token, positions, block_tables, kv, cfg: GPTConfig
     return logits.astype(jnp.float32), kv
 
 
+def verify_step_paged(params, tokens, positions, valid_len, block_tables, kv,
+                      cfg: GPTConfig):
+    """Speculative-decode verify: score k draft tokens (plus the lane's
+    current token) in ONE forward over the paged cache.
+
+    tokens [B, K1] int32 — lane b's token j sits at global position
+    `positions[b] + j` (j=0 is the last emitted token whose KV has not
+    landed yet, j>=1 are draft proposals); `valid_len` [B] int32 is the
+    per-lane count of real tokens (<= K1; 0 for padding lanes — the K/V of
+    slots at or past it scatter to the null block so a short draft can
+    never clobber a neighbouring block through index clamping);
+    block_tables [B, W] int32 as in `decode_step_paged`. Each layer
+    scatters all K1 tokens' K/V first, then attends causally (query j sees
+    history 0..positions[b]+j), so logits[b, j] is EXACTLY what a
+    sequential `decode_step_paged` would produce after accepting drafts
+    0..j-1 — the greedy accept rule (longest matching draft prefix + one
+    corrective/bonus token) therefore reproduces non-speculative greedy
+    decode token-for-token. Returns (logits [B, K1, V] f32, kv).
+    """
+    if cfg.mlp_type == "moe":
+        raise NotImplementedError("paged decode does not support MoE yet")
+    B, K1 = tokens.shape
+    W = block_tables.shape[1]
+    BS = kv["k"].shape[3]
+    M = W * BS
+    H, Dh = cfg.n_heads, cfg.d_head
+    scale = 1.0 / math.sqrt(cfg.d_head)
+    pos = positions[:, None] + jnp.arange(K1)[None, :]          # [B, K1]
+    x = params["tok_embed"][tokens].astype(cfg.dtype)           # [B, K1, E]
+    if cfg.pos == "learned":
+        x = x + params["pos_embed"][pos].astype(cfg.dtype)
+    rope_tables = None
+    if cfg.pos == "rotary":
+        rd = min(cfg.rotary_dim, cfg.d_head)
+        rope_tables = rope_frequencies(rd, cfg.max_seq, dtype=jnp.float32)
+    valid = jnp.arange(K1)[None, :] < valid_len[:, None]        # [B, K1]
+    phys = jnp.where(
+        valid,
+        jnp.take_along_axis(
+            block_tables, jnp.minimum(pos // BS, W - 1), axis=1
+        ),
+        0,
+    )
+    off = pos % BS
+    cols = jnp.arange(M)
+    layer_stack = {k: params[k] for k in _LAYER_KEYS if k in params}
+
+    def scan_body(x, inp):
+        layer_params, kk, vv = inp  # kk/vv: [NB, H, BS, Dh]
+        p = jax.tree_util.tree_map(lambda a: a.astype(cfg.dtype), layer_params)
+        h = _norm(x, p["ln1_w"], p["ln1_b"], cfg.norm)
+        qkv = jnp.einsum("bse,ethd->btshd", h, p["w_qkv"]) + p["b_qkv"][:, None]
+        q, k, v = (
+            qkv[:, i].transpose(0, 2, 1, 3).reshape(B, H, K1, Dh)
+            for i in range(3)
+        )
+        if cfg.pos == "rotary":
+            cos, sin = rope_tables
+            rd = min(cfg.rotary_dim, Dh)
+            c = cos[pos][:, None]                               # [B, 1, K1, rd/2]
+            s = sin[pos][:, None]
+            if rd < Dh:
+                q = jnp.concatenate(
+                    [_rope_rotate(q[..., :rd], c, s), q[..., rd:]], -1
+                )
+                k = jnp.concatenate(
+                    [_rope_rotate(k[..., :rd], c, s), k[..., rd:]], -1
+                )
+            else:
+                q, k = _rope_rotate(q, c, s), _rope_rotate(k, c, s)
+        # Scatter every lane's K1 tokens to their (block, offset) slots,
+        # then gather each lane's table history — the drafts' own keys come
+        # back through the same path, so query j attends drafts 0..j.
+        kk = kk.at[phys, :, off].set(k.transpose(0, 2, 1, 3).astype(kk.dtype))
+        vv = vv.at[phys, :, off].set(v.transpose(0, 2, 1, 3).astype(vv.dtype))
+        gk = kk[block_tables].transpose(0, 2, 1, 3, 4).reshape(B, H, M, Dh)
+        gv = vv[block_tables].transpose(0, 2, 1, 3, 4).reshape(B, H, M, Dh)
+        scores = jnp.einsum(
+            "bhsd,bhtd->bhst", q, gk, preferred_element_type=jnp.float32
+        ) * scale                                               # [B, H, K1, M]
+        scores = jnp.where(
+            cols[None, None, None, :] <= pos[:, None, :, None], scores, -1e30
+        )
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bhst,bhtd->bhsd", probs.astype(gv.dtype), gv)
+        attn_out = jnp.einsum("bhsd,hde->bse", attn, p["w_o"]) + p["b_o"]
+
+        if cfg.parallel_block:
+            mlp_in = h
+        else:
+            x = x + attn_out
+            mlp_in = _norm(x, p["ln2_w"], p["ln2_b"], cfg.norm)
+        u = jnp.einsum("bse,ef->bsf", mlp_in, p["w_in"]) + p["b_in"]
+        if cfg.activation == "swiglu":
+            g = jnp.einsum("bse,ef->bsf", mlp_in, p["w_gate"])
+            u = jax.nn.silu(g) * u
+        else:
+            u = jax.nn.gelu(u)
+        mlp_out = jnp.einsum("bsf,fe->bse", u, p["w_out"]) + p["b_out"]
+        out = x + attn_out + mlp_out if cfg.parallel_block else x + mlp_out
+        return out, (kk, vv)
+
+    x, (ks, vs) = jax.lax.scan(scan_body, x, (layer_stack, kv["k"], kv["v"]))
+    kv = {"k": ks, "v": vs}
+    x = _norm(x, params["ln_f_w"], params["ln_f_b"], cfg.norm)
+    head = params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("bke,ev->bkv", x, head.astype(cfg.dtype))
+    return logits.astype(jnp.float32), kv
+
+
 def make_generate(cfg: GPTConfig, max_new_tokens: int, temperature: float = 0.0):
     """Returns jittable `gen(params, prompt [B, S0], rng) -> tokens
     [B, max_new_tokens]`: prefill + a device-side `lax.scan` decode loop —

@@ -1,9 +1,11 @@
 """ray_tpu.serve — online model serving (reference: `python/ray/serve/`).
 
 Control plane: ServeController actor reconciling deployment → replica-actor
-state. Data plane: client-side Router (power-of-two-choices) → replica
-actors; batch formation in the router so TPU replicas run one XLA program
-per formed batch. See SURVEY.md §2.5 / §3.4.
+state, with engine-metrics autoscaling (`fleet/autoscale.py`). Data plane:
+client-side Router (prefix-affinity placement for LLM prompts via
+`fleet/routing.py`, power-of-two-choices otherwise) → replica actors;
+batch formation in the router so TPU replicas run one XLA program per
+formed batch. See SURVEY.md §2.5 / §3.4 and README.md "Fleet serving".
 """
 
 from .api import (

@@ -18,6 +18,10 @@ from typing import Any, Dict, List, Optional
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 SERVE_NAMESPACE = "serve"
 
+# A router that stops reporting for this long no longer counts toward the
+# deployment's outstanding-request total (process exited, handle dropped).
+_ROUTER_REPORT_TTL_S = 5.0
+
 
 class _DeploymentState:
     def __init__(self, spec: Dict[str, Any]):
@@ -34,10 +38,28 @@ class _DeploymentState:
         self.next_replica_id = 0
         # Consecutive missed pings per READY replica tag; replaced at 3.
         self.miss_counts: Dict[str, int] = {}
-        # autoscaling bookkeeping
+        # autoscaling bookkeeping: outstanding counts are keyed PER ROUTER
+        # and summed — EMA-blending different routers' reports into one
+        # stream undercounted the fleet (two routers with 10 outstanding
+        # each converged the EMA to ~10, not 20).
+        self.router_reports: Dict[str, List[float]] = {}  # id -> [ongoing, t]
         self.ongoing_ema: float = 0.0
         self.last_scale_action_t: float = 0.0
+        # Latest fleet telemetry per READY replica tag (piggybacked on the
+        # reconcile health probe): {"t": mono, "engine": {...} | None}.
+        self.replica_meta: Dict[str, Dict[str, Any]] = {}
         self.status: str = "UPDATING"
+
+    def ongoing_total(self, now: float) -> float:
+        """Outstanding requests summed across LIVE routers; expired
+        reporters are pruned in place."""
+        dead = [
+            rid for rid, (_, t) in self.router_reports.items()
+            if now - t > _ROUTER_REPORT_TTL_S
+        ]
+        for rid in dead:
+            del self.router_reports[rid]
+        return sum(v for v, _ in self.router_reports.values())
 
 
 class ServeController:
@@ -133,6 +155,16 @@ class ServeController:
                 "replica_tags": list(state.replica_tags),
                 "batch_methods": state.spec.get("batch_methods", {}),
                 "max_ongoing_requests": state.spec["opts"]["max_ongoing_requests"],
+                "prefix_affinity": state.spec["opts"].get(
+                    "prefix_affinity_routing", True
+                ),
+                # Aligned with `replicas`: each entry is the replica's last
+                # piggybacked engine telemetry (None when absent/stale) —
+                # the fleet router's affinity + load inputs.
+                "replica_meta": [
+                    (state.replica_meta.get(t) or {}).get("engine")
+                    for t in state.replica_tags
+                ],
                 "status": state.status,
             }
 
@@ -189,9 +221,18 @@ class ServeController:
             return out
 
     # ---------------------------------------------------------- autoscaling
-    def record_request_metrics(self, app_name: str, deployment_name: str, ongoing: float):
+    def record_request_metrics(
+        self,
+        app_name: str,
+        deployment_name: str,
+        ongoing: float,
+        router_id: str = "",
+    ):
         """Routers report their outstanding-request counts (reference:
-        `autoscaling_metrics.py` pushes replica queue lengths)."""
+        `autoscaling_metrics.py` pushes replica queue lengths). Reports are
+        keyed by `router_id` and SUMMED across live routers — a router that
+        stops reporting expires after `_ROUTER_REPORT_TTL_S`."""
+        now = time.monotonic()
         with self._lock:
             app = self._apps.get(app_name)
             if not app:
@@ -199,31 +240,89 @@ class ServeController:
             state = app["deployments"].get(deployment_name)
             if not state:
                 return
-            state.ongoing_ema = 0.8 * state.ongoing_ema + 0.2 * ongoing
+            state.router_reports[router_id] = [float(ongoing), now]
+            # The EMA advances inside _maybe_autoscale (once per report —
+            # updating it here too would double-decay it).
             self._maybe_autoscale(state)
 
     def _maybe_autoscale(self, state: _DeploymentState):
+        """Scale decision from the fleet policy (`serve/fleet/autoscale`):
+        router-outstanding pressure (summed across routers) OR engine
+        queue-depth / TTFT-tail pressure scales up; scale-down additionally
+        requires the coldest replica's prefix-hit economics to agree.
+        Called on every router report AND every reconcile pass — an idle
+        deployment whose routers went away still scales down."""
         cfg = state.spec["opts"].get("autoscaling_config")
         if not cfg:
             return
+        from .fleet import FleetSignals, decide_scale
+
         now = time.monotonic()
-        per_replica = state.ongoing_ema / max(len(state.replicas), 1)
+        engines = [
+            m["engine"]
+            for m in state.replica_meta.values()
+            if m and m.get("engine")
+        ]
+        ttfts = [
+            e["ttft_p99_s"] for e in engines if e.get("ttft_p99_s") is not None
+        ]
+        # Refresh the EMA toward the current router total so pressure decays
+        # once routers stop reporting (expired reporters drop out of the
+        # sum) — but only while SOME signal source is live: with no live
+        # router reports and no engine telemetry the controller is blind,
+        # and a blind decay-to-zero would scale down under in-flight work
+        # (a router only reports on new submissions).
+        total = state.ongoing_total(now)
+        if state.router_reports or engines:
+            state.ongoing_ema = 0.8 * state.ongoing_ema + 0.2 * total
+        signals = FleetSignals(
+            replicas=len(state.replicas),
+            ongoing=state.ongoing_ema,
+            queue_depth=float(
+                sum(e.get("queue_depth") or 0 for e in engines)
+            ),
+            running=float(sum(e.get("running") or 0 for e in engines)),
+            ttft_p99_s=max(ttfts) if ttfts else None,
+            hit_rates=[e.get("prefix_hit_rate") for e in engines],
+        )
+        delta = decide_scale(
+            signals,
+            target_ongoing_requests=cfg["target_ongoing_requests"],
+            target_queue_depth=cfg.get("target_queue_depth", 4.0),
+            ttft_p99_target_s=cfg.get("ttft_p99_target_s"),
+            downscale_hit_rate=cfg.get("downscale_hit_rate", 0.2),
+        )
         if (
-            per_replica > cfg["target_ongoing_requests"]
+            delta > 0
             and state.target_replicas < cfg["max_replicas"]
             and now - state.last_scale_action_t > cfg["upscale_delay_s"]
         ):
             state.target_replicas += 1
-            state.last_scale_action_t = now
-            self._version += 1
         elif (
-            per_replica < 0.5 * cfg["target_ongoing_requests"]
+            delta < 0
             and state.target_replicas > cfg["min_replicas"]
             and now - state.last_scale_action_t > cfg["downscale_delay_s"]
         ):
             state.target_replicas -= 1
-            state.last_scale_action_t = now
-            self._version += 1
+        else:
+            return
+        state.last_scale_action_t = now
+        self._version += 1
+        try:
+            from ..util.metrics import serve_fleet_metrics
+
+            name = state.spec["name"]
+            m = serve_fleet_metrics()
+            m["serve_autoscale_decisions_total"].inc(
+                1.0,
+                tags={"deployment": name,
+                      "direction": "up" if delta > 0 else "down"},
+            )
+            m["serve_deployment_target_replicas"].set(
+                float(state.target_replicas), tags={"deployment": name}
+            )
+        except Exception:  # noqa: BLE001 — metrics never load-bearing
+            pass
 
     # ------------------------------------------------------------ reconcile
     def _reconcile_loop(self):
@@ -251,24 +350,31 @@ class ServeController:
                 with self._lock:
                     starting = list(state.starting)
                 probes = list(replicas) + [h for h, _, _ in starting]
-                refs = [h.ping.remote() for h in probes]
+                # Health probe + fleet telemetry in one RPC: an answered
+                # telemetry() IS the liveness signal, and LLM replicas ship
+                # their hot-prefix digest / queue depth / TTFT tail along
+                # with it (routers read it back via get_deployment_info).
+                refs = [h.telemetry.remote() for h in probes]
                 ready = set()
+                telem: Dict[Any, Any] = {}
                 if refs:
                     done, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=5.0)
                     for ref in done:
                         try:
-                            ray_tpu.get(ref)
+                            telem[ref] = ray_tpu.get(ref)
                             ready.add(ref)
                         except Exception:  # noqa: BLE001
                             pass
                 ready_refs = refs[: len(replicas)]
                 starting_refs = refs[len(replicas):]
                 now = time.time()
+                mono = time.monotonic()
                 startup_tmo = float(
                     state.spec["opts"].get("replica_startup_timeout_s") or 600.0
                 )
 
                 keep, promote, kill = [], [], []
+                meta_updates: Dict[str, Dict[str, Any]] = {}
                 # READY replicas: a missed ping is counted, not fatal — a
                 # replica busy with a long batch stays ROUTED until three
                 # consecutive misses prove it wedged/dead (previously one
@@ -277,6 +383,9 @@ class ServeController:
                     if r in ready:
                         state.miss_counts.pop(t, None)
                         keep.append((h, t))
+                        v = telem.get(r)
+                        if isinstance(v, dict) and v.get("engine") is not None:
+                            meta_updates[t] = {"t": mono, "engine": v["engine"]}
                     else:
                         m = state.miss_counts.get(t, 0) + 1
                         state.miss_counts[t] = m
@@ -288,6 +397,12 @@ class ServeController:
                 for (h, t, t0), r in zip(starting, starting_refs):
                     if r in ready:
                         promote.append((h, t))
+                        # Telemetry lands WITH the promoting probe, so a
+                        # just-promoted LLM replica is affinity-routable the
+                        # moment serve.run's health wait returns.
+                        v = telem.get(r)
+                        if isinstance(v, dict) and v.get("engine") is not None:
+                            meta_updates[t] = {"t": mono, "engine": v["engine"]}
                     elif now - t0 > startup_tmo:
                         kill.append((h, t))
                     else:
@@ -327,6 +442,16 @@ class ServeController:
                         self._drain(state, excess)
                     changed = True
                 with self._lock:
+                    # Telemetry bookkeeping: adopt this pass's readings and
+                    # drop tags that are no longer routable (a drained
+                    # replica's digest must not keep attracting traffic).
+                    live_tags = set(state.replica_tags)
+                    for t, m in meta_updates.items():
+                        if t in live_tags:
+                            state.replica_meta[t] = m
+                    for t in list(state.replica_meta):
+                        if t not in live_tags:
+                            del state.replica_meta[t]
                     state.status = (
                         "HEALTHY"
                         if len(state.replicas) == state.target_replicas
@@ -334,6 +459,13 @@ class ServeController:
                     )
                     if changed:
                         self._version += 1
+                    # Engine-metrics autoscale tick: pressure measured AT
+                    # the engines must move targets even when no router is
+                    # reporting (idle fleets still need scale-down).
+                    try:
+                        self._maybe_autoscale(state)
+                    except Exception:  # noqa: BLE001
+                        pass
 
     def _start_replica(self, app_name: str, dname: str, state: _DeploymentState):
         import ray_tpu
@@ -385,8 +517,10 @@ class ServeController:
                 break
             # Drop the drained replica's miss counter: leaving it would leak
             # an entry per replica generation (redeploy/scale-down/delete)
-            # and poison a later replica that reuses the tag.
+            # and poison a later replica that reuses the tag. Its telemetry
+            # goes too — a dead replica's digest must not attract traffic.
             state.miss_counts.pop(tag, None)
+            state.replica_meta.pop(tag, None)
             try:
                 ray_tpu.kill(handle)
             except Exception:  # noqa: BLE001

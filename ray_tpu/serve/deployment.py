@@ -15,13 +15,22 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 @dataclasses.dataclass
 class AutoscalingConfig:
-    """Reference: `serve/_private/autoscaling_policy.py` knobs."""
+    """Reference: `serve/_private/autoscaling_policy.py` knobs, extended
+    with the engine-metrics signals (`serve/fleet/autoscale.py`): scale-up
+    also fires on per-replica engine queue depth or the TTFT tail, and
+    scale-down additionally requires the coldest replica's recent
+    prefix-hit rate to be below `downscale_hit_rate` (a hot cache is
+    cheaper to keep than to re-warm)."""
 
     min_replicas: int = 1
     max_replicas: int = 4
     target_ongoing_requests: float = 2.0
     upscale_delay_s: float = 3.0
     downscale_delay_s: float = 30.0
+    # Engine-metrics signals (ignored for deployments without an engine).
+    target_queue_depth: float = 4.0
+    ttft_p99_target_s: Optional[float] = None
+    downscale_hit_rate: float = 0.2
 
 
 @dataclasses.dataclass
@@ -37,6 +46,10 @@ class DeploymentOptions:
     # legitimately take minutes.
     replica_startup_timeout_s: float = 600.0
     max_num_models_per_replica: int = 3  # multiplexing LRU size
+    # Fleet routing: steer requests to the replica whose hot-prefix digest
+    # matches the prompt's leading KV blocks (serve/fleet/routing.py).
+    # False = plain power-of-two (the bench baseline).
+    prefix_affinity_routing: bool = True
 
 
 class Deployment:

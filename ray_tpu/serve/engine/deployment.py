@@ -89,8 +89,15 @@ class _LLMReplica:
             body.get("eos_token"),
         )
 
-    def engine_stats(self) -> Dict[str, Any]:
-        return self.engine.stats()
+    def engine_stats(self, include_raw: bool = False) -> Dict[str, Any]:
+        return self.engine.stats(include_raw=include_raw)
+
+    def fleet_state(self) -> Dict[str, Any]:
+        """Telemetry the generic Replica piggybacks on controller health
+        probes (`replica.telemetry`): queue depth, free blocks, hot-prefix
+        digest, TTFT tail, recent prefix-hit rate, spec acceptance — the
+        inputs to fleet routing and engine-metrics autoscaling."""
+        return self.engine.fleet_state()
 
 
 LLMDeployment = _deployment(

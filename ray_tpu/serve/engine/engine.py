@@ -41,6 +41,7 @@ from typing import Any, Dict, Iterator, List, Optional
 
 from collections import deque
 
+from ...util.metrics import quantile as _quantile
 from .kv_manager import KVBlockManager
 from .scheduler import Scheduler, Sequence, SchedulerOutput, _next_pow2
 
@@ -58,11 +59,16 @@ def _paged_jits():
     if _JITS is None:
         import jax
 
-        from ...models.gpt import decode_step_paged, prefill_paged
+        from ...models.gpt import (
+            decode_step_paged,
+            prefill_paged,
+            verify_step_paged,
+        )
 
         _JITS = (
             jax.jit(prefill_paged, static_argnums=(6,), donate_argnums=(5,)),
             jax.jit(decode_step_paged, static_argnums=(5,), donate_argnums=(4,)),
+            jax.jit(verify_step_paged, static_argnums=(6,), donate_argnums=(5,)),
         )
     return _JITS
 
@@ -83,6 +89,12 @@ class EngineOptions:
     # shared; a prompt whose prefix is cached skips straight to the first
     # cold block. Freed blocks are retained (reclaimable, LRU-evicted).
     enable_prefix_caching: bool = True
+    # Speculative decoding (greedy only): per-lane draft length k proposed
+    # by n-gram prompt lookup (spec.py) and scored in ONE verify forward
+    # (`verify_step_paged`) — up to k+1 tokens emitted per step per lane.
+    # 0 disables. Draft tokens are funded inside `max_step_tokens`.
+    spec_tokens: int = 0
+    spec_ngram: int = 2
     temperature: float = 0.0      # 0 = greedy
     seed: int = 0
 
@@ -137,16 +149,31 @@ class InferenceEngine:
             self.opts.block_size,
             enable_prefix_caching=self.opts.enable_prefix_caching,
         )
+        proposer = None
+        if self.opts.spec_tokens > 0:
+            if self.opts.temperature > 0.0:
+                # The greedy accept rule (longest matching draft prefix +
+                # one corrective token) only reproduces GREEDY decode;
+                # sampled decode would need rejection sampling.
+                raise ValueError(
+                    "speculative decoding requires temperature=0 (greedy)"
+                )
+            from .spec import NGramProposer
+
+            proposer = NGramProposer(
+                k=self.opts.spec_tokens, n=self.opts.spec_ngram
+            )
         self.scheduler = Scheduler(
             self.block_manager,
             max_num_seqs=self.opts.max_num_seqs,
             max_prefills_per_step=self.opts.max_prefills_per_step,
             max_step_tokens=self.opts.max_step_tokens,
             prefill_chunk=self.opts.prefill_chunk_tokens,
+            draft_proposer=proposer,
         )
         # cfg is static (hashable frozen dataclass); kv buffers are donated
         # — each call consumes self.kv and hands back its successor.
-        self._prefill, self._decode = _paged_jits()
+        self._prefill, self._decode, self._verify = _paged_jits()
         import numpy as np
 
         self._np = np
@@ -163,11 +190,17 @@ class InferenceEngine:
         self.total_tokens = 0
         self.total_preemptions = 0
         self.total_finished = 0
+        self.total_spec_proposed = 0
+        self.total_spec_accepted = 0
         self._ttfts: "deque[float]" = deque(maxlen=1024)
         self._tpots: "deque[float]" = deque(maxlen=1024)
         self._step_ttfts: List[float] = []     # reset each step()
         self._step_tpots: List[float] = []
+        self._step_spec = [0, 0]               # [proposed, accepted]
         self._tok_window: List[float] = []     # token-emit timestamps
+        # (t, hits, misses) snapshots — fleet_state's RECENT hit-rate
+        # window, the autoscaler's cache-cold signal.
+        self._hit_snaps: "deque" = deque(maxlen=64)
         # request_id -> {trace, submit_t, admit_t, first_t} (wall-clock):
         # per-request span bookkeeping for traced (Serve) submissions —
         # untraced submits (engine unit tests, direct callers) skip it.
@@ -221,6 +254,15 @@ class InferenceEngine:
                 "(decode lanes + prefill chunk tokens)",
                 boundaries=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
             )
+            self._m_spec_prop = Counter(
+                "serve_engine_spec_proposed_total",
+                "speculative draft tokens scored by the verify step",
+            )
+            self._m_spec_acc = Counter(
+                "serve_engine_spec_accepted_total",
+                "speculative draft tokens accepted (emitted without a "
+                "dedicated decode step)",
+            )
             # Counters export monotonic increments; the KV manager keeps
             # lifetime totals — ship deltas since the last step.
             self._kv_exported = {"hits": 0, "misses": 0, "evictions": 0}
@@ -237,7 +279,8 @@ class InferenceEngine:
                           self._m_tps, self._m_tokens, self._m_preempt,
                           self._m_ttft, self._m_tpot, self._m_pc_hits,
                           self._m_pc_misses, self._m_pc_evict,
-                          self._m_step_tokens):
+                          self._m_step_tokens, self._m_spec_prop,
+                          self._m_spec_acc):
                     m.set_default_tags(tags)
             except Exception:  # noqa: BLE001 — engine used outside Serve
                 pass
@@ -271,6 +314,10 @@ class InferenceEngine:
                     self._kv_exported[key] += delta
             if stats["step_budget_tokens"]:
                 self._m_step_tokens.observe(stats["step_budget_tokens"])
+            if stats["step_spec_proposed"]:
+                self._m_spec_prop.inc(stats["step_spec_proposed"])
+            if stats["step_spec_accepted"]:
+                self._m_spec_acc.inc(stats["step_spec_accepted"])
         except Exception:  # noqa: BLE001 — no runtime in unit tests
             pass
 
@@ -472,10 +519,13 @@ class InferenceEngine:
         )
         seq.num_computed = chunk.start + L
         # The chunk's KV is landed — its newly-FULL blocks are now safe to
-        # serve as prefix-cache hits for later prompts.
-        self.block_manager.register_computed(
-            seq.request_id, seq.prompt, seq.num_computed
-        )
+        # serve as prefix-cache hits for later prompts. Under the engine
+        # lock: registration touches the hot-hash digest that telemetry
+        # (`fleet_state`, actor RPC thread) iterates.
+        with self._lock:
+            self.block_manager.register_computed(
+                seq.request_id, seq.prompt, seq.num_computed
+            )
         if chunk.last:
             tok = self._sample(np.asarray(logits))
             self._emit(seq, tok)
@@ -483,7 +533,74 @@ class InferenceEngine:
                 rec.setdefault("first_t", time.time())
             self._maybe_finish(seq)
 
+    def _run_verify(self, out: SchedulerOutput):
+        """Speculative step: every decode lane rides ONE `verify_step_paged`
+        call — lane i scores its current token plus its funded draft (other
+        lanes ride along with an empty draft: their slot 0 is exactly a
+        plain decode). Greedy acceptance: the longest draft prefix matching
+        the model's own argmax is emitted, then one corrective (or, on full
+        acceptance, bonus) token — token-for-token identical to plain
+        greedy decode, just fewer dispatches."""
+        jnp = self._jnp
+        np = self._np
+        seqs = out.decodes
+        B = out.batch_bucket
+        W = out.width_bucket
+        K1 = self.opts.spec_tokens + 1
+        tokens = np.zeros((B, K1), np.int32)
+        positions = np.zeros((B,), np.int32)
+        valid_len = np.zeros((B,), np.int32)  # 0 for padding lanes
+        tables = np.zeros((B, W), np.int32)   # padding lanes -> null block
+        lane_drafts: List[List[int]] = []
+        for i, seq in enumerate(seqs):
+            d = out.drafts.get(seq.request_id, [])
+            lane_drafts.append(d)
+            tokens[i, 0] = seq.output[-1]
+            if d:
+                tokens[i, 1:1 + len(d)] = d
+            positions[i] = seq.num_tokens - 1
+            valid_len[i] = 1 + len(d)
+            table = self.block_manager.block_table(seq.request_id)
+            tables[i, : len(table)] = table
+        logits, self.kv = self._verify(
+            self.params,
+            jnp.asarray(tokens),
+            jnp.asarray(positions),
+            jnp.asarray(valid_len),
+            jnp.asarray(tables),
+            self.kv,
+            self.cfg,
+        )
+        logits = np.asarray(logits)
+        for i, seq in enumerate(seqs):
+            d = lane_drafts[i]
+            greedy = logits[i].argmax(axis=-1)
+            emitted: List[int] = []
+            accepted = 0
+            for j, dt in enumerate(d):
+                g = int(greedy[j])
+                if g == dt:
+                    emitted.append(dt)
+                    accepted += 1
+                else:
+                    emitted.append(g)  # the corrective token
+                    break
+            if accepted == len(d):
+                emitted.append(int(greedy[len(d)]))  # bonus token
+            self.total_spec_proposed += len(d)
+            self.total_spec_accepted += accepted
+            self._step_spec[0] += len(d)
+            self._step_spec[1] += accepted
+            for tok in emitted:
+                self._emit(seq, tok)
+                if self._maybe_finish(seq):
+                    # eos mid-span: later landed KV is garbage ABOVE the
+                    # watermark — never registered, freed with the seq.
+                    break
+
     def _run_decode(self, out: SchedulerOutput):
+        if out.drafts:
+            return self._run_verify(out)
         jnp = self._jnp
         np = self._np
         seqs = out.decodes
@@ -515,6 +632,7 @@ class InferenceEngine:
         driver thread. Returns a stats snapshot."""
         t0 = time.monotonic()
         self._step_ttfts, self._step_tpots = [], []
+        self._step_spec = [0, 0]  # [proposed, accepted]
         tok0 = self.total_tokens
         with self._lock:
             out = self.scheduler.schedule()
@@ -555,6 +673,8 @@ class InferenceEngine:
             "step_preemptions": len(out.preempted),
             "step_prefills": len(out.prefills),
             "step_decodes": len(out.decodes),
+            "step_spec_proposed": self._step_spec[0],
+            "step_spec_accepted": self._step_spec[1],
             "step_ttfts": list(self._step_ttfts),
             "step_tpots": list(self._step_tpots),
             "step_s": now - t0,
@@ -562,13 +682,23 @@ class InferenceEngine:
         self._export_metrics(stats)
         return stats
 
-    def stats(self) -> Dict[str, Any]:
+    def stats(self, include_raw: bool = False) -> Dict[str, Any]:
+        """Engine counters + latency summaries. `include_raw=True` adds the
+        bounded raw TTFT/TPOT windows so a fleet bench can pool percentiles
+        ACROSS replicas instead of averaging per-replica medians."""
         np = self._np
-        kv_stats = self.block_manager.stats()
+        # Under the engine lock: called from actor RPC threads while the
+        # driver thread mutates the block manager (same race fleet_state
+        # guards against — _evictable() iterates the cached dict).
         with self._lock:
+            kv_stats = self.block_manager.stats()
             ttfts = list(self._ttfts)
             tpots = list(self._tpots)
+        extra = (
+            {"ttft_recent": ttfts, "tpot_recent": tpots} if include_raw else {}
+        )
         return {
+            **extra,
             "queue_depth": self.scheduler.queue_depth,
             "running": self.scheduler.num_running,
             "kv_utilization": kv_stats.utilization,
@@ -579,8 +709,55 @@ class InferenceEngine:
             "total_tokens": self.total_tokens,
             "total_finished": self.total_finished,
             "total_preemptions": self.total_preemptions,
+            "spec_proposed": self.total_spec_proposed,
+            "spec_accepted": self.total_spec_accepted,
+            "spec_acceptance_rate": (
+                round(self.total_spec_accepted / self.total_spec_proposed, 4)
+                if self.total_spec_proposed
+                else None
+            ),
             "ttft_p50_s": float(np.median(ttfts)) if ttfts else None,
+            "ttft_p99_s": _quantile(ttfts, 0.99),
             "tpot_p50_s": float(np.median(tpots)) if tpots else None,
+        }
+
+    def fleet_state(self) -> Dict[str, Any]:
+        """Bounded telemetry the controller piggybacks on its health probes
+        and routers steer by (`serve/fleet/`): load (queue/running/free
+        blocks), the hot-prefix digest, the TTFT tail, the RECENT prefix-
+        hit rate (30s window — the autoscaler's cache-cold signal), and the
+        spec-decode acceptance rate."""
+        # Under the engine lock: telemetry runs on the actor RPC thread
+        # while the driver thread mutates the block manager (the digest's
+        # hot-hash OrderedDict would otherwise be iterated mid-mutation).
+        with self._lock:
+            kv_stats = self.block_manager.stats()
+            digest = self.block_manager.prefix_digest(64)
+            queue_depth = self.scheduler.queue_depth
+            running = self.scheduler.num_running
+            ttfts = list(self._ttfts)
+        now = time.monotonic()
+        self._hit_snaps.append((now, kv_stats.hits, kv_stats.misses))
+        while self._hit_snaps and now - self._hit_snaps[0][0] > 30.0:
+            self._hit_snaps.popleft()
+        t0, h0, m0 = self._hit_snaps[0]
+        dh, dm = kv_stats.hits - h0, kv_stats.misses - m0
+        return {
+            "queue_depth": queue_depth,
+            "running": running,
+            "free_blocks": kv_stats.free_blocks,
+            "block_size": self.opts.block_size,
+            "kv_utilization": kv_stats.utilization,
+            "digest": digest,
+            "ttft_p99_s": _quantile(ttfts, 0.99),
+            "prefix_hit_rate": (
+                round(dh / (dh + dm), 4) if (dh + dm) > 0 else None
+            ),
+            "spec_acceptance_rate": (
+                round(self.total_spec_accepted / self.total_spec_proposed, 4)
+                if self.total_spec_proposed
+                else None
+            ),
         }
 
     # -------------------------------------------------------- driver thread

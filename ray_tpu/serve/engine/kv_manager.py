@@ -66,6 +66,17 @@ def _chain_hash(prev: bytes, tokens: Sequence[int]) -> bytes:
     return h.digest()
 
 
+# Wire width of one hot-prefix digest entry (`prefix_digest`): the fleet
+# router only needs to DISCRIMINATE prefixes (a truncation collision routes
+# to a replica that turns out to miss — no correctness impact), so digests
+# ship 8 of the 16 hash bytes. `serve/fleet/routing.py` derives its routing
+# keys with the same truncation.
+DIGEST_HASH_BYTES = 8
+
+# Hot-prefix hashes retained for digest export (recency-ordered).
+_HOT_CAP = 512
+
+
 @dataclasses.dataclass(frozen=True)
 class KVStats:
     num_blocks: int          # allocatable blocks (excludes the null block)
@@ -111,6 +122,10 @@ class KVBlockManager:
         self._hash_of: Dict[int, bytes] = {}      # registered block -> key
         self._index: Dict[bytes, int] = {}        # key -> canonical block
         self._chain: Dict[str, List[bytes]] = {}  # per-seq registered keys
+        # Recency-ordered registered/hit hashes (hottest LAST): the bounded
+        # hot-prefix digest the fleet router steers by. Advisory only —
+        # entries die with their index entry on eviction.
+        self._hot: "OrderedDict[bytes, None]" = OrderedDict()
         # (src, dst) physical copies the ENGINE must apply before the next
         # kernel launch — the manager owns only the map.
         self._pending_copies: List[Tuple[int, int]] = []
@@ -150,6 +165,33 @@ class KVBlockManager:
     def seq_len(self, seq_id: str) -> int:
         return self._lens[seq_id]
 
+    def num_registered(self, seq_id: str) -> int:
+        """Full blocks of `seq_id` already in the prefix index — the
+        scheduler's cheap check for whether registration has blocks to
+        catch up on (multi-token speculative appends can jump PAST a block
+        boundary, so an exact `landed % block_size == 0` test misses)."""
+        return len(self._chain.get(seq_id, ()))
+
+    def _touch_hot(self, h: bytes) -> None:
+        self._hot[h] = None
+        self._hot.move_to_end(h)
+        while len(self._hot) > _HOT_CAP:
+            self._hot.popitem(last=False)
+
+    def prefix_digest(self, max_entries: int = 64) -> List[str]:
+        """Bounded digest of the HOTTEST prefix hashes (truncated hex,
+        hottest first) — piggybacked on controller telemetry so fleet
+        routers can steer prompts toward the replica already holding their
+        prefix. Empty when prefix caching is off."""
+        if not self.caching or max_entries < 1:
+            return []
+        out = []
+        for h in reversed(self._hot):
+            out.append(h[:DIGEST_HASH_BYTES].hex())
+            if len(out) >= max_entries:
+                break
+        return out
+
     def stats(self) -> KVStats:
         total = self.num_blocks - 1
         live = len(self._ref)
@@ -178,6 +220,7 @@ class KVBlockManager:
                 del self._cached[b]
                 h = self._hash_of.pop(b)
                 del self._index[h]
+                self._hot.pop(h, None)
                 self.evictions += 1
                 return b
         raise KVCacheExhausted("KV pool exhausted (no blank or evictable blocks)")
@@ -253,6 +296,7 @@ class KVBlockManager:
                     break
                 hit_blocks.append(b)
                 chain.append(h)
+                self._touch_hot(h)
                 prev = h
             self.hits += len(hit_blocks)
             self.misses += cacheable - len(hit_blocks)
@@ -285,7 +329,14 @@ class KVBlockManager:
     def fork(self, parent_id: str, child_id: str) -> List[int]:
         """Share `parent_id`'s entire table with a new sequence (beam /
         n-best style). Every block increfs; whichever sequence later extends
-        into the shared last partial block triggers copy-on-write there."""
+        into the shared last partial block triggers copy-on-write there.
+
+        Caveat: fork of a sequence carrying a SPECULATIVE over-allocation
+        (its `_lens` grown past the landed watermark for rejected drafts)
+        is not supported — grow()'s COW check keys off `_lens`, so a write
+        below the over-allocated tail would miss its copy. The engine never
+        forks; a future beam-search integration must fork only sequences
+        whose allocation matches their landed length."""
         if child_id in self._tables:
             raise ValueError(f"sequence {child_id!r} already has an allocation")
         table = self._tables[parent_id]  # KeyError = unknown parent
@@ -311,11 +362,16 @@ class KVBlockManager:
         (the sequence's full token list) and `num_computed` (tokens whose KV
         is actually written), newly-completed full blocks are registered in
         the prefix index. Returns the (possibly extended) block table;
-        KVCacheExhausted when the pool is dry — the scheduler preempts."""
+        KVCacheExhausted when the pool is dry — the scheduler preempts.
+
+        `new_len` below the current coverage is a no-op on the table
+        (registration still runs): a speculative grow funds draft slots the
+        verify step may reject, so the NEXT step legitimately asks for less
+        than the table already covers."""
         table = self._tables[seq_id]
         cur = self._lens[seq_id]
         if new_len < cur:
-            raise ValueError(f"cannot shrink {seq_id!r}: {cur} -> {new_len}")
+            new_len = cur
         need = self.blocks_for(new_len) - len(table)
         wi = cur // self.block_size      # block the next write lands in
         need_cow = int(
@@ -377,6 +433,7 @@ class KVBlockManager:
             elif canon is None:
                 self._index[h] = b
                 self._hash_of[b] = h
+            self._touch_hot(h)
             chain.append(h)
 
     def drain_cow(self) -> List[Tuple[int, int]]:

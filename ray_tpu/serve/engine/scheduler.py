@@ -119,11 +119,20 @@ class SchedulerOutput:
     preempted: List[Sequence]      # freed + requeued this step (for logging)
     batch_bucket: int              # padded decode batch size (0 = no decode)
     width_bucket: int              # padded block-table width (blocks)
+    # Speculative drafts funded this step: request_id -> draft tokens. A
+    # lane with a draft runs the k+1-token verify step instead of a plain
+    # decode; its draft tokens count against the step budget.
+    drafts: Dict[str, List[int]] = dataclasses.field(default_factory=dict)
 
     @property
     def step_tokens(self) -> int:
-        """Token budget actually spent this step (1/decode lane + chunks)."""
-        return len(self.decodes) + sum(c.num_tokens for c in self.prefills)
+        """Token budget actually spent this step (1/decode lane + funded
+        draft tokens + prefill chunks)."""
+        return (
+            len(self.decodes)
+            + sum(len(d) for d in self.drafts.values())
+            + sum(c.num_tokens for c in self.prefills)
+        )
 
 
 def _next_pow2(n: int) -> int:
@@ -141,6 +150,7 @@ class Scheduler:
         max_prefills_per_step: int = 1,
         max_step_tokens: int = 256,
         prefill_chunk: int = 64,
+        draft_proposer=None,
     ):
         if max_step_tokens <= max_num_seqs:
             raise ValueError(
@@ -154,6 +164,10 @@ class Scheduler:
         self.max_prefills_per_step = max_prefills_per_step
         self.max_step_tokens = max_step_tokens
         self.prefill_chunk = prefill_chunk
+        # Speculative decoding (None = off): proposes draft tokens per
+        # decoding lane; funded drafts ride the same step-token budget as
+        # everything else (decode lanes first, drafts next, prefill last).
+        self.proposer = draft_proposer
         self.waiting: Deque[Sequence] = deque()
         self.running: List[Sequence] = []
         self._seqs: Dict[str, Sequence] = {}
@@ -197,6 +211,8 @@ class Scheduler:
             self.running.remove(seq)
             self.kv.free(seq.request_id)
         del self._seqs[seq.request_id]
+        if self.proposer is not None:
+            self.proposer.forget(seq.request_id)
 
     def _chunk_for(self, seq: Sequence, budget: int) -> PrefillChunk:
         n = min(len(seq.prompt) - seq.num_computed, budget, self.prefill_chunk)
@@ -210,29 +226,60 @@ class Scheduler:
     def schedule(self) -> SchedulerOutput:
         prefills: List[PrefillChunk] = []
         preempted: List[Sequence] = []
+        drafts: Dict[str, List[int]] = {}
 
-        # 1. Grow every DECODING sequence's table for the token this
-        # iteration will append; preempt the youngest on exhaustion.
-        # token_ids + the computed watermark let the KV manager register
-        # newly-full blocks in the prefix index (KV for the latest token is
-        # not landed until the step consumes it, hence num_tokens - 1).
-        # Registration can only progress when the landed watermark fills a
-        # block, so the O(context) token-list concat is built only then —
-        # the register loop catches up on every missing block at once.
+        # Draft funding rides what's left after every decode lane gets its
+        # guaranteed 1 token (conservative: preemption below only shrinks
+        # the lane count).
+        draft_budget = self.max_step_tokens - sum(
+            1 for s in self.running if s.state == RUNNING and s.is_decoding
+        )
+
+        # 1. Grow every DECODING sequence's table for the token(s) this
+        # iteration will append — one slot for the plain decode token plus
+        # one per funded speculative draft; preempt the youngest on
+        # exhaustion (dropping the lane's draft first: a shorter step beats
+        # sacrificing someone's cache). token_ids + the computed watermark
+        # let the KV manager register newly-full blocks in the prefix index
+        # (KV for the latest token is not landed until the step consumes
+        # it, hence num_tokens - 1). Registration progresses whenever the
+        # landed watermark covers MORE full blocks than are registered —
+        # speculative multi-token appends can jump past a boundary, so the
+        # O(context) token-list concat is built only on that check, and the
+        # register loop catches up on every missing block at once.
         for seq in list(self.running):
             if seq.state != RUNNING or not seq.is_decoding:
                 continue  # mid-prefill, or preempted as a victim this loop
             landed = seq.num_tokens - 1
             reg = {}
-            if landed > 0 and landed % self.kv.block_size == 0:
+            if landed > 0 and (
+                landed // self.kv.block_size
+                > self.kv.num_registered(seq.request_id)
+            ):
                 reg = dict(
                     token_ids=seq.prompt + seq.output, num_computed=landed
                 )
+            d: List[int] = []
+            if self.proposer is not None and draft_budget > 0:
+                # Cap: emitting accepted+1 tokens must never overshoot the
+                # request's remaining generation budget. The proposer keeps
+                # its own history copy — this call is O(new tokens).
+                remaining = seq.max_new_tokens - len(seq.output)
+                if remaining > 1:
+                    d = self.proposer.propose(
+                        seq.request_id, seq.prompt, seq.output,
+                        min(draft_budget, remaining - 1),
+                    )
             while True:
                 try:
-                    self.kv.grow(seq.request_id, seq.num_tokens + 1, **reg)
+                    self.kv.grow(
+                        seq.request_id, seq.num_tokens + 1 + len(d), **reg
+                    )
                     break
                 except KVCacheExhausted:
+                    if d:
+                        d = []  # drop the draft before preempting anyone
+                        continue
                     victim = self._pick_victim(exclude=seq)
                     if victim is None:
                         # seq itself is the youngest — preempt it.
@@ -241,12 +288,20 @@ class Scheduler:
                         break
                     self._preempt(victim)
                     preempted.append(victim)
+            if d and seq.state == RUNNING:
+                drafts[seq.request_id] = d
+                draft_budget -= len(d)
 
         decodes = [
             s for s in self.running if s.state == RUNNING and s.is_decoding
         ]
-        # Decode lanes are funded first; prefill chunks spend the remainder.
-        budget = self.max_step_tokens - len(decodes)
+        # Decode lanes (and their funded drafts) first; prefill chunks
+        # spend the remainder.
+        budget = (
+            self.max_step_tokens
+            - len(decodes)
+            - sum(len(d) for d in drafts.values())
+        )
 
         # 2. Continue in-flight partial prefills (admission order) before
         # admitting anyone new — their blocks are already committed.
@@ -287,6 +342,12 @@ class Scheduler:
             prefills.append(chunk)
             budget -= chunk.num_tokens
 
+        # A lane preempted AFTER its draft was funded must not leak a stale
+        # drafts entry into the work order.
+        if drafts:
+            live = {s.request_id for s in decodes}
+            drafts = {rid: d for rid, d in drafts.items() if rid in live}
+
         bb = _next_pow2(len(decodes)) if decodes else 0
         max_w = max(
             (len(self.kv.block_table(s.request_id)) for s in decodes),
@@ -298,6 +359,7 @@ class Scheduler:
             preempted=preempted,
             batch_bucket=min(bb, _next_pow2(self.max_num_seqs)),
             width_bucket=_next_pow2(max_w) if max_w else 0,
+            drafts=drafts,
         )
 
     def _pick_victim(self, exclude: Sequence) -> Optional[Sequence]:

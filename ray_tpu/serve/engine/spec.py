@@ -1,0 +1,87 @@
+"""Prompt-lookup (n-gram) draft proposer for speculative decoding.
+
+No second model: the draft source is the sequence's OWN token history
+(prompt + generated). If the last `n` tokens also occur earlier in the
+history, the tokens that followed that earlier occurrence are proposed as
+the draft — long verbatim spans (quoting the prompt, boilerplate, greedy
+repetition loops) verify at near-100% acceptance, and the paged verify
+step (`models/gpt.py:verify_step_paged`) scores all k drafts in ONE
+forward instead of k sequential decode dispatches.
+
+The proposer is incremental: each sequence carries a (ngram -> latest
+start position) index that advances as tokens append, so a propose() call
+costs O(new tokens), not O(context). Preemption folds generated tokens
+into the prompt WITHOUT changing the token list, so the index survives
+preemption untouched.
+
+Pure host-side policy — no JAX; the scheduler funds accepted drafts inside
+its step-token budget and the engine verifies them.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+
+class NGramProposer:
+    """One deployment-wide proposer; per-sequence state keyed by request id
+    (dropped via `forget` when the sequence retires)."""
+
+    def __init__(self, k: int = 4, n: int = 2):
+        if k < 1:
+            raise ValueError("spec draft length k must be >= 1")
+        if n < 1:
+            raise ValueError("ngram match length n must be >= 1")
+        self.k = k
+        self.n = n
+        # request_id -> [ngram -> latest start position, private history
+        # copy, positions indexed]. The proposer keeps its OWN history so
+        # the per-step scheduler call hands over only (prompt, output)
+        # references — no O(context) concat per decode lane per step.
+        self._state: Dict[str, list] = {}
+
+    def propose(
+        self,
+        request_id: str,
+        prompt: Sequence[int],
+        output: Sequence[int],
+        max_draft: int,
+    ) -> List[int]:
+        """Draft up to `min(k, max_draft)` tokens likely to follow the
+        sequence. Returns [] when the trailing n-gram has no earlier
+        occurrence (or the context is too short) — the engine then runs a
+        plain decode step for this lane. Costs O(tokens appended since the
+        last call): new tokens only ever appear at the tail of `output`
+        (preemption folds output into prompt WITHOUT changing the token
+        list, so the retained history stays valid)."""
+        limit = min(self.k, max_draft)
+        n = self.n
+        total = len(prompt) + len(output)
+        if limit < 1 or total < n + 1:
+            return []
+        st = self._state.get(request_id)
+        if st is None:
+            hist = [int(t) for t in prompt]
+            hist += [int(t) for t in output]
+            st = self._state[request_id] = [{}, hist, 0]
+        else:
+            hist = st[1]
+            delta = total - len(hist)
+            if delta > 0:
+                hist.extend(int(t) for t in output[len(output) - delta:])
+        index, hist, consumed = st
+        # Index every n-gram that starts strictly BEFORE the trailing one —
+        # matching the suffix against itself would propose the suffix.
+        for i in range(consumed, total - n):
+            index[tuple(hist[i:i + n])] = i
+        st[2] = max(consumed, total - n)
+        p = index.get(tuple(hist[total - n:]))
+        if p is None:
+            return []
+        return list(hist[p + n:p + n + limit])
+
+    def forget(self, request_id: str) -> None:
+        self._state.pop(request_id, None)
+
+    def __len__(self) -> int:
+        return len(self._state)

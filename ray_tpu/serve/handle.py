@@ -2,31 +2,96 @@
 `serve/_private/router.py:924` Router, `:295` PowerOfTwoChoicesReplicaScheduler).
 
 The router lives client-side (in whichever process holds the handle):
-power-of-two-choices over per-replica outstanding counts, periodic snapshot
-refresh from the controller, and router-side batch formation for
-`@serve.batch` methods (one replica call per formed batch — one XLA program
-per batch on TPU replicas).
+prefix-affinity placement for LLM prompts (the fleet plane — see
+`serve/fleet/routing.py`: the prompt's leading full KV blocks hash to a
+routing key matched against each replica's piggybacked hot-prefix digest,
+with rendezvous fallback for cold prefixes and power-of-two fallback under
+load skew), plain power-of-two-choices over per-replica outstanding counts
+otherwise, periodic snapshot refresh from the controller, and router-side
+batch formation for `@serve.batch` methods (one replica call per formed
+batch — one XLA program per batch on TPU replicas). Unary calls fail over
+ONCE to a different replica when the picked one died between refreshes.
 """
 
 from __future__ import annotations
 
-import hashlib
 import random
 import threading
 import time
+import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 _ROUTER_REFRESH_S = 1.0
+
+# Routing-key block size used before any replica telemetry reveals the
+# engine's real one (matches EngineOptions.block_size's default).
+_DEFAULT_ROUTING_BLOCK = 16
+
+
+def _is_replica_failure(e: BaseException) -> bool:
+    """True for infrastructure failures (replica killed/crashed between
+    router refreshes) — retryable on another replica; user-code exceptions
+    are not."""
+    try:
+        from ..core.exceptions import (
+            ActorDiedError,
+            ActorUnavailableError,
+            TaskError,
+            WorkerCrashedError,
+        )
+    except Exception:  # noqa: BLE001
+        return False
+    kinds = (ActorDiedError, ActorUnavailableError, WorkerCrashedError)
+    if isinstance(e, kinds):
+        return True
+    return isinstance(e, TaskError) and isinstance(
+        getattr(e, "cause", None), kinds
+    )
+
+
+def _routing_prompt(args, kwargs) -> Optional[List[int]]:
+    """Best-effort token-id prompt extraction for prefix-affinity routing:
+    `generate(prompt, ...)` style calls carry it as the first positional or
+    a `prompt=` kwarg; HTTP ingress carries it in the request body. Returns
+    None (→ load-based routing) for anything that doesn't look like token
+    ids — routing must never fail a call."""
+    p = kwargs.get("prompt")
+    if p is None and args:
+        a0 = args[0]
+        if isinstance(a0, (list, tuple)):
+            p = a0
+        else:
+            j = getattr(a0, "json", None)  # HTTP Request-like
+            if callable(j):
+                try:
+                    body = j()
+                    if isinstance(body, dict):
+                        p = body.get("prompt")
+                except Exception:  # noqa: BLE001
+                    p = None
+    if isinstance(p, (list, tuple)) and p and not isinstance(
+        p[0], (str, bytes, list, tuple, dict)
+    ):
+        try:
+            int(p[0])
+        except (TypeError, ValueError):
+            return None
+        return list(p)
+    return None
 
 
 class DeploymentResponse:
     """Future-like result of `handle.method.remote()` (reference
     `serve/handle.py` DeploymentResponse)."""
 
-    def __init__(self, ref=None, future=None, on_done=None):
+    def __init__(self, ref=None, future=None, on_done=None, retry=None):
         self._ref = ref
         self._future = future
         self._on_done = on_done
+        # One-shot failover: on a REPLICA failure (not a user exception),
+        # re-route the call through the router once (`Router.call` wires
+        # this up for unary calls).
+        self._retry = retry
 
     def result(self, timeout_s: Optional[float] = None):
         import ray_tpu
@@ -38,6 +103,11 @@ class DeploymentResponse:
                     raise ref
                 return ref
             return ray_tpu.get(self._ref, timeout=timeout_s)
+        except Exception as e:  # noqa: BLE001
+            retry, self._retry = self._retry, None
+            if retry is not None and _is_replica_failure(e):
+                return retry(timeout_s)
+            raise
         finally:
             if self._on_done is not None:
                 self._on_done()
@@ -169,6 +239,10 @@ class Router:
         self._outstanding: Dict[int, int] = {}  # replica idx -> in-flight
         self._batchers: Dict[str, _Batcher] = {}
         self._reported_t = 0.0
+        # Stable identity for controller-side metrics: outstanding counts
+        # are keyed per router and SUMMED across routers (EMA-blending
+        # different routers into one stream undercounted the fleet).
+        self._router_id = uuid.uuid4().hex[:12]
 
     # ------------------------------------------------------------ snapshot
     def _controller(self):
@@ -197,29 +271,94 @@ class Router:
             self._last_refresh = now
             self._outstanding = {i: self._outstanding.get(i, 0) for i in range(len(info["replicas"]))}
 
-    def _pick_replica(self, model_id: str = "") -> Tuple[int, Any]:
+    def _pick_replica(
+        self,
+        model_id: str = "",
+        prompt: Optional[List[int]] = None,
+        exclude: Optional[int] = None,
+    ) -> Tuple[int, Any, str]:
+        """Returns (index, replica handle, replica tag) — the tag is read
+        under the same lock as the pick, so failover bookkeeping can't be
+        torn by a concurrent refresh reordering the replica list."""
         self._refresh()
         with self._lock:
             replicas = self._info["replicas"]
             if not replicas:
                 raise RuntimeError(f"No replicas for {self.deployment_name}")
+            n = len(replicas)
+            tags = self._info["replica_tags"]
+            candidates = [i for i in range(n) if i != exclude] or list(range(n))
             if model_id:
-                # Rendezvous hash → cache-affine replica for multiplexed models.
-                tags = self._info["replica_tags"]
+                # Rendezvous hash → cache-affine replica for multiplexed
+                # models (same construction as the fleet plane's cold-prefix
+                # convergence).
+                from .fleet import rendezvous_rank
+
                 idx = max(
-                    range(len(replicas)),
-                    key=lambda i: hashlib.md5(
-                        f"{model_id}:{tags[i]}".encode()
-                    ).hexdigest(),
+                    candidates,
+                    key=lambda i: rendezvous_rank(model_id, tags[i]),
                 )
-            elif len(replicas) == 1:
-                idx = 0
+            elif len(candidates) == 1:
+                idx = candidates[0]
             else:
-                # Power of two choices on local outstanding counts.
-                a, b = random.sample(range(len(replicas)), 2)
-                idx = a if self._outstanding.get(a, 0) <= self._outstanding.get(b, 0) else b
+                idx = self._pick_fleet(candidates, prompt)
             self._outstanding[idx] = self._outstanding.get(idx, 0) + 1
-            return idx, replicas[idx]
+            return idx, replicas[idx], tags[idx]
+
+    def _pick_fleet(self, candidates: List[int], prompt) -> int:
+        """Prefix-affinity placement (`serve/fleet/routing.py`): hash the
+        prompt's leading full KV blocks (the engine's own content-hash
+        chain) and steer to the replica whose advertised hot-prefix digest
+        matches deepest; cold prefixes converge by rendezvous, saturated or
+        telemetry-less fleets degrade to power-of-two on load. Called under
+        self._lock.
+
+        Affinity engages only once SOME replica has reported engine
+        telemetry — a deployment that never reports one (plain non-LLM
+        classes whose methods happen to take numeric lists) keeps plain
+        power-of-two load spreading. The controller captures telemetry on
+        the same reconcile pass that PROMOTES a replica, so an LLM fleet
+        has it from the moment `serve.run` returns; if a replica's report
+        predates block_size (older engine), a default keeps cold routing
+        deterministic."""
+        info = self._info
+        metas = info.get("replica_meta") or []
+        chain: List[str] = []
+        if (
+            prompt is not None
+            and info.get("prefix_affinity", True)
+            and any(metas)
+        ):
+            bs = next(
+                (m.get("block_size") for m in metas if m and m.get("block_size")),
+                0,
+            ) or _DEFAULT_ROUTING_BLOCK
+            from .fleet import routing_chain
+
+            chain = routing_chain(prompt, bs)
+        if chain or any(m for m in metas):
+            from .fleet import pick_replica as _fleet_pick
+
+            tags = info["replica_tags"]
+            spill = max(int(info.get("max_ongoing_requests") or 8), 1)
+            idx, _reason = _fleet_pick(
+                chain,
+                [tags[i] for i in candidates],
+                [metas[i] if i < len(metas) else None for i in candidates],
+                {
+                    j: self._outstanding.get(i, 0)
+                    for j, i in enumerate(candidates)
+                },
+                spill,
+            )
+            return candidates[idx]
+        # No telemetry at all: power of two choices on local outstanding.
+        a, b = random.sample(candidates, 2)
+        return (
+            a
+            if self._outstanding.get(a, 0) <= self._outstanding.get(b, 0)
+            else b
+        )
 
     def _done(self, idx: int):
         with self._lock:
@@ -233,7 +372,8 @@ class Router:
         try:
             total = sum(self._outstanding.values())
             self._controller().record_request_metrics.remote(
-                self.app_name, self.deployment_name, float(total)
+                self.app_name, self.deployment_name, float(total),
+                self._router_id,
             )
         except Exception:  # noqa: BLE001
             pass
@@ -255,15 +395,38 @@ class Router:
             self._maybe_report_metrics()
             return batcher.submit(args[0], model_id)
 
-        idx, replica = self._pick_replica(model_id)
+        prompt = _routing_prompt(args, kwargs)
+        idx, replica, failed_tag = self._pick_replica(model_id, prompt=prompt)
         try:
             ref = replica.handle_request.remote(method, args, kwargs, model_id)
         except Exception:
             self._done(idx)
             raise
         self._maybe_report_metrics()
+
+        def retry(timeout_s):
+            # The replica died between refreshes: force a state refresh and
+            # re-route ONCE to a different replica instead of surfacing the
+            # dead-handle error to the caller.
+            import ray_tpu
+
+            self._refresh(force=True)
+            with self._lock:
+                t2 = self._info["replica_tags"]
+                ex = t2.index(failed_tag) if failed_tag in t2 else None
+            i2, r2, _ = self._pick_replica(model_id, prompt=prompt, exclude=ex)
+            try:
+                return ray_tpu.get(
+                    r2.handle_request.remote(method, args, kwargs, model_id),
+                    timeout=timeout_s,
+                )
+            finally:
+                self._done(i2)
+
         # Outstanding count drops when the caller consumes the result.
-        return DeploymentResponse(ref=ref, on_done=lambda: self._done(idx))
+        return DeploymentResponse(
+            ref=ref, on_done=lambda: self._done(idx), retry=retry
+        )
 
     def call_streaming(
         self, method: str, args, kwargs, model_id: str = ""
@@ -272,7 +435,9 @@ class Router:
         (reference: `handle.options(stream=True)` →
         ObjectRefGenerator-backed responses)."""
         self._refresh()
-        idx, replica = self._pick_replica(model_id)
+        idx, replica, _ = self._pick_replica(
+            model_id, prompt=_routing_prompt(args, kwargs)
+        )
         try:
             gen = getattr(replica, "handle_request_streaming").options(
                 num_returns="streaming"
@@ -286,7 +451,7 @@ class Router:
     def call_batch(self, method: str, batched_args: List, model_id: str) -> List:
         import ray_tpu
 
-        idx, replica = self._pick_replica(model_id)
+        idx, replica, _ = self._pick_replica(model_id)
         try:
             return ray_tpu.get(
                 replica.handle_batch.remote(method, batched_args, model_id)

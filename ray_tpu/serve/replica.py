@@ -145,5 +145,20 @@ class Replica:
     def ping(self) -> str:
         return "ok"
 
+    def telemetry(self) -> Dict[str, Any]:
+        """Health probe + piggybacked fleet telemetry in ONE round trip:
+        the controller's reconcile loop calls this instead of `ping`, and a
+        deployment exposing `fleet_state()` (the LLM engine does) ships its
+        hot-prefix digest / queue depth / TTFT tail with every probe — no
+        extra RPC, no extra poll loop."""
+        out: Dict[str, Any] = {"ok": True, "num_processed": self._num_processed}
+        fn = getattr(self._callable, "fleet_state", None)
+        if fn is not None:
+            try:
+                out["engine"] = fn()
+            except Exception:  # noqa: BLE001 — telemetry never fails health
+                out["engine"] = None
+        return out
+
     def stats(self) -> Dict[str, Any]:
         return {"num_processed": self._num_processed}

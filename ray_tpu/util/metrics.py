@@ -43,6 +43,16 @@ def prune_series(tags: Dict[str, str]) -> None:
         fn({str(k): str(v) for k, v in tags.items()})
 
 
+def quantile(xs: Sequence[float], q: float) -> Optional[float]:
+    """Nearest-rank quantile of a small sample (None when empty) — shared
+    by the serve engine's telemetry (TTFT tails) and bench summaries so
+    every surface reports the same number for the same window."""
+    if not xs:
+        return None
+    s = sorted(xs)
+    return float(s[min(len(s) - 1, int(len(s) * q))])
+
+
 _ELASTIC: Optional[Dict[str, "_Metric"]] = None
 _ELASTIC_LOCK = threading.Lock()
 
@@ -81,6 +91,34 @@ def elastic_metrics() -> Dict[str, "_Metric"]:
         return _ELASTIC
 
 
+_FLEET: Optional[Dict[str, "_Metric"]] = None
+_FLEET_LOCK = threading.Lock()
+
+
+def serve_fleet_metrics() -> Dict[str, "_Metric"]:
+    """Fleet-serving metric families (the Serve controller emits these):
+    `serve_autoscale_decisions_total` counts applied scale actions by
+    direction, `serve_deployment_target_replicas` is each deployment's
+    current autoscale target. Created lazily so importing metrics never
+    boots a runtime."""
+    global _FLEET
+    with _FLEET_LOCK:
+        if _FLEET is None:
+            _FLEET = {
+                "serve_autoscale_decisions_total": Counter(
+                    "serve_autoscale_decisions_total",
+                    "Autoscale actions applied by the Serve controller",
+                    tag_keys=("deployment", "direction"),
+                ),
+                "serve_deployment_target_replicas": Gauge(
+                    "serve_deployment_target_replicas",
+                    "Current autoscale target replica count per deployment",
+                    tag_keys=("deployment",),
+                ),
+            }
+        return _FLEET
+
+
 class _Metric:
     kind = "gauge"
 
@@ -95,11 +133,13 @@ class _Metric:
         return self
 
     def _record(self, value: float, tags: Optional[Dict[str, str]]):
-        from ..core import api
-
+        # Same non-booting rule as Histogram._flush: a metric record from an
+        # un-inited process is DROPPED, never a reason to boot a runtime
+        # (an engine unit test driving step() used to leak a whole local
+        # runtime into the test session through one Gauge.set).
         merged = {**self._default_tags, **(tags or {})}
-        backend = api._global_runtime().backend
-        send = getattr(backend, "record_metric", None)
+        backend = _backend()
+        send = getattr(backend, "record_metric", None) if backend else None
         if send is not None:
             send(self._name, self.kind, value, merged, help=self._description)
 

