@@ -168,6 +168,126 @@ class ChunkPipeline:
             raise self._errors[0]
 
 
+def _native_land_mode() -> Optional[str]:
+    """Which native landing path to use, or None for pure Python.
+
+    `bulk_native_lander`: "auto" (stream when the extension builds),
+    "stream" (whole-span poll/read/pwrite loop in C — the payload never
+    passes through Python), "ring" (Python recv_into + native pinned lander
+    thread consuming a (buf, off, len) descriptor ring), "off". Any value
+    other than "off" degrades to the pure-Python pipeline when the native
+    extension is unbuildable (no g++, unsupported platform)."""
+    mode = str(rt_config.get("bulk_native_lander")).lower()
+    if mode in ("off", "0", "false", "no"):
+        return None
+    from .. import native as _native
+
+    if _native.load_bulk_lib() is None:
+        return None
+    return "ring" if mode == "ring" else "stream"
+
+
+# Chunk buffers a stuck native lander may still be pwrite-ing when its close
+# deadline expires: freeing them would be a use-after-free, so they are
+# parked here forever (same contract as ChunkPipeline's stuck-lander abort,
+# which leaves its daemon thread holding the Python buffer).
+_LEAKED_RING_BUFFERS: list = []
+
+
+def _land_stream_native(sock: socket.socket, fd: int, dst_off: int,
+                        length: int, deadline_s: float):
+    """Whole-span native landing: one ctypes call (GIL released throughout)
+    runs the poll/read/pwrite loop in C. poll() enforces the same PROGRESS
+    deadline as the Python path — any byte re-arms it."""
+    from .. import native as _native
+
+    lib = _native.load_bulk_lib()
+    rc = lib.rt_bulk_land_stream(
+        sock.fileno(), fd, dst_off, length,
+        int(max(deadline_s, 0.001) * 1000),
+    )
+    if rc == length:
+        return
+    err = int(-rc)
+    import errno as _errno
+
+    if err == _errno.ETIMEDOUT:
+        raise socket.timeout(
+            f"bulk landing stalled: no socket progress within {deadline_s}s "
+            f"(native stream lander)"
+        )
+    if err == _errno.EPIPE:
+        raise ConnectionError("bulk peer closed mid-span")
+    raise OSError(err, f"native bulk landing failed: {os.strerror(err)}")
+
+
+def _land_ring_native(sock: socket.socket, fd: int, dst_off: int, length: int,
+                      chunk: int, window: int, deadline_s: float):
+    """Bounded-window landing with the pwrites on a NATIVE pinned thread:
+    this thread recv_into's chunk buffers (GIL released in the syscall) and
+    hands (buffer, offset, len) descriptors to the C ring; completion is
+    FIFO, so buffer `k` is recyclable once `k+1` chunks have landed. Same
+    window bound and progress deadlines as ChunkPipeline, without a Python
+    lander thread in the GIL rotation."""
+    import ctypes
+    import errno as _errno
+
+    from .. import native as _native
+
+    def _ring_err(rc: int):
+        err = int(-rc)
+        if err == _errno.ETIMEDOUT:
+            raise socket.timeout(
+                f"bulk landing stalled: no chunk landed within {deadline_s}s "
+                f"(native ring lander, window {window})"
+            )
+        raise OSError(err, f"native bulk landing failed: {os.strerror(err)}")
+
+    lib = _native.load_bulk_lib()
+    h = lib.rt_lander_create(fd, window)
+    if not h:
+        raise OSError("native ring lander create failed")
+    bufs = [bytearray(min(chunk, max(length, 1))) for _ in range(window)]
+    cbufs: list = [None] * window  # keep ctypes views alive while in flight
+    tmo_ms = int(max(deadline_s, 0.001) * 1000)
+    try:
+        got = 0
+        submitted = 0
+        sock.settimeout(deadline_s)
+        while got < length:
+            slot = submitted % window
+            if submitted >= window:
+                # Recycle the slot only after its previous chunk landed.
+                rc = lib.rt_lander_wait(h, submitted - window + 1, tmo_ms)
+                if rc != 0:
+                    _ring_err(rc)
+            buf = bufs[slot]
+            ln = min(chunk, length - got)
+            view = memoryview(buf)[:ln]
+            filled = 0
+            while filled < ln:
+                r = sock.recv_into(view[filled:])
+                if r == 0:
+                    raise ConnectionError("bulk peer closed mid-span")
+                filled += r
+            cb = (ctypes.c_char * ln).from_buffer(buf)
+            rc = lib.rt_lander_submit(h, cb, dst_off + got, ln, tmo_ms)
+            if rc < 0:
+                _ring_err(rc)
+            cbufs[slot] = cb
+            submitted += 1
+            got += ln
+        rc = lib.rt_lander_wait(h, submitted, tmo_ms)
+        if rc != 0:
+            _ring_err(rc)
+    finally:
+        if lib.rt_lander_close(h, tmo_ms) != 0:
+            # Lander stuck past the deadline mid-pwrite: the buffers must
+            # outlive it (see bulk.cpp header). The transfer itself aborts
+            # via the exception already in flight.
+            _LEAKED_RING_BUFFERS.append((bufs, cbufs))
+
+
 def _recv_exact_into(sock: socket.socket, view: memoryview, deadline_s: float):
     """Fill `view` from the socket; the deadline applies to PROGRESS (any
     recv returning bytes resets it), not the whole span."""
@@ -441,7 +561,14 @@ def _recv_to_sink(sock: socket.socket, sink, offset: int, length: int,
     destination's backing file — the write()-path allocates cold tmpfs pages
     ~7× faster than recv_into a fresh mapping would fault them (mem.py).
 
-    Large spans ride a bounded-window CHUNK PIPELINE (ChunkPipeline): this
+    The landing runs OFF the GIL when the native extension builds
+    (`bulk_native_lander`): "stream" hands the socket+file fds to one C
+    poll/read/pwrite loop (no Python in the payload path at all), "ring"
+    keeps the recv here but lands chunks on a native pinned thread. Both
+    keep the per-chunk PROGRESS deadlines and abort-with-no-partial-object
+    semantics of the Python paths below, which remain the fallback:
+
+    large spans ride a bounded-window CHUNK PIPELINE (ChunkPipeline): this
     thread recv_into's one chunk while lander thread(s) pwrite the previous
     ones, so the socket drains during the landing write instead of after it
     (the kernel socket buffer only hides ~a rcvbuf of that overlap; the
@@ -450,9 +577,22 @@ def _recv_to_sink(sock: socket.socket, sink, offset: int, length: int,
     dst_path, dst_base = sink
     fd = os.open(dst_path, os.O_WRONLY)
     try:
-        sock.settimeout(deadline_s)
         chunk = rt_config.get("bulk_chunk_bytes")
         window = rt_config.get("bulk_window_chunks")
+        mode = _native_land_mode()
+        if mode == "stream":
+            # Off-GIL whole-span landing: on CPU-starved receivers the GIL
+            # handoff between the Python reader and lander threads serializes
+            # the pipeline's overlap (0.74 -> 1.1+ GiB/s measured in-cluster
+            # on a 1-vCPU host — docs/ROOFLINE_put_path.md).
+            _land_stream_native(sock, fd, dst_base + offset, length,
+                                deadline_s)
+            return
+        if mode == "ring" and window >= 2 and length >= 2 * chunk:
+            _land_ring_native(sock, fd, dst_base + offset, length, chunk,
+                              window, deadline_s)
+            return
+        sock.settimeout(deadline_s)
         if (
             rt_config.get("bulk_pipeline")
             and window >= 2

@@ -382,6 +382,62 @@ class ClusterBackend(RuntimeBackend):
             )
         return ObjectRef(oid, self.client_address)
 
+    def put_serialized(self, payload: bytes, buffers, owner_task_hex: str,
+                       contains=()) -> "Tuple[ObjectRef, Optional[str], bool]":
+        """Store an ALREADY-serialized (payload, out-of-band buffers) pair as
+        a first-class object. The data plane's block transport serializes
+        columnar segments itself so it can compute every buffer's (offset,
+        length) span within the stored frame (`serialization.pack` wire
+        format) — consumers then pull single spans over the bulk plane.
+        Returns (ref, local_store_name, span_addressable): the name lets a
+        SAME-NODE consumer read the segment straight out of the shared store
+        with zero controller round trips (the deps-map fast path's
+        equivalent); span_addressable False means the frame rode the inline
+        plane, where span-addressed bulk reads are impossible."""
+        with self._put_lock:
+            self._put_idx += 1
+            idx = self._put_idx
+        oid = ObjectID.of(TaskID.from_hex(owner_task_hex), 2**24 + idx)
+        hex_id = oid.hex()
+        size = serialization.packed_size(payload, buffers)
+        if contains:
+            self.ensure_published(list(contains))
+        if size <= store.INLINE_THRESHOLD:
+            frame = bytearray(size)
+            serialization.pack_into(payload, buffers, memoryview(frame))
+            self._request({"type": "put_inline", "id": hex_id,
+                           "data": bytes(frame), "contains": list(contains)})
+            return ObjectRef(oid, self.client_address), None, False
+        if self.remote_client:
+            frame = bytearray(size)
+            serialization.pack_into(payload, buffers, memoryview(frame))
+            self._request({"type": "put_data", "id": hex_id,
+                           "data": bytes(frame), "contains": list(contains)})
+            # Lands in the HEAD arena with the same frame layout — spans stay
+            # valid there (resolved via object_sources; no local name here).
+            return ObjectRef(oid, self.client_address), None, True
+        shm_name, size = self.local_store.create_packed(hex_id, payload, buffers)
+        self._request({
+            "type": "register_object", "id": hex_id, "name": shm_name,
+            "size": size, "contains": list(contains),
+        })
+        return ObjectRef(oid, self.client_address), shm_name, True
+
+    def object_sources(self, hex_ids: Sequence[str]) -> List[Optional[dict]]:
+        """(bulk addr, store name, size) of a live copy of each id, or None
+        where no span-servable copy exists (inline/spilled/unknown). One
+        controller round trip for the whole list."""
+        try:
+            resp = self._request(
+                {"type": "object_sources", "ids": list(hex_ids)}
+            )
+            out = (resp or {}).get("sources")
+        except Exception:  # noqa: BLE001 — resolution is best-effort
+            out = None
+        if not isinstance(out, list) or len(out) != len(hex_ids):
+            return [None] * len(hex_ids)
+        return out
+
     # ----------------------------------------------------------------- get
     def _read_location(self, loc: dict, hex_id: str) -> Any:
         status = loc["status"]

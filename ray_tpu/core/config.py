@@ -133,6 +133,14 @@ define("bulk_land_threads", 1,
        doc="Lander threads per span for the pipelined bulk landing "
            "(pwrites are positional, so >1 is safe; helps only when the "
            "receiver has spare cores)")
+define("bulk_native_lander", "auto",
+       doc="Off-GIL landing for bulk pulls (native/src/bulk.cpp): 'stream' "
+           "runs the whole poll/read/pwrite receive loop in one native call "
+           "(payload never passes through Python), 'ring' keeps the Python "
+           "recv_into but lands chunks on a native pinned thread consuming "
+           "a descriptor ring, 'off' forces the pure-Python paths, 'auto' "
+           "= stream when the extension builds. Overrides bulk_pipeline / "
+           "bulk_land_threads (those govern the Python fallback)")
 define("bulk_rcvbuf_bytes", 8 * 1024 * 1024,
        doc="SO_RCVBUF for bulk pull connections (0 = kernel default): a "
            "deep receive window lets the sender stream across receiver "
@@ -171,6 +179,13 @@ define("arena_prefault", True,
 define("worker_forkserver", True,
        doc="Per-node pre-imported template process; CPU workers fork from "
            "it in ~10ms instead of booting an interpreter (~2s)")
+# Data plane (ray_tpu/data): exchange block traffic over the bulk planes.
+define("data_block_transport", True,
+       doc="Shuffle-exchange map outputs land as ONE flat arena segment per "
+           "task (pickle-5 frame, columns as out-of-band buffers at known "
+           "offsets) and reduce tasks pull only their partition's byte span "
+           "over the bulk plane (data/transport.py); off = the classic "
+           "per-partition pickled object puts (num_returns=P)")
 # Two-level scheduling (reference: ClusterTaskManager/LocalTaskManager split).
 define("local_dispatch", True,
        doc="Hand queued plain tasks to node agents' LocalDispatchers; the "

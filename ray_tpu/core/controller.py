@@ -1653,6 +1653,28 @@ class Controller:
                 return {"status": "timeout"}
         return {"status": "lost"}
 
+    def _object_source(self, hex_id: str) -> Optional[dict]:
+        """Data-plane span reads: resolve a live servable copy of an object
+        to (bulk addr, store name, size) so a consumer can pull just ITS
+        span of a block segment over the bulk plane (`data/transport.py`)
+        instead of materializing the whole object locally. Read-only; None
+        when the object is inline, spilled-only, or unknown (the caller
+        falls back to a plain get)."""
+        obj = self.objects.get(hex_id)
+        if obj is None or obj.status != "ready" or obj.inline is not None:
+            return None
+        src = self._source_for(obj)
+        if src is None or not src.get("bulk") or not src.get("name"):
+            return None
+        return {"bulk": src["bulk"], "name": src["name"],
+                "node": src["node"], "size": obj.size}
+
+    async def h_object_sources(self, conn, meta, msg):
+        """Batched _object_source: one RPC resolves every map segment a
+        reduce task will read (per-object round trips were measurably the
+        whole cost of the transport path on small exchanges)."""
+        return {"sources": [self._object_source(h) for h in msg["ids"]]}
+
     async def h_wait_objects(self, conn, meta, msg):
         ids: List[str] = msg["ids"]
         num_returns: int = msg["num_returns"]

@@ -3,9 +3,14 @@
 Pull-based: bundles of blocks stream through fused task chains with a
 bounded number of in-flight tasks (backpressure — reference
 `backpressure_policy/`). All-to-all ops run as a two-stage map/reduce
-exchange where the map side returns one object per output partition
-(`num_returns=P`) so each reduce task fetches only its own parts —
-the shape of the reference's push-based shuffle (`push_based_shuffle.py`).
+exchange — the shape of the reference's push-based shuffle
+(`push_based_shuffle.py`). By default (`data_block_transport`) the
+exchange's intermediate partitions ride the BLOCK TRANSPORT
+(`transport.py`): each map task lands ALL its partitions as one flat arena
+segment and returns only a span descriptor; reduce tasks read their
+partition zero-copy from the local store or pull just its byte span over
+the bulk plane. The classic form (map `num_returns=P`, one pickled object
+put per partition) remains behind the flag and as the universal fallback.
 """
 
 from __future__ import annotations
@@ -19,6 +24,7 @@ import numpy as np
 from ..core.api import get as ray_get, put as ray_put, wait as ray_wait
 from ..core.remote_function import RemoteFunction
 from ..core.task_spec import TaskOptions
+from . import transport
 from .block import Block, BlockAccessor, concat_blocks, is_columnar
 from .context import DataContext
 from .plan import (
@@ -64,28 +70,59 @@ def _exec_chain(payload: bytes, blocks: List[Block]):
     return out, _meta_of(out)
 
 
-def _partition_map(payload: bytes, blocks: List[Block]):
-    """Map side of an exchange: returns P lists of blocks (one per partition)."""
+def _build_partitions(payload: bytes, blocks: List[Block]) -> List[List[Block]]:
+    """Shared map-side partitioning: concat the input, run the partition
+    functor, drop empty pieces. Both exchange wire strategies (classic
+    per-partition puts and the block transport) shape THIS result."""
     part_fn, num_parts = cloudpickle.loads(payload)
     parts: List[List[Block]] = [[] for _ in range(num_parts)]
     block = concat_blocks(blocks)
     for idx, piece in part_fn(block):
         if BlockAccessor(piece).num_rows() > 0:
             parts[idx].append(piece)
-    return tuple(parts) if num_parts > 1 else parts[0]
+    return parts
 
 
-def _exchange_reduce(payload: bytes, *parts):
-    """Reduce side: concat this partition's parts, post-process, return bundle."""
+def _partition_map(payload: bytes, blocks: List[Block]):
+    """Map side of an exchange: returns P lists of blocks (one per partition)."""
+    parts = _build_partitions(payload, blocks)
+    return tuple(parts) if len(parts) > 1 else parts[0]
+
+
+def _reduce_post(payload: bytes, blocks: List[Block]):
+    """Shared reduce tail: concat this partition's blocks, post-process,
+    drop empties, return (blocks, meta) — both wire strategies end here."""
     post_fn = cloudpickle.loads(payload)
-    blocks: List[Block] = []
-    for p in parts:
-        blocks.extend(p)
     merged = concat_blocks(blocks) if blocks else {}
     out = post_fn(merged)
     out_blocks = out if isinstance(out, list) else [out]
     out_blocks = [b for b in out_blocks if BlockAccessor(b).num_rows() > 0]
     return out_blocks, _meta_of(out_blocks)
+
+
+def _exchange_reduce(payload: bytes, *parts):
+    """Reduce side: concat this partition's parts, post-process, return bundle."""
+    blocks: List[Block] = []
+    for p in parts:
+        blocks.extend(p)
+    return _reduce_post(payload, blocks)
+
+
+def _partition_map_segment(payload: bytes, blocks: List[Block]):
+    """Map side of an exchange over the BLOCK TRANSPORT: all P partitions
+    land as one flat arena segment; the return value is only the small span
+    descriptor (transport.put_partitions)."""
+    return transport.put_partitions(_build_partitions(payload, blocks))
+
+
+def _exchange_reduce_segments(payload: bytes, j: int, *descs):
+    """Reduce side over the block transport: fetch ONLY partition j's span
+    from each map segment (cross-machine: a (name, offset, length) bulk-plane
+    read; same host: zero-copy borrow), then post-process as usual."""
+    blocks: List[Block] = []
+    for part in transport.fetch_partitions(list(descs), j):
+        blocks.extend(part)
+    return _reduce_post(payload, blocks)
 
 
 def _sample_rows(blocks: List[Block], key, k: int):
@@ -207,25 +244,48 @@ class StreamingExecutor:
         num_parts: int,
         post_fn: Callable,
     ) -> List[RefBundle]:
-        """Generic exchange: per-input partition map → per-output reduce."""
-        map_fn = _remote(_partition_map, num_returns=max(num_parts, 1))
-        part_refs: List[List[Any]] = []
-        for b, pf in zip(bundles, part_fns):
-            payload = cloudpickle.dumps((pf, num_parts))
-            refs = map_fn.remote(payload, b.blocks_ref)
-            part_refs.append(refs if num_parts > 1 else [refs])
-        reduce_fn = _remote(_exchange_reduce, num_returns=2)
+        """Generic exchange: per-input partition map → per-output reduce.
+
+        Two wire strategies for the intermediate partitions:
+          * block transport (default, `data_block_transport`): each map task
+            emits ONE flat arena segment + span descriptor; reduce task j
+            pulls only partition j's byte span over the bulk plane (zero-copy
+            borrow on the same host) — data/transport.py;
+          * classic: `num_returns=P` map tasks, each partition its own
+            pickled object put (P×N objects; kept for A/B measurement and as
+            the shape the transport descriptor degrades to).
+        """
         post_payload = cloudpickle.dumps(post_fn)
-        out = []
-        for j in range(num_parts):
-            parts_j = [refs[j] for refs in part_refs]
-            blocks_ref, meta_ref = reduce_fn.remote(post_payload, *parts_j)
-            out.append((blocks_ref, meta_ref))
-        result = []
-        for blocks_ref, meta_ref in out:
-            meta = ray_get(meta_ref)
-            result.append(RefBundle(blocks_ref, meta["num_rows"], meta["size_bytes"]))
-        return result
+        if transport.transport_enabled():
+            map_fn = _remote(_partition_map_segment)
+            desc_refs = []
+            for b, pf in zip(bundles, part_fns):
+                payload = cloudpickle.dumps((pf, num_parts))
+                desc_refs.append(map_fn.remote(payload, b.blocks_ref))
+            reduce_fn = _remote(_exchange_reduce_segments, num_returns=2)
+            out = [
+                reduce_fn.remote(post_payload, j, *desc_refs)
+                for j in range(num_parts)
+            ]
+        else:
+            map_fn = _remote(_partition_map, num_returns=max(num_parts, 1))
+            part_refs: List[List[Any]] = []
+            for b, pf in zip(bundles, part_fns):
+                payload = cloudpickle.dumps((pf, num_parts))
+                refs = map_fn.remote(payload, b.blocks_ref)
+                part_refs.append(refs if num_parts > 1 else [refs])
+            reduce_fn = _remote(_exchange_reduce, num_returns=2)
+            out = [
+                reduce_fn.remote(post_payload, *[refs[j] for refs in part_refs])
+                for j in range(num_parts)
+            ]
+        # One batched get for every reduce task's metadata (these used to be
+        # fetched one blocking round trip at a time).
+        metas = ray_get([meta_ref for _, meta_ref in out])
+        return [
+            RefBundle(blocks_ref, meta["num_rows"], meta["size_bytes"])
+            for (blocks_ref, _), meta in zip(out, metas)
+        ]
 
     def _exchange_repartition(self, op, bundles) -> List[RefBundle]:
         n = op.num_outputs
@@ -282,12 +342,16 @@ class StreamingExecutor:
             offset += b.num_rows
         right_re = self._map_reduce(right, part_fns, len(bundles), _identity_post)
         zip_fn = _remote(_zip_blocks, num_returns=2)
-        out = []
-        for lb, rb in zip(bundles, right_re):
-            blocks_ref, meta_ref = zip_fn.remote(lb.blocks_ref, rb.blocks_ref)
-            meta = ray_get(meta_ref)
-            out.append(RefBundle(blocks_ref, meta["num_rows"], meta["size_bytes"]))
-        return out
+        refs = [
+            zip_fn.remote(lb.blocks_ref, rb.blocks_ref)
+            for lb, rb in zip(bundles, right_re)
+        ]
+        # Batched metadata resolve: one get for the whole zip stage.
+        metas = ray_get([meta_ref for _, meta_ref in refs])
+        return [
+            RefBundle(blocks_ref, meta["num_rows"], meta["size_bytes"])
+            for (blocks_ref, _), meta in zip(refs, metas)
+        ]
 
 
 # ------------------------------------------------- partition/post functors
@@ -440,6 +504,7 @@ class _TaskStream:
 
     def __iter__(self) -> Iterator[RefBundle]:
         in_flight: collections.deque = collections.deque()
+        metas: Dict[Any, dict] = {}  # meta_ref -> resolved meta
         produced = 0
         exhausted = False
         while True:
@@ -453,7 +518,19 @@ class _TaskStream:
             if not in_flight:
                 return
             blocks_ref, meta_ref = in_flight.popleft()
-            meta = ray_get(meta_ref)
+            if meta_ref not in metas:
+                # Batched metadata resolve: block on the HEAD's meta but
+                # opportunistically fetch every other already-completed
+                # in-flight meta in the SAME get — streaming order and
+                # backpressure are unchanged, round trips collapse from one
+                # per bundle to one per window refill.
+                pending = [m for _, m in in_flight if m not in metas]
+                ready, _ = ray_wait(pending, num_returns=len(pending),
+                                    timeout=0) if pending else ([], [])
+                batch = [meta_ref] + ready
+                for ref, meta in zip(batch, ray_get(batch)):
+                    metas[ref] = meta
+            meta = metas.pop(meta_ref)
             bundle = RefBundle(blocks_ref, meta["num_rows"], meta["size_bytes"])
             yield bundle
             produced += bundle.num_rows

@@ -1,0 +1,341 @@
+"""Block transport — exchange traffic over the arena-backed bulk planes.
+
+The streaming executor's shuffle exchange used to move every partition as its
+own pickled object put (`num_returns=P` map tasks → P×N small objects, each
+fetched with a full object get). This module replaces that with ONE flat
+segment per map task plus span-addressed reads:
+
+  * the map task packs its P partitions into a single pickle-5 frame whose
+    out-of-band buffers are the partitions' numpy columns, laid out
+    contiguously (`serialization.pack` wire format:
+    ``[u32 npayload][payload][u32 nbufs]{[u64 len][buffer]}*``). Because the
+    transport serializes the frame itself (`ClusterBackend.put_serialized`),
+    it knows every column's exact (offset, length) span inside the stored
+    object and publishes a small DESCRIPTOR (span table + per-partition
+    row/byte counts + the pinning ObjectRef) as the task's return value;
+  * a reduce task for partition ``j`` resolves live copies via the
+    controller's batched ``object_sources`` and pulls ONLY partition j's span
+    from the source's bulk server (`core/bulk.py` wire protocol supports
+    (name, offset, length) span requests natively) — cross-machine reduce
+    traffic shrinks from whole-object pulls to exactly the bytes consumed;
+  * on the SAME host the descriptor degrades to a plain ``ray_get`` of the
+    segment, which rides the zero-copy borrow/map handover
+    (`bulk_borrow`/`_pull_map`): the rebuilt columns are numpy views over
+    the source arena mapping — no copy at all.
+
+Fallbacks (always correctness-preserving, see data/README.md):
+  * backend without ``put_serialized`` (local mode, remote client) → plain
+    ``ray_put`` of the partition list, spans absent;
+  * non-columnar (simple list) partitions, object-dtype or structured
+    columns → that partition is carried in-band in the pickle payload and
+    fetched via ``ray_get``;
+  * any span-fetch failure (source moved/evicted/spilled mid-read, bulk
+    endpoint gone) → ``ray_get`` of the whole segment.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import pickle
+import socket
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..core import api
+from ..core import bulk as bulk_mod
+from ..core import config as rt_config
+from ..core import serialization
+from ..core.api import get as ray_get, put as ray_put
+from .block import Block, BlockAccessor, is_columnar
+
+DESCRIPTOR_VERSION = 1
+
+
+def transport_enabled() -> bool:
+    """Whether exchange traffic should ride block segments at all (the
+    pickled-put path remains selectable for A/B measurement —
+    `scripts/bench_data.py` records both)."""
+    return bool(rt_config.get("data_block_transport"))
+
+
+# ------------------------------------------------------------ serialization
+def _rebuild_col(dtype_str: str, shape, buf) -> np.ndarray:
+    """Out-of-band column reconstruction: a zero-copy view over whatever
+    buffer the unpickler hands us (the arena mapping on a local read)."""
+    arr = np.frombuffer(buf, dtype=np.dtype(dtype_str))
+    return arr.reshape(shape)
+
+
+class _OOBColumn:
+    """Wraps one contiguous numpy column so its bytes travel as ONE
+    out-of-band pickle-5 buffer at a knowable frame offset. Unpickles
+    straight to the ndarray (callers never see the wrapper)."""
+
+    __slots__ = ("arr",)
+
+    def __init__(self, arr: np.ndarray):
+        self.arr = arr
+
+    def __reduce__(self):
+        return (
+            _rebuild_col,
+            (self.arr.dtype.str, self.arr.shape, pickle.PickleBuffer(self.arr)),
+        )
+
+
+def _rebuild_inband(data: bytes):
+    import cloudpickle
+
+    return cloudpickle.loads(data)
+
+
+class _InbandPart:
+    """A partition the span layout cannot carry (simple blocks, object
+    columns): pre-pickled to BYTES so it stays entirely in the in-band
+    payload — it must never emit out-of-band buffers of its own, or the
+    buffer→column index mapping below would silently misalign."""
+
+    __slots__ = ("data",)
+
+    def __init__(self, part):
+        import cloudpickle
+
+        self.data = cloudpickle.dumps(part)
+
+    def __reduce__(self):
+        return (_rebuild_inband, (self.data,))
+
+
+def _spannable(part: List[Block]) -> bool:
+    for blk in part:
+        if not is_columnar(blk):
+            return False
+        for v in blk.values():
+            if not isinstance(v, np.ndarray) or v.dtype.hasobject or v.dtype.fields:
+                return False
+    return True
+
+
+# ------------------------------------------------------------------ producer
+def put_partitions(parts: List[List[Block]]) -> Dict[str, Any]:
+    """Pack per-partition block lists into one segment object; returns the
+    descriptor (small, pickles into the task's normal return value). The
+    descriptor's nested ObjectRef keeps the segment pinned for as long as
+    any holder of the descriptor lives (contained-ref tracking)."""
+    rows = [sum(BlockAccessor(b).num_rows() for b in p) for p in parts]
+    sizes = [sum(BlockAccessor(b).size_bytes() for b in p) for p in parts]
+    rt = api._global_runtime()
+    backend = rt.backend
+    put_serialized = getattr(backend, "put_serialized", None)
+    if put_serialized is None or getattr(backend, "remote_client", False):
+        return {"v": DESCRIPTOR_VERSION, "ref": ray_put(parts),
+                "rows": rows, "bytes": sizes, "spans": None}
+
+    wrapped: List[Any] = []
+    part_cols: List[Optional[List[np.ndarray]]] = []  # pickle-order columns
+    for part in parts:
+        if not _spannable(part):
+            wrapped.append(_InbandPart(part))
+            part_cols.append(None)
+            continue
+        wp, cols = [], []
+        for blk in part:
+            nd = {}
+            for k, v in blk.items():
+                arr = np.ascontiguousarray(v)
+                nd[k] = _OOBColumn(arr)
+                cols.append(arr)
+            wp.append(nd)
+        wrapped.append(wp)
+        part_cols.append(cols)
+
+    payload, buffers = serialization.serialize(wrapped)
+    # Buffer k ↔ the k-th wrapped column, in partition/block/column traversal
+    # order (pickle walks lists and dicts in order; _InbandPart partitions
+    # contribute none by construction). A count mismatch means something
+    # unexpected went out-of-band — drop the span table, keep the object.
+    expected = sum(len(c) for c in part_cols if c is not None)
+    spans = None
+    if len(buffers) == expected:
+        # Frame layout: [u32 npayload][payload][u32 nbufs] then per buffer
+        # [u64 len][bytes]; data offset of buffer k is computable up front.
+        cur = 4 + len(payload) + 4
+        buf_offs = []
+        for b in buffers:
+            n = b.raw().nbytes
+            buf_offs.append((cur + 8, n))
+            cur += 8 + n
+        spans = []
+        k = 0
+        for part, cols in zip(parts, part_cols):
+            if cols is None:
+                spans.append(None)
+                continue
+            n_cols = len(cols)
+            first = buf_offs[k][0] if n_cols else 0
+            end = (buf_offs[k + n_cols - 1][0] +
+                   buf_offs[k + n_cols - 1][1]) if n_cols else 0
+            blocks_meta = []
+            ki = k
+            for blk in part:
+                cols_meta = []
+                for name in blk.keys():
+                    arr = cols[ki - k]
+                    off, nb = buf_offs[ki]
+                    cols_meta.append(
+                        (name, arr.dtype.str, arr.shape, off - first, nb)
+                    )
+                    ki += 1
+                blocks_meta.append(cols_meta)
+            spans.append({"off": first, "len": end - first,
+                          "blocks": blocks_meta})
+            k += n_cols
+
+    ref, name, span_ok = put_serialized(payload, buffers,
+                                        rt.current_task_id.hex())
+    if not span_ok:
+        spans = None  # inline frame: span-addressed reads are impossible
+    return {"v": DESCRIPTOR_VERSION, "ref": ref, "name": name, "rows": rows,
+            "bytes": sizes, "spans": spans}
+
+
+# ------------------------------------------------------------------ consumer
+def _try_local_read(desc: Dict[str, Any]):
+    """Zero-RPC fast path: the descriptor names the segment in the producer
+    node's shared store — a consumer on the SAME node deserializes it
+    straight off the arena mapping, exactly like the deps-map fast path
+    resolves classic task args (no controller round trip, no blocked-worker
+    lease dance). Returns the partition list or None when the segment is not
+    readable here (other node, evicted, spilled — callers fall back)."""
+    name = desc.get("name")
+    if not name:
+        return None
+    backend = api._global_runtime().backend
+    local_store = getattr(backend, "local_store", None)
+    if local_store is None:
+        return None
+    try:
+        return local_store.read(name)
+    except Exception:  # noqa: BLE001 — not local / gone; resolve properly
+        return None
+
+
+def _fetch_span(addr: str, name: str, offset: int, length: int,
+                tmo: float) -> bytearray:
+    """Pull one (offset, length) span of a stored object from a peer's bulk
+    server into private memory (partition-sized — not a store object)."""
+    buf = bytearray(length)
+    sock = bulk_mod._open_bulk_conn(addr, tmo)
+    with contextlib.closing(sock):
+        req = json.dumps(
+            {"name": name, "offset": offset, "length": length}
+        ).encode()
+        sock.sendall(bulk_mod._LEN.pack(len(req)) + req)
+        status, n = bulk_mod._HDR.unpack(
+            bulk_mod._recv_exact(sock, bulk_mod._HDR.size, tmo)
+        )
+        if status != 0:
+            raise RuntimeError(
+                "bulk span fetch failed: "
+                + bulk_mod._recv_exact(sock, n, tmo).decode(errors="replace")
+            )
+        if n != length:
+            raise RuntimeError(
+                f"bulk span length mismatch: asked {length}, got {n}"
+            )
+        bulk_mod._recv_exact_into(sock, memoryview(buf), tmo)
+    return buf
+
+
+def _rebuild_from_span(span: Dict[str, Any], buf: bytearray) -> List[Block]:
+    view = memoryview(buf)
+    out: List[Block] = []
+    for cols_meta in span["blocks"]:
+        blk: Dict[str, np.ndarray] = {}
+        for name, dtype_str, shape, rel_off, nbytes in cols_meta:
+            blk[name] = _rebuild_col(
+                dtype_str, tuple(shape), view[rel_off:rel_off + nbytes]
+            )
+        out.append(blk)
+    return out
+
+
+def fetch_partition(desc: Dict[str, Any], j: int) -> List[Block]:
+    """Partition ``j`` of one segment descriptor (see fetch_partitions)."""
+    return fetch_partitions([desc], j)[0]
+
+
+def fetch_partitions(descs: List[Dict[str, Any]], j: int) -> List[List[Block]]:
+    """Partition ``j`` of EVERY map segment, batched: one controller round
+    trip resolves all sources, local segments materialize in one batched get
+    (zero-copy borrow/map on this host), and remote spans pull concurrently.
+    Any per-segment failure degrades that segment to a whole-object get —
+    per-object RPC round trips, not bytes, dominated small exchanges, so
+    everything here is one-RPC-per-stage, not per-object."""
+    out: List[Optional[List[Block]]] = [None] * len(descs)
+    spannable: List[int] = []  # desc indices that could take the span path
+    for i, desc in enumerate(descs):
+        spans = desc.get("spans")
+        if spans is not None and spans[j] is not None and not spans[j]["blocks"]:
+            out[i] = []  # empty partition: nothing to fetch at all
+            continue
+        parts = _try_local_read(desc)
+        if parts is not None:
+            out[i] = parts[j]  # same-node segment: zero-copy, zero RPCs
+            continue
+        if spans is None or spans[j] is None:
+            continue  # resolved via the batched get below
+        spannable.append(i)
+
+    backend = api._global_runtime().backend
+    sources_of = getattr(backend, "object_sources", None)
+    remote: List[int] = []
+    srcs: Dict[int, dict] = {}
+    if spannable and sources_of is not None:
+        resolved = sources_of([descs[i]["ref"].id.hex() for i in spannable])
+        local_addrs = bulk_mod._local_addrs()
+        for i, src in zip(spannable, resolved):
+            if src and src["bulk"].rsplit(":", 1)[0] not in local_addrs:
+                remote.append(i)
+                srcs[i] = src
+            # else: same host (borrow/map handover beats a TCP span copy) or
+            # unresolvable — both take the batched get below.
+
+    if remote:
+        tmo = rt_config.get("transfer_chunk_timeout_s")
+
+        def pull(i: int):
+            span = descs[i]["spans"][j]
+            try:
+                buf = _fetch_span(srcs[i]["bulk"], srcs[i]["name"],
+                                  span["off"], span["len"], tmo)
+            except (OSError, RuntimeError, socket.timeout):
+                # Source died/evicted mid-read: the controller's directory
+                # still knows other copies (or re-executes lineage) — the
+                # plain get path below absorbs all of that.
+                return None
+            return _rebuild_from_span(span, buf)
+
+        if len(remote) == 1:
+            results = [pull(remote[0])]
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                max_workers=min(4, len(remote)),
+                thread_name_prefix="rtpu-span-fetch",
+            ) as ex:
+                results = list(ex.map(pull, remote))
+        for i, res in zip(remote, results):
+            out[i] = res
+
+    pending = [i for i, res in enumerate(out) if res is None]
+    if pending:
+        # One batched get for every whole-segment materialization (local
+        # zero-copy reads + any span-fetch fallbacks).
+        values = ray_get([descs[i]["ref"] for i in pending])
+        for i, parts in zip(pending, values):
+            out[i] = parts[j]
+    return out  # type: ignore[return-value]

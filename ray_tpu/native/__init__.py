@@ -1,10 +1,13 @@
 """Native (C++) components, built on demand with g++ and bound via ctypes.
 
 The reference keeps its runtime core in C++ (`src/ray/…`); here the
-machine-local object plane's hot allocator lives in
-`src/arena.cpp` (plasma-equivalent arena — SURVEY.md §2.1). The .so is
-compiled once per source change into `_build/` (no pip, no pybind — plain
-g++ + ctypes per the environment contract).
+machine-local object plane's hot allocator lives in `src/arena.cpp`
+(plasma-equivalent arena — SURVEY.md §2.1), the seqlock channel ops in
+`src/channel.cpp`, and the bulk-plane off-GIL landing (stream + ring
+landers) in `src/bulk.cpp`. Each .so is compiled once per source change
+into `_build/` (no pip, no pybind — plain g++ + ctypes per the
+environment contract); every loader degrades to None so the Python
+fallbacks keep working where no toolchain exists.
 """
 
 from __future__ import annotations
@@ -176,6 +179,62 @@ def load_channel_lib() -> Optional[ctypes.CDLL]:
 
 def channel_build_error() -> Optional[str]:
     return _ch_error
+
+
+# ------------------------------------------------------- bulk lander (off-GIL)
+_BULK_SRC = os.path.join(_DIR, "src", "bulk.cpp")
+_bulk_lib: Optional[ctypes.CDLL] = None
+_bulk_error: Optional[str] = None
+
+
+def load_bulk_lib() -> Optional[ctypes.CDLL]:
+    """Native bulk-plane landing ops (`src/bulk.cpp`): the whole-span
+    poll/read/pwrite stream loop and the pinned ring-lander thread — used by
+    `core/bulk.py` to take the receive path off the GIL; None if unbuildable
+    (the pure-Python ChunkPipeline remains the fallback)."""
+    global _bulk_lib, _bulk_error
+    with _lock:
+        if _bulk_lib is not None:
+            return _bulk_lib
+        if _bulk_error is not None:
+            return None
+        lib_path = _lib_path(_BULK_SRC, "ray_tpu_bulk")
+        err = _compile(_BULK_SRC, lib_path, "bulk")
+        if err is not None:
+            _bulk_error = err
+            return None
+        lib = _dlopen(_BULK_SRC, lib_path, "bulk")
+        if lib is None:
+            _bulk_error = "bulk dlopen failed (see stderr)"
+            return None
+        lib.rt_bulk_land_stream.restype = ctypes.c_longlong
+        lib.rt_bulk_land_stream.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_int,
+        ]
+        lib.rt_lander_create.restype = ctypes.c_void_p
+        lib.rt_lander_create.argtypes = [ctypes.c_int, ctypes.c_int]
+        lib.rt_lander_submit.restype = ctypes.c_longlong
+        lib.rt_lander_submit.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
+            ctypes.c_uint64, ctypes.c_int,
+        ]
+        lib.rt_lander_wait.restype = ctypes.c_int
+        lib.rt_lander_wait.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int,
+        ]
+        lib.rt_lander_completed.restype = ctypes.c_longlong
+        lib.rt_lander_completed.argtypes = [ctypes.c_void_p]
+        lib.rt_lander_error.restype = ctypes.c_int
+        lib.rt_lander_error.argtypes = [ctypes.c_void_p]
+        lib.rt_lander_close.restype = ctypes.c_int
+        lib.rt_lander_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        _bulk_lib = lib
+        return _bulk_lib
+
+
+def bulk_build_error() -> Optional[str]:
+    return _bulk_error
 
 
 class Arena:

@@ -14,10 +14,14 @@
 #include <atomic>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <string>
 #include <thread>
 #include <vector>
+
+#include <sys/socket.h>
+#include <unistd.h>
 
 // ---- arena C API (arena.cpp) ----
 extern "C" {
@@ -36,6 +40,17 @@ int64_t rtpu_ch_write(uint8_t* base, uint64_t num_readers, const uint8_t* data,
 int64_t rtpu_ch_wait_read(uint8_t* base, uint64_t last_seq, uint64_t* out_len,
                           uint64_t* out_flag, int64_t timeout_us);
 void rtpu_ch_ack(uint8_t* base, uint64_t reader_slot_idx, uint64_t seq);
+
+// ---- bulk lander C API (bulk.cpp) ----
+long long rt_bulk_land_stream(int sock_fd, int dst_fd, unsigned long long off,
+                              unsigned long long len, int deadline_ms);
+void* rt_lander_create(int dst_fd, int depth);
+long long rt_lander_submit(void* h, const void* buf, unsigned long long off,
+                           unsigned long long len, int timeout_ms);
+int rt_lander_wait(void* h, unsigned long long target, int timeout_ms);
+long long rt_lander_completed(void* h);
+int rt_lander_error(void* h);
+int rt_lander_close(void* h, int timeout_ms);
 }
 
 static std::atomic<int> failures{0};
@@ -130,9 +145,76 @@ static void channel_stress() {
     for (auto& th : readers) th.join();
 }
 
+// ------------------------------------------------------------ bulk lander
+static void lander_stress() {
+    // Ring lander: this thread plays the Python reader (fill + submit with
+    // the window bound), the native thread pwrites — the exact interleaving
+    // core/bulk.py::_land_ring_native runs, minus the socket.
+    char tmpl[] = "/tmp/tsan-lander-XXXXXX";
+    int fd = mkstemp(tmpl);
+    CHECK(fd >= 0, "lander tmpfile");
+    unlink(tmpl);
+    constexpr int kDepth = 4;
+    constexpr int kChunk = 64 << 10;
+    constexpr int kChunks = 256;
+    void* h = rt_lander_create(fd, kDepth);
+    CHECK(h != nullptr, "lander create");
+    std::vector<std::vector<char>> bufs(kDepth, std::vector<char>(kChunk));
+    for (int i = 0; i < kChunks; ++i) {
+        int slot = i % kDepth;
+        if (i >= kDepth)  // recycle a slot only after its chunk landed
+            CHECK(rt_lander_wait(h, (unsigned long long)(i - kDepth + 1),
+                                 10000) == 0, "lander window wait");
+        std::memset(bufs[slot].data(), i & 0xff, kChunk);
+        CHECK(rt_lander_submit(h, bufs[slot].data(),
+                               (unsigned long long)i * kChunk, kChunk,
+                               10000) > 0, "lander submit");
+    }
+    CHECK(rt_lander_wait(h, kChunks, 10000) == 0, "lander drain");
+    CHECK(rt_lander_error(h) == 0, "lander error");
+    CHECK(rt_lander_completed(h) == kChunks, "lander completed count");
+    CHECK(rt_lander_close(h, 10000) == 0, "lander close");
+    std::vector<char> back(kChunk);
+    for (int i = 0; i < kChunks; ++i) {
+        ssize_t n = pread(fd, back.data(), kChunk, (off_t)i * kChunk);
+        bool ok = n == kChunk;
+        for (int j = 0; ok && j < kChunk; ++j)
+            ok = back[j] == (char)(i & 0xff);
+        CHECK(ok, "lander landed content");
+    }
+
+    // Stream lander over a socketpair: writer thread feeds a pattern, the
+    // poll/read/pwrite loop lands it at an offset.
+    int sp[2];
+    CHECK(socketpair(AF_UNIX, SOCK_STREAM, 0, sp) == 0, "socketpair");
+    constexpr long long kStream = 2 << 20;
+    std::thread writer([&] {
+        std::vector<char> chunk(4096);
+        long long sent = 0;
+        while (sent < kStream) {
+            std::memset(chunk.data(), (sent / 4096) & 0xff, chunk.size());
+            ssize_t n = write(sp[1], chunk.data(), chunk.size());
+            if (n <= 0) break;
+            sent += n;
+        }
+        close(sp[1]);
+    });
+    long long rc = rt_bulk_land_stream(sp[0], fd, 0, kStream, 10000);
+    CHECK(rc == kStream, "stream land");
+    writer.join();
+    close(sp[0]);
+    for (int i = 0; i < (int)(kStream / 4096); ++i) {
+        char b = 0;
+        CHECK(pread(fd, &b, 1, (off_t)i * 4096) == 1 && b == (char)(i & 0xff),
+              "stream landed content");
+    }
+    close(fd);
+}
+
 int main() {
     arena_stress();
     channel_stress();
+    lander_stress();
     if (failures.load() != 0) {
         std::fprintf(stderr, "%d failures\n", failures.load());
         return 1;

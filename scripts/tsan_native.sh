@@ -5,6 +5,6 @@ set -euo pipefail
 cd "$(dirname "$0")/../ray_tpu/native/src"
 OUT=${TMPDIR:-/tmp}/ray_tpu_native_tsan
 g++ -fsanitize=thread -O1 -g -std=c++17 \
-    native_stress_test.cpp arena.cpp channel.cpp \
+    native_stress_test.cpp arena.cpp channel.cpp bulk.cpp \
     -lpthread -lrt -o "$OUT"
 TSAN_OPTIONS="halt_on_error=1" "$OUT"

@@ -256,16 +256,21 @@ class _FaultyBulkServer:
             pass
 
 
+@pytest.mark.parametrize("lander", ["stream", "ring", "off"])
 @pytest.mark.parametrize("mode", ["kill", "stall"])
-def test_bulk_chaos_abort_leaves_no_partial_object(bulk_pair, mode):
+def test_bulk_chaos_abort_leaves_no_partial_object(bulk_pair, mode, lander):
     """Mid-transfer worker death and a stalled chunk must abort within the
     per-chunk progress deadline, leave NO partial object visible, and let
-    the same pull succeed against a healthy source afterwards."""
+    the same pull succeed against a healthy source afterwards — on EVERY
+    landing path: native stream, native ring, and the Python pipeline
+    (the poisoning semantics are a contract, not an implementation)."""
     src, good_addr, dst = bulk_pair
     size = 32 << 20
     faulty = _FaultyBulkServer(size, mode)
     old = os.environ.get("RAY_TPU_TRANSFER_CHUNK_TIMEOUT_S")
+    old_lander = os.environ.get("RAY_TPU_BULK_NATIVE_LANDER")
     os.environ["RAY_TPU_TRANSFER_CHUNK_TIMEOUT_S"] = "1.5"
+    os.environ["RAY_TPU_BULK_NATIVE_LANDER"] = lander
     rt_config._reset_cache_for_tests()
     try:
         hx = secrets.token_hex(28)
@@ -301,16 +306,25 @@ def test_bulk_chaos_abort_leaves_no_partial_object(bulk_pair, mode):
             os.environ.pop("RAY_TPU_TRANSFER_CHUNK_TIMEOUT_S", None)
         else:
             os.environ["RAY_TPU_TRANSFER_CHUNK_TIMEOUT_S"] = old
+        if old_lander is None:
+            os.environ.pop("RAY_TPU_BULK_NATIVE_LANDER", None)
+        else:
+            os.environ["RAY_TPU_BULK_NATIVE_LANDER"] = old_lander
         rt_config._reset_cache_for_tests()
 
 
-def test_bulk_pipelined_tcp_roundtrip(bulk_pair):
-    """The pipelined chunk window reassembles a multi-chunk span exactly
-    over real sockets (chunk size shrunk so a small object spans many)."""
+@pytest.mark.parametrize("lander", ["stream", "ring", "off"])
+def test_bulk_pipelined_tcp_roundtrip(bulk_pair, lander):
+    """A multi-chunk span reassembles exactly over real sockets on every
+    landing path (chunk size shrunk so a small object spans many): native
+    stream, native ring, and the Python chunk pipeline ("off" pins the
+    pure-Python path so it stays covered even where the extension builds)."""
     src, addr, dst = bulk_pair
     old_chunk = os.environ.get("RAY_TPU_BULK_CHUNK_BYTES")
+    old_lander = os.environ.get("RAY_TPU_BULK_NATIVE_LANDER")
     os.environ["RAY_TPU_BULK_CHUNK_BYTES"] = str(1 << 20)
     os.environ["RAY_TPU_BULK_SAME_HOST_MAP"] = "0"
+    os.environ["RAY_TPU_BULK_NATIVE_LANDER"] = lander
     rt_config._reset_cache_for_tests()
     try:
         n = (9 << 20) + 777  # ragged tail across 1 MiB chunks
@@ -321,6 +335,28 @@ def test_bulk_pipelined_tcp_roundtrip(bulk_pair):
             os.environ.pop("RAY_TPU_BULK_CHUNK_BYTES", None)
         else:
             os.environ["RAY_TPU_BULK_CHUNK_BYTES"] = old_chunk
+        if old_lander is None:
+            os.environ.pop("RAY_TPU_BULK_NATIVE_LANDER", None)
+        else:
+            os.environ["RAY_TPU_BULK_NATIVE_LANDER"] = old_lander
+        del os.environ["RAY_TPU_BULK_SAME_HOST_MAP"]
+        rt_config._reset_cache_for_tests()
+
+
+def test_bulk_native_unavailable_degrades_to_python(bulk_pair, monkeypatch):
+    """With the native extension unbuildable the landing silently takes the
+    Python pipeline — same bytes, no error (the graceful-degrade contract of
+    native/__init__.py)."""
+    from ray_tpu import native as native_mod
+
+    src, addr, dst = bulk_pair
+    monkeypatch.setattr(native_mod, "load_bulk_lib", lambda: None)
+    os.environ["RAY_TPU_BULK_SAME_HOST_MAP"] = "0"
+    rt_config._reset_cache_for_tests()
+    try:
+        data = np.random.default_rng(5).integers(0, 255, 8 << 20, np.uint8).tobytes()
+        _roundtrip(src, addr, dst, data, streams=1, force_tcp=False)
+    finally:
         del os.environ["RAY_TPU_BULK_SAME_HOST_MAP"]
         rt_config._reset_cache_for_tests()
 
