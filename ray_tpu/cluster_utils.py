@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import json
 import os
-import select
+import selectors
 import subprocess
 import sys
 import time
@@ -31,28 +31,36 @@ from .core.rpc import ensure_auth_token
 
 def read_sentinel(proc: subprocess.Popen, prefix: str, timeout: float) -> Optional[str]:
     """Read stdout lines until one starts with `prefix`; honors the deadline
-    even when the child stays alive but silent (select before readline)."""
+    even when the child stays alive but silent (poll before readline).
+    selectors (epoll), not select(): a driver holding thousands of direct
+    worker channels has fds past select()'s 1024 cap, and a HEAD RESTART is
+    exactly when such a driver calls this."""
     deadline = time.monotonic() + timeout
     buf = b""
     fd = proc.stdout.fileno()
-    while time.monotonic() < deadline:
-        if proc.poll() is not None and not buf:
-            return None
-        ready, _, _ = select.select([fd], [], [], min(0.5, max(0.01, deadline - time.monotonic())))
-        if not ready:
-            continue
-        chunk = os.read(fd, 4096)
-        if not chunk:
-            if proc.poll() is not None:
+    sel = selectors.DefaultSelector()
+    sel.register(fd, selectors.EVENT_READ)
+    try:
+        while time.monotonic() < deadline:
+            if proc.poll() is not None and not buf:
                 return None
-            continue
-        buf += chunk
-        while b"\n" in buf:
-            line, buf = buf.split(b"\n", 1)
-            text = line.decode(errors="replace")
-            if text.startswith(prefix):
-                return text[len(prefix):].strip()
-    return None
+            ready = sel.select(min(0.5, max(0.01, deadline - time.monotonic())))
+            if not ready:
+                continue
+            chunk = os.read(fd, 4096)
+            if not chunk:
+                if proc.poll() is not None:
+                    return None
+                continue
+            buf += chunk
+            while b"\n" in buf:
+                line, buf = buf.split(b"\n", 1)
+                text = line.decode(errors="replace")
+                if text.startswith(prefix):
+                    return text[len(prefix):].strip()
+        return None
+    finally:
+        sel.close()
 
 
 def launch_node_agent(
@@ -131,7 +139,8 @@ class Cluster:
             )
 
     # -------------------------------------------------------------- head
-    def _start_head(self, num_cpus, resources, object_store_memory, restore=False):
+    def _start_head(self, num_cpus, resources, object_store_memory,
+                    restore=False, sentinel_timeout=60):
         if self.session_dir is None:
             self.session_dir = os.path.join(
                 "/tmp/ray_tpu", f"cluster_{int(time.time() * 1000)}_{os.getpid()}"
@@ -160,7 +169,7 @@ class Cluster:
             stderr=log_f,
             cwd=pkg_root,
         )
-        val = read_sentinel(proc, "RAY_TPU_CONTROLLER_PORT=", 30)
+        val = read_sentinel(proc, "RAY_TPU_CONTROLLER_PORT=", sentinel_timeout)
         if val is None:
             proc.terminate()
             raise RuntimeError(
@@ -180,11 +189,15 @@ class Cluster:
             self.head_proc.wait(timeout=10)
 
     def restart_head(self):
-        """Restart the controller against the same session dir: it replays
-        the periodic snapshot, re-binds its port, and re-adopts surviving
-        actor workers as they reconnect."""
+        """Restart the controller against the same session dir: it restores
+        the checkpoint + replays the WAL, re-binds its port, and re-adopts
+        surviving actor workers as they reconnect. Generous sentinel: the
+        restarting head competes for CPU with every orphaned worker's
+        reconnect loop (a 2,000-worker fleet on a small host can stretch a
+        ~2s interpreter boot past a minute of wall time)."""
         num_cpus, resources, object_store_memory = self._head_args
-        self._start_head(num_cpus, resources, object_store_memory, restore=True)
+        self._start_head(num_cpus, resources, object_store_memory,
+                         restore=True, sentinel_timeout=180)
 
     # ------------------------------------------------------------- nodes
     def add_node(

@@ -54,6 +54,15 @@ class ClusterBackend(RuntimeBackend):
         self._create_buf: list = []
         self._create_lock = __import__("threading").Lock()
         self._create_flush_scheduled = False
+        # Head-failover survivability: recently-sent creation frames, kept
+        # so a reconnect can RESUBMIT in-flight creations (the controller
+        # dedups on the client-minted actor id, so replay + resubmission
+        # can't double-create). (monotonic, frame) pairs, bounded.
+        from collections import deque as _deque
+
+        self._create_ledger = _deque(maxlen=512)
+        self._reconnect_lock = __import__("threading").Lock()
+        self._shutting_down = False
 
     def set_runtime(self, runtime):
         self._runtime = runtime
@@ -140,17 +149,90 @@ class ClusterBackend(RuntimeBackend):
     def reconnect(self) -> bool:
         """Re-establish this backend's connection after a controller restart
         (used by actor workers being re-adopted — their nested API must not
-        keep pointing at the dead socket)."""
+        keep pointing at the dead socket — and by the driver-side failover
+        loop below). Registration is idempotent on the controller."""
+        if self._shutting_down or self.io.loop.is_closed():
+            return False  # shutdown raced the failover loop
         try:
             if self.conn is not None:
                 self.conn.close()
         except Exception:  # noqa: BLE001
             pass
+        # The direct manager is KEPT: its actor channels ride worker conns
+        # that never touched the head (surviving actors keep answering
+        # through the outage, and locally-held results stay resolvable).
+        # Leases self-heal — leased plain workers exited with the old head
+        # and their channel-close handlers resubmit against the new conn.
         try:
             self._connect(self._register_as)
+            self._resubmit_creates()
             return True
         except Exception:  # noqa: BLE001
             return False
+
+    # Creation frames sent within this window BEFORE the outage began are
+    # resubmitted after a failover (older ones were acked + checkpointed
+    # many ticks ago; the window also bounds the re-create risk for a
+    # freshly killed-and-GCed actor id). Anchored at connection-loss time,
+    # NOT at reconnect time: a slow head restart (a 2,000-worker fleet can
+    # stretch boot past a minute) must not age in-flight creations out of
+    # their own recovery path.
+    _RESUBMIT_WINDOW_S = 15.0
+
+    def _resubmit_creates(self):
+        base = getattr(self, "_conn_lost_at", None)
+        if base is None:
+            base = time.monotonic()
+        frames = [
+            dict(m) for t, m in list(self._create_ledger)
+            if t >= base - self._RESUBMIT_WINDOW_S
+        ]
+        if not frames or self.conn is None:
+            return
+        try:
+            self.conn.post({"type": "create_actor_batch", "items": frames})
+        except ConnectionError:
+            pass  # next close/reconnect cycle retries
+
+    def _on_conn_lost(self):
+        """Controller connection dropped. Drivers attached to an EXTERNAL
+        (standalone) cluster retry with capped exponential backoff — the
+        head may be restarting from its WAL; a session whose controller is
+        our own child is simply over."""
+        if (
+            self._shutting_down
+            or self.role not in ("driver", "client")
+            or self._controller_proc is not None
+        ):
+            return
+        self._conn_lost_at = time.monotonic()  # resubmit-window anchor
+        import threading
+
+        threading.Thread(
+            target=self._reconnect_with_backoff, name="head-reconnect",
+            daemon=True,
+        ).start()
+
+    def _reconnect_with_backoff(self) -> bool:
+        from . import config as rt_config
+
+        if not self._reconnect_lock.acquire(blocking=False):
+            return False  # a reconnect loop is already running
+        try:
+            deadline = time.monotonic() + rt_config.get(
+                "head_reconnect_deadline_s"
+            )
+            delay = 0.1
+            while not self._shutting_down and time.monotonic() < deadline:
+                time.sleep(delay)
+                delay = min(delay * 2, 2.0)  # capped exponential backoff
+                if self.io.loop.is_closed():
+                    return False  # backend shut down under us
+                if self.reconnect():
+                    return True
+            return False
+        finally:
+            self._reconnect_lock.release()
 
     def _connect(self, register_as: str):
         from .rpc import adopt_local_session_token
@@ -175,7 +257,10 @@ class ClusterBackend(RuntimeBackend):
                 phases["tcp_timeout"] = round(_t.monotonic() - t0, 2)
                 raise
             phases["tcp"] = round(_t.monotonic() - t0, 2)
-            conn = Connection(reader, writer, on_push=self._on_controller_push)
+            conn = Connection(
+                reader, writer, on_push=self._on_controller_push,
+                on_close=self._on_conn_close,
+            )
             conn.start()
             self.conn = conn
             payload = {"type": register_as, "node_id": os.environ.get("RAY_TPU_NODE_ID", "node0")}
@@ -234,13 +319,17 @@ class ClusterBackend(RuntimeBackend):
         # (ray://) clients stay on the classic plane — no shm locality and
         # possibly no route to worker sockets.
         if self.role in ("driver", "worker") and not self.remote_client:
-            from .direct import DirectCallManager
+            if self.direct is None:  # kept across failover reconnects
+                from .direct import DirectCallManager
 
-            self.direct = DirectCallManager(self)
+                self.direct = DirectCallManager(self)
 
     async def _on_controller_push(self, msg: dict):
         if msg.get("type") == "revoke_lease" and self.direct is not None:
             self.direct.on_revoke(msg["worker_id"])
+
+    async def _on_conn_close(self):
+        self._on_conn_lost()
 
     # ------------------------------------------- actor-creation coalescing
     def _buffer_create(self, msg: dict):
@@ -279,6 +368,9 @@ class ClusterBackend(RuntimeBackend):
                 return
             items, self._create_buf = self._create_buf, []
             self._create_flush_scheduled = False
+        now = time.monotonic()
+        for m in items:
+            self._create_ledger.append((now, m))
         if self.conn is None or self.conn._closed:
             raise RayTpuError("Lost connection to controller (connection closed)")
         try:
@@ -612,9 +704,22 @@ class ClusterBackend(RuntimeBackend):
         }
         if name:
             # Named creation stays a round trip: the name-taken conflict is
-            # a synchronous ValueError by API contract.
+            # a synchronous ValueError by API contract. Ledgered first so a
+            # head failover mid-request still lands the creation on
+            # reconnect (dedup'd by actor id server-side); a creation the
+            # CALLER saw rejected is un-ledgered — resubmitting it after a
+            # failover could spawn an orphan nobody holds a handle to.
+            entry = (
+                time.monotonic(),
+                {k: v for k, v in msg.items() if k != "type"},
+            )
+            self._create_ledger.append(entry)
             resp = self._request(msg)
             if resp and resp.get("error"):
+                try:
+                    self._create_ledger.remove(entry)
+                except ValueError:
+                    pass  # already rotated out of the bounded deque
                 raise ValueError(resp["error"])
             return
         # Anonymous creation is fire-and-forget (reference semantics: actor
@@ -824,6 +929,7 @@ class ClusterBackend(RuntimeBackend):
     def shutdown(self) -> None:
         from .ref_tracker import TRACKER
 
+        self._shutting_down = True  # no failover reconnects past this point
         TRACKER.set_flusher(None)
         if self.direct is not None:
             self.direct.close()

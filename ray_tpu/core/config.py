@@ -100,9 +100,36 @@ define("controller_shard_threads", True,
            "(all shards execute on the controller's main loop — same "
            "partitioning, single executor)")
 # Persistence.
-define("snapshot_interval_s", 1.0, doc="Controller state snapshot period")
+define("snapshot_interval_s", 1.0,
+       doc="Controller checkpoint period (with the WAL on, a checkpoint is "
+           "log COMPACTION, not the durability boundary)")
 define("gcs_storage", "file",
        doc="Metadata backend url: file[://dir] (durable) | memory (volatile)")
+# Write-ahead event log (core/event_log.py — the GCS replay role).
+define("wal_enabled", True,
+       doc="Append every state-mutating control-plane transition to the "
+           "session-dir WAL; restore = checkpoint + replay (sub-second "
+           "actor-state recovery). Active only for standalone controllers "
+           "with a durable gcs_storage backend")
+define("wal_segment_bytes", 8 * 1024 * 1024,
+       doc="WAL segment rotation size; checkpoints unlink covered segments")
+define("wal_fsync_interval_s", 0.05,
+       doc="WAL fsync batching window (loss bound for machine crashes; "
+           "process kill -9 loses nothing written)")
+define("wal_fsync_bytes", 256 * 1024,
+       doc="Dirty-byte threshold that forces an immediate WAL fsync")
+define("wal_sync", "batch",
+       doc="WAL durability mode: batch (fsync-batched, default) | always "
+           "(fsync per append — chaos tests) | none")
+# Head failover (client side).
+define("head_reconnect_deadline_s", 30.0,
+       doc="How long drivers/agents retry reconnecting to a restarting "
+           "head (capped exponential backoff) before declaring it dead")
+define("readopt_deadline_s", 40.0,
+       doc="After a head restore, how long restored actors wait for their "
+           "surviving worker to reconnect before the normal death/restart "
+           "path runs (raise for huge fleets on starved hosts — the "
+           "re-registration storm itself takes time)")
 define("pull_timeout_s", 120.0, doc="Cross-node object pull base timeout")
 # Chunked transfer plane (reference: object_manager chunked push/pull,
 # `object_manager.h` default chunk 5 MiB; admission `pull_manager.h:52`).

@@ -24,6 +24,14 @@ The multi-node seam is unchanged: remote node daemons join through
 
 Data plane stays OUT of this process: objects ride named shm segments
 (store.py); the controller holds only locations, sizes, refstate, and waiters.
+
+Fault model (docs/CONTROL_PLANE_HA.md): head death is a recoverable event,
+not a cluster funeral. Every state-mutating transition is written ahead to
+a CRC-guarded, fsync-batched event log (event_log.py); the periodic
+checkpoint compacts it. A restarted head restores checkpoint + replay,
+re-binds its port, and re-adopts surviving workers/agents as they
+reconnect — actors, the data plane, and in-flight direct calls never
+touch the head on their hot paths and keep running through the outage.
 """
 
 from __future__ import annotations
@@ -481,6 +489,11 @@ class Controller:
         self._shutdown_event = asyncio.Event()
         self._worker_procs: Dict[str, subprocess.Popen] = {}
         self._forkserver = None  # set in start()
+        # Write-ahead event log (event_log.py): every state-mutating
+        # transition appends; restore = checkpoint + replay. None when
+        # disabled (driver-owned session / memory:// backend).
+        self._wal = None
+        self._recoveries_total = 0
 
     # ------------------------------------------------------------ lifecycle
     _SNAPSHOT_KEY = "controller_state"
@@ -505,10 +518,19 @@ class Controller:
         self._main_loop = asyncio.get_running_loop()
         for sh in self.shards:
             sh.attach_main_loop(self._main_loop)
-        # _load_snapshot handles missing/corrupt state itself — one read.
+        self._open_wal()
+        # _restore_state handles missing/corrupt state itself — checkpoint
+        # read (if any) + WAL replay past it.
         restored = restore
         if restored:
-            restored = self._load_snapshot()  # adopts the dead session's tag
+            t0 = time.monotonic()
+            restored = self._restore_state()  # adopts the dead session's tag
+            if restored:
+                self._recoveries_total += 1
+                self._self_inc("controller_recoveries_total", 1.0)
+                self._self_observe(
+                    "controller_recovery_seconds", time.monotonic() - t0
+                )
         if not restored:
             store.set_session_tag(str(os.getpid()))
             store.cleanup_stale_segments()
@@ -525,6 +547,22 @@ class Controller:
             self._on_connection, host=bind, port=self.port
         )
         self.port = self._server.sockets[0].getsockname()[1]
+        if not restored:
+            if self._wal is not None:
+                # Fresh session over this dir: discard any surviving log
+                # (e.g. a failed restore's rolled-back records) — appending
+                # this session's boot AFTER them would make the next
+                # failover replay the dead session's actors as ours.
+                self._wal.reset()
+            # First durable record: enough identity (tag/port/arena size) to
+            # restore from a BARE log — a crash before the first checkpoint
+            # must still recover everything appended so far.
+            self._wal_append(
+                "controller_boot",
+                session_tag=store.SESSION_TAG,
+                port=self.port,
+                object_store_memory=self.object_store_memory,
+            )
         # Head-store bulk plane (bulk.py): serves the controller's objects to
         # pulling agents the same way agents serve each other.
         from .bulk import BulkServer
@@ -571,10 +609,96 @@ class Controller:
     # --------------------------------------------------- persistence (GCS FT)
     # Reference analog: GCS tables behind `RedisStoreClient`
     # (`redis_store_client.h:33`) + replay via `gcs_init_data.cc`. Redesign:
-    # a periodic pickle of the durable directories to the session dir; a
-    # restarted controller replays it, re-binds the SAME port, and re-adopts
-    # workers as they reconnect (their shm arena survived the crash — kill -9
-    # skips teardown, and segment names key off the ORIGINAL session tag).
+    # a WRITE-AHEAD EVENT LOG (event_log.py) appends every state-mutating
+    # transition as it happens; the periodic pickle of the durable
+    # directories is now a CHECKPOINT that compacts the log (snapshot =
+    # checkpoint + truncate-before). A restarted controller restores the
+    # checkpoint, REPLAYS the log past it, re-binds the SAME port, and
+    # re-adopts workers as they reconnect (their shm arena survived the
+    # crash — kill -9 skips teardown, and segment names key off the
+    # ORIGINAL session tag). Recovery loses nothing after the last WAL
+    # fsync instead of everything after the last snapshot tick. See
+    # docs/CONTROL_PLANE_HA.md for the record schema and recovery ordering.
+    def _open_wal(self):
+        """The WAL is active exactly where restore is possible: standalone
+        controllers (driver-owned sessions die with their driver) with a
+        durable metadata backend."""
+        if not self.standalone or not rt_config.get("wal_enabled"):
+            return
+        if str(rt_config.get("gcs_storage")).startswith("memory"):
+            return
+        from .event_log import EventLog
+
+        self._wal = EventLog(
+            os.path.join(self.session_dir, "wal"),
+            segment_bytes=rt_config.get("wal_segment_bytes"),
+            sync=rt_config.get("wal_sync"),
+            fsync_interval_s=rt_config.get("wal_fsync_interval_s"),
+            fsync_bytes=rt_config.get("wal_fsync_bytes"),
+            on_fsync=self._on_wal_fsync,
+        )
+        if self._wal.truncated_records:
+            # Torn tail cut at open: the dropped bytes were never
+            # acknowledged durable, but leave a forensic marker.
+            self._event(
+                "recovery_truncated", records=self._wal.truncated_records
+            )
+
+    def _wal_append(self, kind: str, **fields):
+        if self._wal is not None:
+            self._wal.append(kind, fields)
+
+    def _on_wal_fsync(self, seconds: float):
+        # Fires on the WAL flusher THREAD — marshal onto the main loop (the
+        # metric dicts are main-loop-owned, and /metrics iterates them).
+        loop = getattr(self, "_main_loop", None)
+        if loop is not None:
+            try:
+                loop.call_soon_threadsafe(
+                    self._self_observe, "controller_log_fsync_seconds", seconds
+                )
+            except RuntimeError:
+                pass  # loop closed (shutdown)
+
+    # Controller-internal metric feeds: same aggregation shapes as
+    # h_record_metric, but written locally (the controller has no client
+    # backend to push through). Families/boundaries live in util/metrics.py
+    # so tests and dashboards share one definition.
+    def _self_inc(self, name: str, value: float):
+        key = (name, ())
+        from ..util.metrics import CONTROLLER_HA_HELP
+
+        self.user_metric_help.setdefault(name, CONTROLLER_HA_HELP.get(name, ""))
+        cur = self.user_metrics.get(key, (0.0, None, 0.0))[0]
+        self.user_metrics[key] = (cur + value, "counter", time.time())
+
+    def _self_set_gauge(self, name: str, value: float):
+        from ..util.metrics import CONTROLLER_HA_HELP
+
+        self.user_metric_help.setdefault(name, CONTROLLER_HA_HELP.get(name, ""))
+        self.user_metrics[(name, ())] = (value, "gauge", time.time())
+
+    def _self_observe(self, name: str, value: float):
+        from ..util.metrics import CONTROLLER_HA_BOUNDARIES, CONTROLLER_HA_HELP
+
+        boundaries = CONTROLLER_HA_BOUNDARIES[name]
+        self.user_metric_help.setdefault(name, CONTROLLER_HA_HELP.get(name, ""))
+        key = (name, ())
+        h = self.user_hists.get(key)
+        if h is None:
+            h = self.user_hists[key] = {
+                "boundaries": boundaries,
+                "buckets": [0] * (len(boundaries) + 1),
+                "sum": 0.0, "count": 0,
+            }
+        idx = 0
+        while idx < len(boundaries) and value > boundaries[idx]:
+            idx += 1
+        h["buckets"][idx] += 1
+        h["sum"] += float(value)
+        h["count"] += 1
+        h["ts"] = time.time()
+
     def _spec_blob(self, actor_hex: str, spec) -> Optional[bytes]:
         """Specs are immutable — pickle once, not on every snapshot tick."""
         if spec is None:
@@ -589,6 +713,9 @@ class Controller:
 
         return {
             "session_tag": store.SESSION_TAG,
+            # WAL position this checkpoint covers: restore replays records
+            # AFTER this seq; compaction unlinks segments at or below it.
+            "wal_seq": self._wal.seq if self._wal is not None else 0,
             # Shard layout at snapshot time (forensics + the FT test's
             # cross-shard invariant: the per-shard id lists are disjoint and
             # their union is exactly the actor table). Restore re-routes by
@@ -643,7 +770,7 @@ class Controller:
 
     async def _snapshot_loop(self):
         # Driver-owned sessions (non-standalone) die with their driver and
-        # can never restore — don't pay the snapshot cost for them.
+        # can never restore — don't pay the checkpoint cost for them.
         if not self.standalone:
             return
         loop = asyncio.get_running_loop()
@@ -658,65 +785,257 @@ class Controller:
                 # OFF-loop — large tables must not stall scheduling/RPC.
                 state = self._snapshot_state()
                 await loop.run_in_executor(None, dump, state)
+                # Checkpoint landed: compact the log (truncate-before). The
+                # durability boundary is the WAL fsync, not this tick.
+                if self._wal is not None:
+                    self._wal.checkpoint(state["wal_seq"])
+                    self._self_set_gauge(
+                        "controller_log_bytes", float(self._wal.total_bytes())
+                    )
             except Exception:  # noqa: BLE001
                 traceback.print_exc()
 
-    def _load_snapshot(self) -> bool:
+    def _restore_state(self) -> bool:
+        """Checkpoint restore + WAL replay. Either alone is sufficient: a
+        bare log (crash before the first checkpoint) replays from its
+        controller_boot record; a bare checkpoint (WAL disabled) restores
+        exactly the old snapshot semantics."""
+        snap = None
         try:
             snap = cloudpickle.loads(self._gcs_store.get(self._SNAPSHOT_KEY))
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — missing/corrupt checkpoint: the
+            # WAL replay below may still carry the full state; a corrupt
+            # checkpoint with no WAL is a fresh start (marked in the
+            # timeline once the controller is up).
+            snap = None
+        wal_seq = 0
+        identity = False  # session_tag/port adopted from SOME durable source
+        if snap is not None:
+            wal_seq = int(snap.get("wal_seq", 0))
+            store.set_session_tag(snap["session_tag"])
+            self.port = snap["port"]
+            self.object_store_memory = snap["object_store_memory"]
+            self.store_bytes_used = snap.get("store_bytes_used", 0)
+            identity = True
+            self.named_actors = dict(snap["named_actors"])
+            for jid, j in snap.get("jobs", {}).items():
+                self.jobs[jid] = {**j, "proc": None}  # re-adopted by pid
+            for h, a in snap["actors"].items():
+                astate = ActorState(
+                    actor_hex=h,
+                    spec=cloudpickle.loads(a["spec"]) if a["spec"] else None,
+                    name=a["name"],
+                    namespace=a["namespace"],
+                    handle_bytes=a["handle_bytes"],
+                    detached=a["detached"],
+                )
+                astate.restarts_used = a["restarts_used"]
+                astate.worker_id = a["worker_id"]
+                # Until its worker reconnects, the actor is "restarting":
+                # calls queue instead of failing (reference: restart states).
+                astate.state = "restarting" if a["state"] in ("alive", "pending", "restarting") else a["state"]
+                # Insertion re-routes by the CURRENT shard layout — a restore
+                # with a different controller_shards repartitions cleanly.
+                self.actors[h] = astate
+                astate.shard = self.actors.shard_for(h)
+            for k, v in snap["pgs"].items():
+                self.pgs[k] = dict(v)
+            for h, o in snap["objects"].items():
+                obj = self._obj(h)
+                obj.status = o["status"]
+                obj.inline = o["inline"]
+                obj.locations = dict(o["locations"])
+                obj.spilled_path = o["spilled_path"]
+                obj.spilled_node = o["spilled_node"]
+                obj.size = o["size"]
+                obj.ever_held = o["ever_held"]
+                obj.expected = o["expected"]
+                obj.contains = list(o["contains"])
+                for c in obj.contains:
+                    self._obj(c).pinned += 1
+        replayed = 0
+        if self._wal is not None:
+            for seq, kind, fields in self._wal.replay(from_seq=wal_seq):
+                if self._apply_wal_record(kind, fields):
+                    identity = True
+                replayed += 1
+        if not identity:
+            # Neither checkpoint nor boot record survived (corrupt blob AND
+            # the boot record compacted away). This boots as a FRESH session
+            # — roll back anything replay already inserted, or ghost actors
+            # stuck 'restarting' (no readopt timer arms) would squat names
+            # and poison list_actors forever.
+            self.actors.clear()
+            self.named_actors.clear()
+            self.pgs.clear()
+            self.objects.clear()
+            self.jobs.clear()
+            self.store_bytes_used = 0
             return False
-        store.set_session_tag(snap["session_tag"])
         self.local_store = store.make_store(create_arena=False)  # re-attach
-        self.port = snap["port"]
-        self.object_store_memory = snap["object_store_memory"]
-        self.store_bytes_used = snap.get("store_bytes_used", 0)
-        self.named_actors = dict(snap["named_actors"])
-        for jid, j in snap.get("jobs", {}).items():
-            self.jobs[jid] = {**j, "proc": None}  # re-adopted by pid
-        for h, a in snap["actors"].items():
-            astate = ActorState(
-                actor_hex=h,
-                spec=cloudpickle.loads(a["spec"]) if a["spec"] else None,
-                name=a["name"],
-                namespace=a["namespace"],
-                handle_bytes=a["handle_bytes"],
-                detached=a["detached"],
-            )
-            astate.restarts_used = a["restarts_used"]
-            astate.worker_id = a["worker_id"]
-            # Until its worker reconnects, the actor is "restarting": calls
-            # queue instead of failing (reference: actor restart states).
-            astate.state = "restarting" if a["state"] in ("alive", "pending", "restarting") else a["state"]
-            # Insertion re-routes by the CURRENT shard layout — a restore
-            # with a different controller_shards repartitions cleanly.
-            self.actors[h] = astate
-            astate.shard = self.actors.shard_for(h)
-        for k, v in snap["pgs"].items():
-            self.pgs[k] = dict(v)
-            # Bundles were reserved against head capacity pre-crash; re-apply.
-            for b, nid in zip(v["bundles"], v.get("bundle_nodes", [])):
+        # Actors whose creation never reached a worker (registered/queued at
+        # crash time — worker_id empty) restart their creation task NOW
+        # instead of waiting out the 40s re-adoption deadline (which would
+        # also burn restart budget for a worker that never existed).
+        requeued = 0
+        for astate in self.actors.values():
+            if (
+                astate.state == "restarting"
+                and not astate.worker_id
+                and astate.spec is not None
+            ):
+                astate.state = "pending"
+                self._pin_args(astate.spec)
+                self._enqueue(PendingTask(spec=astate.spec, retries_left=0))
+                requeued += 1
+        if requeued:
+            self._schedule()
+        # Re-apply PG reservations against head capacity exactly once, over
+        # the MERGED (checkpoint + replay) table — bundles were reserved
+        # pre-crash; remote nodes re-register with fresh availability, so
+        # only the head's books need the deduction.
+        for pg in self.pgs.values():
+            for b, nid in zip(pg["bundles"], pg.get("bundle_nodes") or []):
                 if nid == HEAD_NODE:
                     self._acquire(self.head, b)
-        for h, o in snap["objects"].items():
-            obj = self._obj(h)
-            obj.status = o["status"]
-            obj.inline = o["inline"]
-            obj.locations = dict(o["locations"])
-            obj.spilled_path = o["spilled_path"]
-            obj.spilled_node = o["spilled_node"]
-            obj.size = o["size"]
-            obj.ever_held = o["ever_held"]
-            obj.expected = o["expected"]
-            obj.contains = list(o["contains"])
-            for c in obj.contains:
-                self._obj(c).pinned += 1
         self._event("controller_restored", actors=len(self.actors),
-                    objects=len(self.objects))
+                    objects=len(self.objects), replayed=replayed)
         asyncio.get_running_loop().call_later(
-            40.0, lambda: asyncio.ensure_future(self._readopt_deadline())
+            rt_config.get("readopt_deadline_s"),
+            lambda: asyncio.ensure_future(self._readopt_deadline()),
         )
         return True
+
+    # Kept under its historical name for callers/tests that restore
+    # explicitly.
+    _load_snapshot = _restore_state
+
+    def _apply_wal_record(self, kind: str, fields: dict) -> bool:
+        """Apply one replayed WAL record to the directories. IDEMPOTENT by
+        construction — replaying the same log twice reaches a fixpoint (the
+        replay-idempotency test's invariant): creations skip existing
+        entries, deaths re-set terminal states, connection-scoped records
+        (workers, leases) are no-ops because that state cannot outlive the
+        peer's TCP connection. Returns True for identity-bearing records
+        (controller_boot)."""
+        if kind == "controller_boot":
+            # Fallback identity when no checkpoint landed before the crash.
+            if not store.SESSION_TAG:
+                store.set_session_tag(fields["session_tag"])
+                self.port = fields["port"]
+                self.object_store_memory = fields["object_store_memory"]
+            return True
+        if kind == "actor_registered":
+            h = fields["actor"]
+            if h in self.actors:
+                return False
+            astate = ActorState(
+                actor_hex=h,
+                spec=spec_from_proto_bytes(fields["spec"]),
+                name=fields.get("name", ""),
+                namespace=fields.get("namespace", "default"),
+                handle_bytes=fields.get("handle", b""),
+                detached=bool(fields.get("detached")),
+            )
+            astate.state = "restarting"
+            self.actors[h] = astate
+            astate.shard = self.actors.shard_for(h)
+            if astate.name:
+                self.named_actors.setdefault(
+                    (astate.namespace, astate.name), h
+                )
+            return False
+        astate = self.actors.get(fields.get("actor", ""))
+        if kind == "actor_infeasible":
+            if astate is None:
+                a = ActorState(
+                    actor_hex=fields["actor"], spec=None, state="dead"
+                )
+                a.init_error = TaskError(
+                    RuntimeError(fields.get("error", "infeasible")), "",
+                    "actor creation",
+                )
+                self.actors[fields["actor"]] = a
+                a.shard = self.actors.shard_for(fields["actor"])
+            return False
+        if kind == "actor_alive":
+            if astate is not None and astate.state != "dead":
+                astate.worker_id = fields.get("worker") or astate.worker_id
+                # Stays "restarting": alive again only when its worker
+                # actually reconnects (h_register_worker re-adoption).
+            return False
+        if kind == "actor_restarting":
+            if astate is not None and astate.state != "dead":
+                astate.restarts_used = max(
+                    astate.restarts_used, int(fields.get("restarts_used", 0))
+                )
+                astate.state = "restarting"
+            return False
+        if kind in ("actor_death", "actor_killed"):
+            if astate is not None:
+                astate.state = "dead"
+                if fields.get("no_restart", True):
+                    astate.spec = None
+                for key, ah in list(self.named_actors.items()):
+                    if ah == fields["actor"]:
+                        del self.named_actors[key]
+            return False
+        if kind == "pg_created":
+            self.pgs.setdefault(fields["pg"], {
+                "bundles": fields["bundles"],
+                "strategy": fields["strategy"],
+                "name": fields.get("name", ""),
+                "ready": bool(fields.get("ready")),
+                "bundle_nodes": fields.get("bundle_nodes") or [],
+                "bundle_avail": [dict(b) for b in fields["bundles"]],
+            })
+            return False
+        if kind == "pg_placed":
+            pg = self.pgs.get(fields["pg"])
+            if pg is not None and not pg["ready"]:
+                pg["bundle_nodes"] = fields.get("bundle_nodes") or []
+                pg["bundle_avail"] = [dict(b) for b in pg["bundles"]]
+                pg["ready"] = True
+            return False
+        if kind == "pg_removed":
+            self.pgs.pop(fields["pg"], None)
+            return False
+        if kind == "object_ready":
+            obj = self._obj(fields["id"])
+            if obj.status != "ready":  # checkpoint overlap / second replay
+                obj.status = "ready"
+                obj.inline = fields.get("inline")
+                obj.size = int(fields.get("size", 0))
+                obj.expected = True
+                if fields.get("contains") and not obj.contains:
+                    obj.contains = list(fields["contains"])
+                    for ch in obj.contains:
+                        self._obj(ch).pinned += 1
+            if fields.get("name"):
+                node = fields.get("node", HEAD_NODE)
+                if node not in obj.locations:
+                    obj.locations[node] = fields["name"]
+                    if node == HEAD_NODE:
+                        # Mirror the live accounting (_mark_ready): a head
+                        # shm copy counts against the arena budget.
+                        self.store_bytes_used += int(fields.get("size", 0))
+            return False
+        if kind == "object_freed":
+            obj = self.objects.pop(fields["id"], None)
+            if obj is not None:
+                if HEAD_NODE in obj.locations:
+                    self.store_bytes_used -= obj.size
+                for ch in obj.contains:
+                    inner = self.objects.get(ch)
+                    if inner is not None:
+                        inner.pinned = max(0, inner.pinned - 1)
+            return False
+        # worker_registered / lease_granted / lease_returned /
+        # named-actor forensics: connection-scoped — the state cannot
+        # outlive the peer's conn, which did not survive the crash. Workers
+        # re-register live; lease holders re-request. Recorded for
+        # forensics and the chaos suite's ordering assertions only.
+        return False
 
     async def _readopt_deadline(self):
         """Actors still 'restarting' after the reconnect window lost their
@@ -805,6 +1124,8 @@ class Controller:
             store.mark_restorable(store.SESSION_TAG, False)
         if self._server:
             self._server.close()
+        if self._wal is not None:
+            self._wal.close()
         if getattr(self, "_bulk_server", None) is not None:
             self._bulk_server.stop()
         if getattr(self, "_forkserver", None) is not None:
@@ -1163,9 +1484,22 @@ class Controller:
                     obj.holders.discard(conn_id)
                     self._maybe_gc(hex_id)
         if meta["kind"] == "worker":
-            await self._on_worker_death(meta["worker_id"])
+            # Only the CURRENT registration's conn declares the worker dead:
+            # a reconnecting worker can race itself during a head failover
+            # (two register frames, second replaces the first), and the
+            # stale conn's close must not kill the live re-registration —
+            # observed killing a just-re-adopted actor host, which then
+            # burned the actor's restart budget for a worker still alive.
+            cur_ws = self.workers.get(meta["worker_id"])
+            if cur_ws is None or cur_ws.conn is conn:
+                await self._on_worker_death(meta["worker_id"])
         elif meta["kind"] == "node":
-            await self._on_node_death(meta["node_id"])
+            # Only the CURRENT registration's conn declares the node dead: a
+            # re-announced agent (failover reconnect) may have replaced this
+            # conn already — its stale close must not kill the fresh record.
+            cur = self.nodes.get(meta["node_id"])
+            if cur is None or cur.conn is conn:
+                await self._on_node_death(meta["node_id"])
         elif meta["kind"] == "driver":
             self.drivers.discard(conn)
             if not self.drivers and not self.standalone:
@@ -1200,6 +1534,18 @@ class Controller:
         node_id = msg.get("node_id", HEAD_NODE)
         meta["kind"] = "worker"
         meta["worker_id"] = worker_id
+        # Duplicate registration (a reconnecting worker racing itself across
+        # a head failover — two register frames, second replaces the first):
+        # release the replaced record's capacity grant BEFORE the re-adoption
+        # block below re-acquires, or the node's books double-deduct the
+        # actor's resources on every extra frame.
+        old_ws = self.workers.get(worker_id)
+        if old_ws is not None and old_ws.assigned:
+            if old_ws.blocked:
+                old_ws.assigned = {}
+                old_ws.assigned_pg = None
+            else:
+                self._grant_release(old_ws)
         # Prefer the worker's self-report (survives controller restarts —
         # the in-memory map doesn't); fall back to the spawn-time record.
         env_key = msg.get("env_key") or self._worker_env_keys.pop(worker_id, "")
@@ -1265,6 +1611,10 @@ class Controller:
                     break
         self._worker_arrival.set()
         self._worker_arrival.clear()
+        # Connection-scoped (a worker entry cannot outlive its conn, so
+        # replay is a no-op) — recorded for forensics/ordering only.
+        self._wal_append("worker_registered", worker=worker_id,
+                         node=node_id, actor=actor_hex or "")
         self._event("worker_registered", worker=worker_id)
         self._schedule()
         return {"ok": True}
@@ -1273,8 +1623,20 @@ class Controller:
         """A node agent joined (reference: `GcsNodeManager::HandleRegisterNode`).
         The docstring seam promised in round 1 (`register_node`) — now real."""
         node_id = msg["node_id"]
-        if node_id in self.nodes and self.nodes[node_id].alive:
+        existing = self.nodes.get(node_id)
+        if (
+            existing is not None
+            and existing.alive
+            and existing.conn is not None
+            and not existing.conn._closed
+        ):
             return {"ok": False, "error": f"node id {node_id} already registered"}
+        if existing is not None:
+            # Re-announce after a head failover (or an agent-side reconnect
+            # whose old conn the head hasn't reaped yet): replace the stale
+            # record — the fresh registration carries current capacity, and
+            # the node's workers re-register themselves.
+            self.nodes.pop(node_id, None)
         meta["kind"] = "node"
         meta["node_id"] = node_id
         total = {k: float(v) for k, v in (msg.get("resources") or {}).items()}
@@ -1330,6 +1692,8 @@ class Controller:
                 pg["bundle_nodes"] = placement
                 pg["bundle_avail"] = [dict(b) for b in pg["bundles"]]
             pg["ready"] = True
+            self._wal_append("pg_placed", pg=pg_hex,
+                             bundle_nodes=pg["bundle_nodes"])
             self._event("pg_placed", pg=pg_hex)
 
     async def h_shutdown(self, conn, meta, msg):
@@ -1363,6 +1727,15 @@ class Controller:
         if shm_name:
             obj.locations[node_id] = shm_name
         obj.size = size
+        # WAL: the directory entry must survive a head crash in the window
+        # before the next checkpoint — shm payloads outlive the head in the
+        # arena (kill -9 skips teardown) and inline payloads ride the record
+        # itself, so a put acknowledged to the client stays gettable across
+        # failover. Freed ids are tombstoned below (_free_object).
+        self._wal_append(
+            "object_ready", id=hex_id, inline=inline, name=shm_name or "",
+            size=size, node=node_id, contains=list(contains or ()),
+        )
         obj.last_access = time.monotonic()
         if shm_name and node_id == HEAD_NODE:
             self.store_bytes_used += size
@@ -1749,6 +2122,10 @@ class Controller:
     def _free_object(self, hex_id: str):
         self._drop_copies(hex_id)
         obj = self.objects.pop(hex_id, None)
+        if obj is not None:
+            # Tombstone: replay must not resurrect a directory entry whose
+            # arena segment is already unlinked.
+            self._wal_append("object_freed", id=hex_id)
         self._gc_candidates.discard(hex_id)
         if obj is not None:
             for h in obj.contains:  # container gone → nested refs unpin
@@ -2189,6 +2566,27 @@ class Controller:
         on that worker's node (controller-directed pull)."""
         spec = pt.spec
         task_hex = spec.task_id.hex()
+        if spec.task_type == TaskType.ACTOR_CREATION_TASK and spec.actor_id:
+            astate = self.actors.get(spec.actor_id.hex())
+            if (
+                astate is not None
+                and astate.state == "alive"
+                and astate.worker_id
+                and astate.worker_id != ws.worker_id
+            ):
+                # Failover race resolved in the actor's favor: restore
+                # requeued this creation (it looked never-started), but the
+                # surviving worker re-adopted first. Dropping here is what
+                # keeps the chaos gate's "zero duplicated actors" honest.
+                self.running.pop(task_hex, None)
+                ws.state = IDLE
+                ws.current_task = None
+                ws.actor_hex = None
+                self._grant_release(ws)
+                self._unpin_args(spec)
+                self._event("actor_recreate_dropped", actor=spec.actor_id.hex())
+                self._schedule()
+                return
         try:
             await asyncio.gather(
                 *(self._ensure_local(node.node_id, oid.hex()) for oid in spec.arg_refs)
@@ -2910,6 +3308,11 @@ class Controller:
         else:
             self._lease_backlog.pop(bkey, None)
         if grants:
+            self._wal_append(
+                "lease_granted",
+                workers=[g["worker_id"] for g in grants],
+                holder=meta.get("conn_id") or 0,
+            )
             self._event("lease_granted", n=len(grants), holder=meta.get("conn_id"))
         return {"leases": grants}
 
@@ -2992,6 +3395,7 @@ class Controller:
         if leases is not None:
             leases.discard(worker_id)
         if ws is not None and ws.leased_to == meta.get("conn_id"):
+            self._wal_append("lease_returned", worker=worker_id)
             self._release_lease(ws)
 
     def _revoke_leases_for_backlog(self):
@@ -3131,16 +3535,40 @@ class Controller:
     def _fail_stream(self, spec: TaskSpec, err: TaskError):
         """Terminal failure of a streaming task: one error item, then end —
         a waiting consumer must never hang."""
-        s = self._stream(spec.task_id.hex())
+        self._fail_stream_hex(spec.task_id.hex(), err)
+
+    def _fail_stream_hex(self, task_hex: str, err: TaskError):
+        from .ids import TaskID
+
+        s = self._stream(task_hex)
         if s["done"]:
             return
         idx = s["produced"]
-        oid_hex = ObjectID.of(spec.task_id, idx).hex()
+        oid_hex = ObjectID.of(TaskID.from_hex(task_hex), idx).hex()
         self._obj(oid_hex).expected = True
         self._store_error_object(oid_hex, err)
         s["produced"] = idx + 1
         s["done"] = True
         self._wake_stream(s)
+
+    def _fail_streams_of_actor(self, actor_hex: str, err: TaskError):
+        """End every open stream owned by a dead actor's tasks. Streaming
+        calls delivered over the DIRECT actor channel never pass through
+        this controller as specs — a call still queued in the dead worker
+        leaves only a stream entry (created lazily by the consumer's
+        stream_next long-poll), and nothing else will ever end it. TaskID
+        encodes the actor id, so the sweep needs no spec."""
+        from .ids import TaskID
+
+        for task_hex, s in list(self.streams.items()):
+            if s["done"]:
+                continue
+            try:
+                owner = TaskID.from_hex(task_hex).actor_id().hex()
+            except Exception:  # noqa: BLE001 — malformed/foreign id
+                continue
+            if owner == actor_hex:
+                self._fail_stream_hex(task_hex, err)
 
     def _fail_task(self, pt: PendingTask, err: TaskError):
         """Terminal failure for a not-yet-dispatched task: unpin args, error
@@ -3288,6 +3716,8 @@ class Controller:
         if ws is not None:
             astate.worker_id = ws.worker_id
         self._set_actor_state(astate, "alive")
+        self._wal_append("actor_alive", actor=actor_hex,
+                         worker=astate.worker_id or "")
         self._event("actor_alive", actor=actor_hex)
         return None
 
@@ -3346,19 +3776,22 @@ class Controller:
         BATCH happens at the caller (deferred coalescing absorbs it)."""
         spec: TaskSpec = spec_from_proto_bytes(msg["spec"])
         actor_hex = spec.actor_id.hex()
+        # Dedup key: the client-minted actor id. A creation frame
+        # resubmitted after a head failover (reconnect ledger) — or one
+        # whose WAL record already replayed — must not register twice.
+        if actor_hex in self.actors:
+            return {"ok": True, "dup": True}
         bad = self._infeasible(spec.resources)
         if bad:
             astate = ActorState(actor_hex=actor_hex, spec=None, state="dead")
-            astate.init_error = TaskError(
-                RuntimeError(
-                    f"Actor {spec.name} demands {bad} but no node can fit it "
-                    f"(cluster total {self._cluster_totals()}) — infeasible."
-                ),
-                "",
-                spec.name,
+            err_text = (
+                f"Actor {spec.name} demands {bad} but no node can fit it "
+                f"(cluster total {self._cluster_totals()}) — infeasible."
             )
+            astate.init_error = TaskError(RuntimeError(err_text), "", spec.name)
             self.actors[actor_hex] = astate
             astate.shard = self.actors.shard_for(actor_hex)
+            self._wal_append("actor_infeasible", actor=actor_hex, error=err_text)
             return {"ok": False}
         astate = ActorState(
             actor_hex=actor_hex,
@@ -3368,13 +3801,24 @@ class Controller:
             handle_bytes=msg.get("handle", b""),
             detached=spec.options.lifetime == "detached",
         )
-        self.actors[actor_hex] = astate
-        astate.shard = self.actors.shard_for(actor_hex)
         if astate.name:
             key = (astate.namespace, astate.name)
             if key in self.named_actors:
                 return {"error": f"Actor name '{astate.name}' already taken"}
             self.named_actors[key] = actor_hex
+        self.actors[actor_hex] = astate
+        astate.shard = self.actors.shard_for(actor_hex)
+        # WAL before ack (write-ahead contract): the registration + name
+        # bind must be durable before any client can observe them.
+        self._wal_append(
+            "actor_registered",
+            actor=actor_hex,
+            spec=msg["spec"],
+            name=astate.name,
+            namespace=astate.namespace,
+            handle=msg.get("handle", b""),
+            detached=astate.detached,
+        )
         self._pin_args(spec)
         pt = PendingTask(spec=spec, retries_left=0)
         self._event("actor_created", actor=actor_hex, name=astate.name)
@@ -3651,6 +4095,7 @@ class Controller:
         self._set_actor_state(astate, "dead")
         if no_restart:
             astate.spec = None
+        self._wal_append("actor_killed", actor=actor_hex, no_restart=no_restart)
         err = TaskError(ActorDiedError("Actor was killed."), "", "actor task")
         self._drain_actor_queue(astate, err)
         # Inflight (already-delivered) calls can never complete either — the
@@ -3671,6 +4116,9 @@ class Controller:
         for key, ah in list(self.named_actors.items()):
             if ah == actor_hex:
                 del self.named_actors[key]
+        # Streams of direct-plane calls queued in the dying worker have no
+        # controller-side spec to drain — end them by owner id.
+        self._fail_streams_of_actor(actor_hex, err)
         ws = self.workers.get(astate.worker_id)
         if ws is not None:
             self._terminate_worker(ws)
@@ -3767,6 +4215,8 @@ class Controller:
         if spec is not None and (max_restarts == -1 or astate.restarts_used < max_restarts):
             astate.restarts_used += 1
             self._set_actor_state(astate, "restarting")
+            self._wal_append("actor_restarting", actor=actor_hex,
+                             restarts_used=astate.restarts_used)
             self._event("actor_restarting", actor=actor_hex)
             err = TaskError(
                 ActorUnavailableError(f"actor {actor_hex[:12]} restarting"), "", "actor task"
@@ -3787,10 +4237,12 @@ class Controller:
             self._schedule()
         else:
             self._set_actor_state(astate, "dead")
+            self._wal_append("actor_death", actor=actor_hex)
             self._event("actor_death", actor=actor_hex,
                         restarts_used=astate.restarts_used)
             err = TaskError(ActorDiedError(), "", f"actor {actor_hex[:12]}")
             self._drain_actor_queue(astate, err)
+            self._fail_streams_of_actor(actor_hex, err)
             with astate.lock:  # pump (shard loop) writes concurrently
                 inflight = list(astate.inflight.values())
                 astate.inflight.clear()
@@ -4099,6 +4551,11 @@ class Controller:
             # from general node availability (it is already reserved).
             "bundle_avail": [dict(b) for b in bundles],
         }
+        self._wal_append(
+            "pg_created", pg=msg["id"], bundles=bundles, strategy=strategy,
+            name=msg.get("name", ""), ready=feasible,
+            bundle_nodes=placement or [],
+        )
         return {"ok": feasible}
 
     def _place_bundles(
@@ -4171,6 +4628,8 @@ class Controller:
 
     async def h_remove_pg(self, conn, meta, msg):
         pg = self.pgs.pop(msg["id"], None)
+        if pg is not None:
+            self._wal_append("pg_removed", pg=msg["id"])
         if pg and pg["bundle_nodes"]:
             # Release every still-placed bundle — including those of a PG
             # demoted to not-ready after a node death (its surviving bundles
@@ -4247,6 +4706,23 @@ class Controller:
                 return {"status": "ready"}
             if s["done"]:
                 return {"status": "end"}
+            # Dead-owner check: a streaming call queued in a dead actor's
+            # worker (direct plane) produces no items and no done — only
+            # the owning actor's state says so. Without this, the first
+            # poll to race the death sweep long-polls out its full timeout.
+            from .ids import TaskID
+
+            try:
+                owner = TaskID.from_hex(task_hex).actor_id().hex()
+            except Exception:  # noqa: BLE001
+                owner = None
+            astate = self.actors.get(owner) if owner else None
+            if astate is not None and astate.state == "dead":
+                err = astate.init_error or TaskError(
+                    ActorDiedError(), "", f"actor {owner[:12]}"
+                )
+                self._fail_stream_hex(task_hex, err)
+                continue  # loop re-reads: first poll gets the error item
             ev = asyncio.Event()
             s["events"].append(ev)
             try:
@@ -4581,11 +5057,18 @@ class Controller:
                 "cursor": self._timeline_base + len(self.timeline),
                 "events": [],
             }
-        # Clamp to the tail: a stale cursor from a previous controller
-        # lifetime (restore resets the timeline) must re-anchor to "now"
-        # instead of reading an empty feed until the new timeline catches
-        # up to the old count.
-        idx = min(max(cursor - self._timeline_base, 0), len(self.timeline))
+        pos = cursor - self._timeline_base
+        if pos > len(self.timeline):
+            # Cursor from a PREVIOUS controller incarnation (restore resets
+            # the timeline): re-anchor to this incarnation's BASE and replay
+            # its whole feed — anchoring to the tail instead silently
+            # swallowed deaths that landed during the failover gap (a gang
+            # member dying while its supervisor's poll was mid-retry). A
+            # same-incarnation cursor can never run ahead of the tail, so
+            # this branch is unambiguous; cursors BEHIND base (trimmed
+            # history) still clamp forward to base below.
+            pos = 0
+        idx = max(pos, 0)
         kinds = set(msg.get("kinds") or ())
         # Floor of 1: limit<=0 would never advance the cursor — a silently
         # dead subscription instead of an error.
@@ -4786,6 +5269,12 @@ class Controller:
 
     def _prometheus_text(self) -> str:
         now = time.time()
+        if self._wal is not None:
+            # Scrape-time refresh (also keeps the gauge out of the
+            # staleness sweep while the WAL lives).
+            self._self_set_gauge(
+                "controller_log_bytes", float(self._wal.total_bytes())
+            )
         self._prune_stale_metrics(now)
         lines = [
             "# TYPE ray_tpu_tasks_pending gauge",

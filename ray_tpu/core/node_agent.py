@@ -248,34 +248,50 @@ class NodeAgent:
         if rt_config.get("worker_forkserver"):
             self._forkserver.start(pdeathsig=True)
 
-        host, port = self.controller_address.rsplit(":", 1)
-        reader, writer = await open_rpc_connection(host, int(port))
-        self.conn = Connection(
-            reader, writer, on_push=self._on_controller_push, on_close=self._on_controller_close
-        )
-        self.conn.start()
         if rt_config.get("local_dispatch"):
             from .local_dispatch import LocalDispatcher
 
             self.dispatcher = LocalDispatcher(self)
             self.dispatcher.start()
-        resp = await self.conn.request(
-            {
-                "type": "register_node",
-                "node_id": self.node_id,
-                "resources": self.resources,
-                "fetch_addr": f"{self.node_ip}:{self.fetch_port}",
-                "bulk_addr": f"{self.node_ip}:{bulk_port}",
-                "local_dispatch": self.dispatcher is not None,
-                "session_tag": store.SESSION_TAG,
-                "object_store_memory": self.object_store_memory,
-                "labels": self.labels,
-                "pid": os.getpid(),
-            },
-            timeout=15,
-        )
+        # Registration is re-announcable: a head failover closes this conn
+        # and _reconnect_controller re-sends the SAME frame (the restarted
+        # controller accepts re-registration over a dead record).
+        self._register_payload = {
+            "type": "register_node",
+            "node_id": self.node_id,
+            "resources": self.resources,
+            "fetch_addr": f"{self.node_ip}:{self.fetch_port}",
+            "bulk_addr": f"{self.node_ip}:{bulk_port}",
+            "local_dispatch": self.dispatcher is not None,
+            "session_tag": store.SESSION_TAG,
+            "object_store_memory": self.object_store_memory,
+            "labels": self.labels,
+            "pid": os.getpid(),
+        }
+        resp = await self._connect_controller()
         if not (resp or {}).get("ok"):
             raise RuntimeError(f"node registration rejected: {resp}")
+
+    async def _connect_controller(self) -> dict:
+        host, port = self.controller_address.rsplit(":", 1)
+        reader, writer = await open_rpc_connection(host, int(port))
+        # on_close attaches only AFTER a successful registration: a failed
+        # probe conn's close must not spawn another reconnect loop (loops
+        # multiplying per failed attempt is how an agent ends up racing
+        # itself into 'already registered' rejections).
+        conn = Connection(reader, writer, on_push=self._on_controller_push)
+        conn.start()
+        try:
+            resp = await conn.request(dict(self._register_payload), timeout=15)
+        except (ConnectionError, OSError):
+            conn.close()
+            raise
+        if (resp or {}).get("ok"):
+            conn.on_close = self._on_controller_close
+            self.conn = conn
+        else:
+            conn.close()
+        return resp or {}
 
     async def _memory_monitor_loop(self):
         """Sample node memory pressure; over the limit, report worker RSS
@@ -337,7 +353,46 @@ class NodeAgent:
                 proc.terminate()
 
     async def _on_controller_close(self):
-        # Controller gone → the session is over.
+        # Controller connection dropped: the head may be RESTARTING from
+        # its WAL (GCS-FT semantics), not gone. Re-announce this node with
+        # capped exponential backoff; only a head that stays dead past the
+        # deadline ends the session. Workers keep running throughout — the
+        # data plane never needed the head.
+        if self._shutdown.is_set() or getattr(self, "_reconnecting", False):
+            return
+        print(f"[agent {self.node_id}] controller connection lost; "
+              "attempting re-announce", file=sys.stderr, flush=True)
+        self._reconnecting = True
+        asyncio.ensure_future(self._reconnect_controller())
+
+    async def _reconnect_controller(self):
+        try:
+            deadline = time.monotonic() + rt_config.get(
+                "head_reconnect_deadline_s"
+            )
+            delay = 0.2
+            while not self._shutdown.is_set() and time.monotonic() < deadline:
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, 2.0)
+                try:
+                    resp = await self._connect_controller()
+                except (ConnectionError, OSError, asyncio.TimeoutError):
+                    continue
+                if resp.get("ok"):
+                    print(f"[agent {self.node_id}] re-announced to controller",
+                          file=sys.stderr, flush=True)
+                    return
+        finally:
+            self._reconnecting = False
+        # Deadline passed with no successful re-announce FROM THIS LOOP —
+        # but never shut a healthy agent down: a registration this loop saw
+        # rejected as 'already registered' means another path won.
+        if self._shutdown.is_set():
+            return
+        if self.conn is not None and not self.conn._closed:
+            return
+        print(f"[agent {self.node_id}] controller did not come back; "
+              "shutting down", file=sys.stderr, flush=True)
         self._shutdown.set()
 
     # ------------------------------------------------- controller messages

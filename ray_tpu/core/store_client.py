@@ -69,7 +69,23 @@ class FileStoreClient(StoreClient):
         tmp = f"{self._path(key)}.tmp.{os.getpid()}"
         with open(tmp, "wb") as f:
             f.write(value)
+            # fsync BEFORE the rename: checkpoint durability is load-bearing
+            # now that a landed checkpoint licenses WAL compaction — with
+            # delayed allocation, a machine crash after the rename could
+            # otherwise surface a zero-length checkpoint AFTER the covered
+            # log segments were unlinked (unbounded loss, not the documented
+            # fsync-window bound). The rename itself is fsync'd via the dir.
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, self._path(key))
+        try:
+            dirfd = os.open(self.root, os.O_RDONLY)
+            try:
+                os.fsync(dirfd)
+            finally:
+                os.close(dirfd)
+        except OSError:
+            pass  # non-POSIX dir fsync; the file itself is durable
 
     def get(self, key):
         try:

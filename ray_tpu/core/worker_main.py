@@ -481,11 +481,22 @@ class WorkerProcess:
         else:
             self.task_queue.put({"type": "exit"})
 
-    async def _reconnect(self, deadline_s: float = 30.0) -> bool:
+    async def _reconnect(self, deadline_s: Optional[float] = None) -> bool:
         import asyncio
         import time as _time
 
+        if deadline_s is None:
+            from . import config as rt_config
+
+            deadline_s = rt_config.get("head_reconnect_deadline_s")
         end = _time.monotonic() + deadline_s
+        # Jittered capped-exponential backoff: at a 2,000-worker fleet, a
+        # fixed 0.5s retry is a thundering herd that starves the very head
+        # process everyone is waiting on (measured: loadavg 500+ on a
+        # 1-vCPU host, head boot >60s).
+        import random as _random
+
+        delay = 0.5
         while _time.monotonic() < end:
             try:
                 await self._connect()
@@ -499,7 +510,8 @@ class WorkerProcess:
                     self._runtime.backend.reconnect()
                 return True
             except (OSError, ConnectionError) as e:
-                await asyncio.sleep(0.5)
+                await asyncio.sleep(delay * (0.5 + _random.random()))
+                delay = min(delay * 2, 5.0)
                 err = e
         print(f"[worker {self.worker_id}] reconnect gave up: {err!r}", flush=True)
         return False
@@ -875,8 +887,31 @@ class WorkerProcess:
             if mtype == "exit":
                 break
             if mtype == "reconnect":
-                if not self.io.call(self._reconnect(), timeout=40):
-                    break
+                # NON-blocking: the head may be down for seconds, and this
+                # thread is also the DIRECT execution loop — an actor must
+                # keep answering direct calls through the whole outage
+                # (blocking here froze every hosted actor for the
+                # reconnect deadline). Failure to reconnect exits via the
+                # queued message, after in-flight work drains. DEDUPED:
+                # every failed attempt's conn close enqueues another
+                # reconnect message, and concurrent loops double-register
+                # (the stale conn's close then used to kill the live
+                # registration on the controller).
+                if getattr(self, "_reconnect_inflight", False):
+                    continue
+                self._reconnect_inflight = True
+
+                def _done(fut):
+                    ok = False
+                    try:
+                        ok = bool(fut.result())
+                    except Exception:  # noqa: BLE001
+                        ok = False
+                    self._reconnect_inflight = False
+                    if not ok:
+                        self.task_queue.put({"type": "exit"})
+
+                self.io.call_nowait(self._reconnect()).add_done_callback(_done)
                 continue
             if mtype == "actor_handoff":
                 # Direct actor-call fence: every classic call dispatched

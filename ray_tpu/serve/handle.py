@@ -259,9 +259,24 @@ class Router:
             stale = force or self._info is None or now - self._last_refresh > _ROUTER_REFRESH_S
         if not stale:
             return
-        info = ray_tpu.get(
-            self._controller().get_deployment_info.remote(self.app_name, self.deployment_name)
-        )
+        try:
+            info = ray_tpu.get(
+                self._controller().get_deployment_info.remote(self.app_name, self.deployment_name)
+            )
+        except Exception:  # noqa: BLE001 — controller/head unreachable
+            # Head-failover survivability: replica handles route DIRECTLY
+            # (actor channels never touch the head on the hot path), so a
+            # router holding ANY snapshot keeps answering on it through
+            # the outage. The refresh clock is advanced so a dying head is
+            # probed once per refresh window, not per request; the next
+            # successful refresh re-resolves the controller and re-enters
+            # the telemetry/report loop. With no snapshot at all there is
+            # nothing to serve from — surface the failure.
+            with self._lock:
+                if self._info is not None:
+                    self._last_refresh = now
+                    return
+            raise
         if info is None:
             raise RuntimeError(
                 f"Deployment {self.deployment_name} in app {self.app_name} not found"

@@ -156,7 +156,13 @@ class GangSupervisor:
                 resp = backend.poll_events(
                     cursor=cursor, kinds=DEATH_EVENT_KINDS
                 )
-            except Exception:  # noqa: BLE001 — controller unreachable
+            except Exception:  # noqa: BLE001 — controller unreachable: the
+                # head may be mid-failover (docs/CONTROL_PLANE_HA.md). The
+                # backend reconnects underneath us with its own backoff;
+                # this cursor survives the restart because poll_events
+                # re-anchors a previous incarnation's cursor to the NEW
+                # timeline's base server-side (deaths landing during the
+                # gap still arrive) — re-arm nothing, just retry.
                 if stop_evt.wait(_POLL_PERIOD_S * 5):
                     return
                 continue

@@ -56,11 +56,17 @@ class _KillerBase:
                 time.sleep(self.interval_s)
                 if self._stop:
                     break
-                target = self._pick()
-                if target is None:
-                    continue
-                if self._kill(target):
-                    self._kills.append(target)
+                try:
+                    target = self._pick()
+                    if target is None:
+                        continue
+                    if self._kill(target):
+                        self._kills.append(target)
+                except Exception:  # noqa: BLE001 — backend gone (session
+                    # teardown raced the kill loop, or the head is mid-
+                    # failover): stop quietly instead of dying with a
+                    # traceback that races test teardown.
+                    return
 
         self._thread = threading.Thread(target=loop, name="chaos-killer", daemon=True)
         self._thread.start()
@@ -152,6 +158,64 @@ class GangKiller(_KillerBase):
             return True
         except OSError:
             return False
+
+
+class HeadKiller:
+    """Driver-side head chaos (controller HA harness): `kill -9` the head
+    controller mid-workload and restart it against the same session dir —
+    restore = checkpoint + WAL replay (docs/CONTROL_PLANE_HA.md). NOT an
+    actor: an actor's own backend dies with the head; this runs in the
+    driver process next to a `cluster_utils.Cluster`.
+
+    Fault-point injection composes with it: export `RAY_TPU_FAULT_POINTS`
+    (see core/event_log.py — crash-before-fsync / crash-after-log /
+    torn-tail, each optionally scoped `@record_kind`) before starting the
+    head, and the controller kills ITSELF at the named WAL site instead;
+    `restart()` recovers either way.
+
+        killer = HeadKiller(cluster)
+        killer.kill()                  # SIGKILL, head gone mid-wave
+        ... assert the fleet keeps serving ...
+        killer.restart()               # checkpoint + replay, same port
+    """
+
+    def __init__(self, cluster, restart_delay_s: float = 0.2):
+        self.cluster = cluster
+        self.restart_delay_s = restart_delay_s
+        self.kills = 0
+        self._thread = None
+
+    def kill(self):
+        self.cluster.kill_head()
+        self.kills += 1
+
+    def restart(self):
+        self.cluster.restart_head()
+
+    def kill_and_restart(self):
+        self.kill()
+        time.sleep(self.restart_delay_s)
+        self.restart()
+
+    def run(self, interval_s: float = 2.0, max_kills: int = 1):
+        """Background kill→restart loop (cluster-wide chaos next to a
+        workload); join() to wait it out."""
+        import threading
+
+        def loop():
+            for _ in range(max_kills):
+                time.sleep(interval_s)
+                self.kill_and_restart()
+
+        self._thread = threading.Thread(
+            target=loop, name="chaos-head-killer", daemon=True
+        )
+        self._thread.start()
+
+    def join(self, timeout: float = 120.0) -> int:
+        if self._thread is not None:
+            self._thread.join(timeout)
+        return self.kills
 
 
 class NodeKiller(_KillerBase):

@@ -53,6 +53,35 @@ def quantile(xs: Sequence[float], q: float) -> Optional[float]:
     return float(s[min(len(s) - 1, int(len(s) * q))])
 
 
+# Controller-HA observability families (the controller feeds these itself —
+# it has no client backend to push through — but the names, boundaries, and
+# help text live HERE so tests, docs, and dashboards share one definition;
+# see Controller._self_observe / docs/CONTROL_PLANE_HA.md):
+#   controller_recoveries_total   checkpoint+replay restores performed
+#   controller_recovery_seconds   restore latency (snapshot load + WAL replay)
+#   controller_log_bytes          live WAL size on disk (gauge; compaction
+#                                 pulls it back down)
+#   controller_log_fsync_seconds  per-batch WAL fsync latency
+CONTROLLER_HA_BOUNDARIES: Dict[str, Tuple[float, ...]] = {
+    "controller_recovery_seconds": (
+        0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+    ),
+    "controller_log_fsync_seconds": (
+        0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+    ),
+}
+CONTROLLER_HA_HELP: Dict[str, str] = {
+    "controller_recoveries_total":
+        "Controller restores performed (checkpoint + WAL replay)",
+    "controller_recovery_seconds":
+        "Seconds one controller restore took (checkpoint load + log replay)",
+    "controller_log_bytes":
+        "Bytes of write-ahead event log currently on disk",
+    "controller_log_fsync_seconds":
+        "Seconds per batched WAL fsync",
+}
+
+
 _ELASTIC: Optional[Dict[str, "_Metric"]] = None
 _ELASTIC_LOCK = threading.Lock()
 

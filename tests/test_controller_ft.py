@@ -2,9 +2,13 @@
 
 Reference analog: `python/ray/tests/test_gcs_fault_tolerance.py` — kill the
 GCS, restart it against persisted state (RedisStoreClient role), detached
-actors stay reachable (VERDICT item 9 done-criterion).
+actors stay reachable (VERDICT item 9 done-criterion). The HA suite below
+extends it to the WAL contract (docs/CONTROL_PLANE_HA.md): kill -9 with NO
+snapshot landed, injected fault points at the WAL's crash sites, client
+reconnect-with-resubmission, and poll_events cursors across real failover.
 """
 
+import os
 import time
 
 import numpy as np
@@ -14,6 +18,24 @@ import ray_tpu
 from ray_tpu.cluster_utils import Cluster
 
 pytestmark = pytest.mark.cluster
+
+
+def _wait_head_back(deadline_s=30.0):
+    """Block until the CURRENT driver backend's failover reconnect landed
+    (requests succeed again) — the old backend object, not a re-init."""
+    from ray_tpu.core import api
+
+    backend = api._global_runtime().backend
+    end = time.monotonic() + deadline_s
+    last = None
+    while time.monotonic() < end:
+        try:
+            backend._request({"type": "state_summary"}, timeout=5)
+            return backend
+        except Exception as e:  # noqa: BLE001 — still reconnecting
+            last = e
+            time.sleep(0.25)
+    raise AssertionError(f"driver never reconnected to restarted head: {last!r}")
 
 
 def test_controller_kill9_restart_detached_actor_reachable():
@@ -134,6 +156,276 @@ def test_sharded_snapshot_restore_mid_wave():
         assert len(lease_union) == len(set(lease_union)), "duplicated lease"
         # Every actor of the pre-kill wave is present after restore.
         assert wave_ids <= seen_actors
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_wal_recovers_actors_with_no_snapshot():
+    """The WAL alone carries the wave: with checkpoints effectively OFF,
+    kill -9 immediately after creation loses NOTHING after the last fsync
+    (the old snapshot-only controller lost everything since the last tick).
+    Zero lost, zero doubled, named actors resolve."""
+    os.environ["RAY_TPU_SNAPSHOT_INTERVAL_S"] = "600"
+    os.environ["RAY_TPU_WAL_SYNC"] = "always"
+    try:
+        cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 4})
+        ray_tpu.init(address=cluster.address)
+
+        @ray_tpu.remote(num_cpus=0)
+        class W:
+            def __init__(self):
+                self.n = 0
+
+            def bump(self):
+                self.n += 1
+                return self.n
+
+        try:
+            named = [
+                W.options(name=f"wal-{i}", lifetime="detached").remote()
+                for i in range(3)
+            ]
+            anon = [W.remote() for _ in range(8)]
+            assert all(
+                v == 1 for v in ray_tpu.get(
+                    [a.bump.remote() for a in named + anon], timeout=120
+                )
+            )
+            wave_ids = {a._actor_id.hex() for a in named + anon}
+            # NO snapshot wait: the kill lands inside the first checkpoint
+            # window — recovery must come from the log.
+            cluster.kill_head()
+            cluster.restart_head()
+            backend = _wait_head_back()
+
+            for i in range(3):
+                h = ray_tpu.get_actor(f"wal-{i}")
+                assert ray_tpu.get(h.bump.remote(), timeout=60) == 2
+            actors = backend._request({"type": "list_actors"})["actors"]
+            got = [a["actor_id"] for a in actors]
+            assert wave_ids <= set(got), "actor lost across WAL-only restart"
+            assert len(got) == len(set(got)), "actor doubled after replay"
+        finally:
+            ray_tpu.shutdown()
+            cluster.shutdown()
+    finally:
+        os.environ.pop("RAY_TPU_SNAPSHOT_INTERVAL_S", None)
+        os.environ.pop("RAY_TPU_WAL_SYNC", None)
+
+
+def test_driver_reconnects_and_resubmits_through_restart():
+    """The SAME driver backend (no re-init) rides through a head restart:
+    capped-backoff reconnect, idempotent re-registration, and the
+    in-flight creation ledger resubmitting under dedup keys."""
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    ray_tpu.init(address=cluster.address)
+
+    @ray_tpu.remote(num_cpus=0)
+    class P:
+        def ping(self):
+            return "pong"
+
+    try:
+        a = P.options(name="pre-restart", lifetime="detached").remote()
+        assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+        time.sleep(1.2)  # one checkpoint
+        cluster.kill_head()
+        cluster.restart_head()
+        backend = _wait_head_back()
+        # Old handle keeps working through the SAME backend object.
+        assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+        # New work lands post-failover without any client-side re-init.
+        b = P.options(name="post-restart", lifetime="detached").remote()
+        assert ray_tpu.get(b.ping.remote(), timeout=60) == "pong"
+        names = [
+            x["name"] for x in backend._request({"type": "list_actors"})["actors"]
+        ]
+        assert names.count("pre-restart") == 1
+        assert names.count("post-restart") == 1
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("point", [
+    "crash-before-fsync", "crash-after-log", "torn-tail",
+])
+def test_fault_point_crash_sites_recover(point):
+    """Injected crashes at the WAL's three hairiest sites
+    (RAY_TPU_FAULT_POINTS, scoped to actor registration): before the record
+    exists (client resubmission must land it), after the record but before
+    the ack (replay + resubmission must dedup), and mid-record (torn tail
+    must truncate). Every site recovers to exactly ONE live actor."""
+    os.environ["RAY_TPU_FAULT_POINTS"] = f"{point}@actor_registered"
+    cluster = None
+    try:
+        cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+        ray_tpu.init(address=cluster.address)
+
+        @ray_tpu.remote(num_cpus=0)
+        class F:
+            def ping(self):
+                return 1
+
+        # Anonymous creation: the controller hard-exits at the fault point
+        # while appending this registration (ping flushes the buffer).
+        a = F.remote()
+        try:
+            ray_tpu.get(a.ping.remote(), timeout=8)
+        except Exception:  # noqa: BLE001 — head died mid-creation, expected
+            pass
+        # The head must be dead at the injected site (os._exit can race the
+        # client-visible connection close by a beat — poll with a deadline).
+        end = time.monotonic() + 15
+        while cluster.head_proc.poll() is None and time.monotonic() < end:
+            time.sleep(0.1)
+        assert cluster.head_proc.poll() is not None, (
+            f"fault point {point} never fired"
+        )
+        # Clear the fault before restart; recovery replays/truncates and the
+        # driver's reconnect loop resubmits the ledgered creation.
+        os.environ.pop("RAY_TPU_FAULT_POINTS", None)
+        cluster.restart_head()
+        backend = _wait_head_back()
+        assert ray_tpu.get(a.ping.remote(), timeout=90) == 1
+        actors = backend._request({"type": "list_actors"})["actors"]
+        mine = [x for x in actors if x["actor_id"] == a._actor_id.hex()]
+        assert len(mine) == 1, f"{point}: actor lost or doubled: {actors}"
+    finally:
+        os.environ.pop("RAY_TPU_FAULT_POINTS", None)
+        ray_tpu.shutdown()
+        if cluster is not None:
+            cluster.shutdown()
+
+
+def test_poll_events_cursor_and_supervisor_survive_failover():
+    """The elastic gang supervisor's death-detection path across a REAL
+    failover: its poll_events cursor (taken before the kill) clamps across
+    the restart, and a post-restart member death still reaches it."""
+    from ray_tpu.train.elastic.supervisor import GangSupervisor
+    from ray_tpu.train.config import FailureConfig, ScalingConfig
+
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    ray_tpu.init(address=cluster.address)
+
+    @ray_tpu.remote(num_cpus=0, max_restarts=0)
+    class Member:
+        def ping(self):
+            return 1
+
+    try:
+        gang = [Member.remote() for _ in range(2)]
+        assert ray_tpu.get([m.ping.remote() for m in gang], timeout=60) == [1, 1]
+        ids = [m._actor_id.hex() for m in gang]
+
+        class _WG:  # worker_group stand-in: the supervisor only needs ids
+            def actor_ids(self):
+                return ids
+
+        sup = GangSupervisor(
+            ScalingConfig(num_workers=2), FailureConfig(max_failures=1)
+        )
+        sup.watch(_WG())
+        try:
+            cluster.kill_head()
+            cluster.restart_head()
+            backend = _wait_head_back()
+            assert sup.failure() is None, "failover misread as member death"
+            # Post-restart death must still reach the pre-restart watcher
+            # (cursor clamped server-side, monitor retried through the
+            # outage). Kill the member's worker — harsher than ray_tpu.kill
+            # and exactly what GangKiller does.
+            victim = None
+            end = time.monotonic() + 30
+            while victim is None and time.monotonic() < end:
+                workers = backend._request({"type": "list_workers"})["workers"]
+                victim = next(
+                    (w for w in workers if w.get("actor") in ids), None
+                )
+                if victim is None:
+                    time.sleep(0.25)  # member workers still re-registering
+            assert victim is not None, "gang workers never re-adopted"
+            # SIGKILL straight to the pid (GangKiller's move): SIGTERM can
+            # sit behind a loaded worker's GIL for tens of seconds under
+            # full-suite load, and this test times the DETECTION path.
+            import signal as _signal
+
+            os.kill(victim["pid"], _signal.SIGKILL)
+            end = time.monotonic() + 60
+            while sup.failure() is None and time.monotonic() < end:
+                time.sleep(0.2)
+            assert sup.failure() is not None, (
+                "supervisor missed a member death after head failover"
+            )
+        finally:
+            sup.stop_watch()
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_serve_fleet_answers_through_head_restart():
+    """A warmed Serve fleet keeps answering DURING the head outage (direct
+    actor channels never touch the head on the hot path), and the router
+    re-resolves the controller + re-enters telemetry after the restart."""
+    from ray_tpu import serve
+    from ray_tpu.util.chaos import HeadKiller
+
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 4})
+    ray_tpu.init(address=cluster.address)
+    try:
+        serve.start()
+
+        @serve.deployment(num_replicas=2)
+        class Echo:
+            def __call__(self, x):
+                return ("ok", x)
+
+        handle = serve.run(Echo.bind(), name="ha_app", route_prefix="/ha")
+        # Warm every replica path onto the DIRECT plane (first calls ride
+        # the classic plane through the head; sustained traffic upgrades
+        # each channel). The outage guarantee below only holds for direct
+        # channels, so drive traffic until both replicas + the serve
+        # controller are upgraded, then let in-flight handoffs settle.
+        from ray_tpu.core import api as _api
+
+        direct = _api._global_runtime().backend.direct
+        for i in range(60):
+            assert handle.remote(i).result(timeout_s=60) == ("ok", i)
+            if i >= 8 and sum(
+                1 for ch in direct._actors.values() if ch.mode == "direct"
+            ) >= 3:
+                break
+        time.sleep(0.5)  # no handoff fence in flight when the head dies
+
+        killer = HeadKiller(cluster)
+        killer.kill()
+        # Outage window: the fleet must keep serving from the router's
+        # stale snapshot over direct channels — zero failures allowed.
+        during = [handle.remote(100 + i).result(timeout_s=30) for i in range(6)]
+        assert during == [("ok", 100 + i) for i in range(6)]
+
+        killer.restart()
+        _wait_head_back()
+        # After failover: still answering, and the telemetry/report loop is
+        # live again (a fresh controller round trip succeeds).
+        for i in range(4):
+            assert handle.remote(200 + i).result(timeout_s=60) == ("ok", 200 + i)
+        end = time.monotonic() + 60
+        status = {}
+        while time.monotonic() < end:
+            try:
+                status = serve.status().get("applications", {})
+                if status:
+                    break
+            except Exception:  # noqa: BLE001 — controller actor re-adopting
+                time.sleep(0.5)
+        assert "ha_app" in status, f"router never re-entered the loop: {status}"
+        serve.shutdown()
     finally:
         ray_tpu.shutdown()
         cluster.shutdown()

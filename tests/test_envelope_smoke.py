@@ -38,3 +38,31 @@ def test_envelope_quick_actor_smoke():
     # per-actor budget the 2,000-actor bar implies (<150s/2000 = 75ms —
     # here we allow ~15x slack for cold templates + co-tenants).
     assert smoke[0]["extra"]["seconds"] < 75
+
+
+def test_envelope_chaos_smoke():
+    """CI-sized canary for the chaos gate (scripts/envelope.py --chaos,
+    recorded at full 2,000-actor scale in ENVELOPE_r9.json): a 64-actor
+    wave survives one head kill -9 with zero lost / zero doubled actors
+    and a sub-second controller-side restore."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["RAY_TPU_LOG_TO_DRIVER"] = "0"
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "envelope.py"),
+         "--chaos-quick"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO,
+    )
+    assert out.returncode == 0, f"envelope --chaos-quick failed:\n{out.stdout}\n{out.stderr}"
+    rows = [
+        json.loads(line)
+        for line in out.stdout.splitlines()
+        if line.startswith("{") and "envelope_probe" in line
+    ]
+    final = [r for r in rows if r["envelope_probe"] == "chaos_head_failover"]
+    assert final, f"no chaos summary row:\n{out.stdout}"
+    extra = final[0]["extra"]
+    assert extra["zero_lost"] and extra["zero_doubled"]
+    assert extra["restore_under_1s"], extra
+    # Client-visible named-actor recovery stays sub-5s even on loaded CI.
+    assert extra["named_resolve_s_p50"] < 5.0, extra
