@@ -628,29 +628,71 @@ def _recv_to_sink(sock: socket.socket, sink, offset: int, length: int,
         os.close(fd)
 
 
+def _request_span(sock: socket.socket, where: dict, offset: int, length: int,
+                  tmo: float) -> None:
+    """Send one (name|path, offset, length) span request and validate the
+    reply header — the shared front half of every span pull."""
+    req = json.dumps({
+        "name": where.get("name"), "path": where.get("path"),
+        "offset": offset, "length": length,
+    }).encode()
+    sock.sendall(_LEN.pack(len(req)) + req)
+    status, n = _HDR.unpack(_recv_exact(sock, _HDR.size, tmo))
+    if status != 0:
+        raise RuntimeError(
+            f"bulk fetch failed: {_recv_exact(sock, n, tmo).decode(errors='replace')}"
+        )
+    if n != length:
+        raise RuntimeError(f"bulk length mismatch: asked {length}, got {n}")
+
+
+def _land_span(sock: socket.socket, writer, land_at: int, length: int,
+               tmo: float) -> None:
+    """Land a validated span reply into `writer` at `land_at` — the shared
+    back half of every span pull (native off-GIL lander when the writer
+    exposes a sink, raw-view recv otherwise)."""
+    sink = getattr(writer, "sink", lambda: None)()
+    if sink is not None:
+        _recv_to_sink(sock, sink, land_at, length, tmo)
+    else:
+        if hasattr(writer, "ensure_populated"):
+            writer.ensure_populated()
+        _recv_exact_into(sock, writer.raw_view(land_at, length), tmo)
+
+
+def pull_span(addr: str, name: str, offset: int, length: int, writer,
+              timeout_s: float, land_at: int = 0):
+    """Pull one (offset, length) span of a stored object into `writer` at
+    `land_at`, riding the native off-GIL lander when it builds (same
+    landing ladder as whole-object pulls: stream -> ring -> Python chunk
+    pipeline -> serial loop). Public entry for span consumers that land
+    into a store object — the serve KV-transfer plane pulls prefix-cache
+    block runs through here; the data plane's whole-object path is the
+    `land_at == offset` special case (`_pull_span`)."""
+    sock = _open_bulk_conn(addr, timeout_s)
+    with contextlib.closing(sock):
+        _request_span(sock, {"name": name}, offset, length, timeout_s)
+        _land_span(sock, writer, land_at, length, timeout_s)
+
+
+def fetch_span_bytes(addr: str, name: str, offset: int, length: int,
+                     timeout_s: float) -> bytearray:
+    """Pull one span into private memory (no store object — partition/
+    block-sized reads where the consumer deserializes immediately)."""
+    buf = bytearray(length)
+    sock = _open_bulk_conn(addr, timeout_s)
+    with contextlib.closing(sock):
+        _request_span(sock, {"name": name}, offset, length, timeout_s)
+        _recv_exact_into(sock, memoryview(buf), timeout_s)
+    return buf
+
+
 def _pull_span(addr: str, where: dict, writer, offset: int, length: int,
                tmo: float):
     sock = _open_bulk_conn(addr, tmo)
     with contextlib.closing(sock):
-        req = json.dumps({
-            "name": where.get("name"), "path": where.get("path"),
-            "offset": offset, "length": length,
-        }).encode()
-        sock.sendall(_LEN.pack(len(req)) + req)
-        status, n = _HDR.unpack(_recv_exact(sock, _HDR.size, tmo))
-        if status != 0:
-            raise RuntimeError(
-                f"bulk fetch failed: {_recv_exact(sock, n, tmo).decode(errors='replace')}"
-            )
-        if n != length:
-            raise RuntimeError(f"bulk length mismatch: asked {length}, got {n}")
-        sink = getattr(writer, "sink", lambda: None)()
-        if sink is not None:
-            _recv_to_sink(sock, sink, offset, length, tmo)
-        else:
-            if hasattr(writer, "ensure_populated"):
-                writer.ensure_populated()
-            _recv_exact_into(sock, writer.raw_view(offset, length), tmo)
+        _request_span(sock, where, offset, length, tmo)
+        _land_span(sock, writer, offset, length, tmo)
 
 
 _local_addrs_cache: Optional[set] = None

@@ -35,8 +35,6 @@ Fallbacks (always correctness-preserving, see data/README.md):
 
 from __future__ import annotations
 
-import contextlib
-import json
 import pickle
 import socket
 from typing import Any, Dict, List, Optional
@@ -225,28 +223,9 @@ def _try_local_read(desc: Dict[str, Any]):
 def _fetch_span(addr: str, name: str, offset: int, length: int,
                 tmo: float) -> bytearray:
     """Pull one (offset, length) span of a stored object from a peer's bulk
-    server into private memory (partition-sized — not a store object)."""
-    buf = bytearray(length)
-    sock = bulk_mod._open_bulk_conn(addr, tmo)
-    with contextlib.closing(sock):
-        req = json.dumps(
-            {"name": name, "offset": offset, "length": length}
-        ).encode()
-        sock.sendall(bulk_mod._LEN.pack(len(req)) + req)
-        status, n = bulk_mod._HDR.unpack(
-            bulk_mod._recv_exact(sock, bulk_mod._HDR.size, tmo)
-        )
-        if status != 0:
-            raise RuntimeError(
-                "bulk span fetch failed: "
-                + bulk_mod._recv_exact(sock, n, tmo).decode(errors="replace")
-            )
-        if n != length:
-            raise RuntimeError(
-                f"bulk span length mismatch: asked {length}, got {n}"
-            )
-        bulk_mod._recv_exact_into(sock, memoryview(buf), tmo)
-    return buf
+    server into private memory (partition-sized — not a store object).
+    Shared wire front with the KV-transfer plane: `bulk.fetch_span_bytes`."""
+    return bulk_mod.fetch_span_bytes(addr, name, offset, length, tmo)
 
 
 def _rebuild_from_span(span: Dict[str, Any], buf: bytearray) -> List[Block]:

@@ -27,6 +27,14 @@ class _DeploymentState:
     def __init__(self, spec: Dict[str, Any]):
         self.spec = spec
         self.target_replicas: int = spec["opts"]["num_replicas"]
+        # Disaggregated pools (opts.prefill_replicas > 0): how many of the
+        # target replicas run role="prefill"; the rest run role="decode".
+        # Autoscaled separately from the decode pool (_maybe_autoscale).
+        self.target_prefill: int = min(
+            int(spec["opts"].get("prefill_replicas") or 0),
+            max(self.target_replicas - 1, 0),
+        )
+        self.replica_roles: Dict[str, str] = {}  # tag -> prefill|decode
         self.replicas: List = []  # READY ActorHandles (routable)
         self.replica_tags: List[str] = []
         # Replicas whose __init__ has not answered a ping yet (model load +
@@ -60,6 +68,28 @@ class _DeploymentState:
         for rid in dead:
             del self.router_reports[rid]
         return sum(v for v, _ in self.router_reports.values())
+
+
+def _drain_pool_pick(state: _DeploymentState) -> Optional[int]:
+    """Index into state.replicas of the next drain victim for a
+    DISAGGREGATED deployment: drain from the pool exceeding its target
+    (newest first within the pool), so a decode-pool scale-down can
+    never eat the prefill pool or vice versa. None = no preference.
+    Module-level like the rest of _drain's logic: draining is a pure
+    function of `state` (tested that way)."""
+    if state.target_prefill <= 0 or not state.replicas:
+        return None
+    n_prefill = sum(
+        1 for t in state.replica_tags
+        if state.replica_roles.get(t) == "prefill"
+    )
+    over = (
+        "prefill" if n_prefill > state.target_prefill else "decode"
+    )
+    for i in range(len(state.replica_tags) - 1, -1, -1):
+        if state.replica_roles.get(state.replica_tags[i]) == over:
+            return i
+    return None
 
 
 class ServeController:
@@ -99,6 +129,33 @@ class ServeController:
                     state.starting = prev.starting
                     state.miss_counts = prev.miss_counts
                     state.next_replica_id = prev.next_replica_id
+                    state.replica_roles = prev.replica_roles
+                    if state.target_prefill != prev.target_prefill:
+                        # Pool split changed. A replica's role is fixed at
+                        # engine start (nothing migrates a live engine), so
+                        # replicas whose role no longer fits the new split
+                        # are stale — drain exactly THOSE (a correctly-roled
+                        # starting replica must survive); reconcile starts
+                        # correctly-roled replacements via _pick_role.
+                        live = list(state.replica_tags) + [
+                            t for _, t, _ in state.starting
+                        ]
+                        roles = state.replica_roles
+                        if state.target_prefill <= 0:
+                            stale = [t for t in live if roles.get(t)]
+                        else:
+                            stale = [t for t in live if not roles.get(t)]
+                            pre = [t for t in live
+                                   if roles.get(t) == "prefill"]
+                            dec = [t for t in live
+                                   if roles.get(t) == "decode"]
+                            # Pool excess: keep the oldest up to target.
+                            stale += pre[state.target_prefill:]
+                            stale += dec[
+                                state.target_replicas - state.target_prefill:
+                            ]
+                        if stale:
+                            self._drain(state, len(stale), tags=set(stale))
                     new_cfg = spec["opts"].get("user_config")
                     if new_cfg is not None and new_cfg != prev.spec["opts"].get("user_config"):
                         reconfigure_refs += [
@@ -164,6 +221,15 @@ class ServeController:
                 "replica_meta": [
                     (state.replica_meta.get(t) or {}).get("engine")
                     for t in state.replica_tags
+                ],
+                # Disaggregated pools: the prefill-pool target (0 =
+                # colocated) and each replica's controller-assigned role —
+                # the router's pool split uses engine-telemetry roles, but
+                # these let it know a deployment IS disaggregated before
+                # first telemetry, and back tests/introspection.
+                "prefill_replicas": state.target_prefill,
+                "replica_roles": [
+                    state.replica_roles.get(t) for t in state.replica_tags
                 ],
                 "status": state.status,
             }
@@ -263,9 +329,6 @@ class ServeController:
             for m in state.replica_meta.values()
             if m and m.get("engine")
         ]
-        ttfts = [
-            e["ttft_p99_s"] for e in engines if e.get("ttft_p99_s") is not None
-        ]
         # Refresh the EMA toward the current router total so pressure decays
         # once routers stop reporting (expired reporters drop out of the
         # sum) — but only while SOME signal source is live: with no live
@@ -275,37 +338,109 @@ class ServeController:
         total = state.ongoing_total(now)
         if state.router_reports or engines:
             state.ongoing_ema = 0.8 * state.ongoing_ema + 0.2 * total
-        signals = FleetSignals(
-            replicas=len(state.replicas),
-            ongoing=state.ongoing_ema,
-            queue_depth=float(
-                sum(e.get("queue_depth") or 0 for e in engines)
-            ),
-            running=float(sum(e.get("running") or 0 for e in engines)),
-            ttft_p99_s=max(ttfts) if ttfts else None,
-            hit_rates=[e.get("prefix_hit_rate") for e in engines],
-        )
-        delta = decide_scale(
-            signals,
-            target_ongoing_requests=cfg["target_ongoing_requests"],
-            target_queue_depth=cfg.get("target_queue_depth", 4.0),
-            ttft_p99_target_s=cfg.get("ttft_p99_target_s"),
-            downscale_hit_rate=cfg.get("downscale_hit_rate", 0.2),
-        )
-        if (
-            delta > 0
-            and state.target_replicas < cfg["max_replicas"]
-            and now - state.last_scale_action_t > cfg["upscale_delay_s"]
-        ):
-            state.target_replicas += 1
-        elif (
-            delta < 0
-            and state.target_replicas > cfg["min_replicas"]
-            and now - state.last_scale_action_t > cfg["downscale_delay_s"]
-        ):
-            state.target_replicas -= 1
+
+        def pool_signals(pool_engines, replicas, ongoing):
+            p_ttfts = [
+                e["ttft_p99_s"] for e in pool_engines
+                if e.get("ttft_p99_s") is not None
+            ]
+            return FleetSignals(
+                replicas=replicas,
+                ongoing=ongoing,
+                queue_depth=float(
+                    sum(e.get("queue_depth") or 0 for e in pool_engines)
+                ),
+                running=float(
+                    sum(e.get("running") or 0 for e in pool_engines)
+                ),
+                ttft_p99_s=max(p_ttfts) if p_ttfts else None,
+                hit_rates=[e.get("prefix_hit_rate") for e in pool_engines],
+            )
+
+        if state.target_prefill > 0:
+            # Disaggregated pools scale on their OWN signals: the TTFT
+            # tail is made in the prefill pool, queue/in-flight pressure
+            # lives in the decode pool (fleet/autoscale.py rationale).
+            from .fleet import decide_scale_disagg
+
+            pre = [e for e in engines if e.get("role") == "prefill"]
+            dec = [e for e in engines if e.get("role") == "decode"]
+            n_pre = sum(
+                1 for t in state.replica_tags
+                if state.replica_roles.get(t) == "prefill"
+            )
+            dp, dd = decide_scale_disagg(
+                pool_signals(pre, n_pre, 0.0),
+                pool_signals(
+                    dec, len(state.replicas) - n_pre, state.ongoing_ema
+                ),
+                target_ongoing_requests=cfg["target_ongoing_requests"],
+                target_queue_depth=cfg.get("target_queue_depth", 4.0),
+                ttft_p99_target_s=cfg.get("ttft_p99_target_s"),
+                downscale_hit_rate=cfg.get("downscale_hit_rate", 0.2),
+            )
+            # Both pools keep >= 1 replica and the TOTAL respects the
+            # deployment's min/max band and scale delays.
+            target_decode = state.target_replicas - state.target_prefill
+            new_prefill = max(state.target_prefill + dp, 1)
+            new_decode = max(target_decode + dd, 1)
+            new_total = min(
+                max(new_prefill + new_decode, cfg["min_replicas"]),
+                cfg["max_replicas"],
+            )
+            overflow = (new_prefill + new_decode) - new_total
+            if overflow > 0:
+                # Band clamp gives GROWTH back first: a pool that did not
+                # ask to grow is never cut below its current target just
+                # because the other pool hit the ceiling.
+                give = min(
+                    overflow, max(new_prefill - state.target_prefill, 0)
+                )
+                new_prefill -= give
+                new_decode = max(new_decode - (overflow - give), 1)
+            elif overflow < 0:
+                # min_replicas floor raise: decode absorbs it (extra decode
+                # lanes are always usable; extra prefill replicas idle).
+                new_decode = new_total - new_prefill
+            if new_total == state.target_replicas:
+                # No total change = nothing to actuate: roles are assigned
+                # at replica START (_pick_role) and nothing migrates a live
+                # replica between pools, so acting on a pure rebalance
+                # (dp=+1/dd=-1) would drift target_prefill away from the
+                # fleet's real composition forever.
+                return
+            delay = (
+                cfg["upscale_delay_s"]
+                if new_total > state.target_replicas
+                else cfg["downscale_delay_s"]
+            )
+            if now - state.last_scale_action_t <= delay:
+                return
+            delta = new_total - state.target_replicas
+            state.target_prefill = new_prefill
+            state.target_replicas = new_total
         else:
-            return
+            delta = decide_scale(
+                pool_signals(engines, len(state.replicas), state.ongoing_ema),
+                target_ongoing_requests=cfg["target_ongoing_requests"],
+                target_queue_depth=cfg.get("target_queue_depth", 4.0),
+                ttft_p99_target_s=cfg.get("ttft_p99_target_s"),
+                downscale_hit_rate=cfg.get("downscale_hit_rate", 0.2),
+            )
+            if (
+                delta > 0
+                and state.target_replicas < cfg["max_replicas"]
+                and now - state.last_scale_action_t > cfg["upscale_delay_s"]
+            ):
+                state.target_replicas += 1
+            elif (
+                delta < 0
+                and state.target_replicas > cfg["min_replicas"]
+                and now - state.last_scale_action_t > cfg["downscale_delay_s"]
+            ):
+                state.target_replicas -= 1
+            else:
+                return
         state.last_scale_action_t = now
         self._version += 1
         try:
@@ -430,6 +565,7 @@ class ServeController:
                     excess = -need
                 for h, t in kill:
                     state.miss_counts.pop(t, None)
+                    state.replica_roles.pop(t, None)
                     try:
                         ray_tpu.kill(h)  # never leak a replaced replica
                     except Exception:  # noqa: BLE001
@@ -467,13 +603,30 @@ class ServeController:
                     except Exception:  # noqa: BLE001
                         pass
 
+    def _pick_role(self, state: _DeploymentState) -> Optional[str]:
+        """Role for the next replica of a disaggregated deployment: fill
+        the prefill pool to its target first, decode takes the rest. None
+        for colocated deployments (no role injected). Called under the
+        state lock."""
+        if state.target_prefill <= 0:
+            return None
+        live = set(state.replica_tags) | {t for _, t, _ in state.starting}
+        n_prefill = sum(
+            1 for t in live if state.replica_roles.get(t) == "prefill"
+        )
+        return "prefill" if n_prefill < state.target_prefill else "decode"
+
     def _start_replica(self, app_name: str, dname: str, state: _DeploymentState):
         import ray_tpu
         from .replica import Replica
 
         spec = state.spec
-        tag = f"{app_name}#{dname}#{state.next_replica_id}"
-        state.next_replica_id += 1
+        with self._lock:
+            tag = f"{app_name}#{dname}#{state.next_replica_id}"
+            state.next_replica_id += 1
+            role = self._pick_role(state)
+            if role is not None:
+                state.replica_roles[tag] = role
         actor_opts = dict(spec["opts"].get("ray_actor_options") or {})
         RemoteReplica = ray_tpu.remote(Replica)
         if actor_opts:
@@ -485,6 +638,7 @@ class ServeController:
             spec["cls"],
             spec["init_args"],
             spec["opts"].get("user_config"),
+            role,
         )
         with self._lock:
             app = self._apps.get(app_name)
@@ -498,22 +652,49 @@ class ServeController:
             # appending to the orphaned state would leak a live replica.
             import ray_tpu
 
+            state.replica_roles.pop(tag, None)
             try:
                 ray_tpu.kill(handle)
             except Exception:  # noqa: BLE001
                 pass
 
-    def _drain(self, state: _DeploymentState, n: int):
+    def _drain(self, state: _DeploymentState, n: int,
+               tags: Optional[set] = None):
+        """Kill up to `n` replicas. `tags` narrows the victims to exactly
+        that set (pool-split redeploys drain the role-STALE replicas, not
+        whatever drains first); None keeps the default order — unready
+        (starting) replicas first, then _drain_pool_pick."""
         import ray_tpu
 
         for _ in range(n):
+            handle = tag = None
             # Unready (starting) replicas go first: they serve nothing yet.
             if state.starting:
-                handle, tag, _t0 = state.starting.pop()
-            elif state.replicas:
-                handle = state.replicas.pop()
-                tag = state.replica_tags.pop()
-            else:
+                if tags is None:
+                    handle, tag, _t0 = state.starting.pop()
+                else:
+                    for j in range(len(state.starting) - 1, -1, -1):
+                        if state.starting[j][1] in tags:
+                            handle, tag, _t0 = state.starting.pop(j)
+                            break
+            if handle is None and state.replicas:
+                if tags is not None:
+                    i = next(
+                        (i for i in range(len(state.replica_tags) - 1, -1, -1)
+                         if state.replica_tags[i] in tags),
+                        None,
+                    )
+                else:
+                    i = _drain_pool_pick(state)
+                if i is None:
+                    if tags is not None:
+                        break  # no tagged victim left
+                    handle = state.replicas.pop()
+                    tag = state.replica_tags.pop()
+                else:
+                    handle = state.replicas.pop(i)
+                    tag = state.replica_tags.pop(i)
+            if handle is None:
                 break
             # Drop the drained replica's miss counter: leaving it would leak
             # an entry per replica generation (redeploy/scale-down/delete)
@@ -521,6 +702,7 @@ class ServeController:
             # goes too — a dead replica's digest must not attract traffic.
             state.miss_counts.pop(tag, None)
             state.replica_meta.pop(tag, None)
+            state.replica_roles.pop(tag, None)
             try:
                 ray_tpu.kill(handle)
             except Exception:  # noqa: BLE001

@@ -50,6 +50,14 @@ class DeploymentOptions:
     # matches the prompt's leading KV blocks (serve/fleet/routing.py).
     # False = plain power-of-two (the bench baseline).
     prefix_affinity_routing: bool = True
+    # Disaggregated prefill/decode serving (serve/README.md): > 0 splits
+    # the replica set into a prefill pool of this size (engines started
+    # with role="prefill") and a decode pool (role="decode", the rest).
+    # The router then orchestrates prefill->handoff->decode per request,
+    # shipping computed KV between pools over the bulk plane, and the
+    # controller autoscales the two pools on their own signals (TTFT tail
+    # -> prefill, queue/in-flight -> decode). 0 = colocated (default).
+    prefill_replicas: int = 0
 
 
 class Deployment:

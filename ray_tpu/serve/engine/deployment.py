@@ -89,6 +89,80 @@ class _LLMReplica:
             body.get("eos_token"),
         )
 
+    # ---------------------------------------------- disaggregated serving
+    # Router-orchestrated handoff (serve/handle.py `_disagg_call`): the
+    # router sends the prompt to a PREFILL-pool replica's prefill_handoff,
+    # which computes the prompt, emits the first token, and publishes the
+    # KV as a bulk-plane span descriptor; a DECODE-pool replica then runs
+    # decode_imported(_stream), which adopts the descriptor's blocks into
+    # its prefix cache and resubmits prompt+[first] — admission hits the
+    # imported blocks, so only the tail past the last full block is
+    # recomputed. Any failure at any point degrades to plain colocated
+    # recompute (greedy output is identical either way — the parity gate).
+
+    def prefill_handoff(
+        self,
+        prompt: List[int],
+        max_new_tokens: int = 16,
+        eos_token: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        """Run the prefill here, return the first token + the exported KV
+        descriptor for a decode-pool replica to import."""
+        rid = self.engine.submit(prompt, 1, eos_token=eos_token)
+        out = self.engine.stream(rid)
+        tokens = list(out)
+        finished = (
+            max_new_tokens <= 1
+            or not tokens
+            or (eos_token is not None and tokens[-1] == eos_token)
+        )
+        desc = None
+        if not finished:
+            desc = self.engine.export_prompt_kv(prompt)
+        return {
+            "tokens": tokens,
+            "finish_reason": "eos"
+            if (eos_token is not None and tokens and tokens[-1] == eos_token)
+            else out.finish_reason,
+            "finished": finished,
+            "descriptor": desc,
+        }
+
+    def decode_imported(
+        self,
+        prompt: List[int],
+        first_token: int,
+        max_new_tokens: int,
+        eos_token: Optional[int] = None,
+        descriptor: Optional[Dict[str, Any]] = None,
+    ) -> Dict[str, Any]:
+        """Import the prefill replica's KV spans (best effort — failure
+        means recompute) and continue the generation after `first_token`."""
+        self.engine.import_blocks(descriptor)
+        rid = self.engine.submit(
+            list(prompt) + [int(first_token)], max_new_tokens,
+            eos_token=eos_token,
+        )
+        out = self.engine.stream(rid)
+        tokens = list(out)
+        return {"tokens": tokens, "finish_reason": out.finish_reason}
+
+    def decode_imported_stream(
+        self,
+        prompt: List[int],
+        first_token: int,
+        max_new_tokens: int,
+        eos_token: Optional[int] = None,
+        descriptor: Optional[Dict[str, Any]] = None,
+    ):
+        """Streaming variant of decode_imported (one token per chunk)."""
+        self.engine.import_blocks(descriptor)
+        rid = self.engine.submit(
+            list(prompt) + [int(first_token)], max_new_tokens,
+            eos_token=eos_token,
+        )
+        yield from self.engine.stream(rid)
+
     def engine_stats(self, include_raw: bool = False) -> Dict[str, Any]:
         return self.engine.stats(include_raw=include_raw)
 

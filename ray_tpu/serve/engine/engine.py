@@ -97,6 +97,20 @@ class EngineOptions:
     spec_ngram: int = 2
     temperature: float = 0.0      # 0 = greedy
     seed: int = 0
+    # Disaggregated serving (serve/README.md "Disaggregated serving"):
+    # "mixed" (default — exactly the pre-disagg engine), "prefill" (step
+    # budget biased toward prefill chunks: this replica computes prompts,
+    # emits the first token, and hands decode off), or "decode" (prefill's
+    # per-step share capped at max_step_tokens/4 so recompute tails can't
+    # crowd the decode lanes).
+    role: str = "mixed"
+    # Host-RAM KV tier budget (bytes, per replica; 0 disables): HBM-evicted
+    # registered blocks are SAVED here instead of dying, stay advertised in
+    # the hot-prefix digest, serve allocate_cached on an HBM miss, and are
+    # exportable to other replicas over the bulk plane.
+    host_kv_bytes: int = 32 << 20
+    # Deadline for one KV export/import (span fetch + handoff plumbing).
+    kv_transfer_timeout_s: float = 30.0
 
 
 class RequestOutput:
@@ -137,6 +151,10 @@ class InferenceEngine:
 
         self.cfg = dataclasses.replace(cfg, remat=False, remat_policy=None)
         self.opts = options or EngineOptions()
+        if self.opts.role not in ("mixed", "prefill", "decode"):
+            raise ValueError(
+                f"role must be mixed|prefill|decode, got {self.opts.role!r}"
+            )
         self._jnp = jax.numpy
         if params is None:
             params = init_params(jax.random.PRNGKey(self.opts.seed), cfg)
@@ -144,10 +162,16 @@ class InferenceEngine:
         self.kv = init_paged_cache(
             self.cfg, self.opts.num_blocks, self.opts.block_size
         )
+        self.host_tier = None
+        if self.opts.host_kv_bytes > 0 and self.opts.enable_prefix_caching:
+            from .kv_tier import HostKVTier
+
+            self.host_tier = HostKVTier(self.opts.host_kv_bytes)
         self.block_manager = KVBlockManager(
             self.opts.num_blocks,
             self.opts.block_size,
             enable_prefix_caching=self.opts.enable_prefix_caching,
+            host_tier=self.host_tier,
         )
         proposer = None
         if self.opts.spec_tokens > 0:
@@ -163,13 +187,26 @@ class InferenceEngine:
             proposer = NGramProposer(
                 k=self.opts.spec_tokens, n=self.opts.spec_ngram
             )
+        # Role biasing: a prefill-pool replica runs several chunks per step
+        # (its decode lanes are single-token handoff stubs); a decode-pool
+        # replica caps prefill's per-step share so recompute tails (import
+        # misses, degraded handoffs) can't crowd the decode lanes.
+        mpps = self.opts.max_prefills_per_step
+        prefill_cap = None
+        if self.opts.role == "prefill":
+            mpps = max(mpps, 4)
+        elif self.opts.role == "decode":
+            prefill_cap = max(
+                self.opts.prefill_chunk_tokens, self.opts.max_step_tokens // 4
+            )
         self.scheduler = Scheduler(
             self.block_manager,
             max_num_seqs=self.opts.max_num_seqs,
-            max_prefills_per_step=self.opts.max_prefills_per_step,
+            max_prefills_per_step=mpps,
             max_step_tokens=self.opts.max_step_tokens,
             prefill_chunk=self.opts.prefill_chunk_tokens,
             draft_proposer=proposer,
+            prefill_budget_cap=prefill_cap,
         )
         # cfg is static (hashable frozen dataclass); kv buffers are donated
         # — each call consumes self.kv and hands back its successor.
@@ -192,6 +229,13 @@ class InferenceEngine:
         self.total_finished = 0
         self.total_spec_proposed = 0
         self.total_spec_accepted = 0
+        self.total_blocks_imported = 0
+        self.total_blocks_exported = 0
+        # Side work serviced by the driver thread at step boundaries, where
+        # self.kv is stable (kernel donation invalidates old buffers, so no
+        # other thread may ever read the KV arrays): ("export", digests,
+        # Future) entries from export_prompt_kv.
+        self._side_work: "deque" = deque()
         self._ttfts: "deque[float]" = deque(maxlen=1024)
         self._tpots: "deque[float]" = deque(maxlen=1024)
         self._step_ttfts: List[float] = []     # reset each step()
@@ -263,9 +307,27 @@ class InferenceEngine:
                 "speculative draft tokens accepted (emitted without a "
                 "dedicated decode step)",
             )
+            self._m_host_hits = Counter(
+                "serve_engine_host_tier_hits_total",
+                "prefix-cache hits served from the host-RAM KV tier",
+            )
+            self._m_host_bytes = Gauge(
+                "serve_engine_host_tier_bytes",
+                "bytes resident in the host-RAM KV tier",
+            )
+            self._m_kv_import = Counter(
+                "serve_engine_kv_blocks_imported_total",
+                "KV blocks imported from other replicas (disagg handoff / "
+                "cluster-wide prefix cache)",
+            )
+            self._m_kv_export = Counter(
+                "serve_engine_kv_blocks_exported_total",
+                "KV blocks exported as bulk-plane span segments",
+            )
             # Counters export monotonic increments; the KV manager keeps
             # lifetime totals — ship deltas since the last step.
-            self._kv_exported = {"hits": 0, "misses": 0, "evictions": 0}
+            self._kv_exported = {"hits": 0, "misses": 0, "evictions": 0,
+                                 "host_hits": 0, "imported": 0, "exported": 0}
             try:
                 # Under Serve, tag every series with its replica so scrapes
                 # distinguish replicas and the controller can prune a
@@ -274,13 +336,16 @@ class InferenceEngine:
 
                 ctx = get_replica_context()
                 tags = {"app": ctx.app_name, "deployment": ctx.deployment,
-                        "replica": ctx.replica_tag}
+                        "replica": ctx.replica_tag,
+                        "role": self.opts.role}
                 for m in (self._m_queue, self._m_running, self._m_kv,
                           self._m_tps, self._m_tokens, self._m_preempt,
                           self._m_ttft, self._m_tpot, self._m_pc_hits,
                           self._m_pc_misses, self._m_pc_evict,
                           self._m_step_tokens, self._m_spec_prop,
-                          self._m_spec_acc):
+                          self._m_spec_acc, self._m_host_hits,
+                          self._m_host_bytes, self._m_kv_import,
+                          self._m_kv_export):
                     m.set_default_tags(tags)
             except Exception:  # noqa: BLE001 — engine used outside Serve
                 pass
@@ -303,15 +368,19 @@ class InferenceEngine:
                 self._m_ttft.observe(t)
             for t in stats["step_tpots"]:
                 self._m_tpot.observe(t)
-            for key, counter in (
-                ("hits", self._m_pc_hits),
-                ("misses", self._m_pc_misses),
-                ("evictions", self._m_pc_evict),
+            for key, stat_key, counter in (
+                ("hits", "prefix_cache_hits", self._m_pc_hits),
+                ("misses", "prefix_cache_misses", self._m_pc_misses),
+                ("evictions", "prefix_cache_evictions", self._m_pc_evict),
+                ("host_hits", "host_tier_hits", self._m_host_hits),
+                ("imported", "blocks_imported", self._m_kv_import),
+                ("exported", "blocks_exported", self._m_kv_export),
             ):
-                delta = stats[f"prefix_cache_{key}"] - self._kv_exported[key]
+                delta = stats[stat_key] - self._kv_exported[key]
                 if delta > 0:
                     counter.inc(delta)
                     self._kv_exported[key] += delta
+            self._m_host_bytes.set(stats["host_tier_bytes"])
             if stats["step_budget_tokens"]:
                 self._m_step_tokens.observe(stats["step_budget_tokens"])
             if stats["step_spec_proposed"]:
@@ -489,6 +558,223 @@ class InferenceEngine:
             for name, arr in self.kv.items()
         }
 
+    # -------------------------------------------- tiered KV / KV transfer
+    #
+    # Step-top drain order is a correctness contract (kv_manager header):
+    # SAVES read evicted blocks' HBM bytes before anything overwrites them,
+    # then COW copies, then LOADS land tier/import bytes, then kernels run.
+    # Everything below executes on the driver thread only.
+
+    def _block_blobs(self, blocks: List[int]):
+        """The given blocks' KV bytes as contiguous host arrays [2(k/v),
+        L, H, BS, Dh] each — the unit of the host tier and the transfer
+        plane. Batched: ONE device read per KV array (then per-block host
+        copies), not two blocking transfers per block — saves/exports sit
+        at the top of the hot step path."""
+        np = self._np
+        jdx = self._jnp.asarray(blocks)
+        ks = np.asarray(self.kv["k"][:, jdx])   # [L, n, H, BS, Dh]
+        vs = np.asarray(self.kv["v"][:, jdx])
+        return [
+            np.ascontiguousarray(np.stack([ks[:, i], vs[:, i]]))
+            for i in range(len(blocks))
+        ]
+
+    def _apply_host_saves(self):
+        """Copy evicted registered blocks' bytes into the host tier (FIRST
+        drain: the blocks are already reallocated, and COW/loads/kernels
+        may overwrite them later this step)."""
+        with self._lock:
+            saves = self.block_manager.drain_saves()
+        if not saves or self.host_tier is None:
+            return
+        blobs = self._block_blobs([b for _, b in saves])
+        with self._lock:
+            for (h, _), blob in zip(saves, blobs):
+                self.host_tier.put(h, blob)
+
+    def _apply_host_loads(self):
+        """Land tier-hit and imported block bytes on the HBM arrays before
+        any kernel reads them (after saves + COW)."""
+        with self._lock:
+            loads = self.block_manager.drain_loads()
+        if not loads:
+            return
+        jnp = self._jnp
+        np = self._np
+        idx = jnp.asarray([b for _, b, _, _ in loads])
+        ks = np.stack([np.asarray(blob[0]) for _, _, blob, _ in loads])
+        vs = np.stack([np.asarray(blob[1]) for _, _, blob, _ in loads])
+        dt = self.kv["k"].dtype
+        self.kv = {
+            "k": self.kv["k"].at[:, idx].set(
+                jnp.asarray(ks.swapaxes(0, 1), dt)
+            ),
+            "v": self.kv["v"].at[:, idx].set(
+                jnp.asarray(vs.swapaxes(0, 1), dt)
+            ),
+        }
+        # Local host-tier re-admissions are NOT imports (host_hits counts
+        # them) — the import counter tracks only remotely-computed blocks.
+        self.total_blocks_imported += sum(
+            1 for _, _, _, remote in loads if remote
+        )
+
+    def _kv_sig(self) -> str:
+        """Layout signature guarding imports: block bytes only interchange
+        between engines with identical model geometry, block size, and
+        dtype."""
+        c = self.cfg
+        return (
+            f"{c.n_layers}:{c.n_heads}:{c.d_head}:{self.opts.block_size}:"
+            f"{self._jnp.dtype(c.dtype).str}"
+        )
+
+    def prompt_digests(self, prompt: List[int]) -> List[bytes]:
+        """Chain digests of EVERY full block of `prompt` (the kv_manager's
+        content address). Unlike admission's cacheable cap this includes a
+        block ending exactly at the prompt tail — after a completed prefill
+        `register_computed` has registered all of them."""
+        from .kv_manager import _chain_hash
+
+        bs = self.opts.block_size
+        out: List[bytes] = []
+        prev = b""
+        for i in range(len(prompt) // bs):
+            prev = _chain_hash(prev, prompt[i * bs:(i + 1) * bs])
+            out.append(prev)
+        return out
+
+    def export_prompt_kv(
+        self, prompt: List[int], timeout_s: Optional[float] = None
+    ) -> Optional[Dict[str, Any]]:
+        """Publish `prompt`'s computed full-block KV as a span descriptor
+        (kv_transfer.export_descriptor) any replica can import. Runs on the
+        driver thread at a step boundary (the only safe point to read the
+        donated KV arrays); this caller blocks until serviced. Returns None
+        when there is nothing exportable (short prompt, blocks already
+        evicted everywhere, engine stopped)."""
+        digests = self.prompt_digests(prompt)
+        if not digests or self._stop.is_set():
+            return None
+        from concurrent.futures import Future, TimeoutError as _FutTimeout
+
+        fut: "Future" = Future()
+        with self._work:
+            self._side_work.append(("export", digests, fut))
+            self._work.notify_all()
+        try:
+            return fut.result(
+                timeout_s if timeout_s is not None
+                else self.opts.kv_transfer_timeout_s
+            )
+        except _FutTimeout:
+            return None
+        except Exception:  # noqa: BLE001 — export is best-effort: an arena
+            # put or controller RPC failing mid-export must degrade the
+            # handoff to colocated recompute, not fail the caller's request.
+            return None
+
+    def _do_export(self, digests: List[bytes]) -> Optional[Dict[str, Any]]:
+        """Driver-thread half of export_prompt_kv: gather block bytes (HBM
+        blocks at a step boundary; host-tier/pending blobs as-is) and store
+        them as one span-addressed arena segment. Digests no longer held
+        anywhere are dropped from the descriptor — the importer recomputes
+        exactly those blocks."""
+        from . import kv_transfer
+
+        with self._lock:
+            srcs = self.block_manager.export_sources(digests)
+        present: List[bytes] = []
+        kept: List[Tuple] = []
+        for h, src in zip(digests, srcs):
+            if src is None:
+                # A chain hole makes every later block unreachable to the
+                # importer's walk — stop at the first gap.
+                break
+            present.append(h)
+            kept.append(src)
+        if not present:
+            return None
+        hbm_at = [i for i, s in enumerate(kept) if s[0] == "hbm"]
+        hbm_blobs = (
+            self._block_blobs([kept[i][1] for i in hbm_at]) if hbm_at else []
+        )
+        blobs: List = [None] * len(kept)
+        for i, blob in zip(hbm_at, hbm_blobs):
+            blobs[i] = blob
+        for i, s in enumerate(kept):
+            if s[0] != "hbm":
+                blobs[i] = self._np.asarray(s[1])
+        desc = kv_transfer.export_descriptor(
+            present, blobs, self._kv_sig(), self.opts.block_size
+        )
+        if desc is not None:
+            self.total_blocks_exported += len(present)
+        return desc
+
+    def import_blocks(self, desc: Optional[Dict[str, Any]]) -> int:
+        """Adopt a remote replica's exported KV blocks into the local cache
+        (called from any thread — the replica RPC thread during a handoff).
+        Fetches bytes over the fallback ladder (same-node arena read ->
+        bulk span pull -> whole-object get), ALL OR NOTHING, then registers
+        each block as a cached entry whose bytes the driver thread lands
+        before its next kernel. Returns the number adopted; 0 means the
+        importer simply recomputes (degraded mode is the pre-disagg path)."""
+        if not desc or not self.opts.enable_prefix_caching \
+                or self._stop.is_set():
+            return 0
+        if desc.get("sig") != self._kv_sig():
+            return 0
+        from . import kv_transfer
+
+        with self._lock:
+            # A digest already registered in HBM OR resident in the local
+            # host tier needs no network fetch — allocate_cached serves the
+            # tier copy as a host->HBM memcpy at admission.
+            needed = [
+                h for h in desc.get("digests") or []
+                if self.block_manager.holds(bytes.fromhex(h)) is None
+                and not (
+                    self.host_tier is not None
+                    and self.host_tier.contains(bytes.fromhex(h))
+                )
+            ]
+        if not needed:
+            return 0
+        blobs = kv_transfer.fetch_blocks(
+            desc, needed, timeout_s=self.opts.kv_transfer_timeout_s
+        )
+        if not blobs:
+            return 0
+        n = 0
+        with self._lock:
+            for hx, blob in blobs:
+                h = bytes.fromhex(hx)
+                if self.block_manager.holds(h) is not None:
+                    # Raced in since `needed` was computed (a concurrent
+                    # import of a shared prefix) — skip, keep adopting the
+                    # rest: later digests may still be unique to us.
+                    continue
+                if self.block_manager.adopt_block(h, blob) is None:
+                    break  # pool has nothing to give — the rest recompute
+                n += 1
+        return n
+
+    def _service_side_work(self):
+        """Run queued export requests at the step boundary (after loads:
+        freshly imported bytes are already exportable onward)."""
+        while True:
+            with self._lock:
+                if not self._side_work:
+                    return
+                kind, payload, fut = self._side_work.popleft()
+            try:
+                result = self._do_export(payload) if kind == "export" else None
+                fut.set_result(result)
+            except Exception as e:  # noqa: BLE001 — fail the waiter, not the loop
+                fut.set_exception(e)
+
     def _run_prefill(self, chunk):
         """One prefill chunk: compute prompt[start : start+n] into the paged
         cache. Only the FINAL chunk samples the first token (TTFT)."""
@@ -645,7 +931,13 @@ class InferenceEngine:
             if rec is not None:
                 rec.pop("admit_t", None)
                 rec.pop("first_t", None)
+        # Drain order is load-bearing (kv_manager header): eviction SAVES
+        # read their blocks' bytes before COW copies or tier/import LOADS
+        # can overwrite them, and everything lands before kernels run.
+        self._apply_host_saves()
         self._apply_cow()
+        self._apply_host_loads()
+        self._service_side_work()
         for chunk in out.prefills:
             self._run_prefill(chunk)
         if out.decodes:
@@ -663,6 +955,10 @@ class InferenceEngine:
             "prefix_cache_hits": kv_stats.hits,
             "prefix_cache_misses": kv_stats.misses,
             "prefix_cache_evictions": kv_stats.evictions,
+            "host_tier_hits": kv_stats.host_hits,
+            "host_tier_bytes": kv_stats.host_bytes,
+            "blocks_imported": self.total_blocks_imported,
+            "blocks_exported": self.total_blocks_exported,
             "step_budget_tokens": out.step_tokens,
             "tokens_per_s": (
                 len(self._tok_window) / max(now - self._tok_window[0], 1e-3)
@@ -706,6 +1002,12 @@ class InferenceEngine:
             "prefix_cache_hits": kv_stats.hits,
             "prefix_cache_misses": kv_stats.misses,
             "prefix_cache_evictions": kv_stats.evictions,
+            "role": self.opts.role,
+            "host_tier_hits": kv_stats.host_hits,
+            "host_tier_blocks": kv_stats.host_blocks,
+            "host_tier_bytes": kv_stats.host_bytes,
+            "blocks_imported": self.total_blocks_imported,
+            "blocks_exported": self.total_blocks_exported,
             "total_tokens": self.total_tokens,
             "total_finished": self.total_finished,
             "total_preemptions": self.total_preemptions,
@@ -749,6 +1051,13 @@ class InferenceEngine:
             "block_size": self.opts.block_size,
             "kv_utilization": kv_stats.utilization,
             "digest": digest,
+            # Disaggregated pools: the fleet router splits replicas into
+            # prefill/decode pools on this, and the controller autoscales
+            # the two pools on their own signals.
+            "role": self.opts.role,
+            "host_tier_hits": kv_stats.host_hits,
+            "host_tier_blocks": kv_stats.host_blocks,
+            "host_tier_bytes": kv_stats.host_bytes,
             "ttft_p99_s": _quantile(ttfts, 0.99),
             "prefix_hit_rate": (
                 round(dh / (dh + dm), 4) if (dh + dm) > 0 else None
@@ -783,13 +1092,25 @@ class InferenceEngine:
             outs = list(self._outputs.values())
             self._outputs.clear()
             self._trace_info.clear()
+            side, self._side_work = list(self._side_work), deque()
         for out in outs:
             out._q.put(RuntimeError("engine shut down"))
+        for _, _, fut in side:
+            # Exporters blocked in export_prompt_kv must not wait out their
+            # full transfer deadline on a dead driver thread.
+            try:
+                fut.set_result(None)
+            except Exception:  # noqa: BLE001
+                pass
 
     def _loop(self):
         while not self._stop.is_set():
             with self._work:
-                while not self.scheduler.has_work() and not self._stop.is_set():
+                while (
+                    not self.scheduler.has_work()
+                    and not self._side_work
+                    and not self._stop.is_set()
+                ):
                     self._work.wait(timeout=0.1)
             if self._stop.is_set():
                 return

@@ -21,6 +21,22 @@ falls back to evicting the coldest cached block. Admission math
 (`can_allocate` / `free_blocks`) therefore counts blank + cached blocks;
 `KVStats.utilization` counts only live (referenced) blocks.
 
+Tiered cache (the cluster-wide half, PR 12): with a `host_tier`
+(`kv_tier.HostKVTier`) attached, an HBM eviction SAVES the block's bytes to
+host RAM instead of killing the content — the manager queues (hash, block)
+save orders the engine drains (`drain_saves`) before the block is
+overwritten, and `allocate_cached` consults the tier on an index miss:
+a tier hit acquires a fresh block, re-registers the hash, and queues a
+(hash, block, bytes, remote) LOAD (`drain_loads`) the engine applies to
+the HBM arrays before its next kernel launch. `adopt_block` is the same mechanism
+driven by a REMOTE import (`engine.import_blocks`): blocks computed by a
+prefill-pool replica land here as cached entries. The manager stays a pure
+map — every byte move is drained by the engine at a step boundary, ordered
+saves -> COW -> loads -> kernels so evicted bytes are read before anything
+overwrites them. Hot-hash digest entries survive HBM eviction while the
+bytes remain host-resident (the fleet router keeps steering matching
+prompts here, where the import is a host-RAM copy, not a recompute).
+
 Invariants (enforced by `check_invariants`):
   * every block is blank (free list) XOR cached (ref 0, content retained)
     XOR live (ref >= 1) — never two at once, none lost;
@@ -89,6 +105,9 @@ class KVStats:
     misses: int = 0          # cacheable full blocks that had to be computed
     evictions: int = 0       # cached blocks reclaimed for new allocations
     cow_copies: int = 0      # copy-on-write forks of shared blocks
+    host_hits: int = 0       # hits served from the host-RAM tier (subset)
+    host_blocks: int = 0     # blocks resident in the host tier
+    host_bytes: int = 0      # bytes resident in the host tier
 
 
 class KVBlockManager:
@@ -102,6 +121,7 @@ class KVBlockManager:
         num_blocks: int,
         block_size: int,
         enable_prefix_caching: bool = True,
+        host_tier=None,
     ):
         if num_blocks < 2:
             raise ValueError("need >= 2 blocks (block 0 is the null block)")
@@ -110,6 +130,11 @@ class KVBlockManager:
         self.block_size = block_size
         self.num_blocks = num_blocks
         self.caching = enable_prefix_caching
+        # Host-RAM tier below HBM (kv_tier.HostKVTier, None = off). Accessed
+        # only under the engine lock, like every other mutation here.
+        self._tier = host_tier if enable_prefix_caching else None
+        if self._tier is not None:
+            self._tier.on_evict = self._on_tier_evict
         # Block 0 reserved; LIFO free list so recently-freed (cache-warm)
         # blocks are reused first.
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
@@ -129,10 +154,30 @@ class KVBlockManager:
         # (src, dst) physical copies the ENGINE must apply before the next
         # kernel launch — the manager owns only the map.
         self._pending_copies: List[Tuple[int, int]] = []
+        # block -> (hash, bytes, remote): tier/import content the engine
+        # must land in the HBM arrays before its next kernel launch
+        # (drain_loads); `remote` marks content adopted from ANOTHER
+        # replica's export (adopt_block) vs a local host-tier re-admission
+        # — the engine's import counter tracks only the former. An eviction
+        # of a pending-load block just drops the entry — the bytes never
+        # reached HBM, so there is nothing to save and the index entry dies
+        # with it.
+        self._pending_loads: Dict[int, Tuple[bytes, object, bool]] = {}
+        # (hash, block): evicted registered blocks whose bytes the engine
+        # must copy OUT to the host tier before anything overwrites them
+        # (drain_saves runs FIRST in the engine's step-top drain order).
+        self._pending_saves: List[Tuple[bytes, int]] = []
+        # Landed watermark per sequence: tokens whose KV is KNOWN computed
+        # (prefix-cache hits at admission + every register_computed
+        # notification). Lags the true cursor by at most the notification
+        # granularity; `fork` trims the child to it so a speculatively
+        # over-allocated parent can never leak an un-COWed shared tail.
+        self._landed: Dict[str, int] = {}
         self.hits = 0
         self.misses = 0
         self.evictions = 0
         self.cow_copies = 0
+        self.host_hits = 0
 
     # ------------------------------------------------------------- queries
     @property
@@ -206,12 +251,20 @@ class KVBlockManager:
             misses=self.misses,
             evictions=self.evictions,
             cow_copies=self.cow_copies,
+            host_hits=self.host_hits,
+            host_blocks=self._tier.blocks if self._tier is not None else 0,
+            host_bytes=self._tier.bytes_used if self._tier is not None else 0,
         )
 
     # ------------------------------------------------------- block plumbing
     def _acquire(self) -> int:
         """One blank block: the free list first, then LRU-evict the coldest
-        cached block (its index entry dies with it)."""
+        cached block. Without a host tier the evictee's index entry dies
+        with it; with one, its bytes are queued to SAVE into host RAM (the
+        engine drains before anything overwrites the block) and its hot-hash
+        digest entry survives — the fleet router keeps steering matching
+        prompts here, where `allocate_cached`'s tier consult makes the
+        re-admission a host-RAM copy instead of a recompute."""
         if self._free:
             return self._free.pop()
         protected = {s for s, _ in self._pending_copies}
@@ -220,10 +273,29 @@ class KVBlockManager:
                 del self._cached[b]
                 h = self._hash_of.pop(b)
                 del self._index[h]
-                self._hot.pop(h, None)
                 self.evictions += 1
+                pending = self._pending_loads.pop(b, None)
+                if self._tier is not None and pending is None:
+                    # Bytes are in HBM and about to be reused: save them to
+                    # the host tier (skip when the tier already holds them).
+                    if not self._tier.contains(h):
+                        self._pending_saves.append((h, b))
+                    # Host-resident content stays advertised (hot entry
+                    # kept); the tier's own eviction drops it for real.
+                elif pending is not None and self._tier is not None \
+                        and self._tier.contains(h):
+                    pass  # bytes still live in the tier — stay advertised
+                else:
+                    self._hot.pop(h, None)
                 return b
         raise KVCacheExhausted("KV pool exhausted (no blank or evictable blocks)")
+
+    def _on_tier_evict(self, h: bytes) -> None:
+        """Host-tier budget eviction: the content is now gone everywhere
+        below the fleet — stop advertising it (unless it is independently
+        registered in HBM)."""
+        if h not in self._index:
+            self._hot.pop(h, None)
 
     def _incref(self, b: int) -> None:
         if b in self._ref:
@@ -279,7 +351,10 @@ class KVBlockManager:
         if token_ids is not None and len(token_ids) > num_tokens:
             raise ValueError("token_ids longer than the allocation")
         need_total = self.blocks_for(num_tokens)
-        hit_blocks: List[int] = []
+        # Chain walk: per leading full block, an HBM index hit ("idx", b),
+        # a host-tier hit ("tier", h, bytes) — acquired below and loaded by
+        # the engine before its next kernel — or a miss (walk ends).
+        walk: List[Tuple] = []
         chain: List[bytes] = []
         if self.caching and token_ids is not None and len(token_ids) > 1:
             # Cap: never serve the WHOLE prompt from cache — the last
@@ -292,60 +367,99 @@ class KVBlockManager:
                     token_ids[i * self.block_size:(i + 1) * self.block_size],
                 )
                 b = self._index.get(h)
-                if b is None:
+                if b is not None:
+                    walk.append(("idx", b))
+                elif self._tier is not None:
+                    blob = self._tier.get(h)  # touches the tier's LRU
+                    if blob is None:
+                        break
+                    walk.append(("tier", h, blob))
+                else:
                     break
-                hit_blocks.append(b)
                 chain.append(h)
                 self._touch_hot(h)
                 prev = h
-            self.hits += len(hit_blocks)
-            self.misses += cacheable - len(hit_blocks)
+            self.hits += len(walk)
+            self.host_hits += sum(1 for w in walk if w[0] == "tier")
+            self.misses += cacheable - len(walk)
+        idx_hits = [w[1] for w in walk if w[0] == "idx"]
         # Hits currently resting on the cached list are about to be revived —
         # they can't double as eviction fodder for our own fresh blocks
         # (COW-protected ones were never counted evictable to begin with).
+        # Tier hits and cold blocks both need a real acquisition.
         protected = {s for s, _ in self._pending_copies}
         reviving = sum(
-            1 for b in hit_blocks
+            1 for b in idx_hits
             if b not in self._ref and b not in protected
         )
-        need_new = need_total - len(hit_blocks)
+        need_new = need_total - len(idx_hits)
         if need_new > len(self._free) + self._evictable() - reviving:
             raise KVCacheExhausted(
                 f"{need_new} blocks needed, "
                 f"{len(self._free) + self._evictable() - reviving} available"
             )
-        for b in hit_blocks:   # revive/share before _acquire can evict them
-            self._incref(b)
-        fresh = []
-        for _ in range(need_new):
+        # Revive/share EVERY index hit first: a tier-hit acquisition below
+        # may evict from the cached list, and a hit resting there must not
+        # be its victim.
+        for w in walk:
+            if w[0] == "idx":
+                self._incref(w[1])
+        table: List[int] = []
+        for w in walk:
+            if w[0] == "idx":
+                table.append(w[1])
+            else:
+                _, h, blob = w
+                nb = self._acquire()
+                self._ref[nb] = 1
+                self._index[h] = nb
+                self._hash_of[nb] = h
+                self._pending_loads[nb] = (h, blob, False)
+                table.append(nb)
+        for _ in range(need_total - len(walk)):
             nb = self._acquire()
             self._ref[nb] = 1
-            fresh.append(nb)
-        self._tables[seq_id] = hit_blocks + fresh
+            table.append(nb)
+        self._tables[seq_id] = table
         self._lens[seq_id] = num_tokens
         self._chain[seq_id] = chain
-        return list(self._tables[seq_id]), len(hit_blocks) * self.block_size
+        self._landed[seq_id] = len(walk) * self.block_size
+        return list(table), len(walk) * self.block_size
 
     def fork(self, parent_id: str, child_id: str) -> List[int]:
-        """Share `parent_id`'s entire table with a new sequence (beam /
-        n-best style). Every block increfs; whichever sequence later extends
-        into the shared last partial block triggers copy-on-write there.
+        """Share `parent_id`'s table up to its LANDED watermark with a new
+        sequence (beam / n-best style). Shared blocks incref; whichever
+        sequence later extends into a shared partial block triggers
+        copy-on-write there.
 
-        Caveat: fork of a sequence carrying a SPECULATIVE over-allocation
-        (its `_lens` grown past the landed watermark for rejected drafts)
-        is not supported — grow()'s COW check keys off `_lens`, so a write
-        below the over-allocated tail would miss its copy. The engine never
-        forks; a future beam-search integration must fork only sequences
-        whose allocation matches their landed length."""
+        The child is TRIMMED to the parent's landed watermark (tokens whose
+        KV is known computed: admission cache hits + every
+        `register_computed` notification): a parent carrying a SPECULATIVE
+        over-allocation (`_lens` grown past the landed watermark to fund
+        drafts the verify step may reject) must not hand the child slots
+        whose content is undefined — grow()'s COW check keys off `_lens`,
+        so an un-trimmed child writing below the over-allocated tail would
+        miss its copy (the PR 7 caveat, now handled instead of documented).
+        The watermark lags true compute by at most the notification
+        granularity; the trimmed tail is re-derivable (the child recomputes
+        or re-hits it). A parent allocated via plain `allocate()` (token
+        ids unknown) that was never advanced by `grow(..., num_computed=)`
+        or `register_computed` has watermark 0 and shares NOTHING — the
+        manager cannot tell its content from speculative garbage."""
         if child_id in self._tables:
             raise ValueError(f"sequence {child_id!r} already has an allocation")
         table = self._tables[parent_id]  # KeyError = unknown parent
-        for b in table:
+        landed = self._landed.get(parent_id, 0)
+        keep = min(self.blocks_for(landed), len(table))
+        shared = table[:keep]
+        for b in shared:
             self._incref(b)
-        self._tables[child_id] = list(table)
-        self._lens[child_id] = self._lens[parent_id]
-        self._chain[child_id] = list(self._chain.get(parent_id, ()))
-        return list(table)
+        self._tables[child_id] = list(shared)
+        self._lens[child_id] = min(landed, self._lens[parent_id])
+        chain = self._chain.get(parent_id, ())
+        self._chain[child_id] = list(chain[:keep])
+        self._landed[child_id] = self._lens[child_id]
+        return list(shared)
 
     def grow(
         self,
@@ -413,6 +527,9 @@ class KVBlockManager:
         If a block's key already has a canonical twin (same content computed
         by an earlier sequence), this table adopts the twin and releases its
         own copy — identical prefixes converge to identical tables."""
+        landed = min(num_computed, len(token_ids))
+        if landed > self._landed.get(seq_id, 0):
+            self._landed[seq_id] = landed
         if not self.caching:
             return
         chain = self._chain.setdefault(seq_id, [])
@@ -436,6 +553,73 @@ class KVBlockManager:
             self._touch_hot(h)
             chain.append(h)
 
+    # ----------------------------------------------------- tier / transfer
+    def holds(self, h: bytes) -> Optional[int]:
+        """Physical block registered under content hash `h`, or None."""
+        return self._index.get(h)
+
+    def adopt_block(self, h: bytes, blob) -> Optional[int]:
+        """Adopt externally-computed KV content (a remote replica's export,
+        fetched by `engine.import_blocks`): acquire a block, register it
+        under `h`, park it on the cached LRU (MRU end), and queue the bytes
+        as a pending LOAD the engine lands before its next kernel. Returns
+        the block, or None when the pool has nothing to give (the import
+        degrades to recompute — never an error)."""
+        if not self.caching or h in self._index:
+            return None
+        try:
+            b = self._acquire()
+        except KVCacheExhausted:
+            return None
+        self._index[h] = b
+        self._hash_of[b] = h
+        self._cached[b] = None  # ref 0, content retained, MRU end
+        self._pending_loads[b] = (h, blob, True)
+        self._touch_hot(h)
+        return b
+
+    def export_sources(self, digests: Sequence[bytes]) -> List[Optional[Tuple]]:
+        """Where each digest's bytes live right now, aligned with `digests`:
+        ("hbm", block) for registered blocks whose content is landed,
+        ("blob", bytes) for content still in flight (pending load) or only
+        host-tier-resident, None when nowhere. The engine reads HBM sources
+        at a step boundary, where the arrays are stable."""
+        out: List[Optional[Tuple]] = []
+        for h in digests:
+            b = self._index.get(h)
+            if b is not None:
+                pending = self._pending_loads.get(b)
+                if pending is not None and pending[0] == h:
+                    out.append(("blob", pending[1]))
+                else:
+                    out.append(("hbm", b))
+            elif self._tier is not None:
+                blob = self._tier.peek(h)
+                out.append(None if blob is None else ("blob", blob))
+            else:
+                out.append(None)
+        return out
+
+    def drain_loads(self) -> List[Tuple[bytes, int, object, bool]]:
+        """(hash, block, bytes, remote) loads the engine must land in the
+        HBM arrays before its next kernel launch — host-tier hits at
+        admission (remote=False) + adopted imports (remote=True). Entries
+        for since-evicted blocks were already dropped at eviction."""
+        out = [
+            (h, b, blob, remote)
+            for b, (h, blob, remote) in self._pending_loads.items()
+        ]
+        self._pending_loads.clear()
+        return out
+
+    def drain_saves(self) -> List[Tuple[bytes, int]]:
+        """(hash, block) eviction saves: the engine must copy these blocks'
+        HBM bytes into the host tier BEFORE applying COW copies, loads, or
+        kernels (the block is already reallocated — this drain order is
+        what keeps the bytes readable)."""
+        out, self._pending_saves = self._pending_saves, []
+        return out
+
     def drain_cow(self) -> List[Tuple[int, int]]:
         """(src, dst) physical block copies queued by copy-on-write forks.
         The engine MUST apply these to the KV arrays before its next kernel
@@ -452,6 +636,7 @@ class KVBlockManager:
         table = self._tables.pop(seq_id)  # KeyError = double free
         del self._lens[seq_id]
         self._chain.pop(seq_id, None)
+        self._landed.pop(seq_id, None)
         for b in table:
             self._release_one(b)
         return len(table)
@@ -490,3 +675,13 @@ class KVBlockManager:
             assert self._hash_of.get(b) == h, f"index/hash_of drift on block {b}"
         for b, h in self._hash_of.items():
             assert self._index.get(h) == b, f"hash_of/index drift on block {b}"
+        for sid, landed in self._landed.items():
+            assert landed <= self._lens[sid], (
+                f"{sid!r}: landed watermark {landed} past allocation "
+                f"{self._lens[sid]}"
+            )
+        for b, (h, *_rest) in self._pending_loads.items():
+            assert b not in self._free, f"pending-load block {b} on free list"
+            assert self._hash_of.get(b) == h, (
+                f"pending-load block {b} no longer registered under its hash"
+            )

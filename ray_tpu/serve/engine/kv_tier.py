@@ -1,0 +1,87 @@
+"""Host-RAM KV tier — the storage layer below HBM in the tiered prefix
+cache (reference-era analogs: Mooncake's DRAM tier of its disaggregated
+KVCache pool, vLLM's CPU swap space — here content-addressed by the SAME
+chained blake2b digest the HBM index uses, so the two tiers and the fleet
+transfer plane share one global address).
+
+An HBM eviction no longer kills a prefix: the engine copies the block's
+bytes here (`KVBlockManager.drain_saves`) and the digest stays advertised
+in the replica's hot-prefix digest — the fleet router keeps steering
+matching prompts at this replica, where `allocate_cached`'s tier consult
+turns the re-admission into a host->HBM memcpy instead of a recompute.
+Export (`engine.export_prompt_kv`) also serves from here, so content that
+fell out of HBM remains pullable by every other replica over the bulk
+plane: the tier is what makes the cluster-wide cache TIERED rather than
+merely distributed.
+
+Eviction is LRU under a byte budget (`EngineOptions.host_kv_bytes`,
+per-replica). `on_evict` notifies the block manager so the digest stops
+being advertised once the bytes are truly gone. All access runs under the
+engine lock — the tier itself is a plain OrderedDict, no locking here.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable, Optional
+
+
+class HostKVTier:
+    """Content-addressed LRU byte store: digest -> one block's KV bytes
+    (a contiguous ndarray the engine packs/unpacks; the tier never looks
+    inside)."""
+
+    def __init__(self, budget_bytes: int):
+        if budget_bytes <= 0:
+            raise ValueError("host tier needs a positive byte budget")
+        self.budget_bytes = int(budget_bytes)
+        self._blobs: "OrderedDict[bytes, object]" = OrderedDict()
+        self.bytes_used = 0
+        # Set by the block manager: called with each digest the budget
+        # sweep evicts (stops its hot-hash advertisement).
+        self.on_evict: Optional[Callable[[bytes], None]] = None
+        self.hits = 0
+        self.saves = 0
+        self.evictions = 0
+
+    @property
+    def blocks(self) -> int:
+        return len(self._blobs)
+
+    def contains(self, h: bytes) -> bool:
+        return h in self._blobs
+
+    def peek(self, h: bytes):
+        """Read without touching recency (export path: serving a remote
+        pull must not make content look locally hot)."""
+        return self._blobs.get(h)
+
+    def get(self, h: bytes):
+        """Read + touch MRU (admission path: a consult that feeds a real
+        sequence is a use)."""
+        blob = self._blobs.get(h)
+        if blob is not None:
+            self._blobs.move_to_end(h)
+            self.hits += 1
+        return blob
+
+    def put(self, h: bytes, blob) -> bool:
+        """Store one block's bytes; LRU-evicts to the byte budget. A blob
+        larger than the whole budget is refused (never thrash the entire
+        tier for one block)."""
+        n = int(getattr(blob, "nbytes", len(blob)))
+        if n > self.budget_bytes:
+            return False
+        if h in self._blobs:
+            self._blobs.move_to_end(h)
+            return True
+        self._blobs[h] = blob
+        self.bytes_used += n
+        self.saves += 1
+        while self.bytes_used > self.budget_bytes and len(self._blobs) > 1:
+            old_h, old = self._blobs.popitem(last=False)
+            self.bytes_used -= int(getattr(old, "nbytes", len(old)))
+            self.evictions += 1
+            if self.on_evict is not None:
+                self.on_evict(old_h)
+        return True

@@ -151,6 +151,7 @@ class Scheduler:
         max_step_tokens: int = 256,
         prefill_chunk: int = 64,
         draft_proposer=None,
+        prefill_budget_cap: Optional[int] = None,
     ):
         if max_step_tokens <= max_num_seqs:
             raise ValueError(
@@ -164,6 +165,12 @@ class Scheduler:
         self.max_prefills_per_step = max_prefills_per_step
         self.max_step_tokens = max_step_tokens
         self.prefill_chunk = prefill_chunk
+        # Role biasing (disaggregated pools, `EngineOptions.role`): a
+        # DECODE-pool replica caps prefill's share of every step so the few
+        # prompt tails it must recompute (import misses, degraded handoffs)
+        # cannot crowd its decode lanes; None = chunking alone bounds
+        # prefill intrusion (the mixed/colocated default).
+        self.prefill_budget_cap = prefill_budget_cap
         # Speculative decoding (None = off): proposes draft tokens per
         # decoding lane; funded drafts ride the same step-token budget as
         # everything else (decode lanes first, drafts next, prefill last).
@@ -296,12 +303,14 @@ class Scheduler:
             s for s in self.running if s.state == RUNNING and s.is_decoding
         ]
         # Decode lanes (and their funded drafts) first; prefill chunks
-        # spend the remainder.
+        # spend the remainder (capped for decode-pool replicas).
         budget = (
             self.max_step_tokens
             - len(decodes)
             - sum(len(d) for d in drafts.values())
         )
+        if self.prefill_budget_cap is not None:
+            budget = min(budget, self.prefill_budget_cap)
 
         # 2. Continue in-flight partial prefills (admission order) before
         # admitting anyone new — their blocks are already committed.

@@ -23,19 +23,22 @@ the Router (`serve/handle.py`) and ServeController (`serve/controller.py`)
 own the mechanics.
 """
 
-from .autoscale import FleetSignals, decide_scale
+from .autoscale import FleetSignals, decide_scale, decide_scale_disagg
 from .routing import (
     DIGEST_HASH_BYTES,
     pick_replica,
     rendezvous_rank,
     routing_chain,
+    split_pools,
 )
 
 __all__ = [
     "DIGEST_HASH_BYTES",
     "FleetSignals",
     "decide_scale",
+    "decide_scale_disagg",
     "pick_replica",
     "rendezvous_rank",
     "routing_chain",
+    "split_pools",
 ]

@@ -84,3 +84,47 @@ def decide_scale(
         if coldest >= downscale_hit_rate:
             return 0
     return -1
+
+
+def decide_scale_disagg(
+    prefill: FleetSignals,
+    decode: FleetSignals,
+    target_ongoing_requests: float,
+    target_queue_depth: float,
+    ttft_p99_target_s: Optional[float],
+    downscale_hit_rate: float,
+) -> "tuple[int, int]":
+    """Per-pool verdicts for a disaggregated deployment: (prefill_delta,
+    decode_delta), each in {-1, 0, +1}.
+
+    The pools scale on the signals they actually own (DistServe's core
+    observation — prefill and decode saturate on different resources):
+
+      * PREFILL pool — TTFT is made here (the pool computes prompts and
+        emits first tokens), so the TTFT tail and the pool's admission
+        queues drive it. Router-outstanding pressure is excluded: requests
+        spend almost their whole life decoding, so the outstanding count
+        says nothing about prefill capacity.
+      * DECODE pool — queue depth, in-flight decode lanes, and the
+        router-outstanding total (its proxy for inter-token pressure)
+        drive it; the TTFT tail is excluded — a slow first token is never
+        this pool's fault.
+
+    Scale-down economics are unchanged per pool: quiet AND the pool's
+    coldest cache below `downscale_hit_rate` (a prefill pool's warm system
+    prompts are exactly the fleet-wide cache worth keeping)."""
+    dp = decide_scale(
+        dataclasses.replace(prefill, ongoing=0.0),
+        target_ongoing_requests=target_ongoing_requests,
+        target_queue_depth=target_queue_depth,
+        ttft_p99_target_s=ttft_p99_target_s,
+        downscale_hit_rate=downscale_hit_rate,
+    )
+    dd = decide_scale(
+        dataclasses.replace(decode, ttft_p99_s=None),
+        target_ongoing_requests=target_ongoing_requests,
+        target_queue_depth=target_queue_depth,
+        ttft_p99_target_s=None,
+        downscale_hit_rate=downscale_hit_rate,
+    )
+    return dp, dd

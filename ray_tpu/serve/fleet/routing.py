@@ -147,3 +147,26 @@ def pick_replica(
         return eligible[0], "pow2"
     a, b = pick.sample(eligible, 2)
     return (a if load(a) <= load(b) else b), "pow2"
+
+
+# --------------------------------------------------- disaggregated pools
+def split_pools(
+    roles: Sequence[Optional[str]],
+) -> Tuple[List[int], List[int]]:
+    """(prefill indices, decode indices) from per-replica pool roles.
+    A replica with no role / role "mixed" belongs to neither pool —
+    disaggregated orchestration only engages when BOTH pools are non-empty
+    (`serve/handle.py`), so a mixed fleet keeps the colocated path. The
+    pool split is what implements role routing: the router runs
+    `pick_replica` over the PREFILL pool with the prompt's digest chain
+    (deepest-affinity placement — that pool owns the prefix caches) and
+    over the DECODE pool with no chain (pure load: its cache is fed by
+    imports, so placement is about lane pressure, not affinity)."""
+    prefill: List[int] = []
+    decode: List[int] = []
+    for i, role in enumerate(roles):
+        if role == "prefill":
+            prefill.append(i)
+        elif role == "decode":
+            decode.append(i)
+    return prefill, decode

@@ -26,6 +26,13 @@ _ROUTER_REFRESH_S = 1.0
 # Routing-key block size used before any replica telemetry reveals the
 # engine's real one (matches EngineOptions.block_size's default).
 _DEFAULT_ROUTING_BLOCK = 16
+# Bound on the prefill leg of a disagg handoff (prefill + first token is
+# bounded work, unlike decode): a replica whose engine WEDGES without dying
+# raises nothing, and an unbounded get here would pin a handoff-pool thread
+# forever — 32 such requests would starve every disagg call on this router.
+# On timeout the request falls back to colocated recompute (greedy-identical);
+# matches the core plane's 300s stream timeout.
+_PREFILL_HANDOFF_TIMEOUT_S = 300.0
 
 
 def _is_replica_failure(e: BaseException) -> bool:
@@ -130,16 +137,23 @@ class DeploymentResponse:
 
 class DeploymentResponseGenerator:
     """Iterate chunks of a streaming deployment call (reference:
-    `serve.handle.DeploymentResponseGenerator`)."""
+    `serve.handle.DeploymentResponseGenerator`). `direct_gen` carries an
+    already-materialized chunk generator instead of an ObjectRef stream —
+    the disaggregated handoff path yields tokens from two replicas'
+    streams behind one facade."""
 
-    def __init__(self, ref_generator, on_done=None):
+    def __init__(self, ref_generator, on_done=None, direct_gen=None):
         self._gen = ref_generator
         self._on_done = on_done
+        self._direct = direct_gen
 
     def __iter__(self):
         import ray_tpu
 
         try:
+            if self._direct is not None:
+                yield from self._direct
+                return
             for ref in self._gen:
                 yield ray_tpu.get(ref)
         finally:
@@ -239,6 +253,10 @@ class Router:
         self._outstanding: Dict[int, int] = {}  # replica idx -> in-flight
         self._batchers: Dict[str, _Batcher] = {}
         self._reported_t = 0.0
+        # Disaggregated handoff orchestration runs off-thread (two
+        # sequential replica RPCs per request must not block the caller's
+        # .remote()). Created lazily — colocated fleets never pay for it.
+        self._handoff_pool = None
         # Stable identity for controller-side metrics: outstanding counts
         # are keyed per router and SUMMED across routers (EMA-blending
         # different routers into one stream undercounted the fleet).
@@ -286,15 +304,35 @@ class Router:
             self._last_refresh = now
             self._outstanding = {i: self._outstanding.get(i, 0) for i in range(len(info["replicas"]))}
 
+    def _replica_roles(self) -> List[Optional[str]]:
+        """Per-replica pool role, controller-assigned role first (available
+        the moment a replica is routable) with engine telemetry as the
+        fallback. Called under self._lock."""
+        info = self._info
+        roles = list(info.get("replica_roles") or [])
+        metas = info.get("replica_meta") or []
+        out: List[Optional[str]] = []
+        for i in range(len(info["replicas"])):
+            r = roles[i] if i < len(roles) else None
+            if not r and i < len(metas) and metas[i]:
+                r = metas[i].get("role")
+                r = r if r in ("prefill", "decode") else None
+            out.append(r)
+        return out
+
     def _pick_replica(
         self,
         model_id: str = "",
         prompt: Optional[List[int]] = None,
         exclude: Optional[int] = None,
+        role: Optional[str] = None,
     ) -> Tuple[int, Any, str]:
         """Returns (index, replica handle, replica tag) — the tag is read
         under the same lock as the pick, so failover bookkeeping can't be
-        torn by a concurrent refresh reordering the replica list."""
+        torn by a concurrent refresh reordering the replica list. With
+        `role`, candidates are restricted to that pool (falling back to the
+        whole fleet when the pool is empty — a half-dead disaggregated
+        deployment degrades to colocated serving, never to an error)."""
         self._refresh()
         with self._lock:
             replicas = self._info["replicas"]
@@ -303,6 +341,13 @@ class Router:
             n = len(replicas)
             tags = self._info["replica_tags"]
             candidates = [i for i in range(n) if i != exclude] or list(range(n))
+            if role is not None:
+                from .fleet import split_pools
+
+                pre, dec = split_pools(self._replica_roles())
+                pool = pre if role == "prefill" else dec
+                pool = [i for i in pool if i in set(candidates)]
+                candidates = pool or candidates
             if model_id:
                 # Rendezvous hash → cache-affine replica for multiplexed
                 # models (same construction as the fleet plane's cold-prefix
@@ -393,6 +438,183 @@ class Router:
         except Exception:  # noqa: BLE001
             pass
 
+    # ------------------------------------------------- disaggregated calls
+    def _disagg_plan(
+        self, method: str, args, kwargs, prompt: Optional[List[int]]
+    ) -> Optional[Dict]:
+        """(prompt, max_new_tokens, eos) when this call should ride the
+        prefill->handoff->decode path: an LLM generation method, a token
+        prompt, and BOTH pools present. None keeps the colocated path."""
+        if prompt is None or method not in ("generate", "generate_stream",
+                                            "__call__"):
+            return None
+        with self._lock:
+            if self._info is None or not self._info.get("prefill_replicas"):
+                return None  # colocated deployment: pay nothing per call
+            from .fleet import split_pools
+
+            pre, dec = split_pools(self._replica_roles())
+            if not pre or not dec:
+                return None
+        max_new, eos = 16, None
+        try:
+            if method == "__call__":
+                body = args[0].json() if hasattr(args[0], "json") else args[0]
+                if not isinstance(body, dict):
+                    return None
+                max_new = int(body.get("max_new_tokens", 16))
+                eos = body.get("eos_token")
+            else:
+                if len(args) > 1:
+                    max_new = int(args[1])
+                elif "max_new_tokens" in kwargs:
+                    max_new = int(kwargs["max_new_tokens"])
+                if len(args) > 2:
+                    eos = args[2]
+                else:
+                    eos = kwargs.get("eos_token")
+        except Exception:  # noqa: BLE001 — unparseable: keep colocated path
+            return None
+        return {"prompt": list(prompt), "max_new": max_new, "eos": eos}
+
+    def _colocated_fallback(self, plan: Dict, exclude_tag: Optional[str],
+                            timeout_s=None) -> Dict:
+        """Full recompute on one replica (decode pool preferred — its lanes
+        are the scarce resource a dead prefill replica leaves idle): the
+        degraded mode for ANY disagg failure, identical greedy output."""
+        import ray_tpu
+
+        self._refresh(force=True)
+        with self._lock:
+            tags = self._info["replica_tags"]
+            ex = tags.index(exclude_tag) if exclude_tag in tags else None
+        idx, rep, _ = self._pick_replica(
+            prompt=plan["prompt"], exclude=ex, role="decode"
+        )
+        try:
+            return ray_tpu.get(
+                rep.handle_request.remote(
+                    "generate",
+                    (plan["prompt"], plan["max_new"], plan["eos"]), {},
+                ),
+                timeout=timeout_s,
+            )
+        finally:
+            self._done(idx)
+
+    def _disagg_prefill(self, plan: Dict) -> Tuple[Optional[Dict], Optional[Dict]]:
+        """Run the prefill half on the prefill pool. Returns
+        (prefill_result, finished_response): exactly one is non-None —
+        a finished_response means the request completed (first token was
+        the whole generation, or the prefill replica died and the
+        colocated fallback answered)."""
+        import ray_tpu
+
+        idx, rep, tag = self._pick_replica(
+            prompt=plan["prompt"], role="prefill"
+        )
+        try:
+            res = ray_tpu.get(
+                rep.handle_request.remote(
+                    "prefill_handoff",
+                    (plan["prompt"], plan["max_new"], plan["eos"]), {},
+                ),
+                timeout=_PREFILL_HANDOFF_TIMEOUT_S,
+            )
+        except Exception as e:  # noqa: BLE001
+            if not (_is_replica_failure(e)
+                    or isinstance(e, ray_tpu.GetTimeoutError)):
+                raise
+            # Prefill replica died (or wedged) mid-handoff: recompute
+            # elsewhere. Nothing imports a descriptor for THIS request —
+            # the fallback recomputes from scratch, greedy-identical.
+            return None, self._colocated_fallback(plan, tag)
+        finally:
+            self._done(idx)
+        if res.get("finished"):
+            return None, {"tokens": res["tokens"],
+                          "finish_reason": res["finish_reason"]}
+        return res, None
+
+    def _disagg_call(self, plan: Dict) -> Dict:
+        """Unary prefill->handoff->decode orchestration (runs on the
+        handoff pool thread). Greedy-deterministic at every fallback, so
+        the response is token-for-token the colocated response no matter
+        which replicas survive."""
+        import ray_tpu
+
+        res, done = self._disagg_prefill(plan)
+        if done is not None:
+            return done
+        first = res["tokens"][0]
+        idx, rep, tag = self._pick_replica(role="decode")
+        try:
+            rest = ray_tpu.get(
+                rep.handle_request.remote(
+                    "decode_imported",
+                    (plan["prompt"], first, plan["max_new"] - 1, plan["eos"],
+                     res.get("descriptor")), {},
+                )
+            )
+        except Exception as e:  # noqa: BLE001
+            if not _is_replica_failure(e):
+                raise
+            return self._colocated_fallback(plan, tag)
+        finally:
+            self._done(idx)
+        return {"tokens": [first] + rest["tokens"],
+                "finish_reason": rest["finish_reason"]}
+
+    def _disagg_response(self, plan: Dict) -> DeploymentResponse:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with self._lock:
+            if self._handoff_pool is None:
+                self._handoff_pool = ThreadPoolExecutor(
+                    max_workers=32, thread_name_prefix="rtpu-handoff"
+                )
+        self._maybe_report_metrics()
+        return DeploymentResponse(
+            future=self._handoff_pool.submit(self._disagg_call, plan)
+        )
+
+    def _disagg_stream_gen(self, plan: Dict):
+        """Streaming orchestration: yield the prefill replica's first token
+        as soon as it lands (disaggregation's whole point: TTFT decoupled
+        from decode load), then the decode replica's stream. Greedy
+        determinism makes mid-stream failover exact: recompute colocated
+        and skip what was already yielded — no wedged stream, no
+        duplicated or diverging tokens."""
+        import ray_tpu
+
+        res, done = self._disagg_prefill(plan)
+        if done is not None:
+            yield from done["tokens"]
+            return
+        first = res["tokens"][0]
+        yield first
+        emitted = 1
+        idx, rep, tag = self._pick_replica(role="decode")
+        try:
+            gen = rep.handle_request_streaming.options(
+                num_returns="streaming"
+            ).remote(
+                "decode_imported_stream",
+                (plan["prompt"], first, plan["max_new"] - 1, plan["eos"]),
+                {"descriptor": res.get("descriptor")},
+            )
+            for ref in gen:
+                tok = ray_tpu.get(ref)
+                yield tok
+                emitted += 1
+        except Exception as e:  # noqa: BLE001
+            if not _is_replica_failure(e):
+                raise
+            fb = self._colocated_fallback(plan, tag)
+            yield from fb["tokens"][emitted:]
+        finally:
+            self._done(idx)
+
     # ---------------------------------------------------------------- calls
     def call(self, method: str, args, kwargs, model_id: str = "") -> DeploymentResponse:
         self._refresh()
@@ -411,6 +633,10 @@ class Router:
             return batcher.submit(args[0], model_id)
 
         prompt = _routing_prompt(args, kwargs)
+        if not model_id:
+            plan = self._disagg_plan(method, args, kwargs, prompt)
+            if plan is not None:
+                return self._disagg_response(plan)
         idx, replica, failed_tag = self._pick_replica(model_id, prompt=prompt)
         try:
             ref = replica.handle_request.remote(method, args, kwargs, model_id)
@@ -450,8 +676,14 @@ class Router:
         (reference: `handle.options(stream=True)` →
         ObjectRefGenerator-backed responses)."""
         self._refresh()
+        prompt = _routing_prompt(args, kwargs)
+        if not model_id:
+            plan = self._disagg_plan(method, args, kwargs, prompt)
+            if plan is not None:
+                self._maybe_report_metrics()
+                return DeploymentResponseGenerator(None, direct_gen=self._disagg_stream_gen(plan))
         idx, replica, _ = self._pick_replica(
-            model_id, prompt=_routing_prompt(args, kwargs)
+            model_id, prompt=prompt
         )
         try:
             gen = getattr(replica, "handle_request_streaming").options(

@@ -33,9 +33,21 @@ class Replica:
         serialized_cls: bytes,
         serialized_init_args: bytes,
         user_config: Optional[dict] = None,
+        role: Optional[str] = None,
     ):
         cls = cloudpickle.loads(serialized_cls)
         args, kwargs = cloudpickle.loads(serialized_init_args)
+        if role:
+            # Disaggregated pools: the controller assigns this replica's
+            # engine role (prefill/decode) at start time — merged into the
+            # `engine_options` kwarg the LLM deployment class accepts.
+            # Only deployments configured with prefill_replicas > 0 ever
+            # receive a role, so non-engine classes are never touched.
+            kwargs = dict(kwargs)
+            kwargs["engine_options"] = {
+                **(kwargs.get("engine_options") or {}), "role": role,
+            }
+        self._role = role
         self._ctx = ReplicaContext(app_name, deployment_name, replica_tag)
         _set_replica_context(self._ctx)
         if isinstance(cls, type):

@@ -448,23 +448,32 @@ def _bench_fleet_config(label, args, model_kwargs, reqs, kinds, warm,
     lats, wall = run_load(base, reqs, rate or args.rate, args.seed + 1)
     out = _summarize(lats, kinds, reqs, wall, args)
     per_replica = _replica_stats(name)
-    ttfts, hits, misses = [], 0, 0
+    ttfts, pre_ttfts, hits, misses, host_hits = [], [], 0, 0, 0
     spec_prop = spec_acc = 0
     for tag, st in per_replica.items():
-        ttfts += st.pop("ttft_recent", [])
+        t_recent = st.pop("ttft_recent", [])
+        ttfts += t_recent
+        if st.get("role") == "prefill":
+            # Disagg: the REAL first token is emitted by the prefill pool;
+            # the decode pool's internal "first token" is token #2.
+            pre_ttfts += t_recent
         st.pop("tpot_recent", None)
         hits += st["prefix_cache_hits"]
         misses += st["prefix_cache_misses"]
+        host_hits += st.get("host_tier_hits", 0)
         spec_prop += st["spec_proposed"]
         spec_acc += st["spec_accepted"]
+    ttfts = pre_ttfts or ttfts
     out["replicas"] = replicas
     out["engine_options"] = dict(engine_overrides)
     out["per_replica"] = {
         t: {
             k: st[k]
-            for k in ("total_tokens", "total_finished", "prefix_cache_hits",
-                      "prefix_cache_misses", "spec_acceptance_rate",
-                      "ttft_p50_s")
+            for k in ("role", "total_tokens", "total_finished",
+                      "prefix_cache_hits", "prefix_cache_misses",
+                      "host_tier_hits", "blocks_imported", "blocks_exported",
+                      "spec_acceptance_rate", "ttft_p50_s")
+            if k in st
         }
         for t, st in per_replica.items()
     }
@@ -473,6 +482,7 @@ def _bench_fleet_config(label, args, model_kwargs, reqs, kinds, warm,
     out["prefix_hit_rate"] = (
         round(hits / (hits + misses), 4) if hits + misses else None
     )
+    out["host_tier_hits"] = host_hits
     out["spec_acceptance_rate"] = (
         round(spec_acc / spec_prop, 4) if spec_prop else None
     )
@@ -594,19 +604,138 @@ def bench_fleet(args, model_kwargs):
     }
 
 
+def bench_disagg(args, model_kwargs):
+    """Disaggregated prefill/decode vs the colocated fleet (ROADMAP item 1
+    workload: Poisson mix with LONG shared system prompts, equal total KV
+    budget, equal replica count), each at a moderate AND a saturating
+    arrival rate. Two headline properties:
+
+      * cross-replica prefix hit rate — colocated affinity concentrates
+        each prefix group on ONE replica's cache (per-replica 0.65 in
+        BENCH_SERVE_fleet.json); disagg makes the cache cluster-wide: the
+        prefill pool computes each prefix once and every decode replica
+        IMPORTS it over the bulk plane instead of recomputing, so the
+        aggregate hit rate should rise well above the per-replica number;
+      * p50 TTFT vs decode load — in the colocated fleet, saturating
+        decode lanes contend with every long prefill, inflating TTFT; a
+        disaggregated prefill pool keeps computing first tokens at its own
+        pace, so TTFT stays ~flat as the decode side saturates.
+    """
+    import numpy as np
+
+    rng = np.random.default_rng(args.seed)
+    V = model_kwargs["vocab_size"]
+    groups = [
+        rng.integers(1, V, args.prefix_len).tolist()
+        for _ in range(args.prefix_groups)
+    ]
+    kinds = rng.random(args.requests) < args.p_long
+    gidx = rng.integers(0, len(groups), args.requests)
+    reqs = [
+        {
+            "prompt": groups[gidx[i]] + rng.integers(1, V, args.tail_len).tolist(),
+            "max_new_tokens": args.long if kinds[i] else args.short,
+        }
+        for i in range(args.requests)
+    ]
+    warm = [
+        {"prompt": rng.integers(1, V, args.tail_len).tolist(),
+         "max_new_tokens": args.long if i % 2 else args.short}
+        for i in range(args.batch)
+    ]
+    per_replica_blocks = max(args.kv_blocks // args.replicas, 2)
+    engine = dict(num_blocks=per_replica_blocks, block_size=16)
+    rates = {"moderate": args.rate, "saturated": args.rate * args.rate_mult}
+    rows = {}
+    for mode, deploy in (
+        ("colocated", dict(num_replicas=args.replicas)),
+        ("disagg", dict(num_replicas=args.replicas, prefill_replicas=1)),
+    ):
+        for rname, rate in rates.items():
+            rows[f"{mode}_{rname}"] = _bench_fleet_config(
+                f"{mode}_{rname}", args, model_kwargs, reqs, kinds, warm,
+                args.replicas, engine, deploy, rate=rate,
+            )
+
+    def ratio(a, b):
+        return round(a / b, 2) if a and b else None
+
+    co_lo, co_hi = rows["colocated_moderate"], rows["colocated_saturated"]
+    di_lo, di_hi = rows["disagg_moderate"], rows["disagg_saturated"]
+    comparison = {
+        # Fleet-wide cache: aggregate hit rate under the saturating mix.
+        "prefix_hit_rate_disagg": di_hi["prefix_hit_rate"],
+        "prefix_hit_rate_colocated": co_hi["prefix_hit_rate"],
+        "prefix_hit_rate_fleet_baseline": 0.65,  # BENCH_SERVE_fleet.json
+        # TTFT flatness: how much the p50 inflates when decode saturates.
+        "ttft_p50_inflation_colocated": ratio(
+            co_hi["ttft_p50_s"], co_lo["ttft_p50_s"]
+        ),
+        "ttft_p50_inflation_disagg": ratio(
+            di_hi["ttft_p50_s"], di_lo["ttft_p50_s"]
+        ),
+        # The tail is the honest flatness signal on a shared-CPU host (the
+        # p50 moderate baselines are sub-hundred-ms, so tiny absolute
+        # shifts read as huge p50 ratios): a disaggregated prefill pool's
+        # p99 barely moves as decode saturates.
+        "ttft_p99_inflation_colocated": ratio(
+            co_hi["ttft_p99_s"], co_lo["ttft_p99_s"]
+        ),
+        "ttft_p99_inflation_disagg": ratio(
+            di_hi["ttft_p99_s"], di_lo["ttft_p99_s"]
+        ),
+        "ttft_p50_ratio_colocated_over_disagg_saturated": ratio(
+            co_hi["ttft_p50_s"], di_hi["ttft_p50_s"]
+        ),
+        "ttft_p99_ratio_colocated_over_disagg_saturated": ratio(
+            co_hi["ttft_p99_s"], di_hi["ttft_p99_s"]
+        ),
+        "kv_blocks_imported": sum(
+            r.get("blocks_imported", 0)
+            for r in di_hi["per_replica"].values()
+        ),
+    }
+    return {
+        "metric": "serve_disagg_vs_colocated_fleet",
+        "config": {
+            "model": args.model,
+            "replicas": args.replicas,
+            "prefill_replicas": 1,
+            "prefix_groups": args.prefix_groups,
+            "rate_req_s": args.rate,
+            "rate_saturated_req_s": rates["saturated"],
+            "prefix_len": args.prefix_len,
+            "tail_len": args.tail_len,
+            "short": args.short,
+            "long": args.long,
+            "p_long": args.p_long,
+            "batch": args.batch,
+            "kv_blocks_total": args.kv_blocks,
+            "platform": "tpu" if args.tpu else "cpu",
+        },
+        "results": rows,
+        "comparison": comparison,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", choices=["static", "engine", "both"],
                     default="both")
     ap.add_argument("--workload",
-                    choices=["mixed", "prefix", "longprompt", "fleet"],
+                    choices=["mixed", "prefix", "longprompt", "fleet",
+                             "disagg"],
                     default="mixed",
                     help="mixed: static-vs-engine continuous load (r5); "
                          "prefix: shared-system-prompt Poisson load, prefix "
                          "cache on vs off; longprompt: chunked vs monolithic "
                          "prefill under long-prompt interference; fleet: "
                          "multi-replica shared-prefix mix — affinity vs "
-                         "pow2 routing + spec decode on vs off")
+                         "pow2 routing + spec decode on vs off; disagg: "
+                         "prefill/decode pools + cluster-wide KV vs the "
+                         "colocated fleet at moderate AND saturating rates")
+    ap.add_argument("--rate-mult", type=float, default=4.0,
+                    help="disagg workload: saturating rate = rate * this")
     ap.add_argument("--replicas", type=int, default=2,
                     help="fleet workload: replicas per deployment")
     ap.add_argument("--prefix-groups", type=int, default=4,
@@ -657,6 +786,7 @@ def main():
             "prefix": bench_prefix,
             "longprompt": bench_longprompt,
             "fleet": bench_fleet,
+            "disagg": bench_disagg,
         }[args.workload]
         report = bench(args, model_kwargs)
         print(json.dumps(report), flush=True)

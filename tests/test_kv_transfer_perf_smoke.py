@@ -1,0 +1,131 @@
+"""KV-transfer performance smoke (the disaggregated-serving counterpart of
+`test_bulk_perf_smoke.py`): a fixed KV working set rides the full
+export -> span-pull -> import path — pack the span-table frame, store it in
+a real arena, pull every block's span from a real BulkServer with the
+native off-GIL lander, and rebuild the blocks — asserting (a) byte-exact
+reconstruction of every block and (b) a GiB/s floor on the native lander
+path plus native-not-slower-than-Python (generous slack: a smoke against
+gross regressions — e.g. the span path falling off the native lander onto
+per-span Python recv loops — not a calibrated benchmark)."""
+
+import os
+import secrets
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu import native as native_mod
+from ray_tpu.core import bulk, store
+from ray_tpu.core import config as rt_config
+from ray_tpu.serve.engine import kv_transfer
+
+GIB = 1 << 30
+# 512 blocks x 512 KiB = 256 MiB working set: a realistic long-system-
+# prompt KV footprint (gpt2-large-class, tens of blocks per prompt) that
+# still keeps the smoke under a minute on the 1-vCPU bench host.
+N_BLOCKS = 512
+BLOCK_ELEMS = (512 << 10) // 4  # float32
+
+
+@pytest.fixture
+def kv_pair():
+    os.environ.setdefault("RAY_TPU_AUTH_TOKEN", secrets.token_hex(8))
+    old_tag = store.SESSION_TAG
+    store.set_session_tag(f"kp{os.getpid()}")
+    src = store.make_store(create_arena=True, arena_capacity=512 << 20)
+    srv = bulk.BulkServer(src, bind_host="127.0.0.1")
+    port = srv.start()
+    dst = store.LocalStore()
+    try:
+        yield src, f"127.0.0.1:{port}", dst
+    finally:
+        srv.stop()
+        dst.close_all(unlink=True)
+        src.close_all(unlink=True)
+        if hasattr(src, "arena"):
+            src.arena.detach()
+            try:
+                src.arena.unlink()
+            except OSError:
+                pass
+        store.set_session_tag(old_tag)
+
+
+def _timed_import(addr, name, desc, blobs, dst, lander: str) -> float:
+    os.environ["RAY_TPU_BULK_NATIVE_LANDER"] = lander
+    rt_config._reset_cache_for_tests()
+    t0 = time.perf_counter()
+    got = kv_transfer._fetch_remote_runs(
+        {"bulk": addr, "name": name}, desc, list(range(N_BLOCKS)), 120.0,
+        store=dst,
+    )
+    dt = time.perf_counter() - t0
+    assert got is not None and len(got) == N_BLOCKS
+    # Byte-exact reconstruction, spot-checked densely enough to catch an
+    # offset bug anywhere in the span table (every 31st block + ends).
+    for k in {0, 1, N_BLOCKS - 1, *range(0, N_BLOCKS, 31)}:
+        np.testing.assert_array_equal(got[k], blobs[k])
+    return dt
+
+
+@pytest.mark.slow
+def test_kv_transfer_perf_smoke(kv_pair):
+    if native_mod.load_bulk_lib() is None:
+        pytest.skip(
+            f"native bulk lander unbuildable: {native_mod.bulk_build_error()}"
+        )
+    src, addr, dst = kv_pair
+    rng = np.random.default_rng(0)
+    blobs = [
+        rng.standard_normal(BLOCK_ELEMS).astype(np.float32)
+        for _ in range(N_BLOCKS)
+    ]
+    digests = [secrets.token_hex(16) for _ in range(N_BLOCKS)]
+    payload, buffers, spans = kv_transfer.pack_frame(digests, blobs)
+    assert spans is not None and len(spans) == N_BLOCKS
+    from ray_tpu.core import serialization
+
+    size = serialization.packed_size(payload, buffers)
+    frame = bytearray(size)
+    serialization.pack_into(payload, buffers, memoryview(frame))
+    name, _ = src.create_raw(secrets.token_hex(28), bytes(frame))
+    del frame
+    desc = {"v": 1, "digests": digests, "spans": spans,
+            "dtype": blobs[0].dtype.str, "shape": blobs[0].shape}
+    total = sum(n for _, n in spans)
+
+    old = os.environ.get("RAY_TPU_BULK_NATIVE_LANDER")
+    try:
+        # Best of two per mode, interleaved: one shared-box scheduling
+        # hiccup must not decide the comparison.
+        times = {"stream": [], "off": []}
+        for _ in range(2):
+            for mode in ("stream", "off"):
+                times[mode].append(
+                    _timed_import(addr, name, desc, blobs, dst, mode)
+                )
+        t_native, t_python = min(times["stream"]), min(times["off"])
+        rate = total / GIB / t_native
+        print(
+            f"kv import {total / (1 << 20):.0f} MiB in {t_native:.2f}s "
+            f"native ({rate:.2f} GiB/s); python {t_python:.2f}s"
+        )
+        # Floor: the native span path measured ~1 GiB/s on the 1-vCPU
+        # bench host; 0.25 catches it losing its off-GIL advantage (or the
+        # run coalescer degenerating to per-block pulls) through heavy
+        # shared-box noise.
+        assert rate >= 0.25, (
+            f"native KV span import regressed: {rate:.2f} GiB/s"
+        )
+        assert t_native <= t_python * 1.35, (
+            f"native lander slower than python on the span path: "
+            f"{t_native:.2f}s vs {t_python:.2f}s"
+        )
+    finally:
+        src.release(name, unlink=True)
+        if old is None:
+            os.environ.pop("RAY_TPU_BULK_NATIVE_LANDER", None)
+        else:
+            os.environ["RAY_TPU_BULK_NATIVE_LANDER"] = old
+        rt_config._reset_cache_for_tests()

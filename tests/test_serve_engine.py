@@ -222,11 +222,15 @@ class TestPrefixCache:
         kv.check_invariants()
 
     def test_fork_cow_never_mutates_shared_block(self):
-        """fork shares every block; extending into the shared partial last
-        block forks it copy-on-write — the table rewrites to a FRESH block
-        and a physical (src, dst) copy is queued for the engine."""
+        """fork shares every LANDED block; extending into the shared
+        partial last block forks it copy-on-write — the table rewrites to
+        a FRESH block and a physical (src, dst) copy is queued for the
+        engine. (The parent's landed watermark covers its whole allocation
+        here, so the child shares the full table.)"""
         kv = KVBlockManager(num_blocks=16, block_size=4)
-        kv.allocate("parent", 6)  # blocks [b0, b1], b1 half full
+        toks = [1, 2, 3, 4, 5, 6]
+        kv.allocate_cached("parent", toks, 6)  # blocks [b0, b1], b1 half full
+        kv.register_computed("parent", toks, 6)  # landed watermark = 6
         pt = kv.block_table("parent")
         kv.fork("parent", "child")
         assert kv.block_table("child") == pt
@@ -246,6 +250,40 @@ class TestPrefixCache:
         kv.free("parent")
         kv.free("child")
         kv.check_invariants()
+
+    def test_fork_of_speculatively_overgrown_sequence_trims_child(self):
+        """The PR 7 caveat, now HANDLED: a parent whose allocation was
+        speculatively overgrown (grow() past the landed watermark to fund
+        drafts the verify step later rejects) forks a child trimmed to the
+        landed watermark — the child can never write into the undefined
+        tail, and its own extension COWs correctly at the real boundary."""
+        kv = KVBlockManager(num_blocks=16, block_size=4)
+        toks = [1, 2, 3, 4, 5, 6]
+        kv.allocate_cached("parent", toks, 7)   # 6 prompt + 1 gen slot
+        kv.register_computed("parent", toks, 6)  # landed watermark = 6
+        # Speculative overgrowth: fund 4 draft slots nothing has computed.
+        kv.grow("parent", 11)
+        assert kv.seq_len("parent") == 11
+        kv.fork("parent", "child")
+        # Child trimmed to the landed watermark: 6 tokens -> 2 blocks.
+        assert kv.seq_len("child") == 6
+        ct = kv.block_table("child")
+        pt = kv.block_table("parent")
+        assert ct == pt[:2]
+        kv.check_invariants()
+        # Child extending into the shared partial block COWs at the REAL
+        # write position (6), not the overgrown one (11).
+        grown = kv.grow("child", 8)
+        assert grown[1] != pt[1], "shared partial block mutated in place"
+        assert kv.drain_cow() == [(pt[1], grown[1])]
+        kv.check_invariants()
+        # An un-overgrown fork still shares the whole landed table.
+        kv2 = KVBlockManager(num_blocks=16, block_size=4)
+        kv2.allocate_cached("p", toks, 6)
+        kv2.register_computed("p", toks, 6)
+        kv2.fork("p", "c")
+        assert kv2.block_table("c") == kv2.block_table("p")
+        kv2.check_invariants()
 
     def test_randomized_alloc_fork_extend_free_stress(self):
         """Free-list conservation, no double-free, COW-not-in-place, and
