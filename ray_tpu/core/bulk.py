@@ -678,7 +678,9 @@ def pull_span(addr: str, name: str, offset: int, length: int, writer,
 def fetch_span_bytes(addr: str, name: str, offset: int, length: int,
                      timeout_s: float) -> bytearray:
     """Pull one span into private memory (no store object — partition/
-    block-sized reads where the consumer deserializes immediately)."""
+    block-sized reads where the consumer deserializes immediately: the
+    data plane's shuffle partitions, and the MPMD training pipeline's
+    cross-node activation/grad tensors in train/mpmd/transport.py)."""
     buf = bytearray(length)
     sock = _open_bulk_conn(addr, timeout_s)
     with contextlib.closing(sock):

@@ -5,11 +5,18 @@ shared-memory channels: after compile, `execute()` does ZERO task
 submissions — the driver writes the input channel, every stage actor sits in
 a read→compute→write loop, and the result appears in the output channel.
 This is the substrate for cross-host pipeline stages (the in-jit GPipe path
-for a single mesh lives in `ray_tpu.parallel.pipeline`).
+for a single mesh lives in `ray_tpu.parallel.pipeline`; the MPMD training
+pipeline in `ray_tpu.train.mpmd` builds its stage-to-stage edges through
+`make_edge_channel` below). Channels grow on demand past the 1 MiB default;
+per-round get() deadlines are configurable via execute(timeout=...); stage
+exceptions travel the pipeline as StageError values and re-raise at the
+caller, and a DEAD stage host surfaces as a stage-death error within the
+health-poll window instead of a bare channel timeout.
 """
 
 from __future__ import annotations
 
+import time
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -26,21 +33,82 @@ def _advertise_host() -> str:
     return config.get("node_ip") or "127.0.0.1"
 
 
-class _StageHost:
-    """Generic actor hosting one compiled stage's user object + exec loop.
+class StageError:
+    """A stage-host exception travelling the pipeline as DATA: the failing
+    stage publishes it downstream instead of its result, every later stage
+    forwards it untouched (first error wins), and `CompiledDAGRef.get`
+    re-raises it at the caller. The exec loops stay alive — channel seqs
+    advanced exactly one round, so the next execute() is coherent."""
 
-    NOTE: the exec loop runs as one long actor task (`run_loop`), exactly the
-    reference's design — teardown writes a stop sentinel through the input
-    channels, which unblocks and ends the loop.
-    """
+    __slots__ = ("stage", "exc", "repr", "tb")
 
-    def __init__(self, serialized_cls: bytes, serialized_init: bytes):
-        cls = cloudpickle.loads(serialized_cls)
-        args, kwargs = cloudpickle.loads(serialized_init)
-        self._obj = cls(*args, **kwargs)
+    def __init__(self, stage: str, exc: BaseException, tb: str):
+        import pickle
 
-    def ping(self) -> str:
-        return "ok"
+        self.stage = stage
+        self.repr = repr(exc)
+        self.tb = tb
+        try:
+            # Probe with PLAIN pickle — the channels transport values with
+            # pickle, not cloudpickle, so a __main__-defined exception class
+            # (common: user stage code ships by value via cloudpickle) must
+            # be dropped here or it would kill the exec loop mid-write and
+            # wedge the very pipeline this class exists to keep alive.
+            pickle.loads(pickle.dumps(exc))
+            self.exc = exc
+        except Exception:  # noqa: BLE001
+            self.exc = None
+
+    def raise_(self):
+        err = RuntimeError(
+            f"compiled DAG stage {self.stage!r} raised {self.repr}\n{self.tb}"
+        )
+        if self.exc is not None:
+            raise err from self.exc
+        raise err
+
+
+def make_edge_channel(
+    buffer_size: int,
+    producer_node: str,
+    consumer_nodes: List[str],
+    n_readers: int,
+    bind_actor,
+    driver_node: str,
+):
+    """Create the right channel type for one edge: shm seqlock when the
+    producer and every consumer share a node (created remotely through
+    `bind_actor.create_shm_channel` when that node isn't the driver's),
+    persistent TCP otherwise. `bind_actor` is any actor exposing the
+    `bind_tcp_channel`/`create_shm_channel` surface (`_StageHost` here; the
+    MPMD trainer's stage replicas reuse this for their activation/grad
+    edges), or None when the producer is the driver itself."""
+    import ray_tpu
+
+    from ..experimental.channel import RemoteShmChannel
+
+    if all(c == producer_node for c in consumer_nodes):
+        if producer_node == driver_node or bind_actor is None:
+            return Channel(buffer_size, num_readers=n_readers)
+        # Edge entirely on a remote node: the segment must be created
+        # THERE; the driver keeps a no-mapping descriptor.
+        name = ray_tpu.get(
+            bind_actor.create_shm_channel.remote(buffer_size, n_readers)
+        )
+        return RemoteShmChannel(name, n_readers)
+    name = f"rtpuch-{uuid.uuid4().hex[:12]}"
+    if bind_actor is None:  # producer is the driver (input channel)
+        return TcpChannel.bind(name, n_readers, advertise_host=_advertise_host())
+    addr = ray_tpu.get(bind_actor.bind_tcp_channel.remote(name, n_readers))
+    return TcpChannel(name, tuple(addr), n_readers)
+
+
+class ChannelHostMixin:
+    """The channel-construction surface `make_edge_channel` needs from an
+    edge-producing actor. Shared by the compiled-DAG `_StageHost` and the
+    MPMD trainer's stage replicas — the create_shm_channel ownership
+    bookkeeping (keeping the segment tracker-registered in its CREATING
+    process) must not drift between the two."""
 
     def node_id(self) -> str:
         from ..core.runtime_context import get_runtime_context
@@ -66,6 +134,23 @@ class _StageHost:
         self._owned_channels.append(ch)  # keep tracker registration alive
         return ch.name
 
+
+class _StageHost(ChannelHostMixin):
+    """Generic actor hosting one compiled stage's user object + exec loop.
+
+    NOTE: the exec loop runs as one long actor task (`run_loop`), exactly the
+    reference's design — teardown writes a stop sentinel through the input
+    channels, which unblocks and ends the loop.
+    """
+
+    def __init__(self, serialized_cls: bytes, serialized_init: bytes):
+        cls = cloudpickle.loads(serialized_cls)
+        args, kwargs = cloudpickle.loads(serialized_init)
+        self._obj = cls(*args, **kwargs)
+
+    def ping(self) -> str:
+        return "ok"
+
     def run_loop(self, stages: List[Tuple[str, List[Tuple[str, Any]], Channel]]) -> int:
         """One loop task per actor, executing ALL of this actor's stages in
         topological order each round (ordered actor queues mean a second
@@ -74,6 +159,8 @@ class _StageHost:
         | ("dup", earlier_arg_index) — a channel bound to two params of one
         stage is read ONCE per round and its value reused.
         """
+        import traceback
+
         rounds = 0
         closed = False
         try:
@@ -92,8 +179,22 @@ class _StageHost:
                     except ChannelClosed:
                         closed = True
                         break
+                    # An upstream failure arrives as a StageError value:
+                    # forward it (first error wins) without running this
+                    # stage — the round still advances every channel once.
+                    upstream = next(
+                        (a for a in args if isinstance(a, StageError)), None
+                    )
                     try:
-                        result = getattr(self._obj, method_name)(*args)
+                        if upstream is not None:
+                            result = upstream
+                        else:
+                            try:
+                                result = getattr(self._obj, method_name)(*args)
+                            except BaseException as e:  # noqa: BLE001
+                                result = StageError(
+                                    method_name, e, traceback.format_exc()
+                                )
                     finally:
                         for c in reads:
                             c.end_read()
@@ -206,27 +307,11 @@ class CompiledDAG:
         )
         stage_node = {id(n): actor_nodes[id(n._target)] for n in order}
 
-        from ..experimental.channel import RemoteShmChannel
-
         def make_channel(producer_node, consumer_nodes, n_readers, bind_actor):
-            if all(c == producer_node for c in consumer_nodes):
-                if producer_node == driver_node or bind_actor is None:
-                    return Channel(self._buffer_size, num_readers=n_readers)
-                # Edge entirely on a remote node: the segment must be
-                # created THERE; the driver keeps a no-mapping descriptor.
-                name = ray_tpu.get(
-                    bind_actor.create_shm_channel.remote(
-                        self._buffer_size, n_readers
-                    )
-                )
-                return RemoteShmChannel(name, n_readers)
-            name = f"rtpuch-{uuid.uuid4().hex[:12]}"
-            if bind_actor is None:  # producer is the driver (input channel)
-                return TcpChannel.bind(
-                    name, n_readers, advertise_host=_advertise_host()
-                )
-            addr = ray_tpu.get(bind_actor.bind_tcp_channel.remote(name, n_readers))
-            return TcpChannel(name, tuple(addr), n_readers)
+            return make_edge_channel(
+                self._buffer_size, producer_node, consumer_nodes, n_readers,
+                bind_actor, driver_node,
+            )
 
         self._input_channel: Optional[Channel] = None
         if input_consumer_stages:
@@ -295,15 +380,43 @@ class CompiledDAG:
         ]
 
     # ------------------------------------------------------------- execute
-    def execute(self, *args) -> "CompiledDAGRef":
+    def execute(self, *args, timeout: Optional[float] = 60.0) -> "CompiledDAGRef":
+        """One pipeline round. `timeout` is the default deadline for the
+        returned ref's get() — the old hardcoded 60s was wrong for rounds
+        that legitimately run long (training steps); pass what the round
+        actually needs, or None to wait forever."""
         if self._teardown_done:
             raise RuntimeError("Compiled DAG has been torn down")
         if self._input_channel is not None:
             if len(args) != 1:
                 raise ValueError("Compiled DAG execute() takes exactly one input")
-            self._input_channel.write(args[0])
+            # The write can block on the PREVIOUS round's ack (depth-1
+            # backpressure), so it deserves the same budget as the round.
+            self._input_channel.write(args[0], timeout=timeout)
         self._execute_count += 1
-        return CompiledDAGRef(self)
+        return CompiledDAGRef(self, timeout=timeout)
+
+    def check_stage_health(self):
+        """Raise if any stage exec loop has ENDED (a finished loop ref means
+        teardown — or, the case worth diagnosing, the stage host died and
+        its channels will never speak again). Called by CompiledDAGRef.get
+        while it waits, so a SIGKILLed stage surfaces as a stage-death error
+        within seconds instead of a bare channel timeout at the deadline."""
+        if self._teardown_done:
+            return
+        done, _ = self._ray.wait(
+            self._loop_refs, num_returns=len(self._loop_refs), timeout=0
+        )
+        for ref in done:
+            try:
+                self._ray.get(ref)
+            except Exception as e:  # noqa: BLE001 — actor/worker death
+                raise RuntimeError(
+                    f"compiled DAG stage host died mid-execute: {e!r}"
+                ) from e
+            raise RuntimeError(
+                "compiled DAG stage exec loop exited unexpectedly"
+            )
 
     def teardown(self):
         if self._teardown_done:
@@ -330,17 +443,68 @@ class CompiledDAGRef:
     """Result handle for one execute() round (reference returns a Channel-
     backed ref the caller begin_read/end_reads)."""
 
-    def __init__(self, dag: CompiledDAG):
-        self._dag = dag
-        self._consumed = False
+    # Health-check cadence while waiting on an output channel: a dead stage
+    # is reported within this window, not at the (possibly much later) read
+    # deadline.
+    _HEALTH_POLL_S = 2.0
 
-    def get(self, timeout: Optional[float] = 60.0):
+    _UNSET = object()  # get(timeout=None) must still mean "wait forever"
+
+    def __init__(self, dag: CompiledDAG, timeout: Optional[float] = 60.0):
+        self._dag = dag
+        self._timeout = timeout
+        self._consumed = False
+        # Outputs already read by a get() attempt that later timed out on a
+        # SIBLING channel — a retry must not re-read their seqs.
+        self._partial: List[Any] = []
+
+    def _read(self, ch, timeout: Optional[float]):
+        """Channel read in health-check slices: a stage host dying mid-round
+        leaves its output channels silent forever — surface THAT (stage
+        death) instead of the bare TimeoutError the caller would otherwise
+        misread as slowness."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if deadline is None:
+                slice_s = self._HEALTH_POLL_S
+            else:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self._dag.check_stage_health()
+                    raise TimeoutError("compiled DAG output read timed out")
+                slice_s = min(self._HEALTH_POLL_S, remaining)
+            try:
+                return ch.read(slice_s)
+            except TimeoutError:
+                self._dag.check_stage_health()
+            except ConnectionError:
+                # TCP edge: a killed stage host closes its sockets, so the
+                # death arrives as a peer-closed error, not a timeout —
+                # diagnose it the same way before surfacing.
+                self._dag.check_stage_health()
+                raise
+
+    def get(self, timeout=_UNSET):
+        """Collect this round's outputs. Omitted `timeout` uses the
+        execute()-time default; an explicit value overrides it, and
+        timeout=None keeps its old meaning of "wait forever". A stage
+        exception raised during the round re-raises here; a dead stage host
+        raises a stage-death RuntimeError. The ref is consumed only on
+        success, so a timed-out get() may be retried."""
         if self._consumed:
             raise RuntimeError("CompiledDAGRef already consumed")
+        timeout = self._timeout if timeout is self._UNSET else timeout
+        results = self._partial
+        for ch in self._dag._output_channels[len(results):]:
+            try:
+                results.append(self._read(ch, timeout))
+            except ChannelClosed:
+                self._dag.check_stage_health()
+                raise
         self._consumed = True
-        results = []
-        for ch in self._dag._output_channels:
-            results.append(ch.read(timeout))
+        for r in results:
+            if isinstance(r, StageError):
+                r.raise_()
         single = len(results) == 1 and not isinstance(
             self._dag._outputs[0], MultiOutputNode
         )

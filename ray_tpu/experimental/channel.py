@@ -16,6 +16,18 @@ Header layout (little-endian u64s):
 Each reader owns a distinct ack slot and writes its *absolute* last-read seq
 (idempotent store, no read-modify-write) — concurrent acks from readers in
 different processes cannot race.
+
+Growth: a payload larger than the buffer used to fail the write outright
+(the compiled-DAG 1 MiB default was a hard ceiling). Channels are now
+growable by default: the writer allocates a fresh, larger segment, announces
+it with a RELOCATE message (flag 2, payload = new segment name) through the
+old segment, waits for every reader slot to ack the relocation, then
+publishes the oversized payload in the new segment (sequence numbers restart
+at 0 there — both sides reset together, so the seqlock protocol is
+unchanged). Readers follow the forward pointer transparently inside
+begin_read. The relocated-from segment is unlinked by its owner (writer if
+it created it, else the creator's destroy()/resource tracker); grown
+segments are owned by the writer process that created them.
 """
 
 from __future__ import annotations
@@ -27,6 +39,7 @@ from multiprocessing import shared_memory
 from typing import Any, Optional
 
 _FLAG_STOP = 1
+_FLAG_RELOC = 2
 
 
 class ChannelClosed(Exception):
@@ -42,9 +55,11 @@ class Channel:
         create: bool = True,
         num_readers: int = 1,
         reader_slot: int = 0,
+        growable: bool = True,
     ):
         self.num_readers = num_readers
         self.reader_slot = reader_slot
+        self.growable = growable
         self._header = 24 + 8 * num_readers
         if create:
             # Creator stays tracker-registered: unlink() (ours in destroy(),
@@ -95,6 +110,7 @@ class Channel:
         ch = Channel.__new__(Channel)
         ch.num_readers = self.num_readers
         ch.reader_slot = slot
+        ch.growable = self.growable
         ch._header = self._header
         ch._shm = self._shm
         ch._owner = False
@@ -119,11 +135,13 @@ class Channel:
 
     def _write_payload(self, payload: bytes, flag: int, timeout: Optional[float]):
         if len(payload) > len(self._shm.buf) - self._header:
-            raise ValueError(
-                f"Serialized value ({len(payload)}B) exceeds channel buffer "
-                f"({len(self._shm.buf) - self._header}B); recreate the DAG "
-                "with a larger _buffer_size_bytes"
-            )
+            if not self.growable or flag != 0:
+                raise ValueError(
+                    f"Serialized value ({len(payload)}B) exceeds channel buffer "
+                    f"({len(self._shm.buf) - self._header}B); recreate the DAG "
+                    "with a larger _buffer_size_bytes"
+                )
+            self._relocate(len(payload), timeout)
         if self._native is not None:
             timeout_us = -1 if timeout is None else int(timeout * 1e6)
             rc = self._native.rtpu_ch_write(
@@ -145,39 +163,125 @@ class Channel:
         self._set(16, flag)
         self._set(0, seq + 1)  # publish
 
+    def _relocate(self, needed: int, timeout: Optional[float]):
+        """Grow-on-demand: allocate a larger segment, forward every reader to
+        it via a RELOCATE message through the old one, then retire the old
+        segment. Called with the writer role only (single writer per edge).
+        Readers must all ack the relocation before the writer switches —
+        afterwards both sides restart the seqlock at seq 0 in the new
+        segment, so ordering is preserved without any cross-segment state."""
+        old_cap = len(self._shm.buf) - self._header
+        # 1.25x headroom so a steady stream of same-sized payloads relocates
+        # once, not per message as pickle overhead fluctuates.
+        new_cap = max(needed + needed // 4, 2 * old_cap)
+        new_shm = shared_memory.SharedMemory(
+            create=True, size=self._header + new_cap
+        )
+        new_shm.buf[: self._header] = b"\0" * self._header
+        try:
+            self._write_payload(pickle.dumps(new_shm.name), _FLAG_RELOC, timeout)
+            # Every reader slot must observe the forward pointer before the
+            # old segment is retired (their ack lands in the OLD header).
+            seq = self._get(0)
+            deadline = None if timeout is None else time.monotonic() + timeout
+            while self._min_ack() < seq:
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError(
+                        "channel relocate blocked: readers lagging"
+                    )
+                time.sleep(0.0005)
+        except BaseException:
+            new_shm.close()
+            try:
+                new_shm.unlink()
+            except OSError:
+                pass
+            raise
+        old, was_owner = self._shm, self._owner
+        # The old MAPPING is retired, never closed here: sibling views in
+        # this process (other reader slots, the driver's teardown handle)
+        # share the SharedMemory object, and native reads may be mid-flight
+        # against its address. Unlinking by name is safe while mapped; the
+        # pages free when the last attachment closes (destroy/exit).
+        # Retained mappings are bounded by the geometric growth (< 2x the
+        # final size across all relocations).
+        self._retired_shms().append(old)
+        self._shm = new_shm
+        self._owner = True  # this process created the grown segment
+        self._bind_native()
+        if was_owner:
+            try:
+                old.unlink()
+            except OSError:
+                pass
+        # else: the creating process's destroy()/resource tracker unlinks it.
+
+    def _follow_relocation(self):
+        """Reader side of _relocate: attach the new segment named in the
+        RELOCATE payload, ack in the old one (releasing the writer), and
+        restart this reader's sequence counter for the fresh header."""
+        length = self._get(8)
+        new_name = pickle.loads(
+            self._shm.buf[self._header : self._header + length]
+        )
+        self._ack()
+        old, was_owner = self._shm, self._owner
+        self._retired_shms().append(old)  # see _relocate: never close here
+        self._shm = _attach_untracked(new_name)
+        self._owner = False
+        self._last_read_seq = 0
+        self._bind_native()
+        if was_owner:
+            # The reader created the original segment (driver-made channel
+            # whose writer lives in an actor): retiring it here balances the
+            # creation-time tracker registration.
+            try:
+                old.unlink()
+            except OSError:
+                pass
+
     # --------------------------------------------------------------- read
     def begin_read(self, timeout: Optional[float] = None) -> Any:
         """Block until the next message; returns the deserialized value.
-        Caller must `end_read()` when done with it."""
-        if self._native is not None:
-            import ctypes
-
-            out_len = ctypes.c_uint64()
-            out_flag = ctypes.c_uint64()
-            timeout_us = -1 if timeout is None else int(timeout * 1e6)
-            rc = self._native.rtpu_ch_wait_read(
-                self._base_addr, self._last_read_seq,
-                ctypes.byref(out_len), ctypes.byref(out_flag), timeout_us,
+        Caller must `end_read()` when done with it. RELOCATE messages are
+        consumed internally (the reader re-attaches to the grown segment and
+        keeps waiting for the actual payload)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            remaining = (
+                None if deadline is None
+                else max(deadline - time.monotonic(), 0.0)
             )
-            if rc == -1:
-                raise TimeoutError("channel read timed out")
-            self._last_read_seq += 1
-            if out_flag.value == _FLAG_STOP:
+            if self._native is not None:
+                import ctypes
+
+                out_len = ctypes.c_uint64()
+                out_flag = ctypes.c_uint64()
+                timeout_us = -1 if remaining is None else int(remaining * 1e6)
+                rc = self._native.rtpu_ch_wait_read(
+                    self._base_addr, self._last_read_seq,
+                    ctypes.byref(out_len), ctypes.byref(out_flag), timeout_us,
+                )
+                if rc == -1:
+                    raise TimeoutError("channel read timed out")
+                self._last_read_seq += 1
+                flag, length = out_flag.value, out_len.value
+            else:
+                while self._get(0) <= self._last_read_seq:
+                    if deadline is not None and time.monotonic() > deadline:
+                        raise TimeoutError("channel read timed out")
+                    time.sleep(0.0005)
+                self._last_read_seq += 1
+                flag, length = self._get(16), self._get(8)
+            if flag == _FLAG_STOP:
                 self._ack()
                 raise ChannelClosed
-            length = out_len.value
-            return pickle.loads(self._shm.buf[self._header : self._header + length])
-        deadline = None if timeout is None else time.monotonic() + timeout
-        while self._get(0) <= self._last_read_seq:
-            if deadline is not None and time.monotonic() > deadline:
-                raise TimeoutError("channel read timed out")
-            time.sleep(0.0005)
-        self._last_read_seq += 1
-        if self._get(16) == _FLAG_STOP:
-            self._ack()
-            raise ChannelClosed
-        length = self._get(8)
-        return pickle.loads(self._shm.buf[self._header : self._header + length])
+            if flag == _FLAG_RELOC:
+                self._follow_relocation()
+                continue
+            return pickle.loads(
+                self._shm.buf[self._header : self._header + length]
+            )
 
     def end_read(self):
         self._ack()
@@ -198,6 +302,11 @@ class Channel:
         self.end_read()
         return value
 
+    def _retired_shms(self) -> list:
+        if not hasattr(self, "_retired"):
+            self._retired = []
+        return self._retired
+
     # ---------------------------------------------------------- lifecycle
     def close_writer(self):
         """Send the stop sentinel; readers raise ChannelClosed."""
@@ -207,6 +316,12 @@ class Channel:
             pass
 
     def destroy(self):
+        for shm in self._retired_shms():
+            try:
+                shm.close()
+            except Exception:  # noqa: BLE001
+                pass
+        self._retired = []
         try:
             self._shm.close()
             if self._owner:
@@ -217,12 +332,18 @@ class Channel:
     def __reduce__(self):
         # Re-attach on the other side. Readers inherit seq 0, so ship
         # channels BEFORE the first write (compiled DAGs do).
-        return (_attach_channel, (self.name, self.num_readers, self.reader_slot))
+        return (
+            _attach_channel,
+            (self.name, self.num_readers, self.reader_slot, self.growable),
+        )
 
 
-def _attach_channel(name: str, num_readers: int, reader_slot: int) -> "Channel":
+def _attach_channel(
+    name: str, num_readers: int, reader_slot: int, growable: bool = True
+) -> "Channel":
     return Channel(
-        name=name, create=False, num_readers=num_readers, reader_slot=reader_slot
+        name=name, create=False, num_readers=num_readers,
+        reader_slot=reader_slot, growable=growable,
     )
 
 

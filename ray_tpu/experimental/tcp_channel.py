@@ -192,6 +192,13 @@ class TcpChannel:
         self.reader_slot = reader_slot
         self._sock: Optional[socket.socket] = None
         self._last_read_seq = 0
+        # Resumable-read state: bytes already received of the in-progress
+        # header/payload, kept across a TimeoutError so a retried
+        # begin_read (CompiledDAGRef.get's health-poll slices, or a caller
+        # retrying a timed-out get) CONTINUES the stream instead of parsing
+        # mid-payload bytes as a fresh header and desyncing the channel.
+        self._rxbuf = bytearray()
+        self._rxhdr: Optional[Tuple[int, int, int]] = None
 
     # ------------------------------------------------------------- writer
     @classmethod
@@ -236,18 +243,44 @@ class TcpChannel:
             self._sock = sock
         return self._sock
 
+    def _fill(self, sock: socket.socket, need: int, deadline: Optional[float]):
+        """Append to _rxbuf until it holds `need` bytes; on timeout the
+        partial bytes are KEPT for the next attempt."""
+        while len(self._rxbuf) < need:
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError("tcp channel read timed out")
+                sock.settimeout(remaining)
+            try:
+                b = sock.recv(min(1 << 20, need - len(self._rxbuf)))
+            except socket.timeout as e:
+                raise TimeoutError("tcp channel read timed out") from e
+            if not b:
+                raise ConnectionError("tcp channel peer closed")
+            self._rxbuf.extend(b)
+
     def begin_read(self, timeout: Optional[float] = None) -> Any:
         sock = self._connect()
-        sock.settimeout(timeout)
+        deadline = None if timeout is None else time.monotonic() + timeout
         try:
-            seq, flag, length = _HDR.unpack(_recv_exact(sock, _HDR.size))
-            self._last_read_seq = seq
+            if self._rxhdr is None:
+                self._fill(sock, _HDR.size, deadline)
+                self._rxhdr = _HDR.unpack(bytes(self._rxbuf[: _HDR.size]))
+                del self._rxbuf[: _HDR.size]
+            seq, flag, length = self._rxhdr
             if flag == _FLAG_STOP:
+                self._last_read_seq = seq
+                self._rxhdr = None
                 self.end_read()
                 raise ChannelClosed
-            payload = _recv_exact(sock, length)
-        except socket.timeout as e:
-            raise TimeoutError("tcp channel read timed out") from e
+            self._fill(sock, length, deadline)
+            payload = bytes(self._rxbuf[:length])
+            del self._rxbuf[:length]
+            # Acked state advances only once the message is fully consumed
+            # — a timeout mid-payload must not let end_read() ack it.
+            self._last_read_seq = seq
+            self._rxhdr = None
         finally:
             sock.settimeout(None)
         return pickle.loads(payload)

@@ -593,6 +593,98 @@ def stage_forward(
     return logits, aux_stack.sum()
 
 
+def check_mpmd_partitionable(cfg: GPTConfig, num_stages: int) -> None:
+    """Constraints of the MPMD stage split (each stage a SEPARATE jit
+    program on its own gang actor — `ray_tpu.train.mpmd`):
+
+    * layers must divide evenly into stages (same rule as in-mesh GPipe);
+    * embeddings must be UNTIED: with tying, tok_embed lives on the first
+      AND last stage, its gradient splits across two hosts, and the two
+      copies would drift apart under independent updates (Megatron bridges
+      this with a dedicated first/last-stage allreduce — not composed yet);
+    * MoE is not composed yet: the router aux loss is stage-local and the
+      reported loss would silently omit upstream stages' aux terms.
+    """
+    if num_stages < 1:
+        raise ValueError(f"num_stages must be >= 1, got {num_stages}")
+    if cfg.n_layers % num_stages != 0:
+        raise ValueError(
+            f"{cfg.n_layers} layers not divisible by {num_stages} stages"
+        )
+    if num_stages > 1 and cfg.tie_embeddings:
+        raise ValueError(
+            "MPMD pipeline stages need untied embeddings (tie_embeddings="
+            "False): tied tok_embed spans the first and last stage and its "
+            "gradient cannot be combined across separate jit programs"
+        )
+    if cfg.mlp_type == "moe":
+        raise NotImplementedError(
+            "MPMD stages do not carry the MoE aux loss across hosts yet"
+        )
+
+
+def make_mpmd_stage_fns(cfg: GPTConfig, stage: int, num_stages: int) -> Dict[str, Callable]:
+    """Pure per-stage training functions for the MPMD pipeline (arXiv
+    2412.14374 shape: stages as separate jit programs, the host-side 1F1B
+    schedule moving activations/grads between them).
+
+    Returned callables (jit them at the call site; all take the stage's
+    param subset from `extract_stage_params`):
+
+    * ``fwd(params, x) -> y`` — forward only. x is tokens [B, S] on the
+      first stage, activations [B, S, E] elsewhere; y is the activation
+      this stage ships downstream (logits on the last stage).
+    * non-last stages: ``fwd_bwd(params, x, gy) -> (param_grads, gx)`` —
+      backward via jax.vjp with the forward RECOMPUTED from the saved
+      stage input (activation recomputation: the 1F1B runner stores only
+      each in-flight microbatch's stage INPUT, the memory shape that makes
+      deep pipelines fit). On the first stage gx is None (tokens).
+    * last stage: ``loss_bwd(params, x, targets, mask) -> (loss,
+      param_grads, gx)`` — next-token CE in f32, grads wrt params and the
+      incoming activation.
+    """
+    check_mpmd_partitionable(cfg, num_stages)
+    first, last = stage == 0, stage == num_stages - 1
+
+    def _fwd(p, x):
+        y, _aux = stage_forward(p, x, cfg, first=first, last=last)
+        return y
+
+    fns: Dict[str, Callable] = {"fwd": _fwd}
+    if last:
+        def _loss(p, x, targets, mask):
+            logits, _aux = stage_forward(p, x, cfg, first=first, last=True)
+            return _ce_loss(logits, targets, mask)
+
+        if first:  # S == 1 degenerate pipeline: input is tokens, no gx
+            def loss_bwd(p, x, targets, mask=None):
+                loss, gp = jax.value_and_grad(_loss)(p, x, targets, mask)
+                return loss, gp, None
+        else:
+            def loss_bwd(p, x, targets, mask=None):
+                loss, (gp, gx) = jax.value_and_grad(_loss, argnums=(0, 1))(
+                    p, x, targets, mask
+                )
+                return loss, gp, gx
+
+        fns["loss_bwd"] = loss_bwd
+    else:
+        if first:
+            def fwd_bwd(p, x, gy):
+                # Tokens are integers — differentiate wrt params only.
+                _y, vjp = jax.vjp(lambda p_: _fwd(p_, x), p)
+                (gp,) = vjp(gy)
+                return gp, None
+        else:
+            def fwd_bwd(p, x, gy):
+                _y, vjp = jax.vjp(_fwd, p, x)
+                gp, gx = vjp(gy)
+                return gp, gx
+
+        fns["fwd_bwd"] = fwd_bwd
+    return fns
+
+
 def pipeline_stage_shardings(cfg: GPTConfig, mesh, rules: Optional[ShardingRules] = None):
     """Param shardings for the stage-split layout: layer arrays gain a
     leading `stage` dim (→ pp); the rest match param_shardings."""

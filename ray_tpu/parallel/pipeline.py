@@ -9,7 +9,13 @@ schedule emerges automatically from jax AD transposing the forward scan
 (ppermute's transpose is the reverse ppermute). No host-side scheduling, no
 channel round-trips, no NCCL.
 
-Cross-host pipelines over DCN use `ray_tpu.dag.CompiledDAG` channels instead.
+Cross-host pipelines over DCN use the compiled-DAG channel planes instead:
+`ray_tpu.train.mpmd` runs each stage as a SEPARATE jit program on its own
+gang actor with a host-side 1F1B schedule (same `stage_fn(params, act)`
+shape as `make_gpipe_fn` takes here), which is the path that composes with
+per-stage data parallelism + ZeRO sharded updates and elastic reshapes.
+This in-jit GPipe remains the single-program baseline the MPMD parity gate
+measures against (tests/test_train_mpmd.py).
 """
 
 from __future__ import annotations

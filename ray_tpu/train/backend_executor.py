@@ -15,6 +15,13 @@ deadline (collectives interrupted, no wedged barrier), decides
 restart/shrink/stop against its budget + backoff, and the gang re-forms —
 restoring from the latest committed checkpoint with the elasticity band
 applied to the new world size.
+
+The MPMD pipeline trainer (`ray_tpu.train.mpmd.trainer`) runs the same
+supervisor-verdict loop for its S x dp stage gang — watch -> abort (every
+stage's collective group) -> budget/backoff -> reshape (dp re-picked from
+feasible capacity) -> restore from the pipeline's common committed step —
+with per-stage checkpoint directories instead of this executor's single
+gang root.
 """
 
 from __future__ import annotations

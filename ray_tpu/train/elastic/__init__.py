@@ -33,7 +33,13 @@ import os
 import tempfile
 from typing import Any, Dict, Optional
 
-from .ckpt import AsyncShardWriter, ShardedCheckpoint, COMMIT_MARKER
+from .ckpt import (
+    AsyncShardWriter,
+    COMMIT_MARKER,
+    ShardedCheckpoint,
+    latest_common_committed,
+    stage_root,
+)
 from .state import ElasticState
 from .supervisor import DEATH_EVENT_KINDS, GangSupervisor, RestartDecision
 
@@ -191,6 +197,8 @@ __all__ = [
     "AsyncShardWriter",
     "ShardedCheckpoint",
     "COMMIT_MARKER",
+    "stage_root",
+    "latest_common_committed",
     "ElasticState",
     "ElasticSession",
     "elastic_session",

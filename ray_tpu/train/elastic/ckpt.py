@@ -48,6 +48,40 @@ def step_dir_name(step: int, gen: str) -> str:
     return f"step_{step:08d}.{gen}"
 
 
+def stage_root(root: str, stage: int) -> str:
+    """Per-stage checkpoint directory of an MPMD pipeline run: each stage's
+    dp replicas write their shards (rank = dp index, world = dp) under
+    `<root>/stage_NN/`, so the existing axis-0 reshard machinery applies
+    per stage when the pipeline reshapes to a different dp width."""
+    return os.path.join(root, f"stage_{stage:02d}")
+
+
+def latest_common_committed(root: str, num_stages: int):
+    """Newest step committed in EVERY stage directory — the only step the
+    whole pipeline can restore coherently. Per-stage group commits are
+    independent (a crash can land between stage commits), so the restore
+    point is the intersection of committed steps, not any one stage's
+    latest. Returns (step, [stage dirs]) or None."""
+    per_stage = []
+    for s in range(num_stages):
+        sroot = stage_root(root, s)
+        committed = {
+            step: path
+            for step, path in ShardedCheckpoint.list_checkpoints(sroot)
+            if os.path.exists(os.path.join(path, COMMIT_MARKER))
+        }
+        if not committed:
+            return None
+        per_stage.append(committed)
+    common = set(per_stage[0])
+    for committed in per_stage[1:]:
+        common &= set(committed)
+    if not common:
+        return None
+    step = max(common)
+    return step, [per_stage[s][step] for s in range(num_stages)]
+
+
 def _write_atomic(path: str, data: bytes, tmp: Optional[str] = None) -> None:
     """Write-fsync-rename. `tmp` must be unique per WRITER when several
     processes race to produce the same `path` (the group-commit marker):
@@ -166,13 +200,28 @@ class ShardedCheckpoint:
 
     @staticmethod
     def restore(
-        root: str, rank: int, world_size: int
+        root: str, rank: int, world_size: int, step: Optional[int] = None
     ) -> Optional[Tuple[ElasticState, Any]]:
         """Load the latest committed checkpoint for `rank` of a gang of
         `world_size`, resharding if the checkpoint was written by a gang of
-        a different size. Returns (state, tree) or None when no committed
-        checkpoint exists."""
-        found = ShardedCheckpoint.latest_committed(root)
+        a different size. With `step`, pin to that exact committed step
+        (the MPMD restore path: every stage must load the pipeline's COMMON
+        committed step, not its own latest). Returns (state, tree) or None
+        when no matching committed checkpoint exists."""
+        if step is None:
+            found = ShardedCheckpoint.latest_committed(root)
+        else:
+            found = next(
+                (
+                    (st, path)
+                    for st, path in reversed(
+                        ShardedCheckpoint.list_checkpoints(root)
+                    )
+                    if st == step
+                    and os.path.exists(os.path.join(path, COMMIT_MARKER))
+                ),
+                None,
+            )
         if found is None:
             return None
         _, ckpt_dir = found

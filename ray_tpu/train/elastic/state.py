@@ -75,3 +75,26 @@ class ElasticState:
         self.data_offsets[name] = (
             int(self.data_offsets.get(name, 0)) + int(consumed_global)
         )
+
+    # ------------------------------------------------------- MPMD pipeline
+    def record_pipeline(self, stage: int, num_stages: int) -> None:
+        """Stamp the pipeline position this shard belongs to. dp width is
+        deliberately NOT recorded as a constraint — reshapes change it and
+        the axis-0 reshard absorbs that — but the STAGE SPLIT must match on
+        restore: a stage-1-of-2 optimizer shard loaded into stage 1 of 3
+        would silently install the wrong slice of the model."""
+        self.extra["pipeline"] = {"stage": int(stage), "num_stages": int(num_stages)}
+
+    def check_pipeline(self, stage: int, num_stages: int) -> None:
+        got = self.extra.get("pipeline")
+        if got is None:
+            return  # pre-MPMD checkpoint: nothing to validate against
+        if (int(got.get("stage", -1)), int(got.get("num_stages", -1))) != (
+            int(stage), int(num_stages)
+        ):
+            raise ValueError(
+                f"checkpoint belongs to stage {got.get('stage')}/"
+                f"{got.get('num_stages')} but is being restored into stage "
+                f"{stage}/{num_stages} — stage splits cannot change across "
+                "a reshape (only dp width can)"
+            )

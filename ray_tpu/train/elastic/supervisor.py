@@ -202,10 +202,18 @@ class GangSupervisor:
         if self._collective_group:
             from ... import collective
 
-            collective.abort_collective_group(
-                self._collective_group,
-                timeout=self.failure_cfg.abort_deadline_s,
+            # One group (data-parallel gang) or a list of them (MPMD: one
+            # dp group per pipeline stage) — every group a surviving member
+            # could be blocked in gets aborted.
+            groups = (
+                self._collective_group
+                if isinstance(self._collective_group, (list, tuple))
+                else [self._collective_group]
             )
+            for g in groups:
+                collective.abort_collective_group(
+                    g, timeout=self.failure_cfg.abort_deadline_s,
+                )
         if worker_group is not None:
             worker_group.shutdown()
         took = time.monotonic() - t0

@@ -1,0 +1,149 @@
+"""In-process MPMD pipeline: the REAL 1F1B interleaving on threads.
+
+One thread per (stage, dp-replica), queue edges, in-process dp collectives.
+This is the parity harness (losses/grads vs single-jit GPipe vs unpipelined
+on one CPU mesh, no cluster boot) and the deadlock gate for the schedule —
+the cluster trainer (`trainer.py`) swaps in gang actors, compiled-DAG
+channels, and the object-store collectives around the SAME StageRunner.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from .stage import StageRunner
+from .transport import LocalEdge
+from .zero import make_local_comms
+
+
+def run_local_pipeline(
+    cfg,
+    num_stages: int,
+    dp: int,
+    num_microbatches: int,
+    batches: List[np.ndarray],
+    *,
+    params=None,
+    seed: int = 0,
+    zero: bool = True,
+    lr: float = 1e-3,
+    betas=(0.9, 0.95),
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    step_timeout_s: float = 120.0,
+    on_step: Optional[Callable[[int], None]] = None,
+) -> Dict[str, Any]:
+    """Train over `batches` (each [B, S+1] int tokens, B divisible by
+    dp * num_microbatches) and return {"history": per-step driver metrics,
+    "params": final full param tree (host), "runners": the stage runners}.
+    """
+    import jax
+
+    from ...models import gpt
+
+    gpt.check_mpmd_partitionable(cfg, num_stages)
+    if params is None:
+        params = gpt.init_params(jax.random.PRNGKey(seed), cfg)
+    params_np = jax.tree_util.tree_map(np.asarray, params)
+
+    runners: List[List[StageRunner]] = []
+    for s in range(num_stages):
+        comms = make_local_comms(dp)
+        stage_params = gpt.extract_stage_params(params_np, cfg, s, num_stages)
+        runners.append([
+            StageRunner(
+                cfg, s, num_stages, num_microbatches, stage_params,
+                comms[r], zero=zero, lr=lr, betas=betas, eps=eps,
+                weight_decay=weight_decay,
+            )
+            for r in range(dp)
+        ])
+    for s in range(num_stages - 1):
+        for r in range(dp):
+            fwd = LocalEdge(timeout_s=step_timeout_s)
+            bwd = LocalEdge(timeout_s=step_timeout_s)
+            runners[s][r].bind_edges(
+                fwd_in=runners[s][r].fwd_in, fwd_out=fwd,
+                bwd_in=bwd, bwd_out=runners[s][r].bwd_out,
+            )
+            runners[s + 1][r].bind_edges(
+                fwd_in=fwd, fwd_out=runners[s + 1][r].fwd_out,
+                bwd_in=runners[s + 1][r].bwd_in, bwd_out=bwd,
+            )
+
+    results: Dict[tuple, List[Dict[str, Any]]] = {}
+    errors: List[BaseException] = []
+
+    def worker(s: int, r: int):
+        try:
+            out = []
+            for step, batch in enumerate(batches):
+                sl = None
+                if s == 0 or s == num_stages - 1:
+                    sl = np.array_split(np.asarray(batch), dp)[r]
+                out.append(runners[s][r].run_step(sl))
+                if on_step is not None and s == 0 and r == 0:
+                    on_step(step)
+            results[(s, r)] = out
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=worker, args=(s, r), daemon=True)
+        for s in range(num_stages) for r in range(dp)
+    ]
+    for t in threads:
+        t.start()
+    deadline = step_timeout_s * max(1, len(batches))
+    for t in threads:
+        t.join(timeout=deadline)
+        if t.is_alive():
+            raise RuntimeError(
+                "local MPMD pipeline wedged (schedule deadlock or a dead "
+                f"sibling thread); errors so far: {errors!r}"
+            )
+    if errors:
+        raise errors[0]
+
+    history: List[Dict[str, Any]] = []
+    for step in range(len(batches)):
+        last = [results[(num_stages - 1, r)][step] for r in range(dp)]
+        per_stage = [results[(s, 0)][step] for s in range(num_stages)]
+        history.append({
+            "step": step + 1,
+            "loss": float(np.mean([m["loss"] for m in last])),
+            "grad_norm": float(
+                np.sqrt(sum(m["grad_sumsq"] for m in per_stage))
+            ),
+            "busy_s": sum(
+                results[(s, r)][step]["busy_s"]
+                for s in range(num_stages) for r in range(dp)
+            ),
+            "opt_bytes_per_replica": max(
+                m["opt_bytes"] for m in per_stage
+            ),
+        })
+
+    # Reassemble the full model tree from stage 0/last replicas (replicas
+    # are identical post-update by the all-gather contract).
+    merged: Dict[str, np.ndarray] = {}
+    layer_parts: Dict[str, List[np.ndarray]] = {}
+    for s in range(num_stages):
+        tree = runners[s][0].params_host()
+        for k, v in tree.items():
+            if k in gpt_layer_keys():
+                layer_parts.setdefault(k, []).append(np.asarray(v))
+            else:
+                merged.setdefault(k, np.asarray(v))
+    for k, parts in layer_parts.items():
+        merged[k] = np.concatenate(parts, axis=0)
+    return {"history": history, "params": merged, "runners": runners}
+
+
+def gpt_layer_keys():
+    from ...models.gpt import _LAYER_KEYS
+
+    return _LAYER_KEYS

@@ -1,0 +1,60 @@
+"""1F1B pipeline schedule (host-side, per stage).
+
+The MPMD pipeline runs the classic one-forward-one-backward order
+(PipeDream-flush / Megatron "1F1B"): stage s of S warms up with
+min(M, S-1-s) forwards, then alternates F/B in steady state, then drains
+the remaining backwards. Peak in-flight microbatches at stage s is
+S - s (vs M for GPipe), which is what bounds the saved-activation memory —
+the runner stores only each in-flight microbatch's stage INPUT and
+recomputes the forward inside backward (`models/gpt.make_mpmd_stage_fns`).
+
+The schedule is a plain per-stage op list computed up front: deterministic,
+no cross-host coordination beyond the activation/grad channels themselves.
+With depth-1 channels (the compiled-DAG seqlock edges) the interleaving is
+deadlock-free: a stage's k-th write is acked by the consumer's k-th read,
+and 1F1B orders every stage's reads/writes so each blocks only on work the
+neighbor performs earlier in its own list (exercised across (S, M) shapes
+in tests/test_train_mpmd.py).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+# Op kinds: ("F", mb) = forward microbatch mb (recv activation / take input
+# slice, compute, send downstream); ("B", mb) = backward microbatch mb
+# (recv grad / compute loss grad, compute, send upstream, accumulate).
+F = "F"
+B = "B"
+
+
+def build_1f1b(stage: int, num_stages: int, num_microbatches: int) -> List[Tuple[str, int]]:
+    """The op sequence stage `stage` executes for one training step."""
+    S, M, s = num_stages, num_microbatches, stage
+    if not 0 <= s < S:
+        raise ValueError(f"stage {s} out of range for {S} stages")
+    if M < 1:
+        raise ValueError(f"num_microbatches must be >= 1, got {M}")
+    warmup = min(M, S - 1 - s)
+    ops: List[Tuple[str, int]] = [(F, i) for i in range(warmup)]
+    f, b = warmup, 0
+    while f < M or b < M:
+        if f < M:
+            ops.append((F, f))
+            f += 1
+        if b < M:
+            ops.append((B, b))
+            b += 1
+    return ops
+
+
+def max_in_flight(stage: int, num_stages: int, num_microbatches: int) -> int:
+    """Peak number of microbatches whose stage input is saved at once —
+    the 1F1B memory bound (min(M, S - stage))."""
+    return min(num_microbatches, num_stages - stage)
+
+
+def theoretical_bubble_fraction(num_stages: int, num_microbatches: int) -> float:
+    """Ideal pipeline bubble for equal-cost stages: (S-1) / (M + S - 1)."""
+    S, M = num_stages, num_microbatches
+    return (S - 1) / (M + S - 1)

@@ -1,0 +1,215 @@
+"""StageRunner — one pipeline-stage replica's training loop body.
+
+Process-agnostic: the cluster trainer hosts one of these per gang actor
+(edges = compiled-DAG channels, comm = host-plane collectives), the local
+runner hosts them on threads (queue edges, in-process comm). Each runner
+owns ONE stage's jit programs — MPMD: S stages compile S different
+programs, nothing here is shard_mapped over a pp axis.
+
+Per step (`run_step`): execute the 1F1B op list; accumulate this replica's
+stage gradients on device; then the ZeRO update — reduce-scatter the flat
+gradient across the stage's dp group, update this replica's optimizer-state
+chunk, all-gather the updated parameters (zero=False swaps in the
+replicated-state baseline with the identical gradient reduction).
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ...collective.ops import zero_flatten, zero_unflatten
+from ..elastic.state import ElasticState
+from .schedule import B, F, build_1f1b
+from .zero import ReplicatedAdamW, ShardedAdamW, SoloComm
+
+
+@functools.lru_cache(maxsize=64)
+def _jit_stage_fns(cfg, stage: int, num_stages: int) -> Dict[str, Any]:
+    """Process-cached jitted stage programs: GPTConfig is a frozen
+    (hashable) dataclass, so two runners for the same (cfg, stage, split)
+    — a re-spawned incarnation, a second pipeline in the parity tests —
+    share compilations instead of re-tracing fresh closures."""
+    import jax
+
+    from ...models import gpt
+
+    fns = gpt.make_mpmd_stage_fns(cfg, stage, num_stages)
+    return {name: jax.jit(fn) for name, fn in fns.items()}
+
+
+@functools.lru_cache(maxsize=1)
+def _acc_jit():
+    import jax
+
+    return jax.jit(
+        lambda a, b: jax.tree_util.tree_map(lambda x, y: x + y, a, b)
+    )
+
+
+class StageRunner:
+    def __init__(
+        self,
+        cfg,
+        stage: int,
+        num_stages: int,
+        num_microbatches: int,
+        stage_params,
+        comm=None,
+        *,
+        zero: bool = True,
+        lr: float = 1e-3,
+        betas=(0.9, 0.95),
+        eps: float = 1e-8,
+        weight_decay: float = 0.0,
+    ):
+        import jax
+
+        self.cfg = cfg
+        self.stage = stage
+        self.num_stages = num_stages
+        self.num_microbatches = num_microbatches
+        self.first = stage == 0
+        self.last = stage == num_stages - 1
+        self.comm = comm or SoloComm()
+        self.zero = zero
+
+        fns = _jit_stage_fns(cfg, stage, num_stages)
+        self._fwd = fns["fwd"]
+        self._fwd_bwd = fns.get("fwd_bwd")
+        self._loss_bwd = fns.get("loss_bwd")
+        self._acc = _acc_jit()
+
+        flat, self._spec = zero_flatten(stage_params)
+        opt_cls = ShardedAdamW if zero else ReplicatedAdamW
+        self.opt = opt_cls(
+            flat, self.comm, lr=lr, betas=betas, eps=eps,
+            weight_decay=weight_decay,
+        )
+        self.params = jax.device_put(zero_unflatten(flat, self._spec))
+        self.state = ElasticState()
+        # Edges (bind_edges): None where the pipeline boundary is.
+        self.fwd_in = self.fwd_out = self.bwd_in = self.bwd_out = None
+        self.last_busy_s = 0.0
+        self.last_update_s = 0.0
+
+    # ---------------------------------------------------------------- wiring
+    def bind_edges(self, fwd_in=None, fwd_out=None, bwd_in=None, bwd_out=None):
+        self.fwd_in, self.fwd_out = fwd_in, fwd_out
+        self.bwd_in, self.bwd_out = bwd_in, bwd_out
+
+    # ------------------------------------------------------------------ step
+    def run_step(self, tokens: Optional[np.ndarray]) -> Dict[str, Any]:
+        """One training step over this replica's batch slice. `tokens`
+        [b, S+1] feeds the first stage's inputs and the last stage's
+        targets (both when S == 1); interior stages take None."""
+        import jax
+        import jax.numpy as jnp
+
+        M = self.num_microbatches
+        inputs = targets = None
+        if self.first or self.last:
+            if tokens is None:
+                raise ValueError(
+                    f"stage {self.stage} is a pipeline boundary and needs "
+                    "the batch slice"
+                )
+            tokens = np.asarray(tokens)
+            b = tokens.shape[0]
+            if b % M != 0:
+                raise ValueError(
+                    f"replica batch {b} not divisible by {M} microbatches"
+                )
+            mb = b // M
+            if self.first:
+                inputs = tokens[:, :-1].reshape(M, mb, -1)
+            if self.last:
+                targets = tokens[:, 1:].reshape(M, mb, -1)
+
+        saved: Dict[int, Any] = {}
+        acc = None
+        losses: List[float] = []
+        busy = 0.0
+        for op, i in build_1f1b(self.stage, self.num_stages, M):
+            if op == F:
+                if self.first:
+                    x = jnp.asarray(inputs[i])
+                else:
+                    x = jnp.asarray(self.fwd_in.recv())
+                saved[i] = x
+                if not self.last:
+                    t0 = time.monotonic()
+                    y = self._fwd(self.params, x)
+                    y.block_until_ready()
+                    busy += time.monotonic() - t0
+                    self.fwd_out.send(np.asarray(y))
+                # Last stage: loss + backward run together at the B op.
+            else:
+                assert op == B
+                x = saved.pop(i)
+                if self.last:
+                    t0 = time.monotonic()
+                    loss, gp, gx = self._loss_bwd(
+                        self.params, x, jnp.asarray(targets[i])
+                    )
+                    jax.block_until_ready(gp)
+                    busy += time.monotonic() - t0
+                    losses.append(float(loss))
+                else:
+                    gy = jnp.asarray(self.bwd_in.recv())
+                    t0 = time.monotonic()
+                    gp, gx = self._fwd_bwd(self.params, x, gy)
+                    jax.block_until_ready(gp)
+                    busy += time.monotonic() - t0
+                if not self.first:
+                    self.bwd_out.send(np.asarray(gx))
+                acc = gp if acc is None else self._acc(acc, gp)
+
+        # Mean over microbatches (loss = mean of equal-size microbatch
+        # means), then the dp-sharded update.
+        t0 = time.monotonic()
+        flat_g, _ = zero_flatten(jax.tree_util.tree_map(np.asarray, acc))
+        flat_g = flat_g / np.float32(M)
+        new_flat, grad_sumsq = self.opt.step(flat_g)
+        self.params = jax.device_put(zero_unflatten(new_flat, self._spec))
+        self.last_update_s = time.monotonic() - t0
+        self.last_busy_s = busy
+        self.state.step += 1
+        out: Dict[str, Any] = {
+            "step": self.state.step,
+            "busy_s": busy,
+            "update_s": self.last_update_s,
+            "grad_sumsq": grad_sumsq,
+            "opt_bytes": self.opt.optimizer_bytes,
+        }
+        if self.last:
+            out["loss"] = float(np.mean(losses))
+        return out
+
+    # ------------------------------------------------------------ checkpoint
+    def ckpt_tree(self) -> Dict[str, np.ndarray]:
+        return self.opt.ckpt_tree()
+
+    def load_ckpt(self, state: ElasticState, tree: Dict[str, np.ndarray]):
+        """Adopt a restored optimizer shard (already resharded to this dp
+        layout by ShardedCheckpoint.restore) and rebuild the working
+        parameters from the gathered master chunks."""
+        import jax
+
+        self.state = state
+        self.opt.load_ckpt_tree(tree, t=int(state.extra.get("opt_t", state.step)))
+        self.params = jax.device_put(
+            zero_unflatten(self.opt.full_flat(), self._spec)
+        )
+
+    def params_host(self):
+        """Host copy of the full working parameters. Collective-free: the
+        working tree is already the all-gathered result of the last update
+        (calling into the optimizer here would be a stray collective that
+        only one caller runs — a wedge)."""
+        import jax
+
+        return jax.tree_util.tree_map(np.asarray, self.params)

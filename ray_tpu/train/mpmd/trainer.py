@@ -1,0 +1,555 @@
+"""MPMDTrainer — the cluster MPMD pipeline, composed with elastic.
+
+Topology: S stages x dp replicas = S*dp gang actors. Replica r of stage s
+pipes activations to replica r of stage s+1 (and grads back) over
+compiled-DAG edge channels (`dag.compiled.make_edge_channel`: shm seqlock
+on a shared node, persistent TCP across nodes), with bulk tensors riding
+arena segments + span pulls (`mpmd.transport`). Each stage's dp replicas
+form one host-plane collective group for the ZeRO update.
+
+Elastic composition (the PR 4 machinery, extended):
+  * the GangSupervisor watches ALL S*dp actors through the controller death
+    feed; any member death (or a failed step RPC) aborts the WHOLE mesh —
+    every stage collective group is aborted so no survivor waits out a
+    rendezvous round on a dead peer, then the actors are killed and the
+    channels destroyed;
+  * the restart policy (budget + backoff) is the supervisor's; after the
+    backoff the pipeline RESHAPES: dp is re-picked from currently-feasible
+    capacity within [dp_min, dp_max] (stage count S is fixed — stage splits
+    cannot change across a reshape, see ElasticState.check_pipeline);
+  * stage-local checkpoint shards (`elastic.stage_root` layout, one
+    AsyncShardWriter per replica with world=dp) restore at the pipeline's
+    COMMON committed step (`latest_common_committed`), resharding each
+    stage's flat optimizer chunks across the new dp width with the existing
+    axis-0 machinery. The step counter continues exactly where the commit
+    left it.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ...dag.compiled import ChannelHostMixin
+from ..config import FailureConfig, RunConfig, ScalingConfig
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class MPMDOptions:
+    num_stages: int = 2
+    dp: int = 1
+    dp_min: Optional[int] = None      # elasticity band for reshapes
+    dp_max: Optional[int] = None
+    num_microbatches: int = 2
+    zero: bool = True                 # ZeRO sharded update vs replicated
+    lr: float = 1e-3
+    betas: tuple = (0.9, 0.95)
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+    seed: int = 0
+    ckpt_every: int = 1               # steps between async stage saves
+    step_timeout_s: float = 120.0     # driver-side deadline per step RPC
+    channel_timeout_s: float = 120.0  # edge send/recv deadline in the actors
+    inline_max_bytes: int = 256 * 1024
+    channel_buffer_bytes: int = 1 << 20
+    num_cpus_per_replica: float = 1.0
+
+    def band(self) -> "tuple[int, int]":
+        hi = self.dp if self.dp_max is None else self.dp_max
+        lo = hi if self.dp_min is None else self.dp_min
+        return max(1, min(lo, hi)), hi
+
+
+class _StageReplica(ChannelHostMixin):
+    """Gang actor hosting one (stage, dp-rank) StageRunner. The channel
+    construction surface (node_id/bind_tcp_channel/create_shm_channel)
+    comes from the compiled-DAG mixin so `make_edge_channel` binds edges in
+    this process exactly as it does for DAG stage hosts."""
+
+    def __init__(self, payload: bytes):
+        import cloudpickle
+
+        self._opts = cloudpickle.loads(payload)
+        self._runner = None
+        self._writer = None
+
+    def pid(self) -> int:
+        import os
+
+        return os.getpid()
+
+    def setup(self, edges_payload: bytes, restore_step: Optional[int]) -> int:
+        """Join the stage dp group, build the runner, bind edges, restore.
+        Returns the step to resume from (0 on a fresh run)."""
+        import cloudpickle
+
+        from ... import collective
+        from ..elastic import AsyncShardWriter, ShardedCheckpoint
+        from .stage import StageRunner
+        from .transport import ActTransport, ChannelEdge
+        from .zero import SoloComm, StoreDpComm
+
+        o = self._opts
+        edges = cloudpickle.loads(edges_payload)
+        if o["dp"] > 1:
+            collective.init_collective_group(
+                o["dp"], o["dp_rank"], group_name=o["group_name"]
+            )
+            comm = StoreDpComm(o["group_name"], o["dp"], o["dp_rank"])
+        else:
+            comm = SoloComm()
+        cfg = o["cfg"]
+        # Only THIS stage's parameter slice ever lands in this process —
+        # the driver initialized the full tree once and shipped slices.
+        self._runner = StageRunner(
+            cfg, o["stage"], o["num_stages"], o["num_microbatches"],
+            o["stage_params"], comm, zero=o["zero"], lr=o["lr"],
+            betas=o["betas"], eps=o["eps"], weight_decay=o["weight_decay"],
+        )
+        transport = ActTransport(
+            inline_max_bytes=o["inline_max_bytes"],
+            timeout_s=o["channel_timeout_s"],
+        )
+        self._transport = transport
+
+        def edge(ch):
+            return (
+                ChannelEdge(ch, transport, timeout_s=o["channel_timeout_s"])
+                if ch is not None else None
+            )
+
+        self._runner.bind_edges(
+            fwd_in=edge(edges.get("fwd_in")),
+            fwd_out=edge(edges.get("fwd_out")),
+            bwd_in=edge(edges.get("bwd_in")),
+            bwd_out=edge(edges.get("bwd_out")),
+        )
+        self._writer = AsyncShardWriter(
+            o["stage_root"], o["dp_rank"], o["dp"], gen=o["gen"],
+            mode="sharded" if o["zero"] else "replicated",
+        )
+        if restore_step is not None:
+            found = ShardedCheckpoint.restore(
+                o["stage_root"], o["dp_rank"], o["dp"], step=restore_step
+            )
+            if found is None:
+                raise RuntimeError(
+                    f"stage {o['stage']} rank {o['dp_rank']}: committed "
+                    f"step {restore_step} vanished before restore"
+                )
+            state, tree = found
+            state.check_pipeline(o["stage"], o["num_stages"])
+            self._runner.load_ckpt(state, tree)
+        return self._runner.state.step
+
+    def run_step(self, tokens: Optional[np.ndarray], save: bool) -> Dict[str, Any]:
+        o = self._opts
+        metrics = self._runner.run_step(tokens)
+        if save:
+            st = self._runner.state
+            st.record_pipeline(o["stage"], o["num_stages"])
+            st.extra["opt_t"] = self._runner.opt.t
+            self._writer.save(st.step, self._runner.ckpt_tree(), st)
+        return metrics
+
+    def flush(self, timeout: float = 60.0) -> bool:
+        return self._writer.flush(timeout) if self._writer is not None else True
+
+    def transport_stats(self) -> Dict[str, int]:
+        t = getattr(self, "_transport", None)
+        return dict(t.stats) if t is not None else {}
+
+
+class _MPMDGang:
+    """The supervisor-facing gang shim: S*dp actors + their edges/groups."""
+
+    def __init__(self, actors, channels, groups):
+        self.actors = actors            # {(stage, rank): handle}
+        self.channels = channels
+        self.groups = groups
+
+    def actor_ids(self) -> List[str]:
+        return [a._id.hex() for a in self.actors.values()]
+
+    def shutdown(self):
+        from ...core import api
+        from ... import collective
+
+        for g in self.groups:
+            try:
+                collective.abort_collective_group(g, timeout=5.0)
+            except Exception:  # noqa: BLE001
+                pass
+        for a in self.actors.values():
+            try:
+                api.kill(a)
+            except Exception:  # noqa: BLE001
+                pass
+        for g in self.groups:
+            try:
+                collective.destroy_collective_group(g)
+            except Exception:  # noqa: BLE001
+                pass
+        for ch in self.channels:
+            try:
+                ch.destroy()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+class MPMDGangError(RuntimeError):
+    pass
+
+
+class MPMDTrainer:
+    """Drive an MPMD pipeline to `total_steps`, elastically.
+
+    `batch_fn(step) -> np.ndarray [B, S+1]` supplies the global token batch
+    for a step (deterministic in `step` for exact resume trajectories); B
+    must divide by dp_max * num_microbatches, and reshapes only ever pick
+    dp values that DIVIDE dp_max (`_pick_dp`), so every reachable width
+    shards it evenly.
+    """
+
+    def __init__(
+        self,
+        cfg,
+        options: MPMDOptions,
+        total_steps: int,
+        batch_fn: Callable[[int], np.ndarray],
+        run_config: Optional[RunConfig] = None,
+        experiment_name: str = "mpmd",
+    ):
+        from ...models import gpt
+
+        gpt.check_mpmd_partitionable(cfg, options.num_stages)
+        lo, hi = options.band()
+        if not lo <= options.dp <= hi or hi % options.dp != 0:
+            # Same contract _pick_dp enforces for reshaped widths: the
+            # batch is sized for dp_max * M, so the INITIAL dp must divide
+            # dp_max too — failing here beats spawning S*dp actors into a
+            # guaranteed first-step ValueError.
+            raise ValueError(
+                f"dp={options.dp} must lie in [{lo}, {hi}] and divide "
+                f"dp_max={hi} (batch divisibility contract)"
+            )
+        self.cfg = cfg
+        self.opts = options
+        self.total_steps = total_steps
+        self.batch_fn = batch_fn
+        self.run_config = run_config or RunConfig()
+        self.experiment_name = experiment_name
+        self.root = None  # resolved at fit()
+        self.gang: Optional[_MPMDGang] = None
+        self.dp = options.dp
+        self._supervisor = None
+
+    # ------------------------------------------------------------- spawn
+    def _spawn(self, dp: int, restore_step: Optional[int]):
+        """Create the S x dp gang, its edge channels, and the per-stage dp
+        groups; run setup (join + restore) on every replica. Returns
+        (gang, start_step)."""
+        import cloudpickle
+
+        from ...core import api
+        from ...core.runtime_context import get_runtime_context
+        from ...dag.compiled import make_edge_channel
+        from ... import collective
+        from ..elastic.ckpt import stage_root as stage_root_of
+
+        import jax
+
+        from ...models import gpt
+
+        o, S = self.opts, self.opts.num_stages
+        gen = uuid.uuid4().hex[:8]
+        remote_cls = api.remote(_StageReplica)
+        # The full parameter tree is materialized ONCE, here on the driver,
+        # and each replica receives only ITS stage's slice — S*dp gang
+        # actors must never each allocate the whole model just to throw
+        # most of it away (at GPT-J scale that transient would OOM exactly
+        # the hosts the ZeRO sharding is sized for).
+        params_np = jax.tree_util.tree_map(
+            np.asarray, gpt.init_params(jax.random.PRNGKey(o.seed), self.cfg)
+        )
+        stage_slices = [
+            gpt.extract_stage_params(params_np, self.cfg, s, S)
+            for s in range(S)
+        ]
+        del params_np
+        actors: Dict[tuple, Any] = {}
+        for s in range(S):
+            for r in range(dp):
+                payload = cloudpickle.dumps(dict(
+                    cfg=self.cfg, stage=s, num_stages=S, dp=dp, dp_rank=r,
+                    stage_params=stage_slices[s],
+                    num_microbatches=o.num_microbatches, zero=o.zero,
+                    lr=o.lr, betas=o.betas, eps=o.eps,
+                    weight_decay=o.weight_decay, seed=o.seed,
+                    group_name=f"mpmd-{self.experiment_name}-{gen}-s{s}",
+                    stage_root=stage_root_of(self.root, s), gen=gen,
+                    inline_max_bytes=o.inline_max_bytes,
+                    channel_timeout_s=o.channel_timeout_s,
+                ))
+                actors[(s, r)] = remote_cls.options(
+                    num_cpus=o.num_cpus_per_replica
+                ).remote(payload)
+        groups = [
+            f"mpmd-{self.experiment_name}-{gen}-s{s}" for s in range(S)
+        ] if dp > 1 else []
+        for s in range(S):
+            if dp > 1:
+                collective.create_collective_group(
+                    [actors[(s, r)] for r in range(dp)], dp, list(range(dp)),
+                    group_name=groups[s],
+                )
+
+        # Edge channels: replica r of stage s -> replica r of stage s+1
+        # (fwd) and back (bwd), built with the compiled-DAG channel chooser
+        # so same-node edges ride shm and cross-node edges ride TCP.
+        driver_node = get_runtime_context().get_node_id()
+        nodes = {
+            key: nid for key, nid in zip(
+                actors, api.get([a.node_id.remote() for a in actors.values()])
+            )
+        }
+        channels = []
+        edges: Dict[tuple, Dict[str, Any]] = {
+            key: {} for key in actors
+        }
+        for s in range(S - 1):
+            for r in range(dp):
+                fwd = make_edge_channel(
+                    o.channel_buffer_bytes, nodes[(s, r)],
+                    [nodes[(s + 1, r)]], 1, actors[(s, r)], driver_node,
+                )
+                bwd = make_edge_channel(
+                    o.channel_buffer_bytes, nodes[(s + 1, r)],
+                    [nodes[(s, r)]], 1, actors[(s + 1, r)], driver_node,
+                )
+                channels.extend([fwd, bwd])
+                edges[(s, r)]["fwd_out"] = fwd
+                edges[(s + 1, r)]["fwd_in"] = fwd.with_reader_slot(0)
+                edges[(s + 1, r)]["bwd_out"] = bwd
+                edges[(s, r)]["bwd_in"] = bwd.with_reader_slot(0)
+
+        gang = _MPMDGang(actors, channels, groups)
+        try:
+            steps = api.get(
+                [
+                    actors[key].setup.remote(
+                        cloudpickle.dumps(edges[key]), restore_step
+                    )
+                    for key in actors
+                ],
+                timeout=o.step_timeout_s * 2 + 120,
+            )
+        except Exception as e:  # noqa: BLE001 — a member died mid-setup
+            gang.shutdown()
+            raise MPMDGangError(f"gang setup failed: {e!r}") from e
+        start = max(steps)
+        if len(set(steps)) > 1:
+            gang.shutdown()
+            raise MPMDGangError(
+                f"stage replicas restored inconsistent steps {steps}"
+            )
+        return gang, start
+
+    # --------------------------------------------------------------- fit
+    def fit(self) -> Dict[str, Any]:
+        from ...core import api  # noqa: F401 — runtime must be initialized
+        from ..elastic import GangSupervisor
+
+        o, S = self.opts, self.opts.num_stages
+        self.root = self.run_config.resolve_storage()
+        lo, hi = o.band()
+        supervisor = GangSupervisor(
+            ScalingConfig(
+                num_workers=S * self.dp,
+                min_workers=S * lo,
+                max_workers=S * hi,
+                resources_per_worker={"CPU": o.num_cpus_per_replica},
+            ),
+            self.run_config.failure_config or FailureConfig(),
+            experiment_name=self.experiment_name,
+        )
+        self._supervisor = supervisor
+        history: List[Dict[str, Any]] = []
+        recovery_t0: Optional[float] = None
+        try:
+            return self._fit_loop(supervisor, history, recovery_t0, lo, hi)
+        except BaseException:
+            # A non-gang exception (config error, KeyboardInterrupt) must
+            # not leak a live S x dp gang + watch thread behind the raise.
+            supervisor.stop_watch()
+            if self.gang is not None:
+                self.gang.shutdown()
+                self.gang = None
+            raise
+
+    def _fit_loop(self, supervisor, history, recovery_t0, lo, hi):
+        from ..elastic import latest_common_committed
+
+        S = self.opts.num_stages
+        while True:
+            try:
+                found = latest_common_committed(self.root, S)
+                restore_step = found[0] if found else None
+                self.gang, start = self._spawn(self.dp, restore_step)
+                # The supervisor owns group aborts on failure: every
+                # stage's dp rendezvous is interrupted inside abort_mesh
+                # (its _collective_group accepts the list), so survivors
+                # never wait out a round on a dead peer.
+                supervisor.watch(
+                    self.gang, collective_group=list(self.gang.groups)
+                )
+                if recovery_t0 is not None:
+                    supervisor.record_recovery(time.monotonic() - recovery_t0)
+                    recovery_t0 = None
+                self._run_steps(start, history, supervisor)
+                self._finish()
+                supervisor.stop_watch()
+                return {
+                    "history": history,
+                    "error": None,
+                    "attempts": supervisor.attempts,
+                    "dp": self.dp,
+                }
+            except MPMDGangError as e:
+                if recovery_t0 is None:
+                    recovery_t0 = time.monotonic()
+                supervisor.abort_mesh(self.gang)
+                self.gang = None
+                decision = supervisor.on_failure(str(e))
+                if decision.stop:
+                    logger.error(
+                        "MPMD gang failed permanently after %d attempt(s): %s",
+                        supervisor.attempts, e,
+                    )
+                    return {
+                        "history": history,
+                        "error": str(e),
+                        "attempts": supervisor.attempts,
+                        "dp": self.dp,
+                    }
+                logger.warning(
+                    "MPMD gang failure (%s) — restart %d after %.1fs",
+                    e, supervisor.attempts, decision.backoff_s,
+                )
+                if decision.backoff_s > 0:
+                    time.sleep(decision.backoff_s)
+                # RESHAPE: re-pick dp from what the cluster can place NOW
+                # (measured after the backoff so the dead gang's resources
+                # have drained), clamped to the configured band AND to
+                # divisors of dp_max — the batch contract is divisibility
+                # by dp_max * M, which only guarantees divisibility for dp
+                # that divide dp_max (dp=3 in a [1,4] band would crash the
+                # step loop on a batch sized for 4).
+                world = supervisor.plan_world_size()
+                new_dp = self._pick_dp(
+                    world // S if world else self.dp, lo, hi
+                )
+                if new_dp != self.dp:
+                    logger.warning(
+                        "MPMD pipeline reshapes: dp %d -> %d", self.dp, new_dp
+                    )
+                    self.dp = new_dp
+
+    @staticmethod
+    def _pick_dp(feasible: int, lo: int, hi: int) -> int:
+        """Largest dp in [lo, hi] that fits `feasible` AND divides the band
+        ceiling (see reshape comment). The candidate set is never empty (hi
+        divides itself); when even the smallest candidate exceeds feasible
+        it is returned anyway — the spawn then fails and consumes restart
+        budget honestly rather than deadlocking the policy loop."""
+        candidates = [d for d in range(lo, hi + 1) if hi % d == 0]
+        fitting = [d for d in candidates if d <= feasible]
+        return max(fitting) if fitting else min(candidates)
+
+    def _run_steps(self, start: int, history, supervisor):
+        from ...core import api
+
+        o, S, dp = self.opts, self.opts.num_stages, self.dp
+        for step in range(start, self.total_steps):
+            reason = supervisor.failure()
+            if reason:
+                raise MPMDGangError(f"gang member died ({reason})")
+            batch = np.asarray(self.batch_fn(step))
+            if batch.shape[0] % (dp * o.num_microbatches) != 0:
+                raise ValueError(
+                    f"batch {batch.shape[0]} not divisible by dp*microbatches "
+                    f"({dp}*{o.num_microbatches})"
+                )
+            slices = np.array_split(batch, dp)
+            save = (step + 1) % max(1, o.ckpt_every) == 0
+            refs, keys = [], []
+            for (s, r), actor in self.gang.actors.items():
+                tokens = slices[r] if (s == 0 or s == S - 1) else None
+                refs.append(actor.run_step.remote(tokens, save))
+                keys.append((s, r))
+            t0 = time.monotonic()
+            out = self._get_step_results(refs, step, supervisor)
+            wall = time.monotonic() - t0
+            metrics = dict(zip(keys, out))
+            last = [metrics[(S - 1, r)] for r in range(dp)]
+            per_stage0 = [metrics[(s, 0)] for s in range(S)]
+            busy = sum(m["busy_s"] for m in metrics.values())
+            history.append({
+                "step": step + 1,
+                "loss": float(np.mean([m["loss"] for m in last])),
+                "grad_norm": float(
+                    np.sqrt(sum(m["grad_sumsq"] for m in per_stage0))
+                ),
+                "wall_s": wall,
+                "bubble_frac": max(0.0, 1.0 - busy / (wall * S * dp)),
+                "opt_bytes_per_replica": max(
+                    m["opt_bytes"] for m in metrics.values()
+                ),
+                "dp": dp,
+            })
+
+    def _get_step_results(self, refs, step: int, supervisor):
+        """Collect one step's replica results in SHORT slices, consulting
+        the supervisor between them: a member death detected through the
+        controller feed aborts the step within the poll window instead of
+        waiting out the full step deadline on RPCs that will never
+        complete."""
+        from ...core import api
+        from ...core.exceptions import GetTimeoutError
+
+        deadline = time.monotonic() + self.opts.step_timeout_s
+        while True:
+            reason = supervisor.failure()
+            if reason:
+                raise MPMDGangError(f"gang member died ({reason})")
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise MPMDGangError(
+                    f"step {step} timed out after "
+                    f"{self.opts.step_timeout_s:.0f}s"
+                )
+            try:
+                return api.get(refs, timeout=min(2.0, remaining))
+            except GetTimeoutError:
+                continue
+            except Exception as e:  # noqa: BLE001 — a member died
+                raise MPMDGangError(f"step {step} failed: {e!r}") from e
+
+    def _finish(self):
+        from ...core import api
+
+        try:
+            api.get(
+                [a.flush.remote() for a in self.gang.actors.values()],
+                timeout=120,
+            )
+        finally:
+            self.gang.shutdown()
+            self.gang = None

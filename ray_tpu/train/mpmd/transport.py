@@ -1,0 +1,239 @@
+"""Activation/gradient transport for the MPMD pipeline.
+
+Control rides the compiled-DAG channels (`experimental/channel.py` shm
+seqlock on a shared node, `tcp_channel.py` across nodes — the exact edges
+`dag/compiled.py` builds); BULK tensor bytes ride the arena + bulk planes:
+the sender lands the activation as a first-class arena object
+(`put_serialized`, one out-of-band buffer at a knowable frame offset — the
+PR 8/PR 10 span layout) and ships only a tiny descriptor through the
+channel. The receiver imports by rung:
+
+  1. inline — small tensors travel in the channel payload itself (the
+     channels grow on demand, so this is a latency choice, not a limit);
+  2. same-node — the descriptor names the segment in the shared store; the
+     consumer deserializes straight off the arena mapping (zero RPCs, one
+     memcpy into the consumer-owned array — the copy the device transfer
+     would do anyway, taken eagerly so no view outlives the producer's pin);
+  3. cross-node — `object_sources` resolves a live copy and
+     `bulk.fetch_span_bytes` pulls exactly the tensor's span over the
+     native off-GIL lander (one wire request, no whole-object get);
+  4. no rung left -> the step fails loudly and the elastic layer owns it.
+
+Pinning: the sender holds each published object's ref until the NEXT send
+on the same edge completes. Channel writes block until the reader acked the
+previous message, and the reader acks only after importing — so at the
+moment a ref is dropped, its consumer is provably done with it.
+"""
+
+from __future__ import annotations
+
+import pickle
+import queue
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+DEFAULT_INLINE_MAX = 256 * 1024
+
+
+def _rebuild_oob(dtype_str: str, shape, buf) -> np.ndarray:
+    return np.frombuffer(buf, dtype=np.dtype(dtype_str)).reshape(shape)
+
+
+class _OOBArray:
+    """Single-tensor analog of data/transport's _OOBColumn: the array's
+    bytes travel as ONE out-of-band pickle-5 buffer at a computable frame
+    offset; unpickling yields the ndarray directly."""
+
+    __slots__ = ("arr",)
+
+    def __init__(self, arr: np.ndarray):
+        self.arr = arr
+
+    def __reduce__(self):
+        return (
+            _rebuild_oob,
+            (self.arr.dtype.str, self.arr.shape, pickle.PickleBuffer(self.arr)),
+        )
+
+
+class ActTransport:
+    """Publish/fetch of one tensor over the arena + bulk planes."""
+
+    def __init__(
+        self,
+        inline_max_bytes: int = DEFAULT_INLINE_MAX,
+        timeout_s: float = 120.0,
+    ):
+        self.inline_max = int(inline_max_bytes)
+        self.timeout_s = timeout_s
+        # Which rung each publish/fetch took — tests and the bench assert
+        # the arena path actually engaged instead of trusting thresholds.
+        self.stats = {
+            "pub_inline": 0, "pub_arena": 0,
+            "fetch_inline": 0, "fetch_local": 0, "fetch_span": 0,
+        }
+
+    # ----------------------------------------------------------- producer
+    def publish(self, arr: np.ndarray):
+        """Returns (desc, pin). `pin` (an ObjectRef or None) must be held by
+        the caller until the consumer is done — the edge keeps it until its
+        next send completes (see module docstring)."""
+        from ...core import api, serialization, store
+
+        arr = np.ascontiguousarray(arr)
+        # _global_runtime (not the non-initializing peek): worker processes
+        # build their runtime lazily on first API use, and a publish from a
+        # stage actor's first step IS that first use.
+        rt = api._global_runtime()
+        backend = rt.backend if rt is not None else None
+        put_serialized = getattr(backend, "put_serialized", None)
+        # Below the store's own inline threshold put_serialized would land
+        # the frame on the INLINE plane — no shared-store name, no
+        # span-servable copy, nothing for fetch() to read — so such tensors
+        # must stay inline in the channel regardless of inline_max.
+        inline_floor = max(self.inline_max, store.INLINE_THRESHOLD)
+        if (
+            put_serialized is None
+            or arr.nbytes <= inline_floor
+            or getattr(backend, "remote_client", False)
+        ):
+            self.stats["pub_inline"] += 1
+            return {"inline": arr}, None
+        payload, buffers = serialization.serialize(_OOBArray(arr))
+        if len(buffers) != 1:  # something unexpected went out-of-band
+            self.stats["pub_inline"] += 1
+            return {"inline": arr}, None
+        try:
+            task_hex = rt.current_task_id.hex()
+        except Exception:  # noqa: BLE001 — outside a task context
+            self.stats["pub_inline"] += 1
+            return {"inline": arr}, None
+        # Frame layout ([u32 npayload][payload][u32 nbufs]{[u64 len][bytes]})
+        # puts the single buffer's data at a fixed offset.
+        off = 4 + len(payload) + 4 + 8
+        ref, name, span_ok = put_serialized(payload, buffers, task_hex)
+        if name is None:
+            # Inline/remote plane after all (threshold drift): the stored
+            # object has no locally-readable name — keep the tensor in the
+            # channel payload so the consumer never needs the object.
+            self.stats["pub_inline"] += 1
+            return {"inline": arr}, None
+        desc = {
+            "name": name,
+            "hex": ref.id.hex(),
+            "span": (off, arr.nbytes) if span_ok else None,
+            "dtype": arr.dtype.str,
+            "shape": tuple(arr.shape),
+        }
+        self.stats["pub_arena"] += 1
+        return desc, ref
+
+    # ----------------------------------------------------------- consumer
+    def fetch(self, desc: Dict[str, Any]) -> np.ndarray:
+        if "inline" in desc:
+            self.stats["fetch_inline"] += 1
+            return desc["inline"]
+        from ...core import api
+        from ...core import bulk as bulk_mod
+
+        backend = api._global_runtime().backend
+        # Rung 2: same-node shared-store read (the deps-map fast path's
+        # equivalent — no controller round trip). Copy eagerly: the
+        # unpickled array is a view over the producer's arena segment, and
+        # nothing here may outlive the producer's pin.
+        name = desc.get("name")
+        local_store = getattr(backend, "local_store", None)
+        if name and local_store is not None:
+            try:
+                out = np.array(local_store.read(name), copy=True)
+            except Exception:  # noqa: BLE001 — not on this node / evicted
+                pass
+            else:
+                # The copy is ours — release the read pin immediately, or
+                # every per-microbatch activation object stays pinned in
+                # this consumer process forever and the producer's drop
+                # can never actually free arena space.
+                try:
+                    local_store.release(name)
+                except Exception:  # noqa: BLE001 — release is best-effort
+                    pass
+                self.stats["fetch_local"] += 1
+                return out
+        # Rung 3: span pull over the bulk plane.
+        span = desc.get("span")
+        sources_of = getattr(backend, "object_sources", None)
+        if span is not None and sources_of is not None:
+            (src,) = sources_of([desc["hex"]])
+            if src:
+                off, length = span
+                buf = bulk_mod.fetch_span_bytes(
+                    src["bulk"], src["name"], off, length, self.timeout_s
+                )
+                self.stats["fetch_span"] += 1
+                return np.frombuffer(
+                    buf, dtype=np.dtype(desc["dtype"])
+                ).reshape(desc["shape"])
+        raise RuntimeError(
+            f"activation object {desc.get('hex', '?')} unreachable "
+            "(source gone and no span-servable copy) — failing the step for "
+            "the elastic layer"
+        )
+
+
+class ChannelEdge:
+    """One direction of one pipeline edge over a compiled-DAG channel.
+    Construct with the writer end in the producer process and a reader-slot
+    view in the consumer process (channels pickle-attach, exactly as
+    compiled DAG arg plans ship them)."""
+
+    def __init__(
+        self,
+        channel,
+        transport: Optional[ActTransport] = None,
+        timeout_s: float = 120.0,
+    ):
+        self._ch = channel
+        self._transport = transport or ActTransport()
+        self.timeout_s = timeout_s
+        self._pin = None  # previous send's arena object, held until acked
+
+    def send(self, arr: np.ndarray) -> None:
+        desc, pin = self._transport.publish(np.asarray(arr))
+        self._ch.write(desc, timeout=self.timeout_s)
+        # write() returned => the reader acked the PREVIOUS message, whose
+        # import finished before its ack — the old pin is dead weight now.
+        self._pin = pin
+
+    def recv(self) -> np.ndarray:
+        desc = self._ch.begin_read(timeout=self.timeout_s)
+        try:
+            return self._transport.fetch(desc)
+        finally:
+            self._ch.end_read()
+
+    def close(self) -> None:
+        try:
+            self._ch.close_writer()
+        except Exception:  # noqa: BLE001
+            pass
+        self._pin = None
+
+
+class LocalEdge:
+    """In-process edge (thread-to-thread) with channel-like depth-1
+    backpressure — the parity tests run the REAL 1F1B interleaving
+    without a cluster."""
+
+    def __init__(self, depth: int = 1, timeout_s: float = 60.0):
+        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self.timeout_s = timeout_s
+
+    def send(self, arr: np.ndarray) -> None:
+        self._q.put(np.asarray(arr), timeout=self.timeout_s)
+
+    def recv(self) -> np.ndarray:
+        return self._q.get(timeout=self.timeout_s)
+
+    def close(self) -> None:
+        pass

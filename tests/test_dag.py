@@ -72,11 +72,70 @@ class TestChannel:
         finally:
             ch.destroy()
 
-    def test_oversize_value_rejected(self):
-        ch = Channel(128)
+    def test_oversize_value_rejected_when_not_growable(self):
+        ch = Channel(128, growable=False)
         try:
             with pytest.raises(ValueError, match="exceeds channel buffer"):
                 ch.write(np.zeros(1000))
+        finally:
+            ch.destroy()
+
+    def test_grow_on_demand_oversize_payload(self):
+        """A payload larger than the buffer relocates the channel to a grown
+        segment transparently (satellite: the 1 MiB compiled-DAG default
+        must not be a hard ceiling). The channel stays reusable afterwards
+        and can grow again."""
+        import pickle as _pickle
+        import threading
+
+        ch = Channel(1 << 12)
+        r = _pickle.loads(_pickle.dumps(ch.with_reader_slot(0)))
+        got = []
+
+        def read_one():
+            got.append(r.read(timeout=20))
+
+        try:
+            for payload in (
+                np.arange(1 << 18, dtype=np.float64),  # 2 MiB through 4 KiB
+                "small-after-growth",
+                np.arange(1 << 19, dtype=np.float64),  # grow again
+            ):
+                t = threading.Thread(target=read_one)
+                t.start()
+                ch.write(payload, timeout=20)
+                t.join(timeout=20)
+                assert not t.is_alive()
+            np.testing.assert_array_equal(got[0], np.arange(1 << 18, dtype=np.float64))
+            assert got[1] == "small-after-growth"
+            np.testing.assert_array_equal(got[2], np.arange(1 << 19, dtype=np.float64))
+        finally:
+            ch.destroy()
+            r.destroy()
+
+    def test_grow_multi_reader_mixed_native(self):
+        """Relocation with two reader slots, one forced onto the pure-Python
+        path — both follow the forward pointer and land the payload."""
+        import threading
+
+        ch = Channel(1 << 12, num_readers=2)
+        r0, r1 = ch.with_reader_slot(0), ch.with_reader_slot(1)
+        r1._native = None
+        big = np.arange(1 << 18, dtype=np.float64)
+        got = []
+
+        def read_one(r):
+            got.append(r.read(timeout=20))
+
+        try:
+            ts = [threading.Thread(target=read_one, args=(r,)) for r in (r0, r1)]
+            for t in ts:
+                t.start()
+            ch.write(big, timeout=20)
+            for t in ts:
+                t.join(timeout=20)
+                assert not t.is_alive()
+            assert all(np.array_equal(g, big) for g in got)
         finally:
             ch.destroy()
 
@@ -140,6 +199,40 @@ class TestTcpChannel:
             w.close_writer()
             with pytest.raises(ChannelClosed):
                 r.begin_read(timeout=2)
+        finally:
+            w.destroy()
+
+    def test_timeout_mid_payload_is_resumable(self):
+        """A read that times out mid-payload keeps the partial bytes; the
+        retry CONTINUES the stream instead of parsing leftover payload as a
+        fresh header (the health-poll slices in CompiledDAGRef.get retry
+        reads every couple of seconds, so this is the steady state for
+        long rounds over TCP edges)."""
+        import pickle as _pickle
+        import time as _time
+
+        from ray_tpu.experimental import tcp_channel
+        from ray_tpu.experimental.tcp_channel import TcpChannel
+
+        w = TcpChannel.bind("t-resume", 1, advertise_host="127.0.0.1")
+        try:
+            r = w.with_reader_slot(0)
+            r._connect()
+            ws = tcp_channel._BOUND["t-resume"]
+            deadline = _time.monotonic() + 5
+            while not ws.conns and _time.monotonic() < deadline:
+                _time.sleep(0.01)
+            conn = list(ws.conns.values())[0]
+            value = np.arange(100_000)
+            payload = _pickle.dumps(value)
+            msg = tcp_channel._HDR.pack(1, 0, len(payload)) + payload
+            conn.sendall(msg[:100])  # header + a sliver of payload
+            with pytest.raises(TimeoutError):
+                r.begin_read(timeout=0.3)
+            conn.sendall(msg[100:])
+            out = r.begin_read(timeout=5)
+            r.end_read()
+            np.testing.assert_array_equal(out, value)
         finally:
             w.destroy()
 
@@ -218,6 +311,111 @@ class TestCompiledDag:
         finally:
             compiled.teardown()
 
+    def test_oversize_payload_grows_dag_channels(self, local_ray):
+        """A >1 MiB tensor rides a compiled DAG built with the DEFAULT
+        buffer size: the edge channels grow on demand instead of failing
+        the write (satellite regression test)."""
+
+        @ray_tpu.remote
+        class Stage:
+            def fwd(self, x):
+                return x * 2.0
+
+        with InputNode() as inp:
+            dag = Stage.bind().fwd.bind(Stage.bind().fwd.bind(inp))
+        compiled = dag.experimental_compile()
+        try:
+            x = np.random.default_rng(0).standard_normal(300_000)  # ~2.3 MiB
+            np.testing.assert_allclose(
+                compiled.execute(x).get(timeout=60), x * 4.0
+            )
+            # Steady state after growth: the grown edges are reusable.
+            assert compiled.execute(3.0).get(timeout=30) == 12.0
+        finally:
+            compiled.teardown()
+
+    def test_execute_timeout_is_configurable(self, local_ray):
+        """execute(timeout=...) sets the ref's get() deadline — the old
+        hardcoded 60s default is wrong for long rounds. A timed-out get()
+        does NOT consume the ref; a retry with more budget lands the
+        value."""
+        import time as _time
+
+        @ray_tpu.remote
+        class Slow:
+            def fwd(self, x):
+                _time.sleep(1.0)
+                return x + 1
+
+        with InputNode() as inp:
+            dag = Slow.bind().fwd.bind(inp)
+        compiled = dag.experimental_compile()
+        try:
+            ref = compiled.execute(1, timeout=0.1)
+            with pytest.raises(TimeoutError):
+                ref.get()
+            assert ref.get(timeout=30) == 2  # retry with explicit budget
+            assert compiled.execute(5, timeout=30).get() == 6
+        finally:
+            compiled.teardown()
+
+    def test_stage_exception_propagates_to_caller(self, local_ray):
+        """A stage raising mid-round surfaces at ref.get() as that stage's
+        exception (not a bare timeout / ChannelClosed), and the pipeline
+        survives for subsequent rounds."""
+
+        @ray_tpu.remote
+        class Flaky:
+            def fwd(self, x):
+                if x < 0:
+                    raise ValueError(f"bad input {x}")
+                return x * 10
+
+        @ray_tpu.remote
+        class Downstream:
+            def fwd(self, x):
+                return x + 1
+
+        with InputNode() as inp:
+            dag = Downstream.bind().fwd.bind(Flaky.bind().fwd.bind(inp))
+        compiled = dag.experimental_compile()
+        try:
+            assert compiled.execute(2).get(timeout=30) == 21
+            with pytest.raises(RuntimeError, match="bad input -3"):
+                compiled.execute(-3).get(timeout=30)
+            # The error rode the channels as data: every stage advanced one
+            # round, so the next round is coherent.
+            assert compiled.execute(4).get(timeout=30) == 41
+        finally:
+            compiled.teardown()
+
+    def test_unpicklable_stage_exception_still_propagates(self, local_ray):
+        """An exception whose class plain-pickle can't ship (locally
+        defined — common when stage code travels by cloudpickle value) is
+        degraded to its repr/traceback instead of killing the exec loop
+        mid-write; the pipeline survives the round."""
+
+        @ray_tpu.remote
+        class Flaky:
+            def fwd(self, x):
+                class LocalBoom(Exception):
+                    pass
+
+                if x < 0:
+                    raise LocalBoom(f"local {x}")
+                return x + 1
+
+        with InputNode() as inp:
+            dag = Flaky.bind().fwd.bind(inp)
+        compiled = dag.experimental_compile()
+        try:
+            assert compiled.execute(1).get(timeout=30) == 2
+            with pytest.raises(RuntimeError, match="LocalBoom"):
+                compiled.execute(-1).get(timeout=30)
+            assert compiled.execute(2).get(timeout=30) == 3
+        finally:
+            compiled.teardown()
+
     def test_multiple_stages_one_actor(self, local_ray):
         @ray_tpu.remote
         class TwoOps:
@@ -236,6 +434,54 @@ class TestCompiledDag:
             assert compiled.execute(1).get(timeout=30) == -2
         finally:
             compiled.teardown()
+
+
+@pytest.mark.chaos
+@pytest.mark.cluster
+class TestCompiledDagStageDeath:
+    def test_killed_stage_surfaces_as_stage_death_not_timeout(self):
+        """SIGKILL a stage host mid-execute: the caller's get() must raise a
+        stage-death error within the health-poll window — a dead stage used
+        to surface only as a bare channel timeout at the full deadline."""
+        import os
+        import signal
+        import time as _time
+
+        from ray_tpu.core import api
+
+        ray_tpu.init(num_cpus=2)
+        try:
+
+            @ray_tpu.remote
+            class Slow:
+                def fwd(self, x):
+                    _time.sleep(120.0)
+                    return x
+
+            with InputNode() as inp:
+                dag = Slow.bind().fwd.bind(inp)
+            compiled = dag.experimental_compile()
+            try:
+                (victim,) = compiled._actors.values()
+                workers = api._global_runtime().backend._request(
+                    {"type": "list_workers"}
+                )["workers"]
+                pid = next(
+                    w["pid"] for w in workers
+                    if w.get("actor") == victim._id.hex()
+                )
+                ref = compiled.execute(1, timeout=300.0)
+                t0 = _time.monotonic()
+                os.kill(pid, signal.SIGKILL)
+                with pytest.raises(RuntimeError, match="stage host died"):
+                    ref.get()
+                # Surfaced promptly (health poll), nowhere near the 300s
+                # round deadline.
+                assert _time.monotonic() - t0 < 60
+            finally:
+                compiled.teardown()
+        finally:
+            ray_tpu.shutdown()
 
 
 @pytest.mark.cluster

@@ -1,0 +1,80 @@
+"""MPMD training performance smoke (the runnable regression gate for
+BENCH_TRAIN_mpmd.json, mirroring the test_bulk_perf_smoke pattern).
+
+Re-runs the bench's comparison on its shape and asserts the two structural
+claims with generous slack — this is a smoke against gross regressions
+(e.g. the 1F1B schedule serializing, the transport copying per hop, the
+ZeRO shards silently replicating), not a calibrated benchmark; pinned
+numbers live in BENCH_TRAIN_mpmd.json via `scripts/bench_mpmd.py --record`:
+
+  * MPMD step time is not slower than the single-jit GPipe program x slack
+    (recorded: 0.97x on the bench shape — the host schedule + channel +
+    arena transport overheads must stay amortized by per-stage compute);
+  * per-replica optimizer bytes with ZeRO on <= replicated / dp x slack
+    (recorded: exactly replicated / dp).
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH_JSON = os.path.join(REPO, "BENCH_TRAIN_mpmd.json")
+
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+STEP_SLACK = 1.6
+BYTES_SLACK = 1.25
+
+
+@pytest.mark.slow
+def test_bench_artifact_recorded():
+    """The recorded artifact this gate tracks exists and carries the
+    claims (a re-record that drops the ZeRO reduction or the parity block
+    should fail loudly here, not rot silently)."""
+    with open(BENCH_JSON) as f:
+        bench = json.load(f)
+    assert bench["zero"]["reduction_x"] >= bench["zero"]["dp"] * 0.9
+    assert bench["parity"]["max_rel_diff"] < 1e-4
+    assert bench["modes"]["mpmd_zero"]["median_step_s"] <= (
+        bench["modes"]["gpipe_single_jit"]["median_step_s"] * STEP_SLACK
+    )
+
+
+@pytest.mark.slow
+def test_mpmd_not_slower_than_gpipe_and_zero_bytes_shrink():
+    import bench_mpmd
+
+    cfg = bench_mpmd.bench_cfg(quick=False)
+    S, dp, M = 2, 2, 4
+    steps = 6
+    batches = bench_mpmd.make_batches(cfg, 16, steps)
+
+    gp = bench_mpmd.bench_gpipe(cfg, batches, S, M)
+    mp = bench_mpmd.bench_mpmd(cfg, batches, S, dp, M, zero=True)
+    mp_rep = bench_mpmd.bench_mpmd(cfg, batches[:2], S, dp, M, zero=False)
+
+    # Parity first — a fast-but-wrong pipeline is not a pass.
+    np.testing.assert_allclose(
+        mp["losses"][0], gp["losses"][0], rtol=1e-4,
+        err_msg="MPMD step-1 loss diverged from single-jit GPipe",
+    )
+    assert mp["median_step_s"] <= gp["median_step_s"] * STEP_SLACK, (
+        f"MPMD step {mp['median_step_s']:.3f}s vs GPipe "
+        f"{gp['median_step_s']:.3f}s exceeds x{STEP_SLACK} slack"
+    )
+    zero_bytes = mp["opt_bytes_per_replica"]
+    rep_bytes = mp_rep["opt_bytes_per_replica"]
+    assert zero_bytes <= rep_bytes / dp * BYTES_SLACK, (
+        f"ZeRO optimizer bytes {zero_bytes} not ~{dp}x below replicated "
+        f"{rep_bytes}"
+    )
+    print(
+        f"mpmd {mp['median_step_s']:.3f}s vs gpipe {gp['median_step_s']:.3f}s; "
+        f"bubble {mp['bubble_frac_measured']:.2f} "
+        f"(theory {mp['bubble_frac_theoretical']:.2f}); "
+        f"opt bytes {zero_bytes} vs {rep_bytes}"
+    )
