@@ -32,6 +32,7 @@ from .algorithms.dt import DT, DTConfig
 from .algorithms.multi_agent_ppo import MultiAgentPPO, MultiAgentPPOConfig
 from .algorithms.dreamerv3 import DreamerV3, DreamerV3Config
 from . import offline
+from . import podracer
 from .env import register_env, make_env
 from .env.env_runner import EnvRunner
 from .env.multi_agent import MultiAgentEnv, SharedPolicyVectorEnv, make_multi_agent
@@ -71,6 +72,7 @@ __all__ = [
     "DreamerV3Config",
     "MultiAgentPPOConfig",
     "offline",
+    "podracer",
     "register_env",
     "make_env",
     "EnvRunner",

@@ -38,6 +38,7 @@ class Algorithm:
         self._remote_runners: List = []
         self._local_runner: Optional[EnvRunner] = None
         self._ray = None
+        self._podracer = None  # Anakin/Sebulba plane when configured
         self.setup()
 
     # ---------------------------------------------------------------- setup
@@ -49,10 +50,6 @@ class Algorithm:
         probe.close()
 
         self.module = self._make_module()
-        self.learner_group = LearnerGroup(
-            self._make_learner, remote=cfg.remote_learner
-        )
-        self._weights = self.learner_group.get_weights()
 
         rollout_len = cfg.derived_rollout_len()
         runner_kwargs = dict(
@@ -71,6 +68,21 @@ class Algorithm:
             ),
         )
         self._runner_kwargs = runner_kwargs  # eval runners reuse the recipe
+
+        if cfg.podracer_plane is not None:
+            # Podracer planes replace BOTH the LearnerGroup and the sampling
+            # runners — the plane owns the full sample->update loop. Eval
+            # still rides the classic EnvRunner recipe above (same module,
+            # weights pulled from the plane).
+            self.learner_group = None
+            self._podracer = self._build_podracer_plane()
+            self._weights = self._podracer.get_weights()
+            return
+
+        self.learner_group = LearnerGroup(
+            self._make_learner, remote=cfg.remote_learner
+        )
+        self._weights = self.learner_group.get_weights()
         if cfg.num_env_runners > 0:
             import ray_tpu
 
@@ -114,12 +126,41 @@ class Algorithm:
     def _make_learner(self) -> Learner:
         raise NotImplementedError
 
+    # ------------------------------------------------------------ podracer
+    def _build_podracer_plane(self):
+        plane = self.config.podracer_plane
+        if plane == "anakin":
+            from ..podracer.anakin import AnakinDriver
+
+            return AnakinDriver(self)
+        if plane == "sebulba":
+            from ..podracer.sebulba import SebulbaDriver
+
+            return SebulbaDriver(self)
+        raise ValueError(f"Unknown podracer plane {plane!r}")
+
+    def _podracer_update_factory(self, axis_name=None):
+        """(opt, update_fn) for the podracer planes — algorithm-specific.
+
+        `update_fn(state, batch, rng) -> (state, metrics)` over the
+        time-major batch dict; `axis_name` names the pmap axis when the
+        plane shards over devices (gradients must pmean across it).
+        """
+        raise NotImplementedError(
+            f"{type(self).__name__} has no podracer update factory "
+            "(PPO is the first podracer-capable algorithm)"
+        )
+
     # ---------------------------------------------------------------- train
     def train(self) -> Dict:
         t0 = time.perf_counter()
         self.iteration += 1
         self._episodes_this_iter = 0
-        result = self.training_step()
+        if self._podracer is not None:
+            result = self._podracer.training_step()
+            self._weights = self._podracer.get_weights()
+        else:
+            result = self.training_step()
         dt = time.perf_counter() - t0
         steps_this_iter = result.pop("_env_steps_this_iter", 0)
         self._timesteps_total += steps_this_iter
@@ -237,10 +278,15 @@ class Algorithm:
     def save(self, checkpoint_dir: str) -> str:
         os.makedirs(checkpoint_dir, exist_ok=True)
         path = os.path.join(checkpoint_dir, "algorithm_state.pkl")
+        learner_state = (
+            self._podracer.save_state()
+            if self._podracer is not None
+            else self.learner_group.save_state()
+        )
         with open(path, "wb") as f:
             pickle.dump(
                 {
-                    "learner": self.learner_group.save_state(),
+                    "learner": learner_state,
                     "iteration": self.iteration,
                     "timesteps_total": self._timesteps_total,
                     "config": self.config.to_dict(),
@@ -253,10 +299,14 @@ class Algorithm:
         path = os.path.join(checkpoint_dir, "algorithm_state.pkl")
         with open(path, "rb") as f:
             state = pickle.load(f)
-        self.learner_group.load_state(state["learner"])
+        if self._podracer is not None:
+            self._podracer.load_state(state["learner"])
+            self._weights = self._podracer.get_weights()
+        else:
+            self.learner_group.load_state(state["learner"])
+            self._weights = self.learner_group.get_weights()
         self.iteration = state["iteration"]
         self._timesteps_total = state["timesteps_total"]
-        self._weights = self.learner_group.get_weights()
 
     @classmethod
     def from_checkpoint(cls, checkpoint_dir: str, config: AlgorithmConfig):
@@ -283,7 +333,11 @@ class Algorithm:
                 except Exception:  # noqa: BLE001
                     pass
         self._eval_runners = None
-        self.learner_group.shutdown()
+        if self._podracer is not None:
+            self._podracer.stop()
+            self._podracer = None
+        if self.learner_group is not None:
+            self.learner_group.shutdown()
 
     # Tune function-trainable adapter
     def __call__(self, _config: Optional[dict] = None):

@@ -37,6 +37,19 @@ class AlgorithmConfig:
         self.num_learners: int = 0
         self.use_mesh: bool = False
         self.remote_learner: bool = False
+        # podracer planes (arxiv 2104.06272): None keeps the classic
+        # EnvRunner/LearnerGroup path; "anakin" fuses env+learner into one
+        # jit; "sebulba" splits an actor gang from a learner over the block
+        # transport.
+        self.podracer_plane: Optional[str] = None
+        self.podracer_num_envs: int = 64        # total batched envs (anakin)
+        self.podracer_rollout_len: Optional[int] = None  # derived if None
+        self.podracer_num_devices: int = 1      # anakin: pmap width
+        self.podracer_num_actors: int = 2       # sebulba: actor-gang size
+        self.podracer_envs_per_actor: int = 8   # sebulba: VectorEnv width
+        self.podracer_broadcast_interval: int = 1  # sebulba: param sync cadence
+        self.podracer_min_actors: int = 1       # sebulba: elastic floor
+        self.podracer_max_restarts: int = 3     # sebulba: reshape budget
         # debugging
         self.seed: int = 0
         # evaluation (reference: the evaluation-worker config in
@@ -94,6 +107,46 @@ class AlgorithmConfig:
     def framework(self, *_a, **_k) -> "AlgorithmConfig":
         return self  # always JAX here
 
+    def podracer(
+        self,
+        plane: Optional[str] = None,
+        *,
+        num_envs: Optional[int] = None,
+        rollout_len: Optional[int] = None,
+        num_devices: Optional[int] = None,
+        num_actors: Optional[int] = None,
+        envs_per_actor: Optional[int] = None,
+        broadcast_interval: Optional[int] = None,
+        min_actors: Optional[int] = None,
+        max_restarts: Optional[int] = None,
+    ) -> "AlgorithmConfig":
+        """Select a Podracer execution plane (one config surface, two planes).
+
+        `plane="anakin"` needs a functional JaxEnv form of `env` (env.step
+        fused into the learner's jit); `plane="sebulba"` runs the numpy
+        VectorEnvs on an actor gang shipping trajectories to a learner over
+        the block transport. `plane=None` (default) keeps the classic path.
+        """
+        if plane is not None:
+            self.podracer_plane = plane
+        if num_envs is not None:
+            self.podracer_num_envs = num_envs
+        if rollout_len is not None:
+            self.podracer_rollout_len = rollout_len
+        if num_devices is not None:
+            self.podracer_num_devices = num_devices
+        if num_actors is not None:
+            self.podracer_num_actors = num_actors
+        if envs_per_actor is not None:
+            self.podracer_envs_per_actor = envs_per_actor
+        if broadcast_interval is not None:
+            self.podracer_broadcast_interval = broadcast_interval
+        if min_actors is not None:
+            self.podracer_min_actors = min_actors
+        if max_restarts is not None:
+            self.podracer_max_restarts = max_restarts
+        return self
+
     def debugging(self, *, seed: Optional[int] = None, **_c) -> "AlgorithmConfig":
         if seed is not None:
             self.seed = seed
@@ -128,6 +181,27 @@ class AlgorithmConfig:
     def validate(self) -> None:
         if self.env is None:
             raise ValueError("config.environment(env=...) is required")
+        if self.podracer_plane not in (None, "anakin", "sebulba"):
+            raise ValueError(
+                f"podracer plane must be 'anakin' or 'sebulba', got "
+                f"{self.podracer_plane!r}"
+            )
+        if self.podracer_plane == "anakin":
+            from ..podracer.jax_env import jax_env_registered
+
+            if not jax_env_registered(self.env):
+                raise ValueError(
+                    f"Anakin needs a functional JaxEnv form of {self.env!r} "
+                    "(register one via podracer.jax_env.register_jax_env, or "
+                    "use the Sebulba plane for Python-loop envs)."
+                )
+        if self.podracer_plane == "sebulba":
+            if self.podracer_num_actors < 1:
+                raise ValueError("sebulba needs podracer_num_actors >= 1")
+            if self.podracer_min_actors > self.podracer_num_actors:
+                raise ValueError(
+                    "podracer_min_actors must be <= podracer_num_actors"
+                )
 
     def build(self) -> "Algorithm":  # noqa: F821
         if self.algo_class is None:
@@ -144,3 +218,12 @@ class AlgorithmConfig:
             return self.rollout_fragment_length
         total_envs = self.num_samplers * self.num_envs_per_env_runner
         return max(self.train_batch_size // total_envs, 1)
+
+    def derived_podracer_rollout_len(self) -> int:
+        if self.podracer_rollout_len is not None:
+            return self.podracer_rollout_len
+        if self.podracer_plane == "sebulba":
+            total = self.podracer_num_actors * self.podracer_envs_per_actor
+        else:
+            total = self.podracer_num_envs
+        return max(self.train_batch_size // max(total, 1), 1)

@@ -11,7 +11,7 @@ numpy batch once per iteration.
 
 from __future__ import annotations
 
-from typing import Dict
+from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -46,8 +46,16 @@ class PPOConfig(AlgorithmConfig):
             )
 
 
-def make_ppo_update(module, opt, cfg: PPOConfig):
-    """Builds update(state, batch, rng) -> (state, metrics): one XLA program."""
+def make_ppo_update(module, opt, cfg: PPOConfig, axis_name: Optional[str] = None):
+    """Builds update(state, batch, rng) -> (state, metrics): one XLA program.
+
+    `axis_name` makes the program pmap-ready (the Anakin fused plane maps it
+    over devices): gradients are pmean'd across the named axis before the
+    optimizer applies them, so replicated params stay bit-identical on every
+    device. Advantage normalization stays per-device (its minibatch already
+    is a sample statistic; cross-device moments would add two collectives
+    per minibatch for no learning effect at these batch sizes).
+    """
     gamma, lam = cfg.gamma, cfg.lambda_
     clip, vf_clip = cfg.clip_param, cfg.vf_clip_param
     vf_coeff, ent_coeff = cfg.vf_loss_coeff, cfg.entropy_coeff
@@ -98,6 +106,8 @@ def make_ppo_update(module, opt, cfg: PPOConfig):
                 params, opt_state = carry
                 mb = {k: v[idx] for k, v in flat.items()}
                 (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, mb)
+                if axis_name is not None:
+                    grads = lax.pmean(grads, axis_name)
                 updates, opt_state = opt.update(grads, opt_state, params)
                 params = optax.apply_updates(params, updates)
                 return (params, opt_state), aux
@@ -112,6 +122,8 @@ def make_ppo_update(module, opt, cfg: PPOConfig):
             epoch_step, (params, opt_state), jax.random.split(rng, num_epochs)
         )
         metrics = jax.tree.map(lambda x: x.mean(), auxs)
+        if axis_name is not None:
+            metrics = lax.pmean(metrics, axis_name)
         return (params, opt_state), metrics
 
     return update
@@ -130,6 +142,16 @@ class PPO(Algorithm):
         )
         learner.opt_state = opt.init(learner.params)
         return learner
+
+    def _podracer_update_factory(self, axis_name: Optional[str] = None):
+        """PPO's update program for the podracer planes — the SAME
+        `make_ppo_update` the LearnerGroup path jits, handed to Anakin for
+        in-jit fusion (with a pmap axis) or to the Sebulba learner gang."""
+        from ..utils.optim import make_optimizer
+
+        cfg = self.config
+        opt = make_optimizer(cfg)
+        return opt, make_ppo_update(self.module, opt, cfg, axis_name=axis_name)
 
     def training_step(self) -> Dict:
         batches = self._sample_batches()

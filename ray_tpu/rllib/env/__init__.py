@@ -9,6 +9,11 @@ API is gymnasium-flavored:
     reset(seed) -> (obs, info);  step(a) -> (obs, rew, terminated, truncated, info)
 Vector envs auto-reset finished sub-envs and report completed episode
 returns/lengths in `info`.
+
+Dynamics live in xp-generic module functions (`cartpole.cartpole_step`,
+`pendulum.pendulum_step`, ... parameterized over numpy|jnp) so the numpy
+VectorEnvs here and the traceable `podracer.jax_env` forms share ONE
+implementation — `tests/test_podracer_env_parity.py` holds them equal.
 """
 
 from __future__ import annotations

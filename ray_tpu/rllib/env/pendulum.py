@@ -1,5 +1,10 @@
 """Pendulum-v1, natively vectorized — continuous-action counterpart for
-testing Gaussian policies (classic-control dynamics)."""
+testing Gaussian policies (classic-control dynamics).
+
+Like `cartpole.py`, the step math is a module-level function parameterized
+by the array namespace (`xp`) so the numpy sampling plane and the jitted
+Anakin plane (`podracer.jax_env.JaxPendulum`) share one dynamics source.
+"""
 
 from __future__ import annotations
 
@@ -10,14 +15,48 @@ import numpy as np
 from .spaces import Box
 from .vector import VectorEnv
 
+MAX_SPEED = 8.0
+MAX_TORQUE = 2.0
+DT = 0.05
+G = 10.0
+M = 1.0
+L = 1.0
+RESET_THETA_BOUND = np.pi
+RESET_THETADOT_BOUND = 1.0
+
+
+def pendulum_step(xp, theta, theta_dot, u):
+    """One step of the batched pendulum dynamics.
+
+    `theta`/`theta_dot`/`u` are [N]; torque is clipped here. Returns
+    (new_theta, new_theta_dot, cost) where `cost` (>= 0) is computed from
+    the PRE-step state, exactly the classic-control reward convention.
+    Pure in `xp` (numpy or jax.numpy).
+    """
+    u = xp.clip(u, -MAX_TORQUE, MAX_TORQUE)
+    norm_th = ((theta + xp.pi) % (2 * xp.pi)) - xp.pi
+    cost = norm_th**2 + 0.1 * theta_dot**2 + 0.001 * u**2
+
+    new_theta_dot = theta_dot + (
+        3 * G / (2 * L) * xp.sin(theta) + 3.0 / (M * L**2) * u
+    ) * DT
+    new_theta_dot = xp.clip(new_theta_dot, -MAX_SPEED, MAX_SPEED)
+    new_theta = theta + new_theta_dot * DT
+    return new_theta, new_theta_dot, cost
+
+
+def pendulum_obs(xp, theta, theta_dot):
+    """[N] angle/velocity -> [N, 3] (cos, sin, theta_dot) observation."""
+    return xp.stack([xp.cos(theta), xp.sin(theta), theta_dot], axis=1)
+
 
 class VectorPendulum(VectorEnv):
-    MAX_SPEED = 8.0
-    MAX_TORQUE = 2.0
-    DT = 0.05
-    G = 10.0
-    M = 1.0
-    L = 1.0
+    MAX_SPEED = MAX_SPEED
+    MAX_TORQUE = MAX_TORQUE
+    DT = DT
+    G = G
+    M = M
+    L = L
 
     max_episode_steps = 200
 
@@ -33,13 +72,11 @@ class VectorPendulum(VectorEnv):
         self._ep_ret = np.zeros(num_envs, np.float64)
 
     def _obs(self) -> np.ndarray:
-        return np.stack(
-            [np.cos(self._theta), np.sin(self._theta), self._theta_dot], axis=1
-        ).astype(np.float32)
+        return pendulum_obs(np, self._theta, self._theta_dot).astype(np.float32)
 
     def _sample(self, n):
-        theta = self._rng.uniform(-np.pi, np.pi, n)
-        theta_dot = self._rng.uniform(-1.0, 1.0, n)
+        theta = self._rng.uniform(-RESET_THETA_BOUND, RESET_THETA_BOUND, n)
+        theta_dot = self._rng.uniform(-RESET_THETADOT_BOUND, RESET_THETADOT_BOUND, n)
         return theta, theta_dot
 
     def reset(self, seed: Optional[int] = None):
@@ -51,15 +88,10 @@ class VectorPendulum(VectorEnv):
         return self._obs(), {}
 
     def step(self, actions: np.ndarray):
-        u = np.clip(np.asarray(actions, np.float64).reshape(self.num_envs), -self.MAX_TORQUE, self.MAX_TORQUE)
-        th, thdot = self._theta, self._theta_dot
-        norm_th = ((th + np.pi) % (2 * np.pi)) - np.pi
-        cost = norm_th**2 + 0.1 * thdot**2 + 0.001 * u**2
-
-        newthdot = thdot + (3 * self.G / (2 * self.L) * np.sin(th) + 3.0 / (self.M * self.L**2) * u) * self.DT
-        newthdot = np.clip(newthdot, -self.MAX_SPEED, self.MAX_SPEED)
-        self._theta = th + newthdot * self.DT
-        self._theta_dot = newthdot
+        u = np.asarray(actions, np.float64).reshape(self.num_envs)
+        self._theta, self._theta_dot, cost = pendulum_step(
+            np, self._theta, self._theta_dot, u
+        )
         self._steps += 1
         self._ep_ret += -cost
 

@@ -1,0 +1,44 @@
+"""Podracer execution planes (arxiv 2104.06272) behind one config surface.
+
+`AlgorithmConfig.podracer("anakin")` fuses batched env dynamics into the
+learner's jit program (`anakin.AnakinDriver` + the pure-jnp envs in
+`jax_env`); `AlgorithmConfig.podracer("sebulba")` splits a numpy-env actor
+gang from the learner, trajectories riding the block transport and params
+returning over compiled-DAG channels (`sebulba.SebulbaDriver`).
+"""
+
+from .jax_env import (
+    JaxCartPole,
+    JaxEnv,
+    JaxPendulum,
+    autoreset_step,
+    init_env_state,
+    jax_env_registered,
+    make_jax_env,
+    register_jax_env,
+)
+
+__all__ = [
+    "JaxCartPole",
+    "JaxEnv",
+    "JaxPendulum",
+    "AnakinDriver",
+    "SebulbaDriver",
+    "autoreset_step",
+    "init_env_state",
+    "jax_env_registered",
+    "make_jax_env",
+    "register_jax_env",
+]
+
+
+def __getattr__(name):  # lazy: importing jax_env must not pull in transport
+    if name == "AnakinDriver":
+        from .anakin import AnakinDriver
+
+        return AnakinDriver
+    if name == "SebulbaDriver":
+        from .sebulba import SebulbaDriver
+
+        return SebulbaDriver
+    raise AttributeError(name)

@@ -1,0 +1,221 @@
+"""Anakin — env dynamics fused into the learner's jit (Podracer §2,
+arxiv 2104.06272).
+
+One compiled XLA program per training iteration does EVERYTHING:
+
+    lax.scan over T steps of [B] batched env dynamics
+      (policy forward -> action sample -> env.step -> auto-reset)
+    -> time-major trajectory, entirely device-resident
+    -> the algorithm's update program (for PPO: in-jit GAE via
+       `utils/gae.compute_gae`, epoch loop, minibatch permutation,
+       clipped-surrogate loss, optimizer — `make_ppo_update` unchanged)
+
+Sampling therefore costs ZERO Python per env step — the Python side
+dispatches one call per iteration and reads back scalar metrics plus the
+episode-completion arrays. With more than one device the whole program is
+pmapped: env states and rollouts shard over the device axis, gradients
+pmean across it (the update program's `axis_name`), params stay replicated.
+
+This is the plane for envs with a functional `JaxEnv` form
+(`podracer.jax_env`); Python/numpy envs belong on Sebulba.
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .jax_env import JaxEnv, autoreset_step, init_env_state, make_jax_env
+
+AXIS = "devices"
+
+
+def make_anakin_step(env: JaxEnv, module, update_fn, rollout_len: int):
+    """Build the fused step: (state, env_state, rng) ->
+    (state, env_state, metrics, episode_outs). Pure — jit or pmap it."""
+
+    def anakin_step(state, env_state, rng):
+        params, _ = state
+        k_roll, k_up = jax.random.split(rng)
+
+        def one_step(est, key):
+            obs = env.observe_fn(est["core"])
+            k_act, k_reset = jax.random.split(key)
+            dist, value = module.forward(params, obs)
+            action = module.sample(k_act, dist)
+            logp = module.log_prob(dist, action)
+            est, out = autoreset_step(env, est, action, k_reset)
+            rec = {
+                "obs": obs,
+                "actions": action,
+                "logp": logp,
+                "values": value,
+                "rewards": out["reward"],
+                "dones": out["done"],
+                "ep_ret": out["ep_ret"],
+                "ep_len": out["ep_len"],
+            }
+            return est, rec
+
+        env_state, traj = lax.scan(
+            one_step, env_state, jax.random.split(k_roll, rollout_len)
+        )
+        batch = {
+            k: traj[k]
+            for k in ("obs", "actions", "logp", "values", "rewards", "dones")
+        }
+        # Bootstrap view: the post-rollout observation (reset obs where an
+        # episode just ended — GAE masks it through `dones`, exactly the
+        # EnvRunner contract).
+        batch["last_obs"] = env.observe_fn(env_state["core"])
+        state, metrics = update_fn(state, batch, k_up)
+        episodes = {
+            "done": traj["dones"],
+            "ep_ret": traj["ep_ret"],
+            "ep_len": traj["ep_len"],
+        }
+        return state, env_state, metrics, episodes
+
+    return anakin_step
+
+
+class AnakinDriver:
+    """The Anakin execution plane behind `Algorithm` (PPO first).
+
+    Owns (params, opt_state) — there is no separate LearnerGroup; the
+    learner IS the fused program. `training_step()` matches the
+    `Algorithm.training_step` contract so `Algorithm.train()` drives either
+    plane identically.
+    """
+
+    plane = "anakin"
+
+    def __init__(self, algo):
+        cfg = algo.config
+        self.algo = algo
+        self.module = algo.module
+        self.env = make_jax_env(cfg.env, **cfg.env_config)
+        self.num_devices = D = max(1, int(cfg.podracer_num_devices))
+        self.num_envs = B = int(cfg.podracer_num_envs)
+        self.rollout_len = T = int(cfg.derived_podracer_rollout_len())
+        if D > 1:
+            avail = len(jax.devices())
+            if D > avail:
+                raise ValueError(
+                    f"podracer_num_devices={D} > available devices {avail}"
+                )
+            if B % D != 0:
+                raise ValueError(
+                    f"podracer_num_envs={B} must divide over "
+                    f"podracer_num_devices={D}"
+                )
+        opt, update_fn = algo._podracer_update_factory(
+            axis_name=AXIS if D > 1 else None
+        )
+        self._opt = opt
+        self._rng = jax.random.PRNGKey(cfg.seed)
+        self._rng, k_init, k_env = jax.random.split(self._rng, 3)
+        params = self.module.init(k_init)
+        opt_state = opt.init(params)
+        step_fn = make_anakin_step(self.env, self.module, update_fn, T)
+
+        if D > 1:
+            devices = jax.devices()[:D]
+            self._step = jax.pmap(
+                step_fn, axis_name=AXIS, devices=devices,
+                donate_argnums=(0, 1),
+            )
+            env = self.env
+            per_dev = B // D
+            self._env_state = jax.pmap(
+                lambda k: init_env_state(env, k, per_dev), devices=devices
+            )(jax.random.split(k_env, D))
+            self._state = jax.device_put_replicated((params, opt_state), devices)
+        else:
+            self._step = jax.jit(step_fn, donate_argnums=(0, 1))
+            self._env_state = init_env_state(self.env, k_env, B)
+            self._state = (params, opt_state)
+
+    # ----------------------------------------------------------- training
+    def _iter_keys(self):
+        self._rng, key = jax.random.split(self._rng)
+        if self.num_devices > 1:
+            return jax.random.split(key, self.num_devices)
+        return key
+
+    def training_step(self) -> Dict[str, Any]:
+        t0 = time.perf_counter()
+        self._state, self._env_state, metrics, episodes = self._step(
+            self._state, self._env_state, self._iter_keys()
+        )
+        metrics = jax.tree.map(np.asarray, jax.device_get(metrics))
+        dt = time.perf_counter() - t0
+
+        done = np.asarray(episodes["done"]) > 0
+        if done.any():
+            rets = np.asarray(episodes["ep_ret"])[done]
+            lens = np.asarray(episodes["ep_len"])[done]
+            self.algo._episode_returns.extend(rets.tolist())
+            self.algo._episode_lengths.extend(lens.tolist())
+            self.algo._episodes_this_iter += int(done.sum())
+
+        steps = self.rollout_len * self.num_envs
+        scalars = {
+            k: float(np.asarray(v).reshape(-1)[0]) for k, v in metrics.items()
+        }
+        _observe_metrics(self.plane, steps, dt)
+        return {
+            "_env_steps_this_iter": steps,
+            "info": {"learner": scalars, "fused_step_seconds": dt},
+        }
+
+    # ------------------------------------------------------------ weights
+    def get_weights(self):
+        params = self._state[0]
+        if self.num_devices > 1:
+            return jax.tree.map(lambda x: np.asarray(x[0]), params)
+        return jax.device_get(params)
+
+    # ----------------------------------------------------------- persist
+    def save_state(self) -> bytes:
+        params, opt_state = self._state
+        if self.num_devices > 1:
+            params = jax.tree.map(lambda x: np.asarray(x[0]), params)
+            opt_state = jax.tree.map(lambda x: np.asarray(x[0]), opt_state)
+        return pickle.dumps((
+            jax.device_get(params), jax.device_get(opt_state),
+            np.asarray(self._rng),
+        ))
+
+    def load_state(self, blob: bytes):
+        params, opt_state, rng = pickle.loads(blob)
+        self._rng = jnp.asarray(rng)
+        if self.num_devices > 1:
+            devices = jax.devices()[: self.num_devices]
+            self._state = jax.device_put_replicated((params, opt_state), devices)
+        else:
+            self._state = (params, opt_state)
+
+    def stop(self):
+        pass
+
+
+def _observe_metrics(plane: str, env_steps: int, step_seconds: float):
+    """Feed the shared rllib families; never load-bearing (dropped when no
+    cluster backend is attached — same rule as every other metric)."""
+    try:
+        from ...util.metrics import rllib_metrics
+
+        m = rllib_metrics()
+        m["rllib_env_steps_total"].inc(env_steps, tags={"plane": plane})
+        m["rllib_learner_step_seconds"].observe(
+            step_seconds, tags={"plane": plane}
+        )
+    except Exception:  # noqa: BLE001 — metrics never load-bearing
+        pass

@@ -1,0 +1,207 @@
+"""Functional (pure-jnp) environments — the Anakin plane's env protocol.
+
+Podracer (arxiv 2104.06272) Anakin fuses environment dynamics into the
+learner's jit program: env.step must therefore be a *pure function* on jnp
+arrays, so `jax.lax.scan` can unroll rollout collection inside one XLA
+program. The protocol here is batched-native (state pytrees carry a leading
+[N] env axis) because the classic-control dynamics in `..env.cartpole` /
+`..env.pendulum` are already written batched over an array namespace — the
+jitted plane calls the SAME functions with `xp=jax.numpy` that the numpy
+`VectorEnv`s call with `xp=numpy`, so dynamics parity holds by
+construction and `tests/test_podracer_env_parity.py` only has to guard the
+wrapper semantics (reset distribution, auto-reset, step accounting).
+
+Protocol (`JaxEnv`):
+
+    reset_fn(key, n)      -> core state pytree with leading [n]
+    observe_fn(state)     -> [n, obs_dim] float32
+    step_fn(state, action)-> (new_state, reward [n], terminated [n] bool)
+
+Episode bookkeeping (step counters, returns, truncation, auto-reset) is NOT
+the env's job — `autoreset_step` wraps any JaxEnv with the exact semantics
+the numpy `VectorEnv`s implement: step counters increment before the done
+check, truncation fires at max_episode_steps on non-terminated envs,
+finished envs are reset in place (the returned observation of a finished
+env is its RESET observation), and the pre-reset episode return/length are
+exposed so the driver can report completed episodes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..env import cartpole as np_cartpole
+from ..env import pendulum as np_pendulum
+from ..env.spaces import Box, Discrete
+
+
+class JaxEnv:
+    """Base protocol: subclasses provide pure batched reset/observe/step.
+
+    `observation_space`/`action_space` mirror the numpy VectorEnv surface so
+    `Algorithm._make_module` sizes the policy identically for both planes.
+    """
+
+    max_episode_steps: int = 1000
+    observation_space: Any = None
+    action_space: Any = None
+
+    def reset_fn(self, key, n: int):
+        raise NotImplementedError
+
+    def observe_fn(self, state):
+        raise NotImplementedError
+
+    def step_fn(self, state, action):
+        raise NotImplementedError
+
+
+class JaxCartPole(JaxEnv):
+    """CartPole-v1 on jnp — dynamics shared with `env.cartpole`."""
+
+    def __init__(self, max_episode_steps: int = 500):
+        self.max_episode_steps = max_episode_steps
+        self.observation_space = Box(-jnp.inf, jnp.inf, (4,))
+        self.action_space = Discrete(2)
+
+    def reset_fn(self, key, n: int):
+        return jax.random.uniform(
+            key, (n, 4),
+            minval=-np_cartpole.RESET_BOUND, maxval=np_cartpole.RESET_BOUND,
+            dtype=jnp.float32,
+        )
+
+    def observe_fn(self, state):
+        return state.astype(jnp.float32)
+
+    def step_fn(self, state, action):
+        new_state = np_cartpole.cartpole_step(jnp, state, action)
+        reward = jnp.ones(state.shape[0], jnp.float32)
+        terminated = np_cartpole.cartpole_terminated(jnp, new_state)
+        return new_state, reward, terminated
+
+
+class JaxPendulum(JaxEnv):
+    """Pendulum-v1 on jnp — dynamics shared with `env.pendulum`.
+
+    Core state is [n, 2] (theta, theta_dot); never terminates, truncation
+    only.
+    """
+
+    def __init__(self, max_episode_steps: int = 200):
+        self.max_episode_steps = max_episode_steps
+        self.observation_space = Box(-jnp.inf, jnp.inf, (3,))
+        self.action_space = Box(
+            -np_pendulum.MAX_TORQUE, np_pendulum.MAX_TORQUE, (1,)
+        )
+
+    def reset_fn(self, key, n: int):
+        k_th, k_dot = jax.random.split(key)
+        theta = jax.random.uniform(
+            k_th, (n,),
+            minval=-np_pendulum.RESET_THETA_BOUND,
+            maxval=np_pendulum.RESET_THETA_BOUND, dtype=jnp.float32,
+        )
+        theta_dot = jax.random.uniform(
+            k_dot, (n,),
+            minval=-np_pendulum.RESET_THETADOT_BOUND,
+            maxval=np_pendulum.RESET_THETADOT_BOUND, dtype=jnp.float32,
+        )
+        return jnp.stack([theta, theta_dot], axis=1)
+
+    def observe_fn(self, state):
+        return np_pendulum.pendulum_obs(
+            jnp, state[:, 0], state[:, 1]
+        ).astype(jnp.float32)
+
+    def step_fn(self, state, action):
+        u = jnp.asarray(action, jnp.float32).reshape(state.shape[0])
+        theta, theta_dot, cost = np_pendulum.pendulum_step(
+            jnp, state[:, 0], state[:, 1], u
+        )
+        new_state = jnp.stack([theta, theta_dot], axis=1)
+        terminated = jnp.zeros(state.shape[0], bool)
+        return new_state, (-cost).astype(jnp.float32), terminated
+
+
+# --------------------------------------------------------------------------
+# Auto-reset wrapper state: exactly the VectorEnv bookkeeping, as a pytree.
+# --------------------------------------------------------------------------
+def init_env_state(env: JaxEnv, key, n: int) -> Dict[str, Any]:
+    """Fresh wrapper state: core env state + per-env step/return counters."""
+    return {
+        "core": env.reset_fn(key, n),
+        "steps": jnp.zeros(n, jnp.int32),
+        "ep_ret": jnp.zeros(n, jnp.float32),
+    }
+
+
+def autoreset_step(env: JaxEnv, est: Dict[str, Any], action, key):
+    """One wrapped step with VectorEnv-parity auto-reset semantics.
+
+    Returns (new_est, out) where `out` carries everything a rollout records:
+      reward, terminated, truncated, done (float32 — the GAE mask),
+      ep_ret / ep_len (the PRE-reset totals; only meaningful where done).
+    Finished envs are already reset inside `new_est` — observing it yields
+    the reset observation, matching the numpy env's step return.
+    """
+    n = est["steps"].shape[0]
+    core, reward, terminated = env.step_fn(est["core"], action)
+    steps = est["steps"] + 1
+    truncated = (~terminated) & (steps >= env.max_episode_steps)
+    done = terminated | truncated
+    ep_ret = est["ep_ret"] + reward
+
+    fresh = env.reset_fn(key, n)
+    # Core may be any pytree with leading [n] leaves; blend per leaf.
+    new_core = jax.tree.map(
+        lambda f, c: jnp.where(done.reshape((n,) + (1,) * (c.ndim - 1)), f, c),
+        fresh, core,
+    )
+    new_est = {
+        "core": new_core,
+        "steps": jnp.where(done, 0, steps),
+        "ep_ret": jnp.where(done, 0.0, ep_ret),
+    }
+    out = {
+        "reward": reward,
+        "terminated": terminated,
+        "truncated": truncated,
+        "done": done.astype(jnp.float32),
+        "ep_ret": ep_ret,
+        "ep_len": steps,
+    }
+    return new_est, out
+
+
+# --------------------------------------------------------------------------
+# Registry (parallel to ..env's numpy registry; same names resolve to the
+# functional forms so one AlgorithmConfig.environment() drives either plane)
+# --------------------------------------------------------------------------
+_JAX_ENV_REGISTRY: Dict[str, Callable[..., JaxEnv]] = {}
+
+
+def register_jax_env(name: str, ctor: Callable[..., JaxEnv]) -> None:
+    _JAX_ENV_REGISTRY[name] = ctor
+
+
+def make_jax_env(name: str, **kwargs) -> JaxEnv:
+    if name not in _JAX_ENV_REGISTRY:
+        raise KeyError(
+            f"No functional (JaxEnv) form registered for {name!r} — the "
+            f"Anakin plane needs pure-jnp dynamics. Registered: "
+            f"{sorted(_JAX_ENV_REGISTRY)}. Python-loop envs belong on the "
+            "Sebulba plane (config.podracer('sebulba'))."
+        )
+    return _JAX_ENV_REGISTRY[name](**kwargs)
+
+
+def jax_env_registered(name: str) -> bool:
+    return name in _JAX_ENV_REGISTRY
+
+
+register_jax_env("CartPole-v1", JaxCartPole)
+register_jax_env("Pendulum-v1", JaxPendulum)

@@ -120,6 +120,47 @@ def elastic_metrics() -> Dict[str, "_Metric"]:
         return _ELASTIC
 
 
+_RLLIB: Optional[Dict[str, "_Metric"]] = None
+_RLLIB_LOCK = threading.Lock()
+
+
+def rllib_metrics() -> Dict[str, "_Metric"]:
+    """RL-training metric families (both podracer planes and the classic
+    EnvRunner path feed these): `rllib_env_steps_total` counts sampled env
+    transitions by plane, `rllib_learner_step_seconds` is the per-iteration
+    learner/update latency distribution, and
+    `rllib_actor_learner_queue_depth` is the Sebulba actor->learner
+    trajectory queue depth (0 on fused planes — there is no queue). Created
+    lazily so importing metrics never boots a runtime."""
+    global _RLLIB
+    with _RLLIB_LOCK:
+        if _RLLIB is None:
+            _RLLIB = {
+                "rllib_env_steps_total": Counter(
+                    "rllib_env_steps_total",
+                    "Environment transitions sampled for training",
+                    tag_keys=("plane",),
+                ),
+                "rllib_learner_step_seconds": Histogram(
+                    "rllib_learner_step_seconds",
+                    "Seconds per learner update step (one training "
+                    "iteration's optimize call)",
+                    boundaries=(
+                        0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                        1.0, 2.5, 5.0, 10.0,
+                    ),
+                    tag_keys=("plane",),
+                ),
+                "rllib_actor_learner_queue_depth": Gauge(
+                    "rllib_actor_learner_queue_depth",
+                    "Trajectory frames produced by the Sebulba actor gang "
+                    "not yet consumed by the learner",
+                    tag_keys=("plane",),
+                ),
+            }
+        return _RLLIB
+
+
 _FLEET: Optional[Dict[str, "_Metric"]] = None
 _FLEET_LOCK = threading.Lock()
 

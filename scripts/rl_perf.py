@@ -3,6 +3,11 @@
 Reference analog: `rllib/tuned_examples/ppo/cartpole-ppo.yaml` (reward 150
 within 100k env steps) and the env-steps/sec targets in BASELINE.json.
 Run: `python scripts/rl_perf.py` — one JSON line per probe.
+
+`ppo_cartpole_probe()` is importable: `scripts/bench_podracer.py` records
+the same EnvRunner measurement as the baseline row of
+BENCH_RL_podracer.json, so the classic-path number in both artifacts is one
+definition.
 """
 
 from __future__ import annotations
@@ -23,7 +28,9 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
-def main():
+def ppo_cartpole_probe(max_iters: int = 60) -> dict:
+    """Classic EnvRunner-path PPO on CartPole: env-steps/s plus the
+    learning bar (reward 150 within 100k steps). Returns the probe dict."""
     from ray_tpu.rllib import PPOConfig
 
     algo = (
@@ -38,7 +45,7 @@ def main():
     best = 0.0
     reached_at = None
     t0 = time.perf_counter()
-    for _ in range(60):
+    for _ in range(max_iters):
         result = algo.train()
         total_steps = result["timesteps_total"]
         best = max(best, result["episode_reward_mean"])
@@ -48,7 +55,7 @@ def main():
             break
     wall = time.perf_counter() - t0
     algo.stop()
-    print(json.dumps({
+    return {
         "rl_probe": "ppo_cartpole_env_steps_per_sec",
         "value": round(total_steps / wall, 1),
         "unit": "env-steps/s",
@@ -58,7 +65,11 @@ def main():
             "baseline_bar": "reward 150 within 100k steps",
             "bar_met": bool(reached_at is not None and reached_at <= 100_000),
         },
-    }), flush=True)
+    }
+
+
+def main():
+    print(json.dumps(ppo_cartpole_probe()), flush=True)
 
 
 if __name__ == "__main__":

@@ -141,6 +141,69 @@ def test_metric_staleness_pruning(shutdown_only):
         os.environ.pop("RAY_TPU_METRIC_STALENESS_S", None)
 
 
+def test_rllib_podracer_metrics_exported(cluster_rt):
+    """Both podracer planes feed the rllib_* families (satellite of the
+    podracer PR): env-step counters tagged by plane, the learner-step
+    latency histogram, and the Sebulba actor->learner queue-depth gauge."""
+    from ray_tpu.rllib import PPOConfig
+
+    # Anakin: fused plane, driver-side metrics.
+    algo = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .training(train_batch_size=256, minibatch_size=128, num_epochs=1)
+        .debugging(seed=3)
+        .podracer("anakin", num_envs=16, rollout_len=16)
+        .build()
+    )
+    try:
+        algo.train()
+    finally:
+        algo.stop()
+
+    # Sebulba: split plane — the counter/histogram/gauge records originate
+    # in the LEARNER WORKER process and must still reach /metrics.
+    algo = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .training(train_batch_size=256, minibatch_size=128, num_epochs=1)
+        .debugging(seed=3)
+        .podracer("sebulba", num_actors=1, envs_per_actor=8, rollout_len=32)
+        .build()
+    )
+    try:
+        algo.train()
+        # This batch shape (8 envs x 32 steps ~ 6KB) sits BELOW the store
+        # inline threshold: the transport must keep frames in the RPC
+        # descriptor, not burn arena names (the arena path is asserted at
+        # 90KB frames in test_podracer_sebulba.py).
+        stats = algo._podracer.transport_stats
+        assert all(a["pub_inline"] >= 1 and a["pub_arena"] == 0
+                   for a in stats["actors"])
+        assert stats["learner"]["fetch_inline"] >= 1
+        # Histogram deltas flush from the learner WORKER on a 0.25s cadence;
+        # give the flusher one tick before stop() SIGKILLs the gang.
+        time.sleep(0.6)
+    finally:
+        algo.stop()
+
+    text = _scrape(
+        lambda t: 'rllib_env_steps_total{plane="anakin"}' in t
+        and 'rllib_env_steps_total{plane="sebulba"}' in t
+        and 'rllib_learner_step_seconds_count{plane="sebulba"}' in t
+    )
+    assert "# TYPE rllib_env_steps_total counter" in text
+    assert 'rllib_env_steps_total{plane="anakin"} 256' in text
+    assert 'rllib_env_steps_total{plane="sebulba"} 256' in text
+    assert "# TYPE rllib_learner_step_seconds histogram" in text
+    assert 'rllib_learner_step_seconds_count{plane="anakin"} 1' in text
+    assert 'rllib_learner_step_seconds_count{plane="sebulba"} 1' in text
+    # The gauge exists only where a queue exists; after the iteration the
+    # learner has drained it back to 0.
+    assert "# TYPE rllib_actor_learner_queue_depth gauge" in text
+    assert 'rllib_actor_learner_queue_depth{plane="sebulba"} 0' in text
+
+
 def test_tail_logs_returns_worker_output(cluster_rt):
     backend = cluster_rt
 

@@ -1,0 +1,201 @@
+"""Step-for-step parity between the numpy VectorEnvs and their functional
+JaxEnv forms (podracer satellite: the Anakin plane must train on the SAME
+MDP the EnvRunner plane samples).
+
+The dynamics are shared by construction (one xp-parameterized function, see
+`env/cartpole.py`), so what these tests guard is the WRAPPER semantics:
+reward conventions, termination/truncation masks, step accounting, episode
+return bookkeeping, and auto-reset behavior (finished envs return their
+reset observation; counters zero).
+
+Protocol: both sides are forced onto identical PRE-step states each step
+(the jax wrapper state is rebuilt from the numpy env's internals), so
+comparisons are per-transition and immune to f32-vs-f64 drift compounding
+over a horizon. Near-threshold disagreement (a state within float epsilon
+of a termination boundary) is excluded explicitly rather than papered over
+with seed luck.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.rllib.env.cartpole import (
+    THETA_THRESHOLD,
+    X_THRESHOLD,
+    VectorCartPole,
+)
+from ray_tpu.rllib.env.pendulum import VectorPendulum
+from ray_tpu.rllib.podracer.jax_env import (
+    JaxCartPole,
+    JaxPendulum,
+    autoreset_step,
+    init_env_state,
+    jax_env_registered,
+    make_jax_env,
+)
+
+N = 16
+STEPS = 120
+
+
+def _cartpole_margin(state: np.ndarray) -> np.ndarray:
+    """Distance of each env's state from the nearest termination boundary —
+    where this is ~float-epsilon, f32 and f64 may legitimately disagree."""
+    return np.minimum(
+        np.abs(np.abs(state[:, 0]) - X_THRESHOLD),
+        np.abs(np.abs(state[:, 2]) - THETA_THRESHOLD),
+    )
+
+
+def test_cartpole_stepwise_parity():
+    np_env = VectorCartPole(N, max_episode_steps=50)
+    jx_env = JaxCartPole(max_episode_steps=50)
+    np_env.reset(seed=0)
+    rng = np.random.default_rng(1)
+    key = jax.random.PRNGKey(2)
+
+    for t in range(STEPS):
+        # Force identical pre-step state: rebuild the jax wrapper state from
+        # the numpy env's internals (steps == ep_ret for reward-1-per-step).
+        pre_state = np_env._state.copy()
+        pre_steps = np_env._steps.copy()
+        est = {
+            "core": jnp.asarray(pre_state, jnp.float32),
+            "steps": jnp.asarray(pre_steps, jnp.int32),
+            "ep_ret": jnp.asarray(pre_steps, jnp.float32),
+        }
+        actions = rng.integers(0, 2, N)
+        obs, rew, term, trunc, info = np_env.step(actions)
+        key, k = jax.random.split(key)
+        new_est, out = autoreset_step(jx_env, est, jnp.asarray(actions), k)
+
+        done_np = term | trunc
+        # Margin is measured on the RAW post-step core (pre-auto-reset),
+        # recomputed via the env's own step_fn so done rows are included.
+        raw_core, _, _ = jx_env.step_fn(est["core"], jnp.asarray(actions))
+        safe = _cartpole_margin(np.asarray(raw_core)) > 1e-4
+        np.testing.assert_array_equal(
+            np.asarray(out["terminated"])[safe], term[safe],
+            err_msg=f"termination mask diverged at step {t}",
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out["truncated"])[safe], trunc[safe],
+            err_msg=f"truncation mask diverged at step {t}",
+        )
+        np.testing.assert_allclose(np.asarray(out["reward"]), rew, rtol=0)
+        # Where BOTH agree the episode continues, the post-step cores match
+        # to f32 precision and both observations equal those cores.
+        live = safe & ~done_np & ~np.asarray(out["done"]).astype(bool)
+        np.testing.assert_allclose(
+            np.asarray(new_est["core"])[live], np_env._state[live],
+            rtol=1e-5, atol=1e-5,
+            err_msg=f"dynamics diverged at step {t}",
+        )
+        np.testing.assert_allclose(
+            np.asarray(jx_env.observe_fn(new_est["core"]))[live],
+            obs[live], rtol=1e-5, atol=1e-5,
+        )
+        # Episode accounting at done: pre-reset length == the numpy env's
+        # reported episode_lengths (order of finished envs matches nonzero).
+        if done_np.any() and (np.asarray(out["done"]) > 0).any():
+            jx_lens = np.asarray(out["ep_len"])[done_np]
+            assert sorted(int(x) for x in jx_lens) == sorted(
+                info["episode_lengths"]
+            )
+            # Auto-reset: finished rows hold a FRESH state inside bounds and
+            # zeroed counters — the observation returned is the reset one.
+            fresh = np.asarray(new_est["core"])[done_np]
+            assert np.all(np.abs(fresh) <= 0.05 + 1e-6)
+            assert np.all(np.asarray(new_est["steps"])[done_np] == 0)
+            assert np.all(np.asarray(new_est["ep_ret"])[done_np] == 0)
+
+
+def test_pendulum_stepwise_parity():
+    np_env = VectorPendulum(N, max_episode_steps=40)
+    jx_env = JaxPendulum(max_episode_steps=40)
+    np_env.reset(seed=3)
+    rng = np.random.default_rng(4)
+    key = jax.random.PRNGKey(5)
+
+    for t in range(STEPS):
+        pre_theta = np_env._theta.copy()
+        pre_thdot = np_env._theta_dot.copy()
+        pre_steps = np_env._steps.copy()
+        pre_ret = np_env._ep_ret.copy()
+        est = {
+            "core": jnp.asarray(
+                np.stack([pre_theta, pre_thdot], axis=1), jnp.float32
+            ),
+            "steps": jnp.asarray(pre_steps, jnp.int32),
+            "ep_ret": jnp.asarray(pre_ret, jnp.float32),
+        }
+        actions = rng.uniform(-2.0, 2.0, (N, 1)).astype(np.float32)
+        obs, rew, term, trunc, info = np_env.step(actions)
+        key, k = jax.random.split(key)
+        new_est, out = autoreset_step(jx_env, est, jnp.asarray(actions), k)
+
+        # Pendulum never terminates; truncation is pure step accounting —
+        # exact parity, no boundary epsilon.
+        assert not np.asarray(out["terminated"]).any() and not term.any()
+        np.testing.assert_array_equal(np.asarray(out["truncated"]), trunc)
+        np.testing.assert_allclose(
+            np.asarray(out["reward"]), rew, rtol=1e-4, atol=1e-4
+        )
+        live = ~trunc
+        np.testing.assert_allclose(
+            np.asarray(new_est["core"])[live, 0], np_env._theta[live],
+            rtol=1e-4, atol=1e-4,
+        )
+        np.testing.assert_allclose(
+            np.asarray(jx_env.observe_fn(new_est["core"]))[live],
+            obs[live], rtol=1e-4, atol=1e-4,
+        )
+        if trunc.any():
+            # Pre-reset return/length parity at episode end.
+            np.testing.assert_allclose(
+                np.asarray(out["ep_ret"])[trunc],
+                (pre_ret + rew)[trunc],
+                rtol=1e-3, atol=1e-3,
+            )
+            np.testing.assert_array_equal(
+                np.asarray(out["ep_len"])[trunc], (pre_steps + 1)[trunc]
+            )
+            assert np.all(np.asarray(new_est["steps"])[trunc] == 0)
+
+
+def test_autoreset_scan_accounting():
+    """The wrapper composes with lax.scan (the Anakin rollout shape): step
+    counters and done totals stay consistent over a jitted unroll."""
+    env = JaxCartPole(max_episode_steps=25)
+    n, T = 8, 200
+    est = init_env_state(env, jax.random.PRNGKey(0), n)
+
+    def one(est, key):
+        k_act, k_reset = jax.random.split(key)
+        action = jax.random.bernoulli(k_act, 0.5, (n,)).astype(jnp.int32)
+        est, out = autoreset_step(env, est, action, k_reset)
+        return est, out
+
+    est, outs = jax.jit(
+        lambda e, k: jax.lax.scan(one, e, jax.random.split(k, T))
+    )(est, jax.random.PRNGKey(1))
+
+    done = np.asarray(outs["done"])
+    lens = np.asarray(outs["ep_len"])
+    # Every completed episode's length is within [1, max_episode_steps] and
+    # the sum of completed lengths + live counters equals total steps.
+    finished = lens[done > 0]
+    assert finished.size > 0
+    assert finished.min() >= 1 and finished.max() <= 25
+    total = finished.sum() + np.asarray(est["steps"]).sum()
+    assert total == T * n
+
+
+def test_registry_surface():
+    assert jax_env_registered("CartPole-v1")
+    assert jax_env_registered("Pendulum-v1")
+    assert isinstance(make_jax_env("CartPole-v1"), JaxCartPole)
+    with pytest.raises(KeyError, match="[Ss]ebulba"):
+        make_jax_env("NotAnEnv-v0")

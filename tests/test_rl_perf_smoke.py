@@ -1,0 +1,101 @@
+"""Podracer RL performance smoke (the runnable regression gate for
+BENCH_RL_podracer.json, mirroring the test_train_perf_smoke pattern).
+
+Learning parity is asserted BEFORE throughput — a fused plane that races
+through env steps while optimizing a different objective is not a pass.
+The throughput comparison re-measures BOTH sides live on this host (the
+recorded absolute numbers are machine-shaped; the recorded RATIO is the
+claim) with generous slack against gross regressions: the Anakin fused
+program falling out of jit (host round-trips per step), the Sebulba
+transport silently pickling frames through RPC returns, the speedup
+collapsing to EnvRunner-parity.
+
+Pinned numbers live in BENCH_RL_podracer.json via
+`scripts/bench_podracer.py --record`.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH_JSON = os.path.join(REPO, "BENCH_RL_podracer.json")
+
+sys.path.insert(0, REPO)
+
+# Recorded 24.5x; gate at a generous floor — this is a smoke against the
+# fused plane degenerating, not a calibrated benchmark.
+LIVE_SPEEDUP_FLOOR = 8.0
+
+
+@pytest.mark.slow
+def test_bench_artifact_recorded():
+    """The recorded artifact carries the acceptance claims: >= 20x the
+    EnvRunner baseline AND learning parity AND frames on the arena (a
+    re-record that loses any of the three fails loudly here)."""
+    with open(BENCH_JSON) as f:
+        bench = json.load(f)
+    assert bench["quick"] is False
+    modes = bench["modes"]
+    speedup = (
+        modes["anakin"]["env_steps_per_sec"]
+        / modes["envrunner"]["env_steps_per_sec"]
+    )
+    assert speedup >= 20.0, speedup
+    assert bench["summary"]["bar_met"] is True
+    # Learning parity: the classic path met its bar and the Anakin plane's
+    # greedy eval solves the same env.
+    assert bench["summary"]["learning_parity"]["envrunner_bar_met"] is True
+    assert bench["summary"]["learning_parity"]["anakin_eval_reward"] >= 150.0
+    # Sebulba's frames rode arena segments, not pickled RPC returns.
+    tr = modes["sebulba"]["transport"]
+    assert tr["frames_ride_arena"] is True
+    assert tr["actor_pub_arena_total"] > 0
+    assert tr["learner_fetch"]["fetch_inline"] == 0
+
+
+@pytest.mark.slow
+def test_anakin_learning_parity_then_speedup_live():
+    from scripts.bench_podracer import (
+        ANAKIN_ENVS,
+        ANAKIN_ROLLOUT,
+        bench_anakin,
+    )
+    from scripts.rl_perf import ppo_cartpole_probe
+
+    anakin = bench_anakin(quick=False)
+
+    # Parity first: the fused plane must SOLVE the env (greedy eval), and
+    # have crossed the classic path's reward bar during training.
+    assert anakin["eval_reward"] >= 150.0, anakin
+    assert anakin["best_reward"] >= 150.0, anakin
+    assert anakin["reward150_at_steps"] is not None
+    assert (
+        anakin["reward150_at_steps"]
+        <= anakin["steps_measured"] + ANAKIN_ENVS * ANAKIN_ROLLOUT
+    )
+
+    # Then throughput, against a LIVE baseline on this same host.
+    envrunner = ppo_cartpole_probe(max_iters=20)
+    speedup = anakin["env_steps_per_sec"] / envrunner["value"]
+    assert speedup >= LIVE_SPEEDUP_FLOOR, (
+        anakin["env_steps_per_sec"], envrunner["value"], speedup
+    )
+
+
+@pytest.mark.slow
+def test_sebulba_beats_envrunner_and_rides_arena_live():
+    from scripts.bench_podracer import bench_sebulba
+    from scripts.rl_perf import ppo_cartpole_probe
+
+    sebulba = bench_sebulba(quick=False)
+    assert sebulba["transport"]["frames_ride_arena"] is True
+
+    envrunner = ppo_cartpole_probe(max_iters=20)
+    # The split plane pays transport + broadcast per iteration; it must
+    # still clear the single-process classic path (recorded ~5x).
+    assert sebulba["env_steps_per_sec"] >= envrunner["value"] * 1.5, (
+        sebulba["env_steps_per_sec"], envrunner["value"]
+    )
