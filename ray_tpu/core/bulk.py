@@ -660,6 +660,25 @@ def _land_span(sock: socket.socket, writer, land_at: int, length: int,
         _recv_exact_into(sock, writer.raw_view(land_at, length), tmo)
 
 
+def _flight_pull_span(name: str, t0_ns: int, length: int, rung: str,
+                      err: Optional[BaseException] = None) -> None:
+    """One flight-recorder span per bulk pull: bytes, landing rung, and
+    whether a deadline abort cut it short (abort spans are death-kind —
+    exempt from the ring cap, the evidence survives the storm)."""
+    from ..util import flight
+
+    if not flight.enabled():
+        return
+    attrs = {"bytes": length, "rung": rung}
+    kind = ""
+    if err is not None:
+        attrs["abort"] = True
+        attrs["error"] = type(err).__name__
+        kind = "abort"
+    flight.record(name, t0_ns, flight.now_ns(), lane="bulk", kind=kind,
+                  attrs=attrs)
+
+
 def pull_span(addr: str, name: str, offset: int, length: int, writer,
               timeout_s: float, land_at: int = 0):
     """Pull one (offset, length) span of a stored object into `writer` at
@@ -669,10 +688,20 @@ def pull_span(addr: str, name: str, offset: int, length: int, writer,
     into a store object — the serve KV-transfer plane pulls prefix-cache
     block runs through here; the data plane's whole-object path is the
     `land_at == offset` special case (`_pull_span`)."""
-    sock = _open_bulk_conn(addr, timeout_s)
-    with contextlib.closing(sock):
-        _request_span(sock, {"name": name}, offset, length, timeout_s)
-        _land_span(sock, writer, land_at, length, timeout_s)
+    import time as _time
+
+    t0 = _time.monotonic_ns()
+    rung = (_native_land_mode() or "python") \
+        if getattr(writer, "sink", lambda: None)() is not None else "python"
+    try:
+        sock = _open_bulk_conn(addr, timeout_s)
+        with contextlib.closing(sock):
+            _request_span(sock, {"name": name}, offset, length, timeout_s)
+            _land_span(sock, writer, land_at, length, timeout_s)
+    except BaseException as e:
+        _flight_pull_span("bulk.pull", t0, length, rung, e)
+        raise
+    _flight_pull_span("bulk.pull", t0, length, rung)
 
 
 def fetch_span_bytes(addr: str, name: str, offset: int, length: int,
@@ -681,11 +710,19 @@ def fetch_span_bytes(addr: str, name: str, offset: int, length: int,
     block-sized reads where the consumer deserializes immediately: the
     data plane's shuffle partitions, and the MPMD training pipeline's
     cross-node activation/grad tensors in train/mpmd/transport.py)."""
+    import time as _time
+
+    t0 = _time.monotonic_ns()
     buf = bytearray(length)
-    sock = _open_bulk_conn(addr, timeout_s)
-    with contextlib.closing(sock):
-        _request_span(sock, {"name": name}, offset, length, timeout_s)
-        _recv_exact_into(sock, memoryview(buf), timeout_s)
+    try:
+        sock = _open_bulk_conn(addr, timeout_s)
+        with contextlib.closing(sock):
+            _request_span(sock, {"name": name}, offset, length, timeout_s)
+            _recv_exact_into(sock, memoryview(buf), timeout_s)
+    except BaseException as e:
+        _flight_pull_span("bulk.fetch_span", t0, length, "python", e)
+        raise
+    _flight_pull_span("bulk.fetch_span", t0, length, "python")
     return buf
 
 

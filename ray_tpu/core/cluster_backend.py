@@ -268,8 +268,14 @@ class ClusterBackend(RuntimeBackend):
                 payload["worker_id"] = self.worker.worker_id
             # Generous: worker boot storms (many interpreters importing
             # concurrently) legitimately delay controller responses.
+            w0 = _t.time()
             out = await conn.request(payload, timeout=60)
             phases["register"] = round(_t.monotonic() - t0, 2)
+            if isinstance(out, dict):
+                # RTT midpoint of the register round-trip — the instant
+                # the controller most plausibly sampled the "time" it
+                # returns. Used below for flight-recorder clock alignment.
+                out["_rtt_mid"] = (w0 + _t.time()) / 2.0
             return out
 
         try:
@@ -288,6 +294,17 @@ class ClusterBackend(RuntimeBackend):
             ) from e
         if not (result or {}).get("ok"):
             raise RayTpuError(f"Failed to register with controller: {result}")
+        rtt_mid = result.pop("_rtt_mid", None)
+        if result.get("time") is not None and rtt_mid is not None:
+            # Cross-host clock alignment for the flight recorder: offset =
+            # controller wall clock minus the RTT midpoint, so spans from
+            # this process merge onto the controller's timeline honestly
+            # (error bounded by half the register RTT — microseconds on a
+            # LAN, and registration is once per process).
+            from ..util import flight
+
+            flight.set_clock_offset(float(result["time"]) - rtt_mid)
+            flight.set_component(self.role)
         if result.get("session_dir"):
             self.session_dir = result["session_dir"]
         # Adopt the head's session tag unless this process is env-pinned to a

@@ -1520,6 +1520,9 @@ class Controller:
             "ok": True,
             "session_dir": self.session_dir,
             "session_tag": store.SESSION_TAG,
+            # Controller wall clock for the registrant's RTT-midpoint
+            # flight-recorder clock alignment (see cluster_backend._connect).
+            "time": time.time(),
         }
 
     async def h_register_client(self, conn, meta, msg):
@@ -1527,7 +1530,7 @@ class Controller:
         # agent's fetch client). Carries its node so gets resolve locally.
         meta["kind"] = "client"
         meta["node_id"] = msg.get("node_id", HEAD_NODE)
-        return {"ok": True}
+        return {"ok": True, "time": time.time()}
 
     async def h_register_worker(self, conn, meta, msg):
         worker_id = msg["worker_id"]
@@ -1617,7 +1620,7 @@ class Controller:
                          node=node_id, actor=actor_hex or "")
         self._event("worker_registered", worker=worker_id)
         self._schedule()
-        return {"ok": True}
+        return {"ok": True, "time": time.time()}
 
     async def h_register_node(self, conn, meta, msg):
         """A node agent joined (reference: `GcsNodeManager::HandleRegisterNode`).
@@ -1654,7 +1657,7 @@ class Controller:
         )
         self._event("node_added", node=node_id, resources=total)
         self._schedule()  # also retries pending PGs against the new capacity
-        return {"ok": True}
+        return {"ok": True, "time": time.time()}
 
     def _retry_pending_pgs(self):
         """Re-attempt placement of PGs that are not ready — new capacity (an
@@ -4981,6 +4984,23 @@ class Controller:
                 for n in self.nodes.values()
             ]
         }
+
+    async def h_flight_pull(self, conn, meta, msg):
+        """Poke every live worker to flush its flight-recorder span ring
+        NOW (one-way push; drained spans arrive over the task_events
+        channel). `ray-tpu flight` and /api/flight call this before
+        exporting so the merged trace is current rather than up to one
+        flusher period stale."""
+        n = 0
+        for ws in list(self.workers.values()):
+            if ws.state == DEAD or ws.conn is None or ws.conn._closed:
+                continue
+            try:
+                ws.conn.post({"type": "flight_pull"})
+                n += 1
+            except ConnectionError:
+                pass
+        return {"ok": True, "workers": n}
 
     async def h_state_summary(self, conn, meta, msg):
         if msg.get("counts_only"):  # cheap status — no timeline payload

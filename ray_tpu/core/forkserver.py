@@ -358,6 +358,14 @@ def template_main():
     # import+compile these per forked child (~120 ms each on the bench host).
     from . import api, cluster_backend, remote_function, runtime  # noqa: F401
     from ..util import placement_group  # noqa: F401  (api's lazy import)
+    # The flight ring is imported lazily by worker_main's task-events flush
+    # and by _connect's clock handshake — post-fork, that's private pages in
+    # every child. Import here so the module body lands on template pages;
+    # the per-process recorder singleton itself is NOT created (children
+    # build their own empty ring on first record()).
+    from ..util import flight as _flight
+
+    _flight.enabled()  # warm the env parse too
     # Native libs: dlopen + ctypes prototype setup once; children inherit
     # the loaded handle through fork instead of re-opening per boot.
     from .. import native as _native

@@ -350,8 +350,19 @@ class WorkerProcess:
         self._flush_direct_replies()
 
     def _flush_task_events(self):
+        # Piggyback the flight-recorder ring on the batched task_events
+        # channel — spans recorded by actor threads (engine steps, stage
+        # slots) leave with the next flush instead of waiting out the
+        # flight module's own flusher period. drain() is an atomic
+        # pop-all, so the two shippers can never duplicate a span.
+        from ..util import flight as _flight
+
+        fevs = _flight.recorder().drain() if _flight.enabled() else []
+        for ev in fevs:
+            ev.setdefault("worker", self.worker_id)
         with self._reply_lock:
-            if not self._task_events and not self._task_events_dropped:
+            if not self._task_events and not self._task_events_dropped \
+                    and not fevs:
                 return
             events, self._task_events = self._task_events, []
             dropped, self._task_events_dropped = self._task_events_dropped, 0
@@ -360,6 +371,7 @@ class WorkerProcess:
                 {"ts": time.time(), "event": "task_events_dropped",
                  "n": dropped, "worker": self.worker_id}
             )
+        events.extend(fevs)
         self.send({"type": "task_events", "events": events})
 
     def _flush_direct_replies(self):
@@ -442,9 +454,28 @@ class WorkerProcess:
         }
         if self.actor_instance is not None and self._actor_hex:
             payload["actor_hex"] = self._actor_hex  # controller-restart re-adoption
-        await conn.request(payload)
+        t0 = time.time()
+        out = await conn.request(payload)
+        t1 = time.time()
+        if isinstance(out, dict) and out.get("time") is not None:
+            # RTT-midpoint clock alignment (see cluster_backend._connect):
+            # flight-recorder spans from this worker land on the
+            # controller's clock, not this host's.
+            from ..util import flight
+
+            flight.set_clock_offset(float(out["time"]) - (t0 + t1) / 2.0)
+            flight.set_component("worker")
 
     async def _on_push(self, msg: dict):
+        if msg.get("type") == "flight_pull":
+            # On-demand flight-recorder flush (`ray-tpu flight` /
+            # /api/flight poke every worker through the controller so the
+            # merged export is current, not one flusher period stale).
+            try:
+                self._flush_task_events()
+            except ConnectionError:
+                pass
+            return
         if msg.get("type") == "drop_task":
             # Out-of-band: must take effect before the queued execute_task
             # reaches the main loop.

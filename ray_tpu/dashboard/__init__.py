@@ -20,6 +20,9 @@ Endpoints:
     GET /api/events        recent timeline events (?limit=N)
     GET /api/traces        recent request traces (summary rows, ?limit=N)
     GET /api/traces?trace_id=ID  one trace's full span forest
+    GET /api/flight        merged flight-recorder payload (lanes, pipeline
+                           bubble report, ONE Perfetto chrome-trace;
+                           ?trace_id=ID restricts the chrome-trace)
     GET /api/logs?worker_id=ID   tail of one worker's log
 """
 
@@ -130,11 +133,12 @@ class DashboardServer:
                 # api.timeline() see): keeps the two surfaces consistent and
                 # caps the forest assembly this does on the controller's
                 # event loop (the full timeline can hold 100k events).
+                # ONE export path shared with `ray-tpu trace`
+                # (tracing.trace_payload): CLI and HTTP cannot drift.
                 events = list(c.timeline[-10000:])
                 trace_id = query.get("trace_id")
                 if trace_id:
-                    forest = tracing.trace_forest(events)
-                    t = forest.get(trace_id)
+                    t = tracing.trace_payload(events, trace_id=trace_id)["trace"]
                     if t is None:
                         return (
                             "404 Not Found",
@@ -144,7 +148,20 @@ class DashboardServer:
                     data = t
                 else:
                     limit = max(1, int(query.get("limit", 50)))
-                    data = {"traces": tracing.trace_summaries(events, limit)}
+                    data = tracing.trace_payload(events, limit=limit)
+            elif name == "flight":
+                from ..util import flight
+
+                # Pull-on-demand: poke every live worker to flush its span
+                # ring, give the task_events piggybacks a beat to land, then
+                # build the merged payload — the same builder as
+                # `ray-tpu flight` (flight.flight_payload), so the two
+                # surfaces emit identical output for the same timeline.
+                await c.h_flight_pull(None, {}, {})
+                await asyncio.sleep(0.25)
+                data = flight.flight_payload(
+                    list(c.timeline[-10000:]), trace_id=query.get("trace_id")
+                )
             elif name == "logs":
                 wid = query.get("worker_id", "")
                 if not wid:

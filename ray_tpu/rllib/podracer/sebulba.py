@@ -96,7 +96,16 @@ class _ActorMember(ChannelHostMixin):
         batch = self._runner.sample(self._params)
         episode_returns = batch.pop("episode_returns")
         episode_lengths = batch.pop("episode_lengths")
+        from ...util import flight
+
+        t0 = flight.now_ns()
         desc = self._transport.publish(batch)
+        flight.record("sebulba.publish", t0, flight.now_ns(),
+                      lane="rl/actor", attrs={"frames": len(batch)})
+        # Cluster-clock publish stamp: the learner turns the gap between
+        # this and its fetch into an actor->learner queue-wait span.
+        desc = dict(desc)
+        desc["published_at"] = flight.cluster_time()
         return {
             "desc": desc,
             "episode_returns": episode_returns,
@@ -148,8 +157,28 @@ class _LearnerMember(ChannelHostMixin):
         the env axis, run the update program."""
         import jax
 
+        from ...util import flight
+
         self._gauge_queue_depth(len(descs))
-        batches = [self._transport.fetch(d) for d in descs]
+        # Queue-wait spans: published_at is the actor's cluster-clock stamp
+        # (both ends clock-aligned at registration), so the span length IS
+        # the time the fragment sat between the gangs — the latency the
+        # rllib_actor_learner_queue_depth gauge only counts.
+        fetch_t = flight.cluster_time()
+        batches = []
+        for d in descs:
+            d = dict(d)
+            pub = d.pop("published_at", None)
+            if pub is not None and flight.enabled():
+                wait = max(fetch_t - pub, 0.0)
+                t1 = flight.now_ns()
+                flight.record("sebulba.queue_wait",
+                              t1 - int(wait * 1e9), t1,
+                              lane="rl/learner", attrs={"depth": len(descs)})
+            t0 = flight.now_ns()
+            batches.append(self._transport.fetch(d))
+            flight.record("sebulba.import", t0, flight.now_ns(),
+                          lane="rl/learner")
         if len(batches) == 1:
             batch = batches[0]
         else:

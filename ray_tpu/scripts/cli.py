@@ -247,8 +247,10 @@ def cmd_trace(backend, info, args):
     from ray_tpu.util import tracing
 
     events = backend._request({"type": "state_summary"})["timeline"]
+    # Same payload builder as the dashboard's /api/traces — ONE export
+    # path (tracing.trace_payload), so CLI and HTTP cannot drift.
     if not args.trace_id:
-        rows = tracing.trace_summaries(events, args.limit)
+        rows = tracing.trace_payload(events, limit=args.limit)["traces"]
         for r in rows:
             r["start"] = f"{r['start']:.3f}" if r["start"] is not None else ""
             r["duration_ms"] = (
@@ -256,8 +258,7 @@ def cmd_trace(backend, info, args):
             )
         _table(rows, ["trace_id", "name", "start", "duration_ms", "n_tasks", "n_spans"])
         return
-    forest = tracing.trace_forest(events)
-    t = forest.get(args.trace_id)
+    t = tracing.trace_payload(events, trace_id=args.trace_id)["trace"]
     if t is None:
         raise SystemExit(f"unknown trace {args.trace_id}")
     if args.output:
@@ -275,6 +276,44 @@ def cmd_trace(backend, info, args):
               f"  {ev.get('name', 'span')}  {ev.get('args') or ''}")
     for root in t["tasks"]:
         _print_span_tree(root, t0)
+
+
+def cmd_flight(backend, info, args):
+    """`flight` — merged cluster flight-recorder view: pokes every worker
+    to flush its span ring, then prints the lane/drop/pipeline summary;
+    `-o FILE` writes ONE merged Perfetto chrome-trace instead."""
+    import time as _time
+
+    from ray_tpu.util import flight
+
+    # Pull-on-demand: workers flush their rings via the task_events
+    # piggyback; give those posts a beat to land in the controller timeline.
+    try:
+        backend._request({"type": "flight_pull"})
+        _time.sleep(args.wait)
+    except Exception:  # noqa: BLE001 — older controller: use what's there
+        pass
+    events = backend._request({"type": "state_summary"})["timeline"]
+    # Same payload builder as the dashboard's /api/flight — ONE export
+    # path (flight.flight_payload), so CLI and HTTP cannot drift.
+    payload = flight.flight_payload(events, trace_id=args.trace_id)
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(payload["trace_events"], f)
+        print(f"wrote {len(payload['trace_events'])} merged chrome-trace "
+              f"events to {args.output}")
+        return
+    print(f"flight spans: {payload['n_spans']}  dropped: {payload['dropped']}")
+    for lane in sorted(payload["lanes"]):
+        print(f"  {lane:28s} {payload['lanes'][lane]}")
+    rep = payload["pipeline"]
+    if rep:
+        print(f"pipeline bubble: {rep['bubble_frac']:.3f} over "
+              f"{len(rep['steps'])} step(s), {rep['lanes']} lane(s)")
+        print(f"  warmup {rep['warmup_s']:.3f}s  steady {rep['steady_s']:.3f}s"
+              f"  drain {rep['drain_s']:.3f}s")
+        print(f"  transport-wait {rep['transport_wait_s']:.3f}s  "
+              f"compute {rep['compute_s']:.3f}s")
 
 
 def main(argv=None):
@@ -298,6 +337,13 @@ def main(argv=None):
     p_tr.add_argument("-o", "--output", default=None,
                       help="with a trace id: write that trace as chrome-trace JSON")
     p_tr.add_argument("--limit", type=int, default=25)
+    p_fl = sub.add_parser("flight", help="merged cluster flight-recorder view")
+    p_fl.add_argument("trace_id", nargs="?", default=None,
+                      help="restrict the -o chrome trace to one request")
+    p_fl.add_argument("-o", "--output", default=None,
+                      help="write merged Perfetto chrome-trace JSON")
+    p_fl.add_argument("--wait", type=float, default=0.5,
+                      help="seconds to wait for worker flushes after the pull")
     p_job = sub.add_parser("job", help="submit/inspect cluster jobs")
     job_sub = p_job.add_subparsers(dest="job_command", required=True)
     p_sub = job_sub.add_parser("submit")
@@ -338,6 +384,7 @@ def main(argv=None):
             "logs": cmd_logs,
             "timeline": cmd_timeline,
             "trace": cmd_trace,
+            "flight": cmd_flight,
             "job": cmd_job,
             "serve": cmd_serve,
             "workflow": cmd_workflow,

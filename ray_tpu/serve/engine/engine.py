@@ -659,12 +659,21 @@ class InferenceEngine:
             return None
         from concurrent.futures import Future, TimeoutError as _FutTimeout
 
+        from ...util import flight
+        from ...util.tracing import get_trace_id
+
+        # Captured HERE (the replica RPC thread carries the request's task
+        # context); _do_export runs on the driver thread, which has none.
+        # The trace rides the descriptor so the importing replica's spans
+        # join the same x-request-id forest.
+        trace = get_trace_id()
+        t0 = flight.now_ns()
         fut: "Future" = Future()
         with self._work:
             self._side_work.append(("export", digests, fut))
             self._work.notify_all()
         try:
-            return fut.result(
+            desc = fut.result(
                 timeout_s if timeout_s is not None
                 else self.opts.kv_transfer_timeout_s
             )
@@ -674,6 +683,14 @@ class InferenceEngine:
             # put or controller RPC failing mid-export must degrade the
             # handoff to colocated recompute, not fail the caller's request.
             return None
+        if desc is not None:
+            if trace:
+                desc["trace"] = trace
+            flight.record(
+                "kv.export", t0, flight.now_ns(), trace=trace,
+                lane="serve/engine", flow=f"disagg/{trace}" if trace else None,
+                attrs={"blocks": len(desc.get("digests") or ())})
+        return desc
 
     def _do_export(self, digests: List[bytes]) -> Optional[Dict[str, Any]]:
         """Driver-thread half of export_prompt_kv: gather block bytes (HBM
@@ -724,8 +741,21 @@ class InferenceEngine:
         if not desc or not self.opts.enable_prefix_caching \
                 or self._stop.is_set():
             return 0
+        from ...util import flight
+
+        trace = desc.get("trace")
+        t0 = flight.now_ns()
+
+        def _span(n: int, needed: int) -> int:
+            flight.record(
+                "kv.import", t0, flight.now_ns(), trace=trace,
+                lane="serve/engine",
+                flow=f"disagg/{trace}" if trace else None,
+                attrs={"blocks": n, "needed": needed})
+            return n
+
         if desc.get("sig") != self._kv_sig():
-            return 0
+            return _span(0, 0)
         from . import kv_transfer
 
         with self._lock:
@@ -741,12 +771,12 @@ class InferenceEngine:
                 )
             ]
         if not needed:
-            return 0
+            return _span(0, 0)
         blobs = kv_transfer.fetch_blocks(
             desc, needed, timeout_s=self.opts.kv_transfer_timeout_s
         )
         if not blobs:
-            return 0
+            return _span(0, len(needed))
         n = 0
         with self._lock:
             for hx, blob in blobs:
@@ -759,7 +789,7 @@ class InferenceEngine:
                 if self.block_manager.adopt_block(h, blob) is None:
                     break  # pool has nothing to give — the rest recompute
                 n += 1
-        return n
+        return _span(n, len(needed))
 
     def _service_side_work(self):
         """Run queued export requests at the step boundary (after loads:
@@ -917,6 +947,13 @@ class InferenceEngine:
         """One engine iteration; safe to drive manually (tests) or from the
         driver thread. Returns a stats snapshot."""
         t0 = time.monotonic()
+        # Flight-recorder step span: one monotonic_ns read + enabled()
+        # check up front; the record itself only happens on steps that did
+        # work. Budgeted ≤5% of decode-step time (test_flight_perf_smoke).
+        from ...util import flight
+
+        fl_on = flight.enabled()
+        t0_ns = time.monotonic_ns() if fl_on else 0
         self._step_ttfts, self._step_tpots = [], []
         self._step_spec = [0, 0]  # [proposed, accepted]
         tok0 = self.total_tokens
@@ -975,6 +1012,13 @@ class InferenceEngine:
             "step_tpots": list(self._step_tpots),
             "step_s": now - t0,
         }
+        if fl_on and (out.prefills or out.decodes):
+            flight.record(
+                "engine.step", t0_ns, time.monotonic_ns(),
+                lane=f"serve/engine-{self.opts.role or 'colocated'}",
+                attrs={"prefills": len(out.prefills),
+                       "decodes": len(out.decodes),
+                       "tokens": stats["step_tokens"]})
         self._export_metrics(stats)
         return stats
 

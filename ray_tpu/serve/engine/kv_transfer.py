@@ -221,18 +221,34 @@ def fetch_blocks(
     except KeyError:
         return None  # descriptor doesn't carry a requested digest
 
+    from ...util import flight
+
+    # The exporter stamped its trace id on the descriptor, so this span
+    # (and the bulk.pull spans nested under the span_pull rung) lands in
+    # the same x-request-id forest as the prefill that produced the KV.
+    trace = desc.get("trace")
+    t0 = flight.now_ns()
+
+    def _done(result, rung: str):
+        flight.record(
+            "kv.fetch", t0, flight.now_ns(), trace=trace,
+            lane="serve/kv", flow=f"disagg/{trace}" if trace else None,
+            attrs={"rung": rung, "blocks": len(idx),
+                   "ok": result is not None})
+        return result
+
     inline = desc.get("inline")
     if inline is not None:
         dtype = np.dtype(desc["dtype"])
         shape = tuple(desc["shape"])
         try:
-            return [
+            return _done([
                 (needed_hex[j],
                  np.frombuffer(inline[i], dtype=dtype).reshape(shape))
                 for j, i in enumerate(idx)
-            ]
+            ], "inline")
         except Exception:  # noqa: BLE001
-            return None
+            return _done(None, "inline")
 
     backend = _backend()
     if backend is None:
@@ -248,7 +264,9 @@ def fetch_blocks(
         try:
             wrapped = store.read(name)
             blocks = wrapped["blocks"]
-            return [(needed_hex[j], blocks[i]) for j, i in enumerate(idx)]
+            return _done(
+                [(needed_hex[j], blocks[i]) for j, i in enumerate(idx)],
+                "local")
         except Exception:  # noqa: BLE001 — not local / gone; pull spans
             pass
 
@@ -267,7 +285,9 @@ def fetch_blocks(
             except Exception:  # noqa: BLE001 — source died/evicted mid-read
                 got = None
             if got is not None and len(got) == len(idx):
-                return [(needed_hex[j], got[i]) for j, i in enumerate(idx)]
+                return _done(
+                    [(needed_hex[j], got[i]) for j, i in enumerate(idx)],
+                    "span_pull")
 
     # Rung 3: whole-object get (borrow/map zero-copy same host, classic
     # transfer otherwise; lineage re-execution absorbs eviction).
@@ -277,7 +297,9 @@ def fetch_blocks(
 
             wrapped = api.get(ref, timeout=timeout_s)
             blocks = wrapped["blocks"]
-            return [(needed_hex[j], blocks[i]) for j, i in enumerate(idx)]
+            return _done(
+                [(needed_hex[j], blocks[i]) for j, i in enumerate(idx)],
+                "object_get")
         except Exception:  # noqa: BLE001
-            return None
-    return None
+            return _done(None, "object_get")
+    return _done(None, "none")

@@ -21,6 +21,8 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..util import flight, tracing
+
 _ROUTER_REFRESH_S = 1.0
 
 # Routing-key block size used before any replica telemetry reveals the
@@ -475,7 +477,11 @@ class Router:
                     eos = kwargs.get("eos_token")
         except Exception:  # noqa: BLE001 — unparseable: keep colocated path
             return None
-        return {"prompt": list(prompt), "max_new": max_new, "eos": eos}
+        # Captured on the CALLER's thread — the handoff pool thread that
+        # executes the plan has no task context, so the trace id must ride
+        # the plan dict for one x-request-id to cover the whole handoff.
+        return {"prompt": list(prompt), "max_new": max_new, "eos": eos,
+                "trace": tracing.get_trace_id()}
 
     def _colocated_fallback(self, plan: Dict, exclude_tag: Optional[str],
                             timeout_s=None) -> Dict:
@@ -513,6 +519,9 @@ class Router:
         idx, rep, tag = self._pick_replica(
             prompt=plan["prompt"], role="prefill"
         )
+        trace = plan.get("trace")
+        flow = f"disagg/{trace}" if trace else None
+        t0 = flight.now_ns()
         try:
             res = ray_tpu.get(
                 rep.handle_request.remote(
@@ -528,9 +537,18 @@ class Router:
             # Prefill replica died (or wedged) mid-handoff: recompute
             # elsewhere. Nothing imports a descriptor for THIS request —
             # the fallback recomputes from scratch, greedy-identical.
+            # Death-kind span: exempt from the flight ring cap, so the
+            # partial trace stays readable after a SIGKILL'd replica.
+            flight.record(
+                "disagg.prefill_abort", t0, flight.now_ns(), trace=trace,
+                lane="serve/router", kind="death", flow=flow,
+                attrs={"replica": tag, "error": type(e).__name__})
             return None, self._colocated_fallback(plan, tag)
         finally:
             self._done(idx)
+        flight.record(
+            "disagg.prefill_handoff", t0, flight.now_ns(), trace=trace,
+            lane="serve/router", flow=flow, attrs={"replica": tag})
         if res.get("finished"):
             return None, {"tokens": res["tokens"],
                           "finish_reason": res["finish_reason"]}
@@ -543,11 +561,16 @@ class Router:
         which replicas survive."""
         import ray_tpu
 
+        # Re-install the caller's trace id on this pool thread so the
+        # replica RPCs (and their engine spans) inherit it.
+        tracing.set_trace_id(plan.get("trace"))
         res, done = self._disagg_prefill(plan)
         if done is not None:
             return done
         first = res["tokens"][0]
         idx, rep, tag = self._pick_replica(role="decode")
+        trace = plan.get("trace")
+        t0 = flight.now_ns()
         try:
             rest = ray_tpu.get(
                 rep.handle_request.remote(
@@ -559,9 +582,18 @@ class Router:
         except Exception as e:  # noqa: BLE001
             if not _is_replica_failure(e):
                 raise
+            flight.record(
+                "disagg.decode_abort", t0, flight.now_ns(), trace=trace,
+                lane="serve/router", kind="death",
+                attrs={"replica": tag, "error": type(e).__name__})
             return self._colocated_fallback(plan, tag)
         finally:
             self._done(idx)
+        flight.record(
+            "disagg.decode", t0, flight.now_ns(), trace=trace,
+            lane="serve/router",
+            flow=f"disagg/{trace}" if trace else None,
+            attrs={"replica": tag})
         return {"tokens": [first] + rest["tokens"],
                 "finish_reason": rest["finish_reason"]}
 
@@ -587,6 +619,7 @@ class Router:
         duplicated or diverging tokens."""
         import ray_tpu
 
+        tracing.set_trace_id(plan.get("trace"))
         res, done = self._disagg_prefill(plan)
         if done is not None:
             yield from done["tokens"]

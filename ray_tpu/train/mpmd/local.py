@@ -56,7 +56,7 @@ def run_local_pipeline(
         runners.append([
             StageRunner(
                 cfg, s, num_stages, num_microbatches, stage_params,
-                comms[r], zero=zero, lr=lr, betas=betas, eps=eps,
+                comms[r], replica=r, zero=zero, lr=lr, betas=betas, eps=eps,
                 weight_decay=weight_decay,
             )
             for r in range(dp)
@@ -95,6 +95,9 @@ def run_local_pipeline(
         threading.Thread(target=worker, args=(s, r), daemon=True)
         for s in range(num_stages) for r in range(dp)
     ]
+    import time as _time
+
+    run_t0 = _time.monotonic()
     for t in threads:
         t.start()
     deadline = step_timeout_s * max(1, len(batches))
@@ -105,6 +108,7 @@ def run_local_pipeline(
                 "local MPMD pipeline wedged (schedule deadlock or a dead "
                 f"sibling thread); errors so far: {errors!r}"
             )
+    run_wall = _time.monotonic() - run_t0
     if errors:
         raise errors[0]
 
@@ -140,7 +144,19 @@ def run_local_pipeline(
                 merged.setdefault(k, np.asarray(v))
     for k, parts in layer_parts.items():
         merged[k] = np.concatenate(parts, axis=0)
-    return {"history": history, "params": merged, "runners": runners}
+    # Aggregate pipeline-bubble number for the whole run, trainer-style
+    # denominator (wall * lanes) but with the optimizer update included in
+    # the numerator — the same busy definition as the flight recorder's
+    # span-derived attribution (flight.pipeline_report), so the two are
+    # directly cross-checkable on this harness.
+    busy_total = sum(
+        m["busy_s"] + m.get("update_s", 0.0)
+        for outs in results.values() for m in outs
+    )
+    lanes = num_stages * dp
+    bubble = max(0.0, 1.0 - busy_total / max(run_wall * lanes, 1e-9))
+    return {"history": history, "params": merged, "runners": runners,
+            "wall_s": run_wall, "bubble_frac": bubble}
 
 
 def gpt_layer_keys():

@@ -60,6 +60,7 @@ class StageRunner:
         stage_params,
         comm=None,
         *,
+        replica: int = 0,
         zero: bool = True,
         lr: float = 1e-3,
         betas=(0.9, 0.95),
@@ -70,6 +71,9 @@ class StageRunner:
 
         self.cfg = cfg
         self.stage = stage
+        # dp-replica index — only used to label this runner's flight lane
+        # and metric series; the comm object carries the collective rank.
+        self.replica = replica
         self.num_stages = num_stages
         self.num_microbatches = num_microbatches
         self.first = stage == 0
@@ -129,54 +133,113 @@ class StageRunner:
             if self.last:
                 targets = tokens[:, 1:].reshape(M, mb, -1)
 
+        from ...util import flight
+
+        # Flight-recorder slot spans: a lane per (stage, dp-replica) and a
+        # flow key per (step, microbatch, replica), so the merged Perfetto
+        # view draws the 1F1B wave with arrows following each microbatch
+        # across stages. Timing below uses monotonic_ns for BOTH the busy
+        # accounting and the spans (one clock, one read per boundary);
+        # recording is a lock-guarded list append (see overhead gate in
+        # tests/test_flight_perf_smoke.py).
+        fl = flight.recorder() if flight.enabled() else None
+        if fl is not None:
+            flight.ensure_flusher()
+        lane = f"mpmd/s{self.stage}r{self.replica}"
+        step_no = self.state.step + 1
+        base = {"stage": self.stage, "replica": self.replica, "step": step_no}
+
         saved: Dict[int, Any] = {}
         acc = None
         losses: List[float] = []
         busy = 0.0
         for op, i in build_1f1b(self.stage, self.num_stages, M):
+            flow = f"mb/{step_no}/{i}/r{self.replica}"
             if op == F:
                 if self.first:
                     x = jnp.asarray(inputs[i])
                 else:
+                    r0 = time.monotonic_ns()
                     x = jnp.asarray(self.fwd_in.recv())
+                    if fl is not None:
+                        fl.record("mpmd.recv_wait", r0, time.monotonic_ns(),
+                                  lane=lane,
+                                  attrs={**base, "mb": i, "dir": "fwd"})
                 saved[i] = x
                 if not self.last:
-                    t0 = time.monotonic()
+                    t0 = time.monotonic_ns()
                     y = self._fwd(self.params, x)
                     y.block_until_ready()
-                    busy += time.monotonic() - t0
+                    t1 = time.monotonic_ns()
+                    busy += (t1 - t0) * 1e-9
+                    if fl is not None:
+                        fl.record("mpmd.fwd", t0, t1, lane=lane, flow=flow,
+                                  attrs={**base, "mb": i})
+                    s0 = time.monotonic_ns()
                     self.fwd_out.send(np.asarray(y))
+                    if fl is not None:
+                        fl.record("mpmd.send", s0, time.monotonic_ns(),
+                                  lane=lane,
+                                  attrs={**base, "mb": i, "dir": "fwd"})
                 # Last stage: loss + backward run together at the B op.
             else:
                 assert op == B
                 x = saved.pop(i)
                 if self.last:
-                    t0 = time.monotonic()
+                    t0 = time.monotonic_ns()
                     loss, gp, gx = self._loss_bwd(
                         self.params, x, jnp.asarray(targets[i])
                     )
                     jax.block_until_ready(gp)
-                    busy += time.monotonic() - t0
+                    t1 = time.monotonic_ns()
+                    busy += (t1 - t0) * 1e-9
+                    if fl is not None:
+                        fl.record("mpmd.bwd", t0, t1, lane=lane, flow=flow,
+                                  attrs={**base, "mb": i})
                     losses.append(float(loss))
                 else:
+                    r0 = time.monotonic_ns()
                     gy = jnp.asarray(self.bwd_in.recv())
-                    t0 = time.monotonic()
+                    t0 = time.monotonic_ns()
                     gp, gx = self._fwd_bwd(self.params, x, gy)
                     jax.block_until_ready(gp)
-                    busy += time.monotonic() - t0
+                    t1 = time.monotonic_ns()
+                    busy += (t1 - t0) * 1e-9
+                    if fl is not None:
+                        fl.record("mpmd.recv_wait", r0, t0, lane=lane,
+                                  attrs={**base, "mb": i, "dir": "bwd"})
+                        fl.record("mpmd.bwd", t0, t1, lane=lane, flow=flow,
+                                  attrs={**base, "mb": i})
                 if not self.first:
+                    s0 = time.monotonic_ns()
                     self.bwd_out.send(np.asarray(gx))
+                    if fl is not None:
+                        fl.record("mpmd.send", s0, time.monotonic_ns(),
+                                  lane=lane,
+                                  attrs={**base, "mb": i, "dir": "bwd"})
                 acc = gp if acc is None else self._acc(acc, gp)
 
         # Mean over microbatches (loss = mean of equal-size microbatch
         # means), then the dp-sharded update.
-        t0 = time.monotonic()
+        t0 = time.monotonic_ns()
         flat_g, _ = zero_flatten(jax.tree_util.tree_map(np.asarray, acc))
         flat_g = flat_g / np.float32(M)
         new_flat, grad_sumsq = self.opt.step(flat_g)
         self.params = jax.device_put(zero_unflatten(new_flat, self._spec))
-        self.last_update_s = time.monotonic() - t0
+        t1 = time.monotonic_ns()
+        if fl is not None:
+            fl.record("mpmd.update", t0, t1, lane=lane, attrs=dict(base))
+        self.last_update_s = (t1 - t0) * 1e-9
         self.last_busy_s = busy
+        try:
+            from ...util.metrics import train_metrics
+
+            train_metrics()["train_stage_step_seconds"].observe(
+                busy + self.last_update_s,
+                tags={"stage": str(self.stage),
+                      "replica": str(self.replica)})
+        except Exception:  # noqa: BLE001 — metrics must never fail a step
+            pass
         self.state.step += 1
         out: Dict[str, Any] = {
             "step": self.state.step,

@@ -110,8 +110,9 @@ class _StageReplica(ChannelHostMixin):
         # the driver initialized the full tree once and shipped slices.
         self._runner = StageRunner(
             cfg, o["stage"], o["num_stages"], o["num_microbatches"],
-            o["stage_params"], comm, zero=o["zero"], lr=o["lr"],
-            betas=o["betas"], eps=o["eps"], weight_decay=o["weight_decay"],
+            o["stage_params"], comm, replica=o["dp_rank"], zero=o["zero"],
+            lr=o["lr"], betas=o["betas"], eps=o["eps"],
+            weight_decay=o["weight_decay"],
         )
         transport = ActTransport(
             inline_max_bytes=o["inline_max_bytes"],
@@ -501,6 +502,7 @@ class MPMDTrainer:
             last = [metrics[(S - 1, r)] for r in range(dp)]
             per_stage0 = [metrics[(s, 0)] for s in range(S)]
             busy = sum(m["busy_s"] for m in metrics.values())
+            bubble = max(0.0, 1.0 - busy / (wall * S * dp))
             history.append({
                 "step": step + 1,
                 "loss": float(np.mean([m["loss"] for m in last])),
@@ -508,12 +510,22 @@ class MPMDTrainer:
                     np.sqrt(sum(m["grad_sumsq"] for m in per_stage0))
                 ),
                 "wall_s": wall,
-                "bubble_frac": max(0.0, 1.0 - busy / (wall * S * dp)),
+                "bubble_frac": bubble,
                 "opt_bytes_per_replica": max(
                     m["opt_bytes"] for m in metrics.values()
                 ),
                 "dp": dp,
             })
+            try:
+                # The trainer's wall-clock aggregate; the span-derived
+                # attribution (flight.pipeline_report, source="flight")
+                # cross-checks it from the stage actors' slot spans.
+                from ...util.metrics import train_metrics
+
+                train_metrics()["train_pipeline_bubble_fraction"].set(
+                    bubble, tags={"source": "trainer"})
+            except Exception:  # noqa: BLE001
+                pass
 
     def _get_step_results(self, refs, step: int, supervisor):
         """Collect one step's replica results in SHORT slices, consulting

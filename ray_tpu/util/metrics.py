@@ -189,6 +189,67 @@ def serve_fleet_metrics() -> Dict[str, "_Metric"]:
         return _FLEET
 
 
+_TRAIN: Optional[Dict[str, "_Metric"]] = None
+_TRAIN_LOCK = threading.Lock()
+
+
+def train_metrics() -> Dict[str, "_Metric"]:
+    """MPMD-training metric families (stage actors and the trainer driver
+    feed these — before the flight-recorder PR, MPMD exported no
+    Prometheus families at all): `train_stage_step_seconds` is the
+    per-(stage, replica) busy+update time distribution per pipeline step,
+    `train_pipeline_bubble_fraction` is the pipeline idle fraction by
+    source ("trainer" = the driver's aggregate wall-clock formula,
+    "flight" = the span-derived attribution from flight.pipeline_report —
+    the two cross-check each other). Created lazily so importing metrics
+    never boots a runtime."""
+    global _TRAIN
+    with _TRAIN_LOCK:
+        if _TRAIN is None:
+            _TRAIN = {
+                "train_stage_step_seconds": Histogram(
+                    "train_stage_step_seconds",
+                    "Seconds of stage busy time (compute + optimizer "
+                    "update) per pipeline step, per stage replica",
+                    boundaries=(
+                        0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                        1.0, 2.5, 5.0, 10.0, 30.0,
+                    ),
+                    tag_keys=("stage", "replica"),
+                ),
+                "train_pipeline_bubble_fraction": Gauge(
+                    "train_pipeline_bubble_fraction",
+                    "Fraction of the pipeline step spent idle "
+                    "(1 - busy / (wall * stages * dp))",
+                    tag_keys=("source",),
+                ),
+            }
+        return _TRAIN
+
+
+_FLIGHT: Optional[Dict[str, "_Metric"]] = None
+_FLIGHT_LOCK = threading.Lock()
+
+
+def flight_metrics() -> Dict[str, "_Metric"]:
+    """Flight-recorder health families: `flight_spans_dropped_total`
+    counts ring-overflow drops per component (the same bounded-cap +
+    single-marker accounting as task_events_dropped). Created lazily so
+    importing metrics never boots a runtime."""
+    global _FLIGHT
+    with _FLIGHT_LOCK:
+        if _FLIGHT is None:
+            _FLIGHT = {
+                "flight_spans_dropped_total": Counter(
+                    "flight_spans_dropped_total",
+                    "Flight-recorder spans dropped to ring overflow "
+                    "(death-kind spans are exempt from the cap)",
+                    tag_keys=("component",),
+                ),
+            }
+        return _FLIGHT
+
+
 class _Metric:
     kind = "gauge"
 

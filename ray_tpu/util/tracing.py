@@ -304,6 +304,20 @@ def _count_tasks(span_dict: dict) -> int:
     return 1 + sum(_count_tasks(c) for c in span_dict.get("children", ()))
 
 
+# ------------------------------------------------------ shared trace export
+def trace_payload(
+    events: List[dict], trace_id: Optional[str] = None, limit: int = 50
+) -> dict:
+    """ONE export path for every trace surface. ``ray-tpu trace`` and the
+    dashboard's ``/api/traces`` each used to rebuild this JSON by hand
+    and had already drifted; both now emit exactly this dict (plus a
+    surface-local timestamp), so a regression in one is a regression in
+    both — and is caught by one test."""
+    if trace_id is not None:
+        return {"trace": trace_forest(events).get(trace_id)}
+    return {"traces": trace_summaries(events, limit=limit)}
+
+
 # ----------------------------------------------------- chrome-trace export
 def _lane(key: Any, mod: int) -> int:
     """Deterministic lane id: crc32, NOT builtin hash() — hash() is salted
@@ -399,3 +413,49 @@ def chrome_trace_with_flows(
              "args": {"name": label}}
         )
     return out
+
+
+def validate_chrome_trace(trace_events: List[dict]) -> dict:
+    """Schema check for a chrome-trace export: raises ValueError on the
+    first malformation, returns per-phase counts on success. Shared by
+    the ``api.timeline`` test and the flight-recorder tests so every
+    export surface stays Perfetto-loadable."""
+    import json
+
+    if not isinstance(trace_events, list):
+        raise ValueError(f"trace must be a list, got {type(trace_events)}")
+    counts: Dict[str, int] = {}
+    flow_starts, flow_finishes = set(), set()
+    for i, ev in enumerate(trace_events):
+        if not isinstance(ev, dict):
+            raise ValueError(f"event {i}: not a dict")
+        ph = ev.get("ph")
+        if ph not in ("X", "M", "s", "f", "B", "E", "i", "C"):
+            raise ValueError(f"event {i}: bad ph {ph!r}")
+        counts[ph] = counts.get(ph, 0) + 1
+        if not isinstance(ev.get("name"), str):
+            raise ValueError(f"event {i}: missing name")
+        if ph == "M":
+            if not isinstance(ev.get("pid"), int):
+                raise ValueError(f"event {i}: metadata without int pid")
+            if not isinstance((ev.get("args") or {}).get("name"), str):
+                raise ValueError(f"event {i}: metadata without args.name")
+            continue
+        for key in ("pid", "tid"):
+            if not isinstance(ev.get(key), int):
+                raise ValueError(f"event {i}: {key} must be int")
+        if not isinstance(ev.get("ts"), (int, float)):
+            raise ValueError(f"event {i}: ts must be numeric")
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                raise ValueError(f"event {i}: X span needs dur >= 0")
+        if ph in ("s", "f"):
+            if "id" not in ev:
+                raise ValueError(f"event {i}: flow event without id")
+            (flow_starts if ph == "s" else flow_finishes).add(ev["id"])
+    dangling = flow_finishes - flow_starts
+    if dangling:
+        raise ValueError(f"flow finishes without a start: {sorted(dangling)[:5]}")
+    json.dumps(trace_events)  # must be serializable as-is
+    return counts

@@ -1,0 +1,334 @@
+"""Cluster flight recorder (util/flight.py): ring semantics, storm drop
+accounting, bubble attribution, and the merged Perfetto export.
+
+Reference analogs: TorchTitan's flight recorder, Ray's timeline export.
+The cluster-marked tests at the bottom cover the shipping paths (worker
+piggyback + `flight_pull`); the rest are pure-unit on fabricated spans.
+"""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import flight, tracing
+from ray_tpu.util.flight import FlightRecorder
+
+
+def _span(name, ts, dur, *, lane, step=None, mb=None, flow=None, trace="",
+          worker=None, **extra):
+    args = {"lane": lane, **extra}
+    if step is not None:
+        args["step"] = step
+    if mb is not None:
+        args["mb"] = mb
+    if flow is not None:
+        args["flow"] = flow
+    ev = {"ts": ts, "event": "span", "name": name, "dur": dur,
+          "trace": trace, "args": args}
+    if worker is not None:
+        ev["worker"] = worker
+    return ev
+
+
+# ------------------------------------------------------------------ ring
+def test_ring_cap_drops_newest_and_counts():
+    """Storm semantics: at cap the NEWEST span drops (the ring keeps the
+    oldest evidence, matching task_events_dropped), and every drop is
+    counted exactly once."""
+    rec = FlightRecorder(cap=5, component="unit")
+    for i in range(12):
+        t = flight.now_ns()
+        rec.record(f"storm.{i}", t, t + 1000, lane="test")
+    assert len(rec) == 5
+    assert rec.dropped == 7
+    names = [e["name"] for e in rec.snapshot()]
+    assert names == [f"storm.{i}" for i in range(5)]
+
+
+def test_death_kind_spans_exempt_from_cap():
+    """A storm must not evict the evidence: death/abort/kill spans append
+    past the cap."""
+    rec = FlightRecorder(cap=3, component="unit")
+    t = flight.now_ns()
+    for i in range(6):
+        rec.record(f"noise.{i}", t, t, lane="test")
+    rec.record("worker.death", t, t, lane="test", kind="death")
+    rec.record("rpc.abort", t, t, lane="test", kind="abort")
+    assert len(rec) == 5  # 3 capped + 2 exempt
+    assert rec.dropped == 3
+    kinds = [e["args"].get("kind") for e in rec.snapshot()]
+    assert kinds[-2:] == ["death", "abort"]
+
+
+def test_drain_emits_single_drop_marker_and_resets():
+    rec = FlightRecorder(cap=2, component="unit-c")
+    t = flight.now_ns()
+    for i in range(5):
+        rec.record(f"s{i}", t, t, lane="test")
+    out = rec.drain()
+    markers = [e for e in out if e.get("event") == "flight_spans_dropped"]
+    assert len(markers) == 1
+    assert markers[0]["n"] == 3 and markers[0]["component"] == "unit-c"
+    # Counter and ring both reset: a quiet second drain ships nothing.
+    assert rec.drain() == []
+    assert rec.dropped == 0 and len(rec) == 0
+
+
+def test_span_context_records_abort_on_raise():
+    rec = FlightRecorder(cap=16)
+    with pytest.raises(ValueError):
+        with rec.span("kv.import", lane="serve/engine", trace="t1"):
+            raise ValueError("boom")
+    (ev,) = rec.snapshot()
+    assert ev["name"] == "kv.import" and ev["trace"] == "t1"
+    assert ev["args"]["kind"] == "abort"
+    assert ev["args"]["error"] == "ValueError"
+
+
+def test_requeue_respects_cap_and_counts_overflow():
+    rec = FlightRecorder(cap=4)
+    t = flight.now_ns()
+    rec.record("live", t, t, lane="test")
+    stale = [_span(f"old{i}", 1.0, 0.0, lane="test") for i in range(6)]
+    rec.requeue(stale)
+    assert len(rec) == 4
+    # Requeued events go back in FRONT (they are older than the ring).
+    assert rec.snapshot()[0]["name"] == "old0"
+    assert rec.dropped == 3
+
+
+def test_clock_offset_rebases_spans_onto_controller_clock():
+    rec = FlightRecorder(cap=8)
+    rec.set_clock_offset(2.5)
+    t0 = flight.now_ns()
+    rec.record("x", t0, t0 + 10_000_000, lane="test")
+    (ev,) = rec.snapshot()
+    # wall(t0) = local wall + offset, within scheduling slop.
+    assert abs(ev["ts"] - (time.time() + 2.5)) < 0.5
+    assert ev["dur"] == pytest.approx(0.01, abs=1e-4)
+    assert abs(rec.cluster_time() - (time.time() + 2.5)) < 0.5
+
+
+def test_disabled_recorder_is_a_noop(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_FLIGHT", "0")
+    flight._reset_for_tests()
+    t = flight.now_ns()
+    flight.record("never", t, t, lane="test")
+    with flight.span("also.never", lane="test"):
+        pass
+    assert flight.recorder().snapshot() == []
+    monkeypatch.setenv("RAY_TPU_FLIGHT", "1")
+    flight.record("yes", t, t, lane="test")
+    assert [e["name"] for e in flight.recorder().snapshot()] == ["yes"]
+    flight._reset_for_tests()
+
+
+# ------------------------------------------------- pipeline bubble report
+def _two_lane_step(step, t0):
+    """A deterministic 2-stage, 1-replica step: s0 computes [t0, t0+1] and
+    [t0+2, t0+3]; s1 waits 1s then computes [t0+1, t0+2] and [t0+3, t0+4].
+    Window 4s x 2 lanes = 8 lane-seconds, busy 4 -> bubble 0.5; s1's
+    warmup 1s, s0's drain 1s, steady idle 2s."""
+    l0, l1 = "mpmd/s0r0", "mpmd/s1r0"
+    return [
+        _span("mpmd.fwd", t0, 1.0, lane=l0, step=step, mb=0,
+              flow=f"mb/{step}/0/r0"),
+        _span("mpmd.recv_wait", t0, 1.0, lane=l1, step=step, mb=0),
+        _span("mpmd.fwd", t0 + 1.0, 1.0, lane=l1, step=step, mb=0,
+              flow=f"mb/{step}/0/r0"),
+        _span("mpmd.bwd", t0 + 2.0, 1.0, lane=l0, step=step, mb=0,
+              flow=f"mb/{step}/0/r0"),
+        _span("mpmd.update", t0 + 3.0, 1.0, lane=l1, step=step),
+    ]
+
+
+def test_pipeline_report_decomposes_bubble():
+    events = _two_lane_step(1, 100.0) + _two_lane_step(2, 200.0)
+    rep = flight.pipeline_report(events)
+    assert rep is not None and set(rep["steps"]) == {1, 2}
+    s1 = rep["steps"][1]
+    assert s1["lanes"] == 2
+    assert s1["window_s"] == pytest.approx(4.0)
+    assert s1["compute_s"] == pytest.approx(4.0)
+    assert s1["bubble_frac"] == pytest.approx(0.5)
+    assert s1["warmup_s"] == pytest.approx(1.0)  # s1 idle before its fwd
+    assert s1["drain_s"] == pytest.approx(1.0)   # s0 idle after its bwd
+    assert s1["steady_s"] == pytest.approx(2.0)
+    assert s1["transport_wait_s"] == pytest.approx(1.0)
+    # Aggregate over both (identical) steps keeps the same fraction.
+    assert rep["bubble_frac"] == pytest.approx(0.5)
+    assert rep["compute_s"] == pytest.approx(8.0)
+    # Non-MPMD timelines yield no report, not a zero-filled one.
+    assert flight.pipeline_report(
+        [_span("engine.step", 1.0, 0.1, lane="serve/engine")]) is None
+
+
+# --------------------------------------------------------- merged export
+def test_merged_chrome_trace_lanes_flows_metadata():
+    events = (
+        _two_lane_step(1, 100.0)
+        + [
+            _span("disagg.prefill_handoff", 100.1, 0.02, lane="serve/router",
+                  trace="req-9", flow="disagg/req-9"),
+            _span("kv.import", 100.2, 0.03, lane="serve/engine",
+                  trace="req-9", flow="disagg/req-9", worker="w1"),
+            # A classic (non-flight) timeline event rides along untouched.
+            {"ts": 100.0, "event": "task_submitted", "task_id": "ab" * 12},
+        ]
+    )
+    out = flight.merged_chrome_trace(events)
+    counts = tracing.validate_chrome_trace(out)
+    assert counts.get("X", 0) >= 7
+    assert counts.get("s", 0) >= 2 and counts.get("f", 0) >= 2
+
+    lanes = {e["args"]["name"] for e in out
+             if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert {"mpmd/s0r0", "mpmd/s1r0", "serve/router",
+            "serve/engine"} <= lanes
+    procs = {e["args"]["name"] for e in out
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert "worker w1" in procs and "driver" in procs
+    # Flow arrows: the microbatch chain and the disagg chain both present.
+    flow_names = {e["name"] for e in out if e["ph"] in ("s", "f")}
+    assert {"mb/1/0/r0", "disagg/req-9"} <= flow_names
+    # crc32-stable: a second export is byte-identical (Perfetto diffing).
+    assert json.dumps(out, sort_keys=True) == json.dumps(
+        flight.merged_chrome_trace(events), sort_keys=True)
+    # trace_id restriction keeps only that request's flight spans.
+    only = flight.merged_chrome_trace(events, trace_id="req-9")
+    assert {e["name"] for e in only if e["ph"] == "X" and
+            e.get("cat") == "flight"} == {"disagg.prefill_handoff",
+                                          "kv.import"}
+
+
+def test_flight_spans_merge_into_trace_forest():
+    """args.lane spans are shaped like tracing.span_event output, so a
+    traced flight span joins the request's forest for free."""
+    events = [
+        _span("disagg.prefill_handoff", 10.0, 0.5, lane="serve/router",
+              trace="req-3"),
+        _span("kv.export", 10.1, 0.2, lane="serve/engine", trace="req-3"),
+    ]
+    t = tracing.trace_payload(events, trace_id="req-3")["trace"]
+    assert t is not None
+    assert {s["name"] for s in t["spans"]} == {"disagg.prefill_handoff",
+                                               "kv.export"}
+
+
+# ------------------------------------------- one export path, two surfaces
+class _StubController:
+    """Just enough controller for DashboardServer._route: a timeline plus
+    the flight_pull handler the /api/flight endpoint awaits."""
+
+    def __init__(self, timeline):
+        self.timeline = list(timeline)
+        self.pulls = 0
+
+    async def h_flight_pull(self, conn, meta, msg):
+        self.pulls += 1
+        return {"ok": True, "workers": 0}
+
+
+def _route_json(controller, path, query):
+    from ray_tpu.dashboard import DashboardServer
+
+    server = DashboardServer(controller)
+    status, ctype, body = asyncio.new_event_loop().run_until_complete(
+        server._route(path, query))
+    assert status.startswith("200"), body
+    return json.loads(body)
+
+
+def test_cli_and_dashboard_flight_exports_identical():
+    """Satellite: `ray-tpu flight` and GET /api/flight are the same
+    flight.flight_payload call — byte-identical output for one timeline
+    (the CLI writes payload['trace_events']; the dashboard returns the
+    whole payload)."""
+    events = _two_lane_step(1, 100.0) + [
+        _span("kv.fetch", 100.5, 0.01, lane="serve/kv", trace="req-1",
+              flow="disagg/req-1", rung="span_pull"),
+        {"ts": 99.0, "event": "flight_spans_dropped", "n": 4,
+         "component": "worker"},
+    ]
+    c = _StubController(events)
+    got = _route_json(c, "/api/flight", {})
+    got.pop("ts")  # the HTTP envelope's scrape stamp
+    want = flight.flight_payload(events)  # == what cmd_flight prints/writes
+    assert c.pulls == 1  # the endpoint poked the workers first
+    assert json.dumps(got, sort_keys=True, default=str) == json.dumps(
+        want, sort_keys=True, default=str)
+    assert got["dropped"] == 4
+    # And restricted to one request id, still identical.
+    got = _route_json(c, "/api/flight", {"trace_id": "req-1"})
+    got.pop("ts")
+    want = flight.flight_payload(events, trace_id="req-1")
+    assert json.dumps(got, sort_keys=True, default=str) == json.dumps(
+        want, sort_keys=True, default=str)
+
+
+def test_cli_and_dashboard_trace_exports_identical():
+    """Same contract for `ray-tpu trace` / GET /api/traces via
+    tracing.trace_payload."""
+    events = [
+        _span("proxy.request", 5.0, 0.6, lane="serve/router", trace="t1"),
+        _span("engine.prefill", 5.1, 0.2, lane="serve/engine", trace="t1"),
+    ]
+    c = _StubController(events)
+    got = _route_json(c, "/api/traces", {"trace_id": "t1"})
+    got.pop("ts")
+    want = tracing.trace_payload(events, trace_id="t1")["trace"]
+    assert json.dumps(got, sort_keys=True, default=str) == json.dumps(
+        want, sort_keys=True, default=str)
+    got = _route_json(c, "/api/traces", {})
+    got.pop("ts")
+    want = tracing.trace_payload(events, limit=50)
+    assert json.dumps(got, sort_keys=True, default=str) == json.dumps(
+        want, sort_keys=True, default=str)
+
+
+# ------------------------------------------------------------ shipping e2e
+@pytest.mark.cluster
+def test_worker_spans_reach_timeline_via_flight_pull(cluster_runtime):
+    """The pull-on-demand path: a span recorded inside a worker process
+    sits in that worker's ring until the controller pokes it with
+    flight_pull; the piggybacked flush lands it in the merged timeline
+    with the worker id stamped."""
+    from ray_tpu.core import api
+
+    @ray_tpu.remote
+    def noisy():
+        from ray_tpu.util import flight as fl
+
+        t0 = fl.now_ns()
+        fl.recorder().record("test.flight_unit", t0, t0 + 5_000_000,
+                             lane="test/worker", attrs={"mark": 1})
+        return 1
+
+    assert ray_tpu.get(noisy.remote()) == 1
+    backend = api._global_runtime().backend
+    out = backend._request({"type": "flight_pull"})
+    assert out["ok"] and out["workers"] >= 1
+
+    deadline = time.monotonic() + 10
+    spans = []
+    while time.monotonic() < deadline:
+        spans = [e for e in ray_tpu.timeline()
+                 if e.get("event") == "span"
+                 and e.get("name") == "test.flight_unit"]
+        if spans:
+            break
+        backend._request({"type": "flight_pull"})
+        time.sleep(0.3)
+    assert spans, "flight span never reached the controller timeline"
+    ev = spans[0]
+    assert ev["args"]["lane"] == "test/worker"
+    assert ev.get("worker")  # stamped by the piggyback flush
+    assert ev["dur"] == pytest.approx(0.005, abs=2e-3)
+    # The merged export renders it on its own named lane.
+    chrome = flight.merged_chrome_trace(ray_tpu.timeline())
+    lanes = {e["args"]["name"] for e in chrome
+             if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert "test/worker" in lanes

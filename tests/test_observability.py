@@ -141,6 +141,47 @@ def test_metric_staleness_pruning(shutdown_only):
         os.environ.pop("RAY_TPU_METRIC_STALENESS_S", None)
 
 
+def test_train_and_flight_metric_staleness(shutdown_only):
+    """The flight-recorder PR's families — train_stage_step_seconds,
+    train_pipeline_bubble_fraction, flight_spans_dropped_total — register
+    through the lazy factories, export with their tags, and obey the same
+    staleness window as every other family (a torn-down pipeline's stage
+    series must not linger on /metrics forever)."""
+    os.environ["RAY_TPU_METRIC_STALENESS_S"] = "1.0"
+    try:
+        ray_tpu.init(num_cpus=2)
+        from ray_tpu.util.metrics import flight_metrics, train_metrics
+
+        tm = train_metrics()
+        tm["train_stage_step_seconds"].observe(
+            0.25, tags={"stage": "0", "replica": "1"})
+        tm["train_pipeline_bubble_fraction"].set(
+            0.27, tags={"source": "trainer"})
+        flight_metrics()["flight_spans_dropped_total"].inc(
+            7, tags={"component": "worker"})
+        text = _scrape(
+            lambda t: 'train_pipeline_bubble_fraction{source="trainer"} 0.27'
+            in t and "train_stage_step_seconds_count" in t
+            and 'flight_spans_dropped_total{component="worker"} 7' in t
+        )
+        assert "# TYPE train_stage_step_seconds histogram" in text
+        assert ('train_stage_step_seconds_count{replica="1",stage="0"} 1'
+                in text
+                or 'train_stage_step_seconds_count{stage="0",replica="1"} 1'
+                in text)
+        assert "# TYPE train_pipeline_bubble_fraction gauge" in text
+        assert "# TYPE flight_spans_dropped_total counter" in text
+        time.sleep(1.5)
+        text = _scrape(
+            lambda t: "train_pipeline_bubble_fraction" not in t,
+            deadline_s=5.0,
+        )
+        assert "train_pipeline_bubble_fraction" not in text
+        assert "train_stage_step_seconds" not in text
+    finally:
+        os.environ.pop("RAY_TPU_METRIC_STALENESS_S", None)
+
+
 def test_rllib_podracer_metrics_exported(cluster_rt):
     """Both podracer planes feed the rllib_* families (satellite of the
     podracer PR): env-step counters tagged by plane, the learner-step
@@ -254,6 +295,9 @@ def test_cli_status_and_lists(cluster_rt):
     r = _run_cli("trace")
     assert r.returncode == 0, r.stderr
     assert "trace_id" in r.stdout
+    r = _run_cli("flight", "--wait", "0.1")
+    assert r.returncode == 0, r.stderr
+    assert "flight spans:" in r.stdout
 
 
 def test_cli_timeline_writes_chrome_trace(cluster_rt, tmp_path):

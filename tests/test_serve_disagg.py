@@ -865,6 +865,88 @@ class TestDisaggServe:
         assert info_roles == ["decode", "prefill"]
         serve.delete("disagg")
 
+    def test_disagg_request_trace_end_to_end(self, disagg_cluster):
+        """Flight-recorder acceptance: ONE x-request-id covers the whole
+        disagg path — the router's prefill handoff, the kv export on the
+        prefill replica, the kv fetch + import on the decode replica, and
+        the decode itself — all merged into the controller timeline in
+        causal order, joined into the request's trace forest, and drawn
+        as `disagg/<rid>` flow arrows in the merged Perfetto export."""
+        import urllib.request
+
+        from ray_tpu.core import api
+        from ray_tpu.util import flight as flight_mod
+        from ray_tpu.util import tracing
+
+        opts = _engine_opts()
+        app = serve.LLMDeployment.options(
+            num_replicas=2, prefill_replicas=1, max_ongoing_requests=64,
+        ).bind(model="gpt2-small",
+               model_overrides={**TINY, "dtype": "float32"},
+               engine_options=opts)
+        serve.run(app, name="dtrace", route_prefix="/dtrace", timeout_s=600)
+        port = serve.http_port()
+        body = json.dumps(
+            {"prompt": list(range(1, 19)), "max_new_tokens": 6}
+        ).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/dtrace", data=body, method="POST"
+        )
+        resp = urllib.request.urlopen(req, timeout=180)
+        rid = resp.headers.get("x-request-id")
+        assert rid and len(json.loads(resp.read())["tokens"]) == 6
+
+        backend = api._global_runtime().backend
+        want = {"disagg.prefill_handoff", "kv.export", "kv.fetch",
+                "kv.import", "disagg.decode"}
+        end = time.monotonic() + 30.0
+        spans = []
+        while time.monotonic() < end:
+            spans = [
+                e for e in ray_tpu.timeline()
+                if e.get("event") == "span" and e.get("trace") == rid
+            ]
+            if want <= {e["name"] for e in spans}:
+                break
+            # On-demand pull: the replicas' rings flush via the
+            # task_events piggyback when poked.
+            backend._request({"type": "flight_pull"})
+            time.sleep(0.3)
+        names = {e["name"] for e in spans}
+        assert want <= names, f"missing spans: {want - names}"
+
+        # Causal order across three processes (router, prefill replica,
+        # decode replica). EPS absorbs the RTT-midpoint clock-alignment
+        # error — sub-ms on loopback, but the gaps here are also small.
+        starts = {n: min(e["ts"] for e in spans if e["name"] == n)
+                  for n in want}
+        ends = {n: max(e["ts"] + e.get("dur", 0.0) for e in spans
+                       if e["name"] == n) for n in want}
+        EPS = 0.05
+        assert starts["disagg.prefill_handoff"] <= starts["kv.export"] + EPS
+        assert starts["kv.export"] <= starts["kv.import"] + EPS
+        assert starts["kv.import"] <= starts["kv.fetch"] + EPS  # fetch is
+        # part of the import; decode RPC brackets both.
+        assert ends["disagg.decode"] + EPS >= ends["kv.import"]
+        # The import moved the exported prefix, not nothing.
+        imp = max((e for e in spans if e["name"] == "kv.import"),
+                  key=lambda e: e["args"]["blocks"])
+        assert imp["args"]["blocks"] == len(range(1, 19)) // 4
+
+        # Same rid joins the classic trace forest (/api/traces view).
+        t = tracing.trace_payload(ray_tpu.timeline(), trace_id=rid)["trace"]
+        assert t is not None and want <= {s["name"] for s in t["spans"]}
+
+        # Merged Perfetto export: this request's disagg flow arrows.
+        chrome = flight_mod.merged_chrome_trace(
+            ray_tpu.timeline(), trace_id=rid)
+        tracing.validate_chrome_trace(chrome)
+        assert any(e["ph"] == "s" and e["name"] == f"disagg/{rid}"
+                   for e in chrome)
+        assert any(e["ph"] == "f" and e["name"] == f"disagg/{rid}"
+                   for e in chrome)
+        serve.delete("dtrace")
+
     @pytest.mark.chaos
     def test_sigkill_prefill_replica_mid_handoff(self, disagg_cluster_lander):
         """SIGKILL the prefill replica's worker while its prefill runs:
@@ -949,6 +1031,22 @@ class TestDisaggServe:
         assert st["blocks_imported"] in (0, len(prompt) // 4), (
             f"partial KV import after chaos: {st['blocks_imported']}"
         )
+        # Flight acceptance: the aborted handoff left a death-kind span
+        # (cap-exempt in the ring) on the merged timeline — the partial
+        # trace stays readable even though the prefill replica's own ring
+        # died unflushed with the SIGKILL.
+        end = time.monotonic() + 20
+        death = []
+        while time.monotonic() < end and not death:
+            death = [
+                e for e in ray_tpu.timeline()
+                if e.get("event") == "span"
+                and e.get("name") == "disagg.prefill_abort"
+            ]
+            time.sleep(0.3)
+        assert death, "no disagg.prefill_abort death span after SIGKILL"
+        assert death[0]["args"]["kind"] == "death"
+        assert death[0]["args"]["error"]
         serve.delete("chaos")
 
     def test_force_span_pull_rung(self, disagg_cluster):

@@ -187,6 +187,11 @@ def test_api_timeline_writes_chrome_trace(cluster_runtime, tmp_path):
     assert any("event" in e for e in events)  # return value stays raw
     chrome = json.load(open(chrome_path))
     assert chrome and all("ph" in e for e in chrome)
+    # Schema check shared with the flight-recorder exports: every event
+    # carries the fields Perfetto requires for its ph kind, flow arrows
+    # pair up, and the whole thing JSON round-trips.
+    counts = tracing.validate_chrome_trace(chrome)
+    assert counts.get("X", 0) >= 1
     ray_tpu.timeline(raw_path, raw=True)
     raw = json.load(open(raw_path))
     # The controller timeline keeps accumulating between the two snapshots
