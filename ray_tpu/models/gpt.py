@@ -519,22 +519,36 @@ def merge_stage_params(params, cfg: GPTConfig):
     return out
 
 
-def extract_stage_params(params, cfg: GPTConfig, stage: int, num_stages: int):
-    """The parameter subset stage `stage` of a cross-host pipeline actually
-    needs: its layer slice, plus embeddings on the first stage and the final
-    norm + LM head on the last. This is the per-host weight set for
-    compiled-DAG pipelines where each stage lives on its own host/mesh
+def extract_stage_params(
+    params, cfg: GPTConfig, stage: int, num_stages: int,
+    num_chunks: int = 1, chunk: int = 0,
+):
+    """The parameter subset chunk `chunk` of stage `stage` actually needs:
+    its layer slice of the S*v virtual-stage split (virtual stage
+    vs = chunk*S + stage), plus embeddings on the first virtual stage and
+    the final norm + LM head on the last. With num_chunks=1 this is the
+    classic per-host weight set for compiled-DAG pipelines; v>1 is the
+    interleaved split where each host owns v non-contiguous layer groups
     (in-mesh GPipe keeps the full stacked params instead —
-    `split_stage_params`)."""
-    if cfg.n_layers % num_stages != 0:
-        raise ValueError(f"{cfg.n_layers} layers not divisible by {num_stages} stages")
-    per = cfg.n_layers // num_stages
+    `split_stage_params`). With tied embeddings, tok_embed lands on BOTH
+    boundary virtual stages — the runners reconcile its gradient over the
+    embedding bridge before the update."""
+    pipeline = num_stages * num_chunks
+    if cfg.n_layers % pipeline != 0:
+        raise ValueError(
+            f"{cfg.n_layers} layers not divisible by {num_stages} stages "
+            f"x {num_chunks} chunks"
+        )
+    if not 0 <= chunk < num_chunks:
+        raise ValueError(f"chunk {chunk} out of range for {num_chunks} chunks")
+    vs = chunk * num_stages + stage
+    per = cfg.n_layers // pipeline
     out = {
-        k: v[stage * per : (stage + 1) * per]
+        k: v[vs * per : (vs + 1) * per]
         for k, v in params.items()
         if k in _LAYER_KEYS
     }
-    first, last = stage == 0, stage == num_stages - 1
+    first, last = vs == 0, vs == pipeline - 1
     if first or (last and cfg.tie_embeddings):
         out["tok_embed"] = params["tok_embed"]
     if first and cfg.pos == "learned":
@@ -593,29 +607,36 @@ def stage_forward(
     return logits, aux_stack.sum()
 
 
-def check_mpmd_partitionable(cfg: GPTConfig, num_stages: int) -> None:
+def check_mpmd_partitionable(
+    cfg: GPTConfig, num_stages: int, num_chunks: int = 1
+) -> None:
     """Constraints of the MPMD stage split (each stage a SEPARATE jit
     program on its own gang actor — `ray_tpu.train.mpmd`):
 
-    * layers must divide evenly into stages (same rule as in-mesh GPipe);
-    * embeddings must be UNTIED: with tying, tok_embed lives on the first
-      AND last stage, its gradient splits across two hosts, and the two
-      copies would drift apart under independent updates (Megatron bridges
-      this with a dedicated first/last-stage allreduce — not composed yet);
+    * layers must divide evenly into the S*v virtual stages (same rule as
+      in-mesh GPipe for v=1);
+    * interleaving (num_chunks > 1) needs num_stages > 1 — chunk-to-chunk
+      edges on a single stage would be self-loops;
+    * tied embeddings are ALLOWED: tok_embed lives on both boundary
+      virtual stages and the runners allreduce its gradient over a
+      dedicated first/last-stage bridge channel before the update
+      (the Megatron embedding allreduce), keeping the two copies
+      bit-identical;
     * MoE is not composed yet: the router aux loss is stage-local and the
       reported loss would silently omit upstream stages' aux terms.
     """
     if num_stages < 1:
         raise ValueError(f"num_stages must be >= 1, got {num_stages}")
-    if cfg.n_layers % num_stages != 0:
+    if num_chunks < 1:
+        raise ValueError(f"num_chunks must be >= 1, got {num_chunks}")
+    if num_chunks > 1 and num_stages == 1:
         raise ValueError(
-            f"{cfg.n_layers} layers not divisible by {num_stages} stages"
+            "interleaved MPMD (num_chunks > 1) needs num_stages > 1"
         )
-    if num_stages > 1 and cfg.tie_embeddings:
+    if cfg.n_layers % (num_stages * num_chunks) != 0:
         raise ValueError(
-            "MPMD pipeline stages need untied embeddings (tie_embeddings="
-            "False): tied tok_embed spans the first and last stage and its "
-            "gradient cannot be combined across separate jit programs"
+            f"{cfg.n_layers} layers not divisible by {num_stages} stages "
+            f"x {num_chunks} chunks"
         )
     if cfg.mlp_type == "moe":
         raise NotImplementedError(
@@ -623,28 +644,34 @@ def check_mpmd_partitionable(cfg: GPTConfig, num_stages: int) -> None:
         )
 
 
-def make_mpmd_stage_fns(cfg: GPTConfig, stage: int, num_stages: int) -> Dict[str, Callable]:
-    """Pure per-stage training functions for the MPMD pipeline (arXiv
+def make_mpmd_stage_fns(
+    cfg: GPTConfig, stage: int, num_stages: int,
+    num_chunks: int = 1, chunk: int = 0,
+) -> Dict[str, Callable]:
+    """Pure per-chunk training functions for the MPMD pipeline (arXiv
     2412.14374 shape: stages as separate jit programs, the host-side 1F1B
-    schedule moving activations/grads between them).
+    schedule moving activations/grads between them; num_chunks > 1 is the
+    interleaved split where this call builds ONE of the stage's v chunk
+    programs — virtual stage chunk*S + stage).
 
-    Returned callables (jit them at the call site; all take the stage's
+    Returned callables (jit them at the call site; all take the chunk's
     param subset from `extract_stage_params`):
 
     * ``fwd(params, x) -> y`` — forward only. x is tokens [B, S] on the
-      first stage, activations [B, S, E] elsewhere; y is the activation
-      this stage ships downstream (logits on the last stage).
-    * non-last stages: ``fwd_bwd(params, x, gy) -> (param_grads, gx)`` —
+      first virtual stage, activations [B, S, E] elsewhere; y is the
+      activation this chunk ships downstream (logits on the last).
+    * non-last chunks: ``fwd_bwd(params, x, gy) -> (param_grads, gx)`` —
       backward via jax.vjp with the forward RECOMPUTED from the saved
-      stage input (activation recomputation: the 1F1B runner stores only
-      each in-flight microbatch's stage INPUT, the memory shape that makes
-      deep pipelines fit). On the first stage gx is None (tokens).
-    * last stage: ``loss_bwd(params, x, targets, mask) -> (loss,
+      chunk input (activation recomputation: the 1F1B runner stores only
+      each in-flight microbatch's chunk INPUT, the memory shape that makes
+      deep pipelines fit). On the first virtual stage gx is None (tokens).
+    * last chunk: ``loss_bwd(params, x, targets, mask) -> (loss,
       param_grads, gx)`` — next-token CE in f32, grads wrt params and the
       incoming activation.
     """
-    check_mpmd_partitionable(cfg, num_stages)
-    first, last = stage == 0, stage == num_stages - 1
+    check_mpmd_partitionable(cfg, num_stages, num_chunks)
+    vs = chunk * num_stages + stage
+    first, last = vs == 0, vs == num_stages * num_chunks - 1
 
     def _fwd(p, x):
         y, _aux = stage_forward(p, x, cfg, first=first, last=last)

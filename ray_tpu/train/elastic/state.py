@@ -77,24 +77,40 @@ class ElasticState:
         )
 
     # ------------------------------------------------------- MPMD pipeline
-    def record_pipeline(self, stage: int, num_stages: int) -> None:
+    def record_pipeline(
+        self, stage: int, num_stages: int, num_chunks: int = 1
+    ) -> None:
         """Stamp the pipeline position this shard belongs to. dp width is
         deliberately NOT recorded as a constraint — reshapes change it and
-        the axis-0 reshard absorbs that — but the STAGE SPLIT must match on
-        restore: a stage-1-of-2 optimizer shard loaded into stage 1 of 3
-        would silently install the wrong slice of the model."""
-        self.extra["pipeline"] = {"stage": int(stage), "num_stages": int(num_stages)}
+        the axis-0 reshard absorbs that — but the STAGE SPLIT (stages AND
+        interleaved chunks: both change the flat-space layout) must match
+        on restore: a stage-1-of-2 optimizer shard loaded into stage 1 of
+        3 would silently install the wrong slice of the model."""
+        self.extra["pipeline"] = {
+            "stage": int(stage),
+            "num_stages": int(num_stages),
+            "num_chunks": int(num_chunks),
+        }
 
-    def check_pipeline(self, stage: int, num_stages: int) -> None:
+    def check_pipeline(
+        self, stage: int, num_stages: int, num_chunks: int = 1
+    ) -> None:
         got = self.extra.get("pipeline")
         if got is None:
             return  # pre-MPMD checkpoint: nothing to validate against
-        if (int(got.get("stage", -1)), int(got.get("num_stages", -1))) != (
-            int(stage), int(num_stages)
-        ):
+        want = (int(stage), int(num_stages), int(num_chunks))
+        # Checkpoints written before interleaving existed carry no chunk
+        # count — they are v=1 by construction.
+        have = (
+            int(got.get("stage", -1)),
+            int(got.get("num_stages", -1)),
+            int(got.get("num_chunks", 1)),
+        )
+        if have != want:
             raise ValueError(
                 f"checkpoint belongs to stage {got.get('stage')}/"
-                f"{got.get('num_stages')} but is being restored into stage "
-                f"{stage}/{num_stages} — stage splits cannot change across "
-                "a reshape (only dp width can)"
+                f"{got.get('num_stages')} (x{got.get('num_chunks', 1)} "
+                f"chunks) but is being restored into stage "
+                f"{stage}/{num_stages} (x{num_chunks} chunks) — stage "
+                "splits cannot change across a reshape (only dp width can)"
             )

@@ -16,14 +16,21 @@ Entry points:
   * `MPMDTrainer` (trainer.py) — the cluster trainer (gang actors).
   * `run_local_pipeline` (local.py) — same runners on threads; parity
     harness and schedule gate.
-  * `StageRunner`, `build_1f1b`, `ShardedAdamW` — the composable pieces.
+  * `StageRunner`, `build_1f1b`/`build_interleaved_1f1b`, `ShardedAdamW`,
+    `WireCodec` — the composable pieces (interleaved virtual stages cut
+    the bubble toward (S-1)/(v*M+S-1); the bf16 wire halves hop bytes).
 
 See docs/MPMD_TRAINING.md.
 """
 
-from .schedule import build_1f1b, max_in_flight, theoretical_bubble_fraction
+from .schedule import (
+    build_1f1b,
+    build_interleaved_1f1b,
+    max_in_flight,
+    theoretical_bubble_fraction,
+)
 from .stage import StageRunner
-from .transport import ActTransport, ChannelEdge, LocalEdge
+from .transport import ActTransport, ChannelEdge, LocalEdge, WireCodec
 from .zero import (
     LocalDpComm,
     ReplicatedAdamW,
@@ -37,6 +44,8 @@ from .trainer import MPMDOptions, MPMDTrainer
 
 __all__ = [
     "build_1f1b",
+    "build_interleaved_1f1b",
+    "WireCodec",
     "max_in_flight",
     "theoretical_bubble_fraction",
     "StageRunner",

@@ -5,6 +5,12 @@ This is the parity harness (losses/grads vs single-jit GPipe vs unpipelined
 on one CPU mesh, no cluster boot) and the deadlock gate for the schedule —
 the cluster trainer (`trainer.py`) swaps in gang actors, compiled-DAG
 channels, and the object-store collectives around the SAME StageRunner.
+Interleaving (num_chunks = v > 1) wires per-chunk edges plus the wrap
+edges chunk c of stage S-1 -> chunk c+1 of stage 0; tied embeddings add
+the first/last-stage bridge pair (always f32 — gradients for the update
+never ride the lossy wire). `wire_dtype="bf16"` runs every activation/
+grad hop through the real WireCodec so the loss-curve gate exercises the
+actual cast/restore.
 """
 
 from __future__ import annotations
@@ -15,7 +21,7 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from .stage import StageRunner
-from .transport import LocalEdge
+from .transport import LocalEdge, WireCodec
 from .zero import make_local_comms
 
 
@@ -28,6 +34,8 @@ def run_local_pipeline(
     *,
     params=None,
     seed: int = 0,
+    num_chunks: int = 1,
+    wire_dtype: str = "f32",
     zero: bool = True,
     lr: float = 1e-3,
     betas=(0.9, 0.95),
@@ -38,40 +46,77 @@ def run_local_pipeline(
 ) -> Dict[str, Any]:
     """Train over `batches` (each [B, S+1] int tokens, B divisible by
     dp * num_microbatches) and return {"history": per-step driver metrics,
-    "params": final full param tree (host), "runners": the stage runners}.
+    "params": final full param tree (host), "runners": the stage runners,
+    "wall_s"/"bubble_frac": run aggregates, "wire_stats": codec byte
+    counters summed over every activation/grad edge}.
     """
     import jax
 
     from ...models import gpt
 
-    gpt.check_mpmd_partitionable(cfg, num_stages)
+    S, v = num_stages, num_chunks
+    gpt.check_mpmd_partitionable(cfg, S, v)
     if params is None:
         params = gpt.init_params(jax.random.PRNGKey(seed), cfg)
     params_np = jax.tree_util.tree_map(np.asarray, params)
 
     runners: List[List[StageRunner]] = []
-    for s in range(num_stages):
+    for s in range(S):
         comms = make_local_comms(dp)
-        stage_params = gpt.extract_stage_params(params_np, cfg, s, num_stages)
+        chunk_trees = [
+            gpt.extract_stage_params(
+                params_np, cfg, s, S, num_chunks=v, chunk=c
+            )
+            for c in range(v)
+        ]
         runners.append([
             StageRunner(
-                cfg, s, num_stages, num_microbatches, stage_params,
-                comms[r], replica=r, zero=zero, lr=lr, betas=betas, eps=eps,
-                weight_decay=weight_decay,
+                cfg, s, S, num_microbatches,
+                chunk_trees if v > 1 else chunk_trees[0],
+                comms[r], replica=r, num_chunks=v, zero=zero, lr=lr,
+                betas=betas, eps=eps, weight_decay=weight_decay,
             )
             for r in range(dp)
         ])
-    for s in range(num_stages - 1):
-        for r in range(dp):
-            fwd = LocalEdge(timeout_s=step_timeout_s)
-            bwd = LocalEdge(timeout_s=step_timeout_s)
+
+    # The activation/grad wire: one codec (and its byte counters) shared by
+    # every edge; bridges get their own f32 identity codec.
+    codec = WireCodec(wire_dtype)
+    bridge = cfg.tie_embeddings and S > 1
+    for r in range(dp):
+        fwd_in = [[None] * v for _ in range(S)]
+        fwd_out = [[None] * v for _ in range(S)]
+        bwd_in = [[None] * v for _ in range(S)]
+        bwd_out = [[None] * v for _ in range(S)]
+
+        def edge():
+            return LocalEdge(timeout_s=step_timeout_s, codec=codec)
+
+        for c in range(v):
+            for s in range(S - 1):
+                e, eb = edge(), edge()
+                fwd_out[s][c] = e
+                fwd_in[s + 1][c] = e
+                bwd_out[s + 1][c] = eb
+                bwd_in[s][c] = eb
+        # Wrap edges: virtual stage c*S + (S-1) feeds (c+1)*S + 0.
+        for c in range(v - 1):
+            e, eb = edge(), edge()
+            fwd_out[S - 1][c] = e
+            fwd_in[0][c + 1] = e
+            bwd_out[0][c + 1] = eb
+            bwd_in[S - 1][c] = eb
+        bridges = {}
+        if bridge:
+            b_fwd = LocalEdge(timeout_s=step_timeout_s)
+            b_bwd = LocalEdge(timeout_s=step_timeout_s)
+            bridges[0] = {"bridge_out": b_fwd, "bridge_in": b_bwd}
+            bridges[S - 1] = {"bridge_out": b_bwd, "bridge_in": b_fwd}
+        for s in range(S):
             runners[s][r].bind_edges(
-                fwd_in=runners[s][r].fwd_in, fwd_out=fwd,
-                bwd_in=bwd, bwd_out=runners[s][r].bwd_out,
-            )
-            runners[s + 1][r].bind_edges(
-                fwd_in=fwd, fwd_out=runners[s + 1][r].fwd_out,
-                bwd_in=runners[s + 1][r].bwd_in, bwd_out=bwd,
+                fwd_in=fwd_in[s], fwd_out=fwd_out[s],
+                bwd_in=bwd_in[s], bwd_out=bwd_out[s],
+                **bridges.get(s, {}),
             )
 
     results: Dict[tuple, List[Dict[str, Any]]] = {}
@@ -82,7 +127,7 @@ def run_local_pipeline(
             out = []
             for step, batch in enumerate(batches):
                 sl = None
-                if s == 0 or s == num_stages - 1:
+                if s == 0 or s == S - 1:
                     sl = np.array_split(np.asarray(batch), dp)[r]
                 out.append(runners[s][r].run_step(sl))
                 if on_step is not None and s == 0 and r == 0:
@@ -93,7 +138,7 @@ def run_local_pipeline(
 
     threads = [
         threading.Thread(target=worker, args=(s, r), daemon=True)
-        for s in range(num_stages) for r in range(dp)
+        for s in range(S) for r in range(dp)
     ]
     import time as _time
 
@@ -114,8 +159,8 @@ def run_local_pipeline(
 
     history: List[Dict[str, Any]] = []
     for step in range(len(batches)):
-        last = [results[(num_stages - 1, r)][step] for r in range(dp)]
-        per_stage = [results[(s, 0)][step] for s in range(num_stages)]
+        last = [results[(S - 1, r)][step] for r in range(dp)]
+        per_stage = [results[(s, 0)][step] for s in range(S)]
         history.append({
             "step": step + 1,
             "loss": float(np.mean([m["loss"] for m in last])),
@@ -124,39 +169,47 @@ def run_local_pipeline(
             ),
             "busy_s": sum(
                 results[(s, r)][step]["busy_s"]
-                for s in range(num_stages) for r in range(dp)
+                for s in range(S) for r in range(dp)
             ),
             "opt_bytes_per_replica": max(
                 m["opt_bytes"] for m in per_stage
             ),
         })
 
-    # Reassemble the full model tree from stage 0/last replicas (replicas
-    # are identical post-update by the all-gather contract).
+    # Reassemble the full model tree from replica-0 runners in VIRTUAL
+    # STAGE order — layer slices concatenate chunk-major (vs = c*S + s),
+    # which is exactly how extract_stage_params dealt them out. Replicas
+    # are identical post-update by the all-gather contract; with tied
+    # embeddings, tok_embed appears on both boundary virtual stages
+    # (bit-identical post-bridge) and setdefault keeps the first.
     merged: Dict[str, np.ndarray] = {}
     layer_parts: Dict[str, List[np.ndarray]] = {}
-    for s in range(num_stages):
-        tree = runners[s][0].params_host()
-        for k, v in tree.items():
-            if k in gpt_layer_keys():
-                layer_parts.setdefault(k, []).append(np.asarray(v))
-            else:
-                merged.setdefault(k, np.asarray(v))
+    for c in range(v):
+        for s in range(S):
+            tree = runners[s][0].chunk_params_host(c)
+            for k, val in tree.items():
+                if k in gpt_layer_keys():
+                    layer_parts.setdefault(k, []).append(np.asarray(val))
+                else:
+                    merged.setdefault(k, np.asarray(val))
     for k, parts in layer_parts.items():
         merged[k] = np.concatenate(parts, axis=0)
     # Aggregate pipeline-bubble number for the whole run, trainer-style
-    # denominator (wall * lanes) but with the optimizer update included in
-    # the numerator — the same busy definition as the flight recorder's
-    # span-derived attribution (flight.pipeline_report), so the two are
-    # directly cross-checkable on this harness.
+    # denominator (wall * lanes — PHYSICAL lanes S*dp, not S*v*dp: a
+    # stage's chunks share one host thread, so its capacity is one lane)
+    # but with the optimizer update included in the numerator — the same
+    # busy definition as the flight recorder's span-derived attribution
+    # (flight.pipeline_report), so the two are directly cross-checkable
+    # on this harness.
     busy_total = sum(
         m["busy_s"] + m.get("update_s", 0.0)
         for outs in results.values() for m in outs
     )
-    lanes = num_stages * dp
+    lanes = S * dp
     bubble = max(0.0, 1.0 - busy_total / max(run_wall * lanes, 1e-9))
     return {"history": history, "params": merged, "runners": runners,
-            "wall_s": run_wall, "bubble_frac": bubble}
+            "wall_s": run_wall, "bubble_frac": bubble,
+            "wire_stats": dict(codec.stats)}
 
 
 def gpt_layer_keys():

@@ -4,13 +4,19 @@ Process-agnostic: the cluster trainer hosts one of these per gang actor
 (edges = compiled-DAG channels, comm = host-plane collectives), the local
 runner hosts them on threads (queue edges, in-process comm). Each runner
 owns ONE stage's jit programs — MPMD: S stages compile S different
-programs, nothing here is shard_mapped over a pp axis.
+programs, nothing here is shard_mapped over a pp axis. With interleaving
+(num_chunks = v > 1) the runner owns v chunk programs (virtual stage
+vs = c*S + s per chunk c) with per-(stage, chunk) jit cache entries and
+per-chunk edges; all v chunk param trees live in ONE flat ZeRO space so
+the sharded update is a single reduce-scatter/all-gather per step.
 
-Per step (`run_step`): execute the 1F1B op list; accumulate this replica's
-stage gradients on device; then the ZeRO update — reduce-scatter the flat
-gradient across the stage's dp group, update this replica's optimizer-state
-chunk, all-gather the updated parameters (zero=False swaps in the
-replicated-state baseline with the identical gradient reduction).
+Per step (`run_step`): execute the (interleaved) 1F1B op list; accumulate
+this replica's per-chunk gradients on device; reconcile the tied
+embedding's gradient over the first/last-stage bridge if bound; then the
+ZeRO update — reduce-scatter the flat gradient across the stage's dp
+group, update this replica's optimizer-state chunk, all-gather the
+updated parameters (zero=False swaps in the replicated-state baseline
+with the identical gradient reduction).
 """
 
 from __future__ import annotations
@@ -23,21 +29,25 @@ import numpy as np
 
 from ...collective.ops import zero_flatten, zero_unflatten
 from ..elastic.state import ElasticState
-from .schedule import B, F, build_1f1b
+from .schedule import B, F, build_interleaved_1f1b
 from .zero import ReplicatedAdamW, ShardedAdamW, SoloComm
 
 
 @functools.lru_cache(maxsize=64)
-def _jit_stage_fns(cfg, stage: int, num_stages: int) -> Dict[str, Any]:
-    """Process-cached jitted stage programs: GPTConfig is a frozen
-    (hashable) dataclass, so two runners for the same (cfg, stage, split)
-    — a re-spawned incarnation, a second pipeline in the parity tests —
-    share compilations instead of re-tracing fresh closures."""
+def _jit_stage_fns(
+    cfg, stage: int, num_stages: int, num_chunks: int = 1, chunk: int = 0
+) -> Dict[str, Any]:
+    """Process-cached jitted chunk programs: GPTConfig is a frozen
+    (hashable) dataclass, so two runners for the same (cfg, stage, split,
+    chunk) — a re-spawned incarnation, a second pipeline in the parity
+    tests — share compilations instead of re-tracing fresh closures."""
     import jax
 
     from ...models import gpt
 
-    fns = gpt.make_mpmd_stage_fns(cfg, stage, num_stages)
+    fns = gpt.make_mpmd_stage_fns(
+        cfg, stage, num_stages, num_chunks=num_chunks, chunk=chunk
+    )
     return {name: jax.jit(fn) for name, fn in fns.items()}
 
 
@@ -48,6 +58,20 @@ def _acc_jit():
     return jax.jit(
         lambda a, b: jax.tree_util.tree_map(lambda x, y: x + y, a, b)
     )
+
+
+def _as_chunk_list(x, num_chunks: int) -> List[Any]:
+    """Normalize an edge argument: None -> all-None, a single edge ->
+    chunk 0 (the v=1 call shape), a list -> itself (must be length v)."""
+    if x is None:
+        return [None] * num_chunks
+    if isinstance(x, (list, tuple)):
+        if len(x) != num_chunks:
+            raise ValueError(f"expected {num_chunks} edges, got {len(x)}")
+        return list(x)
+    if num_chunks != 1:
+        raise ValueError("interleaved runners need per-chunk edge lists")
+    return [x]
 
 
 class StageRunner:
@@ -61,6 +85,7 @@ class StageRunner:
         comm=None,
         *,
         replica: int = 0,
+        num_chunks: int = 1,
         zero: bool = True,
         lr: float = 1e-3,
         betas=(0.9, 0.95),
@@ -71,23 +96,48 @@ class StageRunner:
 
         self.cfg = cfg
         self.stage = stage
-        # dp-replica index — only used to label this runner's flight lane
+        # dp-replica index — only used to label this runner's flight lanes
         # and metric series; the comm object carries the collective rank.
         self.replica = replica
         self.num_stages = num_stages
         self.num_microbatches = num_microbatches
+        self.num_chunks = num_chunks
+        # first/last mean "hosts the first/last VIRTUAL stage": chunk 0 of
+        # stage 0 embeds tokens, chunk v-1 of stage S-1 computes the loss.
         self.first = stage == 0
         self.last = stage == num_stages - 1
         self.comm = comm or SoloComm()
         self.zero = zero
+        # Validates (S, M, v) — incl. M % S == 0 for v > 1 — up front.
+        self._ops = build_interleaved_1f1b(
+            stage, num_stages, num_microbatches, num_chunks
+        )
 
-        fns = _jit_stage_fns(cfg, stage, num_stages)
-        self._fwd = fns["fwd"]
-        self._fwd_bwd = fns.get("fwd_bwd")
-        self._loss_bwd = fns.get("loss_bwd")
+        self._fns = [
+            _jit_stage_fns(cfg, stage, num_stages, num_chunks, c)
+            for c in range(num_chunks)
+        ]
         self._acc = _acc_jit()
 
-        flat, self._spec = zero_flatten(stage_params)
+        chunk_trees = (
+            list(stage_params)
+            if isinstance(stage_params, (list, tuple))
+            else [stage_params]
+        )
+        if len(chunk_trees) != num_chunks:
+            raise ValueError(
+                f"stage {stage} got {len(chunk_trees)} chunk param trees, "
+                f"expected {num_chunks}"
+            )
+        # ONE flat f32 space covering all chunks: v=1 keeps the bare tree
+        # (flat layout — and so checkpoints — bit-identical to the
+        # pre-interleaving code); v>1 namespaces chunks as {"c0": .., ..}.
+        tree = (
+            chunk_trees[0]
+            if num_chunks == 1
+            else {f"c{c}": t for c, t in enumerate(chunk_trees)}
+        )
+        flat, self._spec = zero_flatten(tree)
         opt_cls = ShardedAdamW if zero else ReplicatedAdamW
         self.opt = opt_cls(
             flat, self.comm, lr=lr, betas=betas, eps=eps,
@@ -95,15 +145,30 @@ class StageRunner:
         )
         self.params = jax.device_put(zero_unflatten(flat, self._spec))
         self.state = ElasticState()
-        # Edges (bind_edges): None where the pipeline boundary is.
-        self.fwd_in = self.fwd_out = self.bwd_in = self.bwd_out = None
+        # Per-chunk edges (bind_edges): None where the virtual-stage chain
+        # boundary is. The bridge pair reconciles the tied embedding grad.
+        self.fwd_in = [None] * num_chunks
+        self.fwd_out = [None] * num_chunks
+        self.bwd_in = [None] * num_chunks
+        self.bwd_out = [None] * num_chunks
+        self.bridge_out = self.bridge_in = None
         self.last_busy_s = 0.0
         self.last_update_s = 0.0
 
     # ---------------------------------------------------------------- wiring
-    def bind_edges(self, fwd_in=None, fwd_out=None, bwd_in=None, bwd_out=None):
-        self.fwd_in, self.fwd_out = fwd_in, fwd_out
-        self.bwd_in, self.bwd_out = bwd_in, bwd_out
+    def bind_edges(
+        self, fwd_in=None, fwd_out=None, bwd_in=None, bwd_out=None,
+        bridge_out=None, bridge_in=None,
+    ):
+        v = self.num_chunks
+        self.fwd_in = _as_chunk_list(fwd_in, v)
+        self.fwd_out = _as_chunk_list(fwd_out, v)
+        self.bwd_in = _as_chunk_list(bwd_in, v)
+        self.bwd_out = _as_chunk_list(bwd_out, v)
+        self.bridge_out, self.bridge_in = bridge_out, bridge_in
+
+    def _chunk_params(self, c: int):
+        return self.params if self.num_chunks == 1 else self.params[f"c{c}"]
 
     # ------------------------------------------------------------------ step
     def run_step(self, tokens: Optional[np.ndarray]) -> Dict[str, Any]:
@@ -113,7 +178,8 @@ class StageRunner:
         import jax
         import jax.numpy as jnp
 
-        M = self.num_microbatches
+        M, v, S = self.num_microbatches, self.num_chunks, self.num_stages
+        P = S * v
         inputs = targets = None
         if self.first or self.last:
             if tokens is None:
@@ -135,100 +201,138 @@ class StageRunner:
 
         from ...util import flight
 
-        # Flight-recorder slot spans: a lane per (stage, dp-replica) and a
-        # flow key per (step, microbatch, replica), so the merged Perfetto
-        # view draws the 1F1B wave with arrows following each microbatch
-        # across stages. Timing below uses monotonic_ns for BOTH the busy
-        # accounting and the spans (one clock, one read per boundary);
-        # recording is a lock-guarded list append (see overhead gate in
-        # tests/test_flight_perf_smoke.py).
+        # Flight-recorder slot spans: a lane per (stage, chunk, dp-replica)
+        # — interleaved chunks render on separate Perfetto rows instead of
+        # shuffling two chunks' spans on one — and a flow key per (step,
+        # microbatch, chunk, replica), so the merged view draws the 1F1B
+        # wave with arrows following each microbatch across stages.
+        # `pipeline_report` regroups these lanes by PHYSICAL (stage,
+        # replica) attrs so its bubble denominator stays wall*S*dp, the
+        # same as the trainer's aggregate. Timing below uses monotonic_ns
+        # for BOTH the busy accounting and the spans (one clock, one read
+        # per boundary); recording is a lock-guarded list append (see
+        # overhead gate in tests/test_flight_perf_smoke.py).
         fl = flight.recorder() if flight.enabled() else None
         if fl is not None:
             flight.ensure_flusher()
-        lane = f"mpmd/s{self.stage}r{self.replica}"
+        lanes = [
+            f"mpmd/s{self.stage}c{c}r{self.replica}" for c in range(v)
+        ]
         step_no = self.state.step + 1
         base = {"stage": self.stage, "replica": self.replica, "step": step_no}
 
-        saved: Dict[int, Any] = {}
-        acc = None
+        saved: Dict[tuple, Any] = {}
+        accs: List[Any] = [None] * v
         losses: List[float] = []
         busy = 0.0
-        for op, i in build_1f1b(self.stage, self.num_stages, M):
-            flow = f"mb/{step_no}/{i}/r{self.replica}"
+        for op, i, c in self._ops:
+            vs = c * S + self.stage
+            firstc, lastc = vs == 0, vs == P - 1
+            lane = lanes[c]
+            flow = f"mb/{step_no}/{i}/c{c}/r{self.replica}"
+            attrs = {**base, "mb": i, "chunk": c}
+            fns = self._fns[c]
             if op == F:
-                if self.first:
+                if firstc:
                     x = jnp.asarray(inputs[i])
                 else:
                     r0 = time.monotonic_ns()
-                    x = jnp.asarray(self.fwd_in.recv())
+                    x = jnp.asarray(self.fwd_in[c].recv())
                     if fl is not None:
                         fl.record("mpmd.recv_wait", r0, time.monotonic_ns(),
-                                  lane=lane,
-                                  attrs={**base, "mb": i, "dir": "fwd"})
-                saved[i] = x
-                if not self.last:
+                                  lane=lane, attrs={**attrs, "dir": "fwd"})
+                saved[(c, i)] = x
+                if not lastc:
                     t0 = time.monotonic_ns()
-                    y = self._fwd(self.params, x)
+                    y = fns["fwd"](self._chunk_params(c), x)
                     y.block_until_ready()
                     t1 = time.monotonic_ns()
                     busy += (t1 - t0) * 1e-9
                     if fl is not None:
                         fl.record("mpmd.fwd", t0, t1, lane=lane, flow=flow,
-                                  attrs={**base, "mb": i})
+                                  attrs=attrs)
                     s0 = time.monotonic_ns()
-                    self.fwd_out.send(np.asarray(y))
+                    self.fwd_out[c].send(np.asarray(y))
                     if fl is not None:
                         fl.record("mpmd.send", s0, time.monotonic_ns(),
-                                  lane=lane,
-                                  attrs={**base, "mb": i, "dir": "fwd"})
-                # Last stage: loss + backward run together at the B op.
+                                  lane=lane, attrs={**attrs, "dir": "fwd"})
+                # Last virtual stage: loss + backward run at the B op.
             else:
                 assert op == B
-                x = saved.pop(i)
-                if self.last:
+                x = saved.pop((c, i))
+                if lastc:
                     t0 = time.monotonic_ns()
-                    loss, gp, gx = self._loss_bwd(
-                        self.params, x, jnp.asarray(targets[i])
+                    loss, gp, gx = fns["loss_bwd"](
+                        self._chunk_params(c), x, jnp.asarray(targets[i])
                     )
                     jax.block_until_ready(gp)
                     t1 = time.monotonic_ns()
                     busy += (t1 - t0) * 1e-9
                     if fl is not None:
                         fl.record("mpmd.bwd", t0, t1, lane=lane, flow=flow,
-                                  attrs={**base, "mb": i})
+                                  attrs=attrs)
                     losses.append(float(loss))
                 else:
                     r0 = time.monotonic_ns()
-                    gy = jnp.asarray(self.bwd_in.recv())
+                    gy = jnp.asarray(self.bwd_in[c].recv())
                     t0 = time.monotonic_ns()
-                    gp, gx = self._fwd_bwd(self.params, x, gy)
+                    gp, gx = fns["fwd_bwd"](self._chunk_params(c), x, gy)
                     jax.block_until_ready(gp)
                     t1 = time.monotonic_ns()
                     busy += (t1 - t0) * 1e-9
                     if fl is not None:
                         fl.record("mpmd.recv_wait", r0, t0, lane=lane,
-                                  attrs={**base, "mb": i, "dir": "bwd"})
+                                  attrs={**attrs, "dir": "bwd"})
                         fl.record("mpmd.bwd", t0, t1, lane=lane, flow=flow,
-                                  attrs={**base, "mb": i})
-                if not self.first:
+                                  attrs=attrs)
+                if not firstc:
                     s0 = time.monotonic_ns()
-                    self.bwd_out.send(np.asarray(gx))
+                    self.bwd_out[c].send(np.asarray(gx))
                     if fl is not None:
                         fl.record("mpmd.send", s0, time.monotonic_ns(),
-                                  lane=lane,
-                                  attrs={**base, "mb": i, "dir": "bwd"})
-                acc = gp if acc is None else self._acc(acc, gp)
+                                  lane=lane, attrs={**attrs, "dir": "bwd"})
+                accs[c] = gp if accs[c] is None else self._acc(accs[c], gp)
+
+        # Tied-embedding bridge (Megatron embedding allreduce): tok_embed
+        # lives on virtual stage 0 (chunk 0 here if stage 0) AND virtual
+        # stage P-1 (chunk v-1 if stage S-1); each side contributes a
+        # partial gradient. Exchange the two partials over the dedicated
+        # edge pair and SUM — float addition commutes, so both hosts
+        # compute bit-identical totals and (same init, same elementwise
+        # adamw) the two copies stay bit-identical forever. Send-then-recv
+        # is deadlock-free: the directions are separate depth-1 channels
+        # and each carries exactly one message per step.
+        if self.bridge_out is not None:
+            own = 0 if self.first else v - 1
+            acc_np = {
+                k: np.asarray(g)
+                for k, g in accs[own].items()
+            } if isinstance(accs[own], dict) else accs[own]
+            mine = np.asarray(acc_np["tok_embed"], dtype=np.float32)
+            b0 = time.monotonic_ns()
+            self.bridge_out.send(mine)
+            other = np.asarray(self.bridge_in.recv(), dtype=np.float32)
+            if fl is not None:
+                fl.record(
+                    "mpmd.bridge", b0, time.monotonic_ns(),
+                    lane=lanes[own],
+                    attrs={**base, "chunk": own, "dir": "embed"},
+                )
+            acc_np["tok_embed"] = mine + other
+            accs[own] = acc_np
 
         # Mean over microbatches (loss = mean of equal-size microbatch
-        # means), then the dp-sharded update.
+        # means), then the dp-sharded update over the ONE flat space.
         t0 = time.monotonic_ns()
+        acc = accs[0] if v == 1 else {f"c{c}": accs[c] for c in range(v)}
         flat_g, _ = zero_flatten(jax.tree_util.tree_map(np.asarray, acc))
         flat_g = flat_g / np.float32(M)
         new_flat, grad_sumsq = self.opt.step(flat_g)
         self.params = jax.device_put(zero_unflatten(new_flat, self._spec))
         t1 = time.monotonic_ns()
         if fl is not None:
-            fl.record("mpmd.update", t0, t1, lane=lane, attrs=dict(base))
+            fl.record("mpmd.update", t0, t1, lane=lanes[0],
+                      attrs=dict(base))
         self.last_update_s = (t1 - t0) * 1e-9
         self.last_busy_s = busy
         try:
@@ -269,10 +373,17 @@ class StageRunner:
         )
 
     def params_host(self):
-        """Host copy of the full working parameters. Collective-free: the
-        working tree is already the all-gathered result of the last update
-        (calling into the optimizer here would be a stray collective that
-        only one caller runs — a wedge)."""
+        """Host copy of the full working parameters (the chunk-namespaced
+        tree when interleaved). Collective-free: the working tree is
+        already the all-gathered result of the last update (calling into
+        the optimizer here would be a stray collective that only one
+        caller runs — a wedge)."""
         import jax
 
         return jax.tree_util.tree_map(np.asarray, self.params)
+
+    def chunk_params_host(self, c: int):
+        """Host copy of ONE chunk's param tree (the whole tree at v=1)."""
+        import jax
+
+        return jax.tree_util.tree_map(np.asarray, self._chunk_params(c))

@@ -48,6 +48,10 @@ class MPMDOptions:
     dp_min: Optional[int] = None      # elasticity band for reshapes
     dp_max: Optional[int] = None
     num_microbatches: int = 2
+    num_chunks: int = 1               # v model chunks per stage (interleaved
+                                      # 1F1B; v>1 needs M % S == 0)
+    wire_dtype: str = "f32"           # activation/grad wire: "f32" | "bf16"
+    send_depth: int = 2               # per-edge send ring (1 = synchronous)
     zero: bool = True                 # ZeRO sharded update vs replicated
     lr: float = 1e-3
     betas: tuple = (0.9, 0.95)
@@ -106,31 +110,49 @@ class _StageReplica(ChannelHostMixin):
         else:
             comm = SoloComm()
         cfg = o["cfg"]
-        # Only THIS stage's parameter slice ever lands in this process —
+        v = o.get("num_chunks", 1)
+        # Only THIS stage's parameter slices ever land in this process —
         # the driver initialized the full tree once and shipped slices.
         self._runner = StageRunner(
             cfg, o["stage"], o["num_stages"], o["num_microbatches"],
-            o["stage_params"], comm, replica=o["dp_rank"], zero=o["zero"],
+            o["stage_params"], comm, replica=o["dp_rank"],
+            num_chunks=v, zero=o["zero"],
             lr=o["lr"], betas=o["betas"], eps=o["eps"],
             weight_decay=o["weight_decay"],
         )
         transport = ActTransport(
             inline_max_bytes=o["inline_max_bytes"],
             timeout_s=o["channel_timeout_s"],
+            wire_dtype=o.get("wire_dtype", "f32"),
         )
         self._transport = transport
+        # The bridge carries gradients FOR the update — it never rides the
+        # lossy wire, so it gets its own f32 transport.
+        bridge_transport = ActTransport(
+            inline_max_bytes=o["inline_max_bytes"],
+            timeout_s=o["channel_timeout_s"],
+        )
 
-        def edge(ch):
+        def edge(ch, tr=transport):
             return (
-                ChannelEdge(ch, transport, timeout_s=o["channel_timeout_s"])
+                ChannelEdge(
+                    ch, tr, timeout_s=o["channel_timeout_s"],
+                    send_depth=o.get("send_depth", 1),
+                )
                 if ch is not None else None
             )
 
+        def chunk_edges(key):
+            chs = edges.get(key) or [None] * v
+            return [edge(ch) for ch in chs]
+
         self._runner.bind_edges(
-            fwd_in=edge(edges.get("fwd_in")),
-            fwd_out=edge(edges.get("fwd_out")),
-            bwd_in=edge(edges.get("bwd_in")),
-            bwd_out=edge(edges.get("bwd_out")),
+            fwd_in=chunk_edges("fwd_in"),
+            fwd_out=chunk_edges("fwd_out"),
+            bwd_in=chunk_edges("bwd_in"),
+            bwd_out=chunk_edges("bwd_out"),
+            bridge_out=edge(edges.get("bridge_out"), bridge_transport),
+            bridge_in=edge(edges.get("bridge_in"), bridge_transport),
         )
         self._writer = AsyncShardWriter(
             o["stage_root"], o["dp_rank"], o["dp"], gen=o["gen"],
@@ -146,7 +168,9 @@ class _StageReplica(ChannelHostMixin):
                     f"step {restore_step} vanished before restore"
                 )
             state, tree = found
-            state.check_pipeline(o["stage"], o["num_stages"])
+            state.check_pipeline(
+                o["stage"], o["num_stages"], o.get("num_chunks", 1)
+            )
             self._runner.load_ckpt(state, tree)
         return self._runner.state.step
 
@@ -155,7 +179,9 @@ class _StageReplica(ChannelHostMixin):
         metrics = self._runner.run_step(tokens)
         if save:
             st = self._runner.state
-            st.record_pipeline(o["stage"], o["num_stages"])
+            st.record_pipeline(
+                o["stage"], o["num_stages"], o.get("num_chunks", 1)
+            )
             st.extra["opt_t"] = self._runner.opt.t
             self._writer.save(st.step, self._runner.ckpt_tree(), st)
         return metrics
@@ -165,7 +191,7 @@ class _StageReplica(ChannelHostMixin):
 
     def transport_stats(self) -> Dict[str, int]:
         t = getattr(self, "_transport", None)
-        return dict(t.stats) if t is not None else {}
+        return t.all_stats() if t is not None else {}
 
 
 class _MPMDGang:
@@ -229,8 +255,17 @@ class MPMDTrainer:
         experiment_name: str = "mpmd",
     ):
         from ...models import gpt
+        from .schedule import build_interleaved_1f1b
 
-        gpt.check_mpmd_partitionable(cfg, options.num_stages)
+        gpt.check_mpmd_partitionable(
+            cfg, options.num_stages, options.num_chunks
+        )
+        # Validates (S, M, v) — interleaving needs M % S == 0 — before any
+        # actor spawns.
+        build_interleaved_1f1b(
+            0, options.num_stages, options.num_microbatches,
+            options.num_chunks,
+        )
         lo, hi = options.band()
         if not lo <= options.dp <= hi or hi % options.dp != 0:
             # Same contract _pick_dp enforces for reshaped widths: the
@@ -269,19 +304,24 @@ class MPMDTrainer:
 
         from ...models import gpt
 
-        o, S = self.opts, self.opts.num_stages
+        o, S, v = self.opts, self.opts.num_stages, self.opts.num_chunks
         gen = uuid.uuid4().hex[:8]
         remote_cls = api.remote(_StageReplica)
         # The full parameter tree is materialized ONCE, here on the driver,
-        # and each replica receives only ITS stage's slice — S*dp gang
-        # actors must never each allocate the whole model just to throw
-        # most of it away (at GPT-J scale that transient would OOM exactly
-        # the hosts the ZeRO sharding is sized for).
+        # and each replica receives only ITS stage's chunk slices — S*dp
+        # gang actors must never each allocate the whole model just to
+        # throw most of it away (at GPT-J scale that transient would OOM
+        # exactly the hosts the ZeRO sharding is sized for).
         params_np = jax.tree_util.tree_map(
             np.asarray, gpt.init_params(jax.random.PRNGKey(o.seed), self.cfg)
         )
         stage_slices = [
-            gpt.extract_stage_params(params_np, self.cfg, s, S)
+            [
+                gpt.extract_stage_params(
+                    params_np, self.cfg, s, S, num_chunks=v, chunk=c
+                )
+                for c in range(v)
+            ]
             for s in range(S)
         ]
         del params_np
@@ -290,8 +330,12 @@ class MPMDTrainer:
             for r in range(dp):
                 payload = cloudpickle.dumps(dict(
                     cfg=self.cfg, stage=s, num_stages=S, dp=dp, dp_rank=r,
-                    stage_params=stage_slices[s],
-                    num_microbatches=o.num_microbatches, zero=o.zero,
+                    stage_params=(
+                        stage_slices[s] if v > 1 else stage_slices[s][0]
+                    ),
+                    num_microbatches=o.num_microbatches,
+                    num_chunks=v, wire_dtype=o.wire_dtype,
+                    send_depth=o.send_depth, zero=o.zero,
                     lr=o.lr, betas=o.betas, eps=o.eps,
                     weight_decay=o.weight_decay, seed=o.seed,
                     group_name=f"mpmd-{self.experiment_name}-{gen}-s{s}",
@@ -313,8 +357,11 @@ class MPMDTrainer:
                 )
 
         # Edge channels: replica r of stage s -> replica r of stage s+1
-        # (fwd) and back (bwd), built with the compiled-DAG channel chooser
-        # so same-node edges ride shm and cross-node edges ride TCP.
+        # (fwd) and back (bwd) PER CHUNK, plus the wrap edges chunk c of
+        # stage S-1 -> chunk c+1 of stage 0 when interleaved, plus the
+        # tied-embedding bridge pair between the boundary stages — all
+        # built with the compiled-DAG channel chooser so same-node edges
+        # ride shm and cross-node edges ride TCP.
         driver_node = get_runtime_context().get_node_id()
         nodes = {
             key: nid for key, nid in zip(
@@ -323,23 +370,47 @@ class MPMDTrainer:
         }
         channels = []
         edges: Dict[tuple, Dict[str, Any]] = {
-            key: {} for key in actors
+            key: {
+                "fwd_in": [None] * v, "fwd_out": [None] * v,
+                "bwd_in": [None] * v, "bwd_out": [None] * v,
+            }
+            for key in actors
         }
-        for s in range(S - 1):
-            for r in range(dp):
-                fwd = make_edge_channel(
-                    o.channel_buffer_bytes, nodes[(s, r)],
-                    [nodes[(s + 1, r)]], 1, actors[(s, r)], driver_node,
+
+        def connect(src, dst, kind, src_c, dst_c):
+            ch = make_edge_channel(
+                o.channel_buffer_bytes, nodes[src], [nodes[dst]], 1,
+                actors[src], driver_node,
+            )
+            channels.append(ch)
+            edges[src][f"{kind}_out"][src_c] = ch
+            edges[dst][f"{kind}_in"][dst_c] = ch.with_reader_slot(0)
+
+        for r in range(dp):
+            for c in range(v):
+                for s in range(S - 1):
+                    connect((s, r), (s + 1, r), "fwd", c, c)
+                    connect((s + 1, r), (s, r), "bwd", c, c)
+            # Wrap: virtual stage c*S + (S-1) feeds (c+1)*S + 0 — the
+            # forward leaves stage S-1's chunk c into stage 0's chunk c+1
+            # (and the grad comes back).
+            for c in range(v - 1):
+                connect((S - 1, r), (0, r), "fwd", c, c + 1)
+                connect((0, r), (S - 1, r), "bwd", c + 1, c)
+            if self.cfg.tie_embeddings and S > 1:
+                b_fwd = make_edge_channel(
+                    o.channel_buffer_bytes, nodes[(0, r)],
+                    [nodes[(S - 1, r)]], 1, actors[(0, r)], driver_node,
                 )
-                bwd = make_edge_channel(
-                    o.channel_buffer_bytes, nodes[(s + 1, r)],
-                    [nodes[(s, r)]], 1, actors[(s + 1, r)], driver_node,
+                b_bwd = make_edge_channel(
+                    o.channel_buffer_bytes, nodes[(S - 1, r)],
+                    [nodes[(0, r)]], 1, actors[(S - 1, r)], driver_node,
                 )
-                channels.extend([fwd, bwd])
-                edges[(s, r)]["fwd_out"] = fwd
-                edges[(s + 1, r)]["fwd_in"] = fwd.with_reader_slot(0)
-                edges[(s + 1, r)]["bwd_out"] = bwd
-                edges[(s, r)]["bwd_in"] = bwd.with_reader_slot(0)
+                channels.extend([b_fwd, b_bwd])
+                edges[(0, r)]["bridge_out"] = b_fwd
+                edges[(S - 1, r)]["bridge_in"] = b_fwd.with_reader_slot(0)
+                edges[(S - 1, r)]["bridge_out"] = b_bwd
+                edges[(0, r)]["bridge_in"] = b_bwd.with_reader_slot(0)
 
         gang = _MPMDGang(actors, channels, groups)
         try:
@@ -501,7 +572,13 @@ class MPMDTrainer:
             metrics = dict(zip(keys, out))
             last = [metrics[(S - 1, r)] for r in range(dp)]
             per_stage0 = [metrics[(s, 0)] for s in range(S)]
-            busy = sum(m["busy_s"] for m in metrics.values())
+            # Busy = stage compute + optimizer update — the same numerator
+            # the local harness and flight.pipeline_report use, so all
+            # three bubble sources stay directly comparable.
+            busy = sum(
+                m["busy_s"] + m.get("update_s", 0.0)
+                for m in metrics.values()
+            )
             bubble = max(0.0, 1.0 - busy / (wall * S * dp))
             history.append({
                 "step": step + 1,

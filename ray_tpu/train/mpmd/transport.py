@@ -19,21 +19,81 @@ channel. The receiver imports by rung:
      native off-GIL lander (one wire request, no whole-object get);
   4. no rung left -> the step fails loudly and the elastic layer owns it.
 
-Pinning: the sender holds each published object's ref until the NEXT send
-on the same edge completes. Channel writes block until the reader acked the
-previous message, and the reader acks only after importing — so at the
-moment a ref is dropped, its consumer is provably done with it.
+**Wire precision** (`wire_dtype`): with "bf16", f32 tensors are cast to
+bfloat16 at publish (round-to-nearest-even via ml_dtypes — already a jax
+dependency) and restored to f32 at fetch, halving every rung's bytes.
+Master weights and the ZeRO update never see the wire dtype — only the
+activation/grad hop is compressed. Default "f32" is a bit-exact identity
+so the parity gates stay bitwise meaningful; bf16 is gated by an allclose
+loss-curve test. `WireCodec.stats` counts raw vs wire bytes per frame so
+benches and the perf smoke can assert the ~2x cut.
+
+**Double-buffered sends** (`ChannelEdge(send_depth=2)`): publish stays on
+the caller's thread, but the blocking channel write moves to a per-edge
+sender thread behind a bounded ring — the send of microbatch k overlaps
+the compute of k+1 instead of stalling on the reader's ack. Pinning
+extends to a 2-deep ring: the sender holds each published object's ref
+until the NEXT write on the same edge completes (write k returning means
+the reader acked — finished importing — message k-1, so at most
+`send_depth` pins are live). Deeper send buffering only RELAXES a
+schedule proven deadlock-free at depth 1: every blocking wait that could
+wedge happens strictly later, never earlier.
 """
 
 from __future__ import annotations
 
 import pickle
 import queue
+import threading
 from typing import Any, Dict, Optional
 
 import numpy as np
 
 DEFAULT_INLINE_MAX = 256 * 1024
+
+WIRE_DTYPES = ("f32", "bf16")
+
+
+class WireCodec:
+    """Optional lossy wire encoding for one pipeline hop. "f32" is the
+    identity; "bf16" casts f32 arrays to bfloat16 for the wire (shipped as
+    a u16 view — numpy has no native bfloat16 — and restored to f32 on the
+    other side). Non-f32 arrays (tokens, already-bf16 payloads) pass
+    through unchanged. Thread-safe byte counters in `.stats`."""
+
+    def __init__(self, wire_dtype: str = "f32"):
+        if wire_dtype not in WIRE_DTYPES:
+            raise ValueError(
+                f"wire_dtype must be one of {WIRE_DTYPES}, got {wire_dtype!r}"
+            )
+        self.wire_dtype = wire_dtype
+        self._lock = threading.Lock()
+        self.stats = {"frames": 0, "raw_bytes": 0, "wire_bytes": 0}
+
+    def encode(self, arr: np.ndarray):
+        """-> (wire_arr, meta): meta is None for identity frames, else the
+        original dtype str the decoder must restore."""
+        arr = np.asarray(arr)
+        out, meta = arr, None
+        if self.wire_dtype == "bf16" and arr.dtype == np.float32:
+            import ml_dtypes
+
+            out = arr.astype(ml_dtypes.bfloat16).view(np.uint16)
+            meta = arr.dtype.str
+        with self._lock:
+            self.stats["frames"] += 1
+            self.stats["raw_bytes"] += arr.nbytes
+            self.stats["wire_bytes"] += out.nbytes
+        return out, meta
+
+    def decode(self, arr: np.ndarray, meta: Optional[str]) -> np.ndarray:
+        if meta is None:
+            return arr
+        import ml_dtypes
+
+        return (
+            np.asarray(arr).view(ml_dtypes.bfloat16).astype(np.dtype(meta))
+        )
 
 
 def _rebuild_oob(dtype_str: str, shape, buf) -> np.ndarray:
@@ -64,15 +124,22 @@ class ActTransport:
         self,
         inline_max_bytes: int = DEFAULT_INLINE_MAX,
         timeout_s: float = 120.0,
+        wire_dtype: str = "f32",
     ):
         self.inline_max = int(inline_max_bytes)
         self.timeout_s = timeout_s
+        self.codec = WireCodec(wire_dtype)
         # Which rung each publish/fetch took — tests and the bench assert
         # the arena path actually engaged instead of trusting thresholds.
+        # Wire bytes live in codec.stats and are merged into stats().
         self.stats = {
             "pub_inline": 0, "pub_arena": 0,
             "fetch_inline": 0, "fetch_local": 0, "fetch_span": 0,
         }
+
+    def all_stats(self) -> Dict[str, int]:
+        """Rung counters + the codec's raw/wire byte counters, one dict."""
+        return {**self.stats, **self.codec.stats}
 
     # ----------------------------------------------------------- producer
     def publish(self, arr: np.ndarray):
@@ -81,7 +148,15 @@ class ActTransport:
         next send completes (see module docstring)."""
         from ...core import api, serialization, store
 
+        arr, wire = self.codec.encode(np.ascontiguousarray(arr))
         arr = np.ascontiguousarray(arr)
+
+        def inline_desc():
+            d = {"inline": arr}
+            if wire is not None:
+                d["wire"] = wire
+            return d
+
         # _global_runtime (not the non-initializing peek): worker processes
         # build their runtime lazily on first API use, and a publish from a
         # stage actor's first step IS that first use.
@@ -99,16 +174,16 @@ class ActTransport:
             or getattr(backend, "remote_client", False)
         ):
             self.stats["pub_inline"] += 1
-            return {"inline": arr}, None
+            return inline_desc(), None
         payload, buffers = serialization.serialize(_OOBArray(arr))
         if len(buffers) != 1:  # something unexpected went out-of-band
             self.stats["pub_inline"] += 1
-            return {"inline": arr}, None
+            return inline_desc(), None
         try:
             task_hex = rt.current_task_id.hex()
         except Exception:  # noqa: BLE001 — outside a task context
             self.stats["pub_inline"] += 1
-            return {"inline": arr}, None
+            return inline_desc(), None
         # Frame layout ([u32 npayload][payload][u32 nbufs]{[u64 len][bytes]})
         # puts the single buffer's data at a fixed offset.
         off = 4 + len(payload) + 4 + 8
@@ -118,7 +193,7 @@ class ActTransport:
             # object has no locally-readable name — keep the tensor in the
             # channel payload so the consumer never needs the object.
             self.stats["pub_inline"] += 1
-            return {"inline": arr}, None
+            return inline_desc(), None
         desc = {
             "name": name,
             "hex": ref.id.hex(),
@@ -126,14 +201,17 @@ class ActTransport:
             "dtype": arr.dtype.str,
             "shape": tuple(arr.shape),
         }
+        if wire is not None:
+            desc["wire"] = wire
         self.stats["pub_arena"] += 1
         return desc, ref
 
     # ----------------------------------------------------------- consumer
     def fetch(self, desc: Dict[str, Any]) -> np.ndarray:
+        wire = desc.get("wire")
         if "inline" in desc:
             self.stats["fetch_inline"] += 1
-            return desc["inline"]
+            return self.codec.decode(desc["inline"], wire)
         from ...core import api
         from ...core import bulk as bulk_mod
 
@@ -159,7 +237,7 @@ class ActTransport:
                 except Exception:  # noqa: BLE001 — release is best-effort
                     pass
                 self.stats["fetch_local"] += 1
-                return out
+                return self.codec.decode(out, wire)
         # Rung 3: span pull over the bulk plane.
         span = desc.get("span")
         sources_of = getattr(backend, "object_sources", None)
@@ -171,9 +249,10 @@ class ActTransport:
                     src["bulk"], src["name"], off, length, self.timeout_s
                 )
                 self.stats["fetch_span"] += 1
-                return np.frombuffer(
+                out = np.frombuffer(
                     buf, dtype=np.dtype(desc["dtype"])
                 ).reshape(desc["shape"])
+                return self.codec.decode(out, wire)
         raise RuntimeError(
             f"activation object {desc.get('hex', '?')} unreachable "
             "(source gone and no span-servable copy) — failing the step for "
@@ -181,29 +260,83 @@ class ActTransport:
         )
 
 
+_RING_CLOSE = object()
+
+
 class ChannelEdge:
     """One direction of one pipeline edge over a compiled-DAG channel.
     Construct with the writer end in the producer process and a reader-slot
     view in the consumer process (channels pickle-attach, exactly as
-    compiled DAG arg plans ship them)."""
+    compiled DAG arg plans ship them).
+
+    `send_depth=1` keeps the classic synchronous write (send blocks until
+    the reader acked the previous message). `send_depth>=2` moves the
+    blocking write to a per-edge sender thread behind a ring of
+    send_depth-1 queued messages + 1 in the write — the producer's compute
+    overlaps the reader's ack. The pin contract extends with the ring: a
+    published object's ref is dropped only after the NEXT write on this
+    edge returns, so at most `send_depth` pins are live at once."""
 
     def __init__(
         self,
         channel,
         transport: Optional[ActTransport] = None,
         timeout_s: float = 120.0,
+        send_depth: int = 1,
     ):
         self._ch = channel
         self._transport = transport or ActTransport()
         self.timeout_s = timeout_s
+        self._depth = max(1, int(send_depth))
         self._pin = None  # previous send's arena object, held until acked
+        self._ring: Optional["queue.Queue"] = None
+        self._sender: Optional[threading.Thread] = None
+        self._err: Optional[BaseException] = None
 
     def send(self, arr: np.ndarray) -> None:
         desc, pin = self._transport.publish(np.asarray(arr))
-        self._ch.write(desc, timeout=self.timeout_s)
-        # write() returned => the reader acked the PREVIOUS message, whose
-        # import finished before its ack — the old pin is dead weight now.
-        self._pin = pin
+        if self._depth == 1:
+            self._ch.write(desc, timeout=self.timeout_s)
+            # write() returned => the reader acked the PREVIOUS message,
+            # whose import finished before its ack — the old pin is dead
+            # weight now.
+            self._pin = pin
+            return
+        if self._err is not None:
+            raise RuntimeError(
+                f"pipeline edge sender failed: {self._err!r}"
+            ) from self._err
+        if self._ring is None:
+            self._ring = queue.Queue(maxsize=self._depth - 1)
+            self._sender = threading.Thread(
+                target=self._drain, daemon=True, name="mpmd-edge-sender"
+            )
+            self._sender.start()
+        try:
+            self._ring.put((desc, pin), timeout=self.timeout_s)
+        except queue.Full:
+            raise RuntimeError(
+                f"pipeline edge send ring full for {self.timeout_s:.0f}s "
+                "(reader wedged?) — failing the step for the elastic layer"
+            ) from None
+
+    def _drain(self) -> None:
+        prev_pin = None
+        while True:
+            item = self._ring.get()
+            if item is _RING_CLOSE:
+                break
+            desc, pin = item
+            try:
+                self._ch.write(desc, timeout=self.timeout_s)
+            except BaseException as e:  # noqa: BLE001 — surfaced on next send
+                self._err = e
+                break
+            # This write returning means the reader acked the previous
+            # message — ITS pin is droppable; the just-written message's
+            # pin must survive until the next write returns.
+            prev_pin = pin  # noqa: F841 — holding the ref IS the point
+        self._pin = None
 
     def recv(self) -> np.ndarray:
         desc = self._ch.begin_read(timeout=self.timeout_s)
@@ -213,6 +346,13 @@ class ChannelEdge:
             self._ch.end_read()
 
     def close(self) -> None:
+        if self._ring is not None:
+            try:
+                self._ring.put(_RING_CLOSE, timeout=5.0)
+                self._sender.join(timeout=5.0)
+            except Exception:  # noqa: BLE001 — best-effort drain
+                pass
+            self._ring = None
         try:
             self._ch.close_writer()
         except Exception:  # noqa: BLE001
@@ -223,17 +363,25 @@ class ChannelEdge:
 class LocalEdge:
     """In-process edge (thread-to-thread) with channel-like depth-1
     backpressure — the parity tests run the REAL 1F1B interleaving
-    without a cluster."""
+    without a cluster. Takes the same wire codec as the cluster path so
+    the bf16 loss-curve gate exercises the actual cast/restore."""
 
-    def __init__(self, depth: int = 1, timeout_s: float = 60.0):
+    def __init__(
+        self,
+        depth: int = 1,
+        timeout_s: float = 60.0,
+        codec: Optional[WireCodec] = None,
+    ):
         self._q: "queue.Queue" = queue.Queue(maxsize=depth)
         self.timeout_s = timeout_s
+        self.codec = codec or WireCodec()
 
     def send(self, arr: np.ndarray) -> None:
-        self._q.put(np.asarray(arr), timeout=self.timeout_s)
+        self._q.put(self.codec.encode(np.asarray(arr)), timeout=self.timeout_s)
 
     def recv(self) -> np.ndarray:
-        return self._q.get(timeout=self.timeout_s)
+        wire_arr, meta = self._q.get(timeout=self.timeout_s)
+        return self.codec.decode(wire_arr, meta)
 
     def close(self) -> None:
         pass

@@ -380,15 +380,28 @@ def merged_chrome_trace(
 
 # -------------------------------------------------------- bubble attribution
 _MPMD_COMPUTE = frozenset({"mpmd.fwd", "mpmd.bwd", "mpmd.update"})
-_MPMD_WAIT = frozenset({"mpmd.recv_wait", "mpmd.send"})
+_MPMD_WAIT = frozenset({"mpmd.recv_wait", "mpmd.send", "mpmd.bridge"})
+
+
+def _physical_lane(args: dict) -> str:
+    """Group spans by PHYSICAL (stage, replica), not Perfetto lane: with
+    interleaving the renderer shows one lane per (stage, chunk, replica)
+    but a stage's chunks share one host thread — counting them as
+    separate capacity lanes would inflate the bubble denominator to
+    wall*S*v*dp while the trainer divides by wall*S*dp."""
+    if "stage" in args and "replica" in args:
+        return f"s{args['stage']}r{args['replica']}"
+    return str(args.get("lane", "?"))
 
 
 def pipeline_report(events: List[dict]) -> Optional[dict]:
     """Decompose the MPMD pipeline bubble from flight spans.
 
-    Per (stage, replica) lane and per step: busy = Σ compute-span
-    durations (fwd/bwd/update), the step window = [min start, max end]
-    across every lane, and idle = window·lanes − busy. Idle splits into
+    Per PHYSICAL (stage, replica) lane — interleaved chunks' spans fold
+    into their host stage's lane via the span attrs (see _physical_lane)
+    — and per step: busy = Σ compute-span durations (fwd/bwd/update), the
+    step window = [min start, max end] across every lane, and
+    idle = window·lanes − busy. Idle splits into
     warmup (lane idle before its first compute of the step), drain (lane
     idle after its last compute), and steady (everything between —
     dominated by transport/recv waits, reported separately from the
@@ -416,7 +429,7 @@ def pipeline_report(events: List[dict]) -> Optional[dict]:
         t1 = max(e["ts"] + e.get("dur", 0.0) for e in evs)
         for e in evs:
             args = e.get("args") or {}
-            lane = lanes.setdefault(str(args.get("lane", "?")), {
+            lane = lanes.setdefault(_physical_lane(args), {
                 "busy": 0.0, "wait": 0.0, "first": None, "last": None})
             dur = e.get("dur", 0.0)
             if e["name"] in _MPMD_COMPUTE:

@@ -1,23 +1,31 @@
 """MPMD pipeline training bench — records BENCH_TRAIN_mpmd.json.
 
-Three executions of the SAME model/batch/optimizer, A/B'd:
+Executions of the SAME model/batch/optimizer, A/B'd:
 
-  * ``unpipelined`` — one jit program, whole model, one device;
-  * ``gpipe``       — single-jit in-mesh GPipe (`models/gpt.pipeline_loss_fn`
-                      over a pp mesh of host devices, one process);
-  * ``mpmd``        — the real thing: S stage gangs x dp replicas as
-                      separate processes (`train.mpmd.MPMDTrainer`), host
-                      1F1B over compiled-DAG channels, activations on the
-                      arena/bulk planes, ZeRO sharded update.
+  * ``unpipelined``      — one jit program, whole model, one device;
+  * ``gpipe``            — single-jit in-mesh GPipe
+                           (`models/gpt.pipeline_loss_fn` over a pp mesh of
+                           host devices, one process);
+  * ``mpmd``             — the real thing: S stage gangs x dp replicas as
+                           separate processes (`train.mpmd.MPMDTrainer`),
+                           host 1F1B over compiled-DAG channels, activations
+                           on the arena/bulk planes, ZeRO sharded update;
+  * ``mpmd_interleaved`` — same processes, v model chunks per stage
+                           (virtual-stage 1F1B): the bubble row the
+                           interleave exists to shrink;
+  * ``mpmd_interleaved_bf16`` — interleaved + bf16 activation wire: same
+                           step, ~half the hop bytes.
 
 Recorded per mode: median step time (after warmup), measured + theoretical
-bubble fraction (mpmd), per-replica optimizer bytes with ZeRO on vs
-replicated (the ~dp x claim), loss parity across all three at step 1, and
+bubble fraction (mpmd rows), wire byte counters, per-replica optimizer
+bytes with ZeRO on vs replicated (the ~dp x claim), loss parity at step 1
+(f32 rows exact-ish; bf16 tracked against its documented tolerance), and
 the model-FLOPs/s figure that anchors the MFU path (this is a 1-vCPU CPU
 host — the MFU bar itself is a TPU number; r5 measured 48% single-host,
 ROADMAP item 2 wants >= 40% multi-host on this exact execution shape).
 
 Usage: python scripts/bench_mpmd.py [--record] [--steps N] [--quick]
+                                    [--interleave V] [--wire-dtype bf16]
 """
 
 from __future__ import annotations
@@ -141,7 +149,8 @@ def bench_gpipe(cfg, batches, num_stages, num_microbatches, lr=1e-3):
 
 
 def bench_mpmd(cfg, batches, num_stages, dp, num_microbatches, *,
-               zero=True, lr=1e-3, storage=None, step_timeout_s=600.0):
+               num_chunks=1, wire_dtype="f32", zero=True, lr=1e-3,
+               storage=None, step_timeout_s=600.0):
     import tempfile
 
     import ray_tpu
@@ -164,7 +173,8 @@ def bench_mpmd(cfg, batches, num_stages, dp, num_microbatches, *,
             cfg,
             MPMDOptions(
                 num_stages=num_stages, dp=dp,
-                num_microbatches=num_microbatches, zero=zero, lr=lr,
+                num_microbatches=num_microbatches, num_chunks=num_chunks,
+                wire_dtype=wire_dtype, zero=zero, lr=lr,
                 step_timeout_s=step_timeout_s, ckpt_every=10**9,
             ),
             total_steps=len(batches),
@@ -192,6 +202,10 @@ def bench_mpmd(cfg, batches, num_stages, dp, num_microbatches, *,
             raise RuntimeError(f"mpmd bench run failed: {res['error']}")
         hist = res["history"]
         walls = [h["wall_s"] for h in hist]
+        wire = {"frames": 0, "raw_bytes": 0, "wire_bytes": 0}
+        for st in stats.values():
+            for k in wire:
+                wire[k] += int(st.get(k, 0))
         return {
             "step_s": walls,
             "median_step_s": float(np.median(walls[1:] or walls)),
@@ -200,19 +214,22 @@ def bench_mpmd(cfg, batches, num_stages, dp, num_microbatches, *,
                 np.median([h["bubble_frac"] for h in hist[1:] or hist])
             ),
             "bubble_frac_theoretical": theoretical_bubble_fraction(
-                num_stages, num_microbatches
+                num_stages, num_microbatches, num_chunks
             ),
             "opt_bytes_per_replica": hist[-1]["opt_bytes_per_replica"],
             "transport": stats,
+            "wire": wire,
         }
     finally:
         if booted:
             ray_tpu.shutdown()
 
 
-def run(record: bool, steps: int, quick: bool):
+def run(record: bool, steps: int, quick: bool, interleave: int = 2,
+        wire_dtype: str = "bf16"):
     cfg = bench_cfg(quick)
     S, dp, M = 2, 2, 4
+    v = interleave
     batch = 16
     batches = make_batches(cfg, batch, steps)
 
@@ -230,6 +247,23 @@ def run(record: bool, steps: int, quick: bool):
         f"   median step {mp['median_step_s']:.3f}s, bubble "
         f"{mp['bubble_frac_measured']:.2f} (theory "
         f"{mp['bubble_frac_theoretical']:.2f})"
+    )
+
+    print(f"== MPMD interleaved v={v} (same shape, f32 wire) ==")
+    mp_il = bench_mpmd(cfg, batches, S, dp, M, num_chunks=v, zero=True)
+    print(
+        f"   median step {mp_il['median_step_s']:.3f}s, bubble "
+        f"{mp_il['bubble_frac_measured']:.2f} (theory "
+        f"{mp_il['bubble_frac_theoretical']:.2f})"
+    )
+
+    print(f"== MPMD interleaved v={v} + {wire_dtype} wire ==")
+    mp_bf = bench_mpmd(
+        cfg, batches, S, dp, M, num_chunks=v, wire_dtype=wire_dtype, zero=True
+    )
+    print(
+        f"   median step {mp_bf['median_step_s']:.3f}s, wire bytes "
+        f"{mp_bf['wire']['wire_bytes']} vs raw {mp_bf['wire']['raw_bytes']}"
     )
 
     print(f"== MPMD S={S} dp={dp} ZeRO OFF (replicated A/B, short) ==")
@@ -256,24 +290,36 @@ def run(record: bool, steps: int, quick: bool):
             "unpipelined": un,
             "gpipe_single_jit": gp,
             "mpmd_zero": mp,
+            "mpmd_interleaved": mp_il,
+            "mpmd_interleaved_bf16": mp_bf,
             "mpmd_replicated": {
                 k: mp_rep[k]
                 for k in ("median_step_s", "opt_bytes_per_replica")
             },
         },
+        "interleave": {"num_chunks": v, "wire_dtype": wire_dtype},
         "parity": {
             # Same init/batch/optimizer: step-1 losses agree across all
-            # three executions (the fuller gate lives in
-            # tests/test_train_mpmd.py::TestParityGate).
+            # f32 executions (the fuller gate lives in
+            # tests/test_train_mpmd.py::TestParityGate); the bf16 wire is
+            # lossy by design, so its column is tracked separately against
+            # the documented loss-curve tolerance (docs/MPMD_TRAINING.md).
             "losses_step1": {
                 "unpipelined": un["losses"][0],
                 "gpipe": gp["losses"][0],
                 "mpmd": mp["losses"][0],
+                "mpmd_interleaved": mp_il["losses"][0],
+                "mpmd_interleaved_bf16": mp_bf["losses"][0],
             },
             "max_rel_diff": float(max(
                 abs(gp["losses"][0] - un["losses"][0]),
                 abs(mp["losses"][0] - un["losses"][0]),
+                abs(mp_il["losses"][0] - un["losses"][0]),
             ) / abs(un["losses"][0])),
+            "bf16_rel_diff": float(
+                abs(mp_bf["losses"][0] - un["losses"][0])
+                / abs(un["losses"][0])
+            ),
         },
         "zero": {
             "opt_bytes_per_replica_zero": zero_bytes,
@@ -311,5 +357,13 @@ if __name__ == "__main__":
     ap.add_argument("--record", action="store_true")
     ap.add_argument("--steps", type=int, default=8)
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument(
+        "--interleave", type=int, default=2, metavar="V",
+        help="virtual-stage chunks per stage for the interleaved rows",
+    )
+    ap.add_argument(
+        "--wire-dtype", default="bf16", choices=("f32", "bf16"),
+        help="activation wire dtype for the compressed-wire row",
+    )
     args = ap.parse_args()
-    run(args.record, args.steps, args.quick)
+    run(args.record, args.steps, args.quick, args.interleave, args.wire_dtype)

@@ -45,6 +45,83 @@ def test_bench_artifact_recorded():
 
 
 @pytest.mark.slow
+def test_bench_artifact_interleaved_and_bf16_rows():
+    """The PR-18 acceptance rows: the interleaved bubble beats both the
+    recorded v=1 row and the pre-interleaving 0.27 baseline, lands within
+    10 points of (S-1)/(v*M+S-1), and the bf16 row ships ~half the
+    activation bytes with its loss inside the documented tolerance."""
+    with open(BENCH_JSON) as f:
+        bench = json.load(f)
+    il = bench["modes"]["mpmd_interleaved"]
+    bf = bench["modes"]["mpmd_interleaved_bf16"]
+    v1 = bench["modes"]["mpmd_zero"]
+    assert bench["interleave"]["num_chunks"] >= 2
+    assert il["bubble_frac_theoretical"] < v1["bubble_frac_theoretical"]
+    assert il["bubble_frac_measured"] < 0.27, "lost to the v=1 baseline"
+    assert il["bubble_frac_measured"] <= v1["bubble_frac_measured"]
+    assert abs(
+        il["bubble_frac_measured"] - il["bubble_frac_theoretical"]
+    ) <= 0.10
+    # The wire codec's byte counters: f32 row identity, bf16 row ~2x cut.
+    assert v1["wire"]["wire_bytes"] == v1["wire"]["raw_bytes"]
+    assert bf["wire"]["raw_bytes"] > 0
+    assert bf["wire"]["wire_bytes"] * 2 == bf["wire"]["raw_bytes"]
+    # Lossy wire, bounded loss drift at step 1 (loss-curve gate proper is
+    # TestParityGate::test_bf16_wire_loss_curve).
+    assert bench["parity"]["bf16_rel_diff"] < 1e-3
+    assert bench["parity"]["max_rel_diff"] < 1e-4  # f32 rows stay exact-ish
+
+
+@pytest.mark.slow
+def test_interleaved_bubble_not_worse_live():
+    """Live re-run of the acceptance comparison on the bench shape: v=2
+    measured bubble <= v=1 (plus a noise floor — single-digit-millisecond
+    ops on a shared vCPU), with parity between the two runs."""
+    import bench_mpmd
+
+    cfg = bench_mpmd.bench_cfg(quick=False)
+    S, dp, M = 2, 2, 4
+    batches = bench_mpmd.make_batches(cfg, 16, 6)
+
+    v1 = bench_mpmd.bench_mpmd(cfg, batches, S, dp, M, zero=True)
+    v2 = bench_mpmd.bench_mpmd(
+        cfg, batches, S, dp, M, num_chunks=2, zero=True
+    )
+    np.testing.assert_allclose(v2["losses"], v1["losses"], rtol=1e-5)
+    assert v2["bubble_frac_theoretical"] < v1["bubble_frac_theoretical"]
+    assert v2["bubble_frac_measured"] <= v1["bubble_frac_measured"] + 0.05, (
+        f"interleaving made the measured bubble WORSE: "
+        f"v2 {v2['bubble_frac_measured']:.3f} vs "
+        f"v1 {v1['bubble_frac_measured']:.3f}"
+    )
+
+
+@pytest.mark.slow
+def test_bf16_wire_halves_transport_bytes():
+    """ActTransport's inline rung through the real codec: bf16 frames ship
+    half the bytes of the same f32 frames, and the restore round-trips
+    within bf16 precision."""
+    from ray_tpu.train.mpmd.transport import ActTransport
+
+    arr = np.random.default_rng(0).standard_normal((64, 128)).astype(np.float32)
+    f32 = ActTransport(inline_max_bytes=1 << 30, timeout_s=10)
+    bf16 = ActTransport(inline_max_bytes=1 << 30, timeout_s=10,
+                        wire_dtype="bf16")
+    for t in (f32, bf16):
+        desc, pin = t.publish(arr)
+        assert pin is None, "inline rung expected (no runtime booted)"
+        got = t.fetch(desc)
+        assert got.dtype == np.float32
+    np.testing.assert_array_equal(f32.fetch(f32.publish(arr)[0]), arr)
+    np.testing.assert_allclose(
+        bf16.fetch(bf16.publish(arr)[0]), arr, rtol=8e-3, atol=1e-6
+    )
+    s32, sbf = f32.all_stats(), bf16.all_stats()
+    assert s32["wire_bytes"] == s32["raw_bytes"]
+    assert sbf["wire_bytes"] * 2 == sbf["raw_bytes"]
+
+
+@pytest.mark.slow
 def test_mpmd_not_slower_than_gpipe_and_zero_bytes_shrink():
     import bench_mpmd
 
