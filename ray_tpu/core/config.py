@@ -213,6 +213,13 @@ define("data_block_transport", True,
            "offsets) and reduce tasks pull only their partition's byte span "
            "over the bulk plane (data/transport.py); off = the classic "
            "per-partition pickled object puts (num_returns=P)")
+define("data_node_strict", False,
+       doc="Block-transport locality decided by logical NODE ID instead of "
+           "host IP: on a one-box multi-node cluster (cluster_utils, "
+           "bench_data --nodes N) every node shares the IPs and /dev/shm, "
+           "so without this flag the 'cross-node' TCP span path never "
+           "engages; strict mode makes such clusters behave like real "
+           "multi-machine ones (see data/transport.py node_strict)")
 # Two-level scheduling (reference: ClusterTaskManager/LocalTaskManager split).
 define("local_dispatch", True,
        doc="Hand queued plain tasks to node agents' LocalDispatchers; the "

@@ -2528,7 +2528,16 @@ class Controller:
             pinned = [n for n in alive_sorted if n.node_id == strat.node_id]
             if not strat.soft:
                 return pinned
-            return pinned + [n for n in alive_sorted if n.node_id != strat.node_id]
+            # Soft-affinity spill follows the HYBRID order, not node-id
+            # order: the data plane's locality scorer pins reduce/consumer
+            # tasks softly to the node holding their source bytes — when
+            # that node is full/dead the task should degrade to the same
+            # pack-then-least-utilized policy as default scheduling instead
+            # of piling onto whatever node sorts first.
+            return pinned + [
+                n for n in self._hybrid_order(alive_sorted, cache)
+                if n.node_id != strat.node_id
+            ]
         if isinstance(strat, NodeLabelSchedulingStrategy):
             # Hard label constraints: only matching nodes are candidates
             # (reference: `NodeLabelSchedulingPolicy`).
@@ -2539,12 +2548,27 @@ class Controller:
         if isinstance(strat, SpreadSchedulingStrategy):
             # True round-robin: each spread decision starts one node further
             # along, so consecutive tasks land on distinct nodes (reference:
-            # `SpreadSchedulingPolicy` round-robins over feasible nodes).
+            # `SpreadSchedulingPolicy` round-robins over FEASIBLE nodes).
+            # Nodes that can never hold the demand (a 0-CPU head) are left
+            # out of the rotation — rotating onto one silently re-packs its
+            # share onto whichever node sorts next, skewing the spread.
+            feasible = [
+                n for n in alive_sorted
+                if all(n.total.get(k, 0.0) >= v
+                       for k, v in spec.resources.items())
+            ] or alive_sorted
             self._spread_rr += 1
-            r = self._spread_rr % len(alive_sorted) if alive_sorted else 0
-            return alive_sorted[r:] + alive_sorted[:r]
+            r = self._spread_rr % len(feasible) if feasible else 0
+            return feasible[r:] + feasible[:r]
         # Hybrid default: pack in node-id order while below the utilization
         # threshold, then least-utilized.
+        return self._hybrid_order(alive_sorted, cache)
+
+    def _hybrid_order(
+        self, alive_sorted: List[NodeState], cache: Optional[dict]
+    ) -> List[NodeState]:
+        """Pack-until-threshold then least-utilized (reference:
+        `hybrid_scheduling_policy.h:50`), cached once per schedule pass."""
         if cache is not None and "hybrid" in cache:
             return cache["hybrid"]
         packable = [n for n in alive_sorted if n.utilization() < 0.8]

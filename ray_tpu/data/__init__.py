@@ -27,6 +27,7 @@ from .preprocessor import (
     StandardScaler,
 )
 from .datasource import _warm_pyarrow as _warm_pyarrow_now
+from .streaming import PullExecutor, StreamingIngest
 from .read_api import (
     from_arrow,
     from_arrow_refs,
@@ -76,6 +77,8 @@ __all__ = [
     "Max",
     "Mean",
     "Std",
+    "PullExecutor",
+    "StreamingIngest",
     "Preprocessor",
     "StandardScaler",
     "MinMaxScaler",

@@ -293,14 +293,13 @@ class Dataset:
         return ds._write(path, "npy")
 
     def write_datasink(self, sink):
-        from ..core.api import get as ray_get
-
         sink.on_write_start()
         results = []
         for i, bundle in enumerate(self._stream()):
-            blocks = ray_get(bundle.blocks_ref)
+            blocks = bundle.blocks()  # descriptor-aware (streaming plane)
             for j, block in enumerate(blocks):
                 results.append(sink.write(block, {"task_idx": i, "block_idx": j}))
+            bundle.release()
         sink.on_write_complete(results)
         return results
 
@@ -364,10 +363,7 @@ class _RowWindow:
             if s == lo and e == hi:
                 out.append(bundle)
             else:
-                from ..core.api import get as ray_get
-
-                blocks = ray_get(bundle.blocks_ref)
-                merged = concat_blocks(blocks)
+                merged = concat_blocks(bundle.blocks())
                 piece = BlockAccessor(merged).slice(s - lo, e - lo)
                 meta = _meta_of([piece])
                 out.append(RefBundle(ray_put([piece]), meta["num_rows"], meta["size_bytes"]))

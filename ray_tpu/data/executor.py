@@ -39,14 +39,48 @@ from .plan import (
 
 
 class RefBundle:
-    """A task's output: ref to a list of blocks + row/byte metadata."""
+    """A task's output: ref to a list of blocks + row/byte metadata.
 
-    __slots__ = ("blocks_ref", "num_rows", "size_bytes")
+    In the streaming plane (data/streaming/) a map/read output is an
+    arena-segment frame and ``blocks_ref`` points at its span DESCRIPTOR
+    (transport.put_bundle); the resolved descriptor rides along in ``desc``
+    so driver-side consumers skip the extra get. ``release()`` tells the
+    producing op's stats the consumer is done with the blocks — that is the
+    measurement behind the bounded-residency proof, never a correctness
+    requirement (windows refill on pull, not on release)."""
 
-    def __init__(self, blocks_ref, num_rows: int, size_bytes: int):
+    __slots__ = ("blocks_ref", "num_rows", "size_bytes", "desc", "_on_release")
+
+    def __init__(self, blocks_ref, num_rows: int, size_bytes: int,
+                 desc: Optional[dict] = None, on_release=None):
         self.blocks_ref = blocks_ref
         self.num_rows = num_rows
         self.size_bytes = size_bytes
+        self.desc = desc
+        self._on_release = on_release
+
+    def blocks(self) -> List[Block]:
+        """Materialize this bundle's blocks on the calling process, through
+        the transport rung ladder when the bundle is descriptor-backed."""
+        if self.desc is not None:
+            return transport.fetch_bundle(self.desc)
+        return transport.resolve_blocks(ray_get(self.blocks_ref))
+
+    def release(self) -> None:
+        cb, self._on_release = self._on_release, None
+        if cb is not None:
+            cb(self)
+
+    def __getstate__(self):
+        # The release hook is a driver-side residency-measurement callback
+        # closing over lock-guarded StreamStats — unpicklable and
+        # meaningless in another process (train shards ship cached bundles
+        # to gang workers via cloudpickle).
+        return (self.blocks_ref, self.num_rows, self.size_bytes, self.desc)
+
+    def __setstate__(self, state):
+        self.blocks_ref, self.num_rows, self.size_bytes, self.desc = state
+        self._on_release = None
 
 
 # --------------------------------------------------------- remote kernels
@@ -66,8 +100,37 @@ def _exec_read_chain(payload: bytes):
 
 def _exec_chain(payload: bytes, blocks: List[Block]):
     chain = cloudpickle.loads(payload)
-    out = apply_chain(chain, blocks)
+    out = apply_chain(chain, transport.resolve_blocks(blocks))
     return out, _meta_of(out)
+
+
+def _fetch_delta(f: dict) -> dict:
+    """Nonzero rung counters only — small enough to ride in task metadata."""
+    return {k: v for k, v in f.items() if v}
+
+
+def _exec_read_chain_segment(payload: bytes):
+    """ONE-TO-ONE streaming form of _exec_read_chain: the output blocks land
+    as a single arena-segment frame; the return value is only the small span
+    descriptor (transport.put_bundle) — rows/bytes ride inside it, so the
+    task has ONE return and the driver's window resolves one ref per bundle."""
+    read_task, chain = cloudpickle.loads(payload)
+    blocks = apply_chain(chain, list(read_task()))
+    return transport.put_bundle(blocks)
+
+
+def _exec_chain_segment(payload: bytes, blocks):
+    """ONE-TO-ONE streaming map: input may itself be a bundle descriptor
+    (resolved through the rung ladder — same-node zero-copy or a bulk span
+    pull), output lands as a fresh segment. The rung delta of the input
+    fetch travels back in the descriptor so driver-side stream stats see
+    worker-side fetch behavior."""
+    with transport.track_fetch() as f:
+        blocks = transport.resolve_blocks(blocks)
+    out = apply_chain(cloudpickle.loads(payload), blocks)
+    desc = transport.put_bundle(out)
+    desc["fetch"] = _fetch_delta(f)
+    return desc
 
 
 def _build_partitions(payload: bytes, blocks: List[Block]) -> List[List[Block]]:
@@ -76,7 +139,7 @@ def _build_partitions(payload: bytes, blocks: List[Block]) -> List[List[Block]]:
     per-partition puts and the block transport) shape THIS result."""
     part_fn, num_parts = cloudpickle.loads(payload)
     parts: List[List[Block]] = [[] for _ in range(num_parts)]
-    block = concat_blocks(blocks)
+    block = concat_blocks(transport.resolve_blocks(blocks))
     for idx, piece in part_fn(block):
         if BlockAccessor(piece).num_rows() > 0:
             parts[idx].append(piece)
@@ -112,21 +175,30 @@ def _partition_map_segment(payload: bytes, blocks: List[Block]):
     """Map side of an exchange over the BLOCK TRANSPORT: all P partitions
     land as one flat arena segment; the return value is only the small span
     descriptor (transport.put_partitions)."""
-    return transport.put_partitions(_build_partitions(payload, blocks))
+    with transport.track_fetch() as f:
+        parts = _build_partitions(payload, blocks)
+    desc = transport.put_partitions(parts)
+    desc["fetch"] = _fetch_delta(f)
+    return desc
 
 
 def _exchange_reduce_segments(payload: bytes, j: int, *descs):
     """Reduce side over the block transport: fetch ONLY partition j's span
     from each map segment (cross-machine: a (name, offset, length) bulk-plane
-    read; same host: zero-copy borrow), then post-process as usual."""
+    read; same host: zero-copy borrow), then post-process as usual. The
+    fetch's rung delta ships in the metadata — the driver's run stats can
+    then assert reduce-side traffic took the rungs it should have."""
     blocks: List[Block] = []
-    for part in transport.fetch_partitions(list(descs), j):
-        blocks.extend(part)
-    return _reduce_post(payload, blocks)
+    with transport.track_fetch() as f:
+        for part in transport.fetch_partitions(list(descs), j):
+            blocks.extend(part)
+    out_blocks, meta = _reduce_post(payload, blocks)
+    meta["fetch"] = _fetch_delta(f)
+    return out_blocks, meta
 
 
 def _sample_rows(blocks: List[Block], key, k: int):
-    block = concat_blocks(blocks)
+    block = concat_blocks(transport.resolve_blocks(blocks))
     acc = BlockAccessor(block)
     n = acc.num_rows()
     if n == 0:
@@ -137,7 +209,8 @@ def _sample_rows(blocks: List[Block], key, k: int):
 
 
 def _zip_blocks(left: List[Block], right: List[Block]):
-    lb, rb = concat_blocks(left), concat_blocks(right)
+    lb = concat_blocks(transport.resolve_blocks(left))
+    rb = concat_blocks(transport.resolve_blocks(right))
     if BlockAccessor(lb).num_rows() != BlockAccessor(rb).num_rows():
         raise ValueError("zip requires datasets with identical row counts")
     out = dict(lb)
@@ -153,6 +226,21 @@ def _remote(fn: Callable, num_returns: int = 1) -> RemoteFunction:
     return RemoteFunction(fn, TaskOptions(num_cpus=1.0, num_returns=num_returns))
 
 
+def read_payloads(ctx: DataContext, src: ReadOp, chain) -> List[bytes]:
+    """Task payloads for a read segment (ReadTask + fused chain each) —
+    shared by both executors so parallelism estimation cannot drift."""
+    parallelism = src.parallelism
+    if parallelism is None or parallelism < 0:
+        est = src.datasource.estimate_inmemory_data_size()
+        if est:
+            parallelism = max(ctx.read_op_min_num_blocks,
+                              est // ctx.target_max_block_size)
+        else:
+            parallelism = ctx.read_op_min_num_blocks
+    read_tasks = src.datasource.get_read_tasks(int(parallelism))
+    return [cloudpickle.dumps((rt, chain)) for rt in read_tasks]
+
+
 # ------------------------------------------------------------- the executor
 class StreamingExecutor:
     def __init__(self, ctx: Optional[DataContext] = None):
@@ -160,7 +248,20 @@ class StreamingExecutor:
 
     # ------------------------------------------------------------ streaming
     def execute(self, plan: LogicalPlan) -> Iterator[RefBundle]:
-        """Yield output bundles, streaming wherever the plan allows."""
+        """Yield output bundles, streaming wherever the plan allows.
+
+        Default route is the bounded-window PULL plane (data/streaming/):
+        per-operator in-flight windows, segment-framed ONE-TO-ONE outputs,
+        locality-placed reduces. `ctx.streaming_pull=False` keeps the legacy
+        stage-barrier path below (A/B baseline; also what zip/union still
+        use internally)."""
+        if self._ctx.streaming_pull:
+            from .streaming.executor import PullExecutor
+
+            return PullExecutor(self._ctx).execute(plan)
+        return self.execute_staged(plan)
+
+    def execute_staged(self, plan: LogicalPlan) -> Iterator[RefBundle]:
         segments = plan.segments()
         stream: Iterator[RefBundle] = iter(())
         for i, (src, chain) in enumerate(segments):
@@ -187,16 +288,7 @@ class StreamingExecutor:
         return None
 
     def _run_read_segment(self, src: ReadOp, chain) -> Iterator[RefBundle]:
-        ctx = self._ctx
-        parallelism = src.parallelism
-        if parallelism is None or parallelism < 0:
-            est = src.datasource.estimate_inmemory_data_size()
-            if est:
-                parallelism = max(ctx.read_op_min_num_blocks, est // ctx.target_max_block_size)
-            else:
-                parallelism = ctx.read_op_min_num_blocks
-        read_tasks = src.datasource.get_read_tasks(int(parallelism))
-        payloads = [cloudpickle.dumps((rt, chain)) for rt in read_tasks]
+        payloads = read_payloads(self._ctx, src, chain)
         fn = _remote(_exec_read_chain, num_returns=2)
         yield from self._stream_tasks(
             (lambda p=p: fn.remote(p)) for p in payloads
@@ -227,14 +319,59 @@ class StreamingExecutor:
             return self._exchange_zip(op, bundles)
         if not bundles:
             return []
-        if kind == "repartition":
-            return self._exchange_repartition(op, bundles)
-        if kind == "random_shuffle":
-            return self._exchange_random_shuffle(op, bundles)
+        spec = self.exchange_spec(op, bundles)
+        if spec is None:
+            return bundles  # degenerate exchange (e.g. sort of all-empty)
+        part_fns, num_parts, post_fn, reverse = spec
+        out = self._map_reduce(bundles, part_fns, num_parts, post_fn)
+        return out[::-1] if reverse else out
+
+    def exchange_spec(
+        self, op: AllToAllOp, bundles: List[RefBundle]
+    ) -> Optional[Tuple[List[Callable], int, Callable, bool]]:
+        """(per-input partition fns, partition count, reduce post fn, reverse
+        output order) for the map/reduce exchange kinds — the ONE definition
+        both wire paths and both executors (staged barrier here, streaming
+        pull in data/streaming/) shape their exchanges from. None means the
+        exchange degenerates to a passthrough. zip/union are not map/reduce
+        shaped and stay in _run_exchange."""
+        kind = op.kind
+        if kind == "repartition" and not op.shuffle:
+            n = op.num_outputs
+            total = sum(b.num_rows for b in bundles)
+            bounds = [round(total * (i + 1) / n) for i in range(n)]
+            part_fns, offset = [], 0
+            for b in bundles:
+                part_fns.append(_EvenPartition(offset, offset + b.num_rows, bounds))
+                offset += b.num_rows
+            return part_fns, n, _identity_post, False
+        if kind == "random_shuffle" or (kind == "repartition" and op.shuffle):
+            n = op.num_outputs or len(bundles)
+            seed = op.seed
+            part_fns = [
+                _RandomPartition(n, None if seed is None else seed + i)
+                for i in range(len(bundles))
+            ]
+            return part_fns, n, _ShufflePost(seed), False
         if kind == "sort":
-            return self._exchange_sort(op, bundles)
+            key, desc = op.key, op.descending
+            n = len(bundles)
+            sample_fn = _remote(_sample_rows)
+            samples = ray_get(
+                [sample_fn.remote(b.blocks_ref, key, 16) for b in bundles]
+            )
+            allsamp = np.sort(np.concatenate([s for s in samples if len(s)]))
+            if len(allsamp) == 0:
+                return None
+            qs = np.linspace(0, len(allsamp) - 1, n + 1).astype(np.int64)[1:-1]
+            boundaries = allsamp[qs]
+            part_fns = [_RangePartition(key, boundaries) for _ in bundles]
+            return part_fns, n, _SortPost(key, desc), bool(desc)
         if kind == "groupby":
-            return self._exchange_groupby(op, bundles)
+            key, aggs = op.key, op.aggs
+            n = min(len(bundles), max(1, self._ctx.max_in_flight_tasks))
+            part_fns = [_HashPartition(key, n) for _ in bundles]
+            return part_fns, n, _GroupByPost(key, aggs), False
         raise ValueError(f"Unknown all-to-all kind {kind}")
 
     def _map_reduce(
@@ -286,47 +423,6 @@ class StreamingExecutor:
             RefBundle(blocks_ref, meta["num_rows"], meta["size_bytes"])
             for (blocks_ref, _), meta in zip(out, metas)
         ]
-
-    def _exchange_repartition(self, op, bundles) -> List[RefBundle]:
-        n = op.num_outputs
-        if op.shuffle:
-            return self._exchange_random_shuffle(
-                AllToAllOp(kind="random_shuffle", num_outputs=n, seed=op.seed), bundles
-            )
-        total = sum(b.num_rows for b in bundles)
-        bounds = [round(total * (i + 1) / n) for i in range(n)]
-        part_fns, offset = [], 0
-        for b in bundles:
-            lo, hi = offset, offset + b.num_rows
-            offset = hi
-            part_fns.append(_EvenPartition(lo, hi, bounds))
-        return self._map_reduce(bundles, part_fns, n, _identity_post)
-
-    def _exchange_random_shuffle(self, op, bundles) -> List[RefBundle]:
-        n = op.num_outputs or len(bundles)
-        seed = op.seed
-        part_fns = [_RandomPartition(n, None if seed is None else seed + i) for i, _ in enumerate(bundles)]
-        return self._map_reduce(bundles, part_fns, n, _ShufflePost(seed))
-
-    def _exchange_sort(self, op, bundles) -> List[RefBundle]:
-        key, desc = op.key, op.descending
-        n = len(bundles)
-        sample_fn = _remote(_sample_rows)
-        samples = ray_get([sample_fn.remote(b.blocks_ref, key, 16) for b in bundles])
-        allsamp = np.sort(np.concatenate([s for s in samples if len(s)]))
-        if len(allsamp) == 0:
-            return bundles
-        qs = np.linspace(0, len(allsamp) - 1, n + 1).astype(np.int64)[1:-1]
-        boundaries = allsamp[qs]
-        part_fns = [_RangePartition(key, boundaries) for _ in bundles]
-        out = self._map_reduce(bundles, part_fns, n, _SortPost(key, desc))
-        return out[::-1] if desc else out
-
-    def _exchange_groupby(self, op, bundles) -> List[RefBundle]:
-        key, aggs = op.key, op.aggs
-        n = min(len(bundles), max(1, self._ctx.max_in_flight_tasks))
-        part_fns = [_HashPartition(key, n) for _ in bundles]
-        return self._map_reduce(bundles, part_fns, n, _GroupByPost(key, aggs))
 
     def _exchange_zip(self, op, bundles) -> List[RefBundle]:
         right = self.execute_to_bundles(op.other_plans[0])

@@ -27,12 +27,16 @@ class DataIterator:
 
     # ------------------------------------------------------------- blocks
     def _iter_blocks(self) -> Iterator[Block]:
-        from ..core.api import get as ray_get
-
         for bundle in self._source():
-            for block in ray_get(bundle.blocks_ref):
+            # Streaming-plane bundles are descriptor-backed (blocks() walks
+            # the transport rung ladder); legacy bundles resolve with a
+            # plain get. release() marks the blocks consumer-done so the
+            # run's residency accounting sees the hand-off.
+            blocks = bundle.blocks()
+            for block in blocks:
                 if BlockAccessor(block).num_rows() > 0:
                     yield block
+            bundle.release()
 
     def iter_rows(self) -> Iterator[Any]:
         for block in self._iter_blocks():

@@ -35,8 +35,11 @@ Fallbacks (always correctness-preserving, see data/README.md):
 
 from __future__ import annotations
 
+import contextlib
+import os
 import pickle
 import socket
+import threading
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -56,6 +59,87 @@ def transport_enabled() -> bool:
     pickled-put path remains selectable for A/B measurement —
     `scripts/bench_data.py` records both)."""
     return bool(rt_config.get("data_block_transport"))
+
+
+def node_strict() -> bool:
+    """Cross-node reads decided by NODE ID instead of host IP. On a real
+    multi-machine cluster the two agree; on a one-box multi-node cluster
+    (`cluster_utils.Cluster`, `bench_data --nodes N`) every node shares the
+    host IPs AND /dev/shm, so the opportunistic local-arena read would
+    silently serve "cross-node" segments zero-copy and the TCP bulk path
+    would never be measured. Strict mode makes the one-box cluster behave
+    byte-for-byte like a real multi-machine one: only segments produced on
+    THIS logical node read locally, everything else rides span pulls."""
+    return bool(rt_config.get("data_node_strict"))
+
+
+def local_node_id() -> str:
+    """This process's logical node id (worker env / backend registration)."""
+    return os.environ.get("RAY_TPU_NODE_ID", "node0")
+
+
+# ------------------------------------------------------------- fetch rungs
+# Per-rung fetch accounting: every descriptor consumption lands on exactly
+# one rung, so "no silent fallback to whole-object gets" is ASSERTABLE
+# (tests/test_data_transport.py) instead of trusted. Counters are process
+# global; `track_fetch()` additionally captures a thread-scoped delta so a
+# reduce/consumer task can ship ITS rung counts back in task metadata
+# (`_exchange_reduce_segments` → meta["fetch"] → StreamStats).
+FETCH_RUNGS = ("inline", "local", "span", "get", "empty")
+_STATS_LOCK = threading.Lock()
+
+
+def _zero_stats() -> Dict[str, int]:
+    d = {r: 0 for r in FETCH_RUNGS}
+    d.update(local_bytes=0, span_bytes=0, get_bytes=0, cross_node_bytes=0)
+    return d
+
+
+_FETCH_STATS = _zero_stats()
+_TRACK = threading.local()
+
+
+def _count(rung: str, n: int = 1, **bytes_kw: int) -> None:
+    with _STATS_LOCK:
+        sinks = [_FETCH_STATS] + list(getattr(_TRACK, "stack", ()))
+        for d in sinks:
+            d[rung] = d.get(rung, 0) + n
+            for k, v in bytes_kw.items():
+                d[k] = d.get(k, 0) + v
+
+
+def fetch_stats() -> Dict[str, int]:
+    """Process-global rung counters (copy)."""
+    with _STATS_LOCK:
+        return dict(_FETCH_STATS)
+
+
+def reset_fetch_stats() -> None:
+    with _STATS_LOCK:
+        _FETCH_STATS.clear()
+        _FETCH_STATS.update(_zero_stats())
+
+
+@contextlib.contextmanager
+def track_fetch():
+    """Capture the rung counts of every fetch on THIS thread inside the
+    body (nested trackers both see them). Yields the mutating dict."""
+    d = _zero_stats()
+    stack = getattr(_TRACK, "stack", None)
+    if stack is None:
+        stack = _TRACK.stack = []
+    stack.append(d)
+    try:
+        yield d
+    finally:
+        stack.remove(d)
+
+
+def merge_fetch_stats(into: Dict[str, int], delta: Optional[Dict[str, int]]) -> None:
+    """Accumulate one task's rung delta into an aggregate dict."""
+    for k, v in (delta or {}).items():
+        if isinstance(v, (int, float)):
+            into[k] = into.get(k, 0) + v
 
 
 # ------------------------------------------------------------ serialization
@@ -129,7 +213,8 @@ def put_partitions(parts: List[List[Block]]) -> Dict[str, Any]:
     put_serialized = getattr(backend, "put_serialized", None)
     if put_serialized is None or getattr(backend, "remote_client", False):
         return {"v": DESCRIPTOR_VERSION, "ref": ray_put(parts),
-                "rows": rows, "bytes": sizes, "spans": None}
+                "rows": rows, "bytes": sizes, "spans": None,
+                "node": local_node_id()}
 
     wrapped: List[Any] = []
     part_cols: List[Optional[List[np.ndarray]]] = []  # pickle-order columns
@@ -196,7 +281,42 @@ def put_partitions(parts: List[List[Block]]) -> Dict[str, Any]:
     if not span_ok:
         spans = None  # inline frame: span-addressed reads are impossible
     return {"v": DESCRIPTOR_VERSION, "ref": ref, "name": name, "rows": rows,
-            "bytes": sizes, "spans": spans}
+            "bytes": sizes, "spans": spans, "node": local_node_id(),
+            "inline": not span_ok}
+
+
+# ------------------------------------------------------- ONE-TO-ONE bundles
+# Map/read outputs in the streaming plane are single-partition segments: the
+# task returns `put_bundle(blocks)`'s descriptor instead of the block list,
+# and whoever consumes the bundle (a chained map task, a reduce task's
+# partitioner, the driver-side iterator) resolves it through the SAME rung
+# ladder the exchange uses. `resolve_blocks` is the universal kernel-entry
+# shim: block lists pass through untouched, so every kernel handles both
+# transports with one line.
+_BUNDLE_KEY = "b1"
+
+
+def put_bundle(blocks: List[Block]) -> Dict[str, Any]:
+    """Pack ONE output's blocks as a single-partition segment descriptor."""
+    desc = put_partitions([blocks])
+    desc[_BUNDLE_KEY] = True
+    return desc
+
+
+def is_descriptor(x: Any) -> bool:
+    return isinstance(x, dict) and x.get(_BUNDLE_KEY) is True and "ref" in x
+
+
+def fetch_bundle(desc: Dict[str, Any]) -> List[Block]:
+    """Materialize a ONE-TO-ONE bundle descriptor's blocks (rung-counted)."""
+    return fetch_partition(desc, 0)
+
+
+def resolve_blocks(x: Any) -> List[Block]:
+    """Kernel-entry shim: descriptor → fetched blocks, block list → itself."""
+    if is_descriptor(x):
+        return fetch_bundle(x)
+    return x
 
 
 # ------------------------------------------------------------------ consumer
@@ -209,6 +329,10 @@ def _try_local_read(desc: Dict[str, Any]):
     readable here (other node, evicted, spilled — callers fall back)."""
     name = desc.get("name")
     if not name:
+        return None
+    if node_strict() and desc.get("node") not in (None, local_node_id()):
+        # One-box multi-node: the name WOULD resolve in /dev/shm, but on a
+        # real cluster this segment lives on another machine. Refuse.
         return None
     backend = api._global_runtime().backend
     local_store = getattr(backend, "local_store", None)
@@ -259,10 +383,12 @@ def fetch_partitions(descs: List[Dict[str, Any]], j: int) -> List[List[Block]]:
         spans = desc.get("spans")
         if spans is not None and spans[j] is not None and not spans[j]["blocks"]:
             out[i] = []  # empty partition: nothing to fetch at all
+            _count("empty")
             continue
         parts = _try_local_read(desc)
         if parts is not None:
             out[i] = parts[j]  # same-node segment: zero-copy, zero RPCs
+            _count("local", local_bytes=int(desc["bytes"][j]))
             continue
         if spans is None or spans[j] is None:
             continue  # resolved via the batched get below
@@ -272,19 +398,33 @@ def fetch_partitions(descs: List[Dict[str, Any]], j: int) -> List[List[Block]]:
     sources_of = getattr(backend, "object_sources", None)
     remote: List[int] = []
     srcs: Dict[int, dict] = {}
+    same_host: set = set()
     if spannable and sources_of is not None:
         resolved = sources_of([descs[i]["ref"].id.hex() for i in spannable])
         local_addrs = bulk_mod._local_addrs()
+        strict = node_strict()
+        here = local_node_id()
         for i, src in zip(spannable, resolved):
-            if src and src["bulk"].rsplit(":", 1)[0] not in local_addrs:
+            if not src:
+                continue  # unresolvable — batched get below
+            if strict:
+                # Node identity, not host IP: on a one-box cluster every
+                # node shares the IPs, so this is what keeps "cross-node"
+                # honest (segments from other logical nodes ride TCP spans).
+                cross = src.get("node") not in (None, here)
+            else:
+                cross = src["bulk"].rsplit(":", 1)[0] not in local_addrs
+            if cross:
                 remote.append(i)
                 srcs[i] = src
-            # else: same host (borrow/map handover beats a TCP span copy) or
-            # unresolvable — both take the batched get below.
+            else:
+                # Same host (borrow/map handover beats a TCP span copy) —
+                # materializes via the batched get below but rung-wise it IS
+                # the same-node zero-copy path.
+                same_host.add(i)
 
     if remote:
         tmo = rt_config.get("transfer_chunk_timeout_s")
-
         def pull(i: int):
             span = descs[i]["spans"][j]
             try:
@@ -295,6 +435,8 @@ def fetch_partitions(descs: List[Dict[str, Any]], j: int) -> List[List[Block]]:
                 # still knows other copies (or re-executes lineage) — the
                 # plain get path below absorbs all of that.
                 return None
+            _count("span", span_bytes=span["len"],
+                   cross_node_bytes=span["len"])
             return _rebuild_from_span(span, buf)
 
         if len(remote) == 1:
@@ -302,11 +444,20 @@ def fetch_partitions(descs: List[Dict[str, Any]], j: int) -> List[List[Block]]:
         else:
             from concurrent.futures import ThreadPoolExecutor
 
+            # The rung tracker stack is thread-local: graft the CALLER's
+            # stack onto each (fresh, per-call) pool thread, or concurrent
+            # span pulls vanish from the task's shipped fetch delta.
+            caller_stack = list(getattr(_TRACK, "stack", ()))
+
+            def pull_tracked(i: int):
+                _TRACK.stack = caller_stack
+                return pull(i)
+
             with ThreadPoolExecutor(
                 max_workers=min(4, len(remote)),
                 thread_name_prefix="rtpu-span-fetch",
             ) as ex:
-                results = list(ex.map(pull, remote))
+                results = list(ex.map(pull_tracked, remote))
         for i, res in zip(remote, results):
             out[i] = res
 
@@ -317,4 +468,11 @@ def fetch_partitions(descs: List[Dict[str, Any]], j: int) -> List[List[Block]]:
         values = ray_get([descs[i]["ref"] for i in pending])
         for i, parts in zip(pending, values):
             out[i] = parts[j]
+            nbytes = int(descs[i]["bytes"][j])
+            if descs[i].get("inline"):
+                _count("inline")  # rode the inline plane; no arena segment
+            elif i in same_host:
+                _count("local", local_bytes=nbytes)  # zero-copy borrow/map
+            else:
+                _count("get", get_bytes=nbytes)
     return out  # type: ignore[return-value]

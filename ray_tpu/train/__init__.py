@@ -26,6 +26,7 @@ from .session import (
     get_context,
     get_dataset_shard,
     get_elastic_session,
+    get_streaming_ingest,
     report,
 )
 from .result import Result
@@ -44,6 +45,7 @@ __all__ = [
     "get_checkpoint",
     "get_dataset_shard",
     "get_elastic_session",
+    "get_streaming_ingest",
     "elastic",
     "Checkpoint",
     "Result",

@@ -140,6 +140,22 @@ def get_dataset_shard(name: str = "train"):
     return s.context.dataset_shards.get(name)
 
 
+def get_streaming_ingest(name: str = "train", *, batch_size: int = 256,
+                         **kwargs):
+    """This rank's dataset shard wrapped in a `StreamingIngest` — a bounded
+    per-rank prefetch queue over the streaming pull plane, so epoch N+1's
+    shard/preprocess/shuffle overlaps epoch N's steps (backpressure parks
+    the producer when the trainer falls behind; docs/STREAMING_DATA.md).
+    Callers own shutdown(): use ``with session.get_streaming_ingest(...)``
+    around the step loop. None when the rank has no such shard."""
+    shard = get_dataset_shard(name)
+    if shard is None:
+        return None
+    from ..data.streaming import StreamingIngest
+
+    return StreamingIngest(shard, batch_size, **kwargs)
+
+
 def get_elastic_session():
     """The worker's ElasticSession (created on first use) — async sharded
     checkpointing + deterministic resume. See ray_tpu.train.elastic."""

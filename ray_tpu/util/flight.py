@@ -474,6 +474,70 @@ def pipeline_report(events: List[dict]) -> Optional[dict]:
     }
 
 
+# Data-plane span vocabulary (data/streaming/ records these on lanes
+# ``data/op{i}`` and ``data/ingest``):
+#   data.wait         — an operator's pull blocked resolving its head task
+#                       (upstream or compute starvation)
+#   data.drain        — an exchange's input barrier (partitioner needed
+#                       global statistics before the map phase)
+#   data.backpressure — the ingest producer parked on a full prefetch
+#                       queue (the TRAINER is the bottleneck)
+#   data.starve       — the trainer waited on an empty prefetch queue
+#                       (the PIPELINE is the bottleneck)
+#   data.bundle       — one bundle yielded (zero-dur marker; rows/bytes)
+_DATA_STALLS = ("data.wait", "data.drain", "data.backpressure", "data.starve")
+
+
+def ingest_report(events: List[dict]) -> Optional[dict]:
+    """Attribute where a streaming data pipeline blocks, from flight spans
+    on the ``data/*`` lanes — pipeline_report's role for the ingest plane.
+
+    Per lane: stall seconds by kind plus bundle/row/byte throughput. The
+    ``bottleneck`` is the (lane, kind) pair with the most stall time —
+    ``data.backpressure`` on ``data/ingest`` reads as "the trainer is
+    slower than the pipeline" (healthy overlap), while ``data.wait`` on an
+    operator lane names the op whose upstream can't keep up. Returns None
+    when no data spans are present."""
+    lanes: Dict[str, dict] = {}
+    t0 = t1 = None
+    for ev in events:
+        if ev.get("event") != "span":
+            continue
+        name = ev.get("name", "")
+        if not name.startswith("data."):
+            continue
+        args = ev.get("args") or {}
+        lane = str(args.get("lane", "?"))
+        if not lane.startswith("data/"):
+            continue
+        d = lanes.setdefault(lane, {
+            "stalls_s": {}, "bundles": 0, "rows": 0, "bytes": 0})
+        dur = ev.get("dur", 0.0)
+        ts = ev.get("ts", 0.0)
+        t0 = ts if t0 is None else min(t0, ts)
+        t1 = ts + dur if t1 is None else max(t1, ts + dur)
+        if name in _DATA_STALLS:
+            d["stalls_s"][name] = d["stalls_s"].get(name, 0.0) + dur
+        elif name == "data.bundle":
+            d["bundles"] += 1
+            d["rows"] += int(args.get("rows", 0))
+            d["bytes"] += int(args.get("bytes", 0))
+    if not lanes:
+        return None
+    bottleneck = None
+    worst = 0.0
+    for lane, d in lanes.items():
+        for kind, s in d["stalls_s"].items():
+            if s > worst:
+                worst = s
+                bottleneck = {"lane": lane, "kind": kind, "stall_s": s}
+    return {
+        "window_s": max((t1 or 0.0) - (t0 or 0.0), 0.0),
+        "lanes": {k: lanes[k] for k in sorted(lanes)},
+        "bottleneck": bottleneck,
+    }
+
+
 def flight_payload(events: List[dict], trace_id: Optional[str] = None) -> dict:
     """ONE shared export for every flight surface (``ray-tpu flight``,
     ``GET /api/flight``) — both emit exactly this, so they cannot
@@ -490,5 +554,6 @@ def flight_payload(events: List[dict], trace_id: Optional[str] = None) -> dict:
         "dropped": dropped,
         "lanes": dict(sorted(lanes.items())),
         "pipeline": pipeline_report(events),
+        "ingest": ingest_report(events),
         "trace_events": merged_chrome_trace(events, trace_id),
     }

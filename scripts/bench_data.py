@@ -15,6 +15,21 @@ mode in its OWN process because workers cache config at first read.
 
     python scripts/bench_data.py --record BENCH_DATA_r01.json   # both modes
     python scripts/bench_data.py --transport on                 # one mode
+
+Multi-node mode (`--nodes N`, N >= 2): boots a `cluster_utils.Cluster` of N
+node-agent processes (+ a 0-CPU head) with `RAY_TPU_DATA_NODE_STRICT=1`, so
+segment reads are decided by LOGICAL node id and cross-node traffic really
+rides the TCP bulk-span plane even though every "node" shares this box.
+It records a multi-epoch TRAINING LOOP (read -> preprocess -> shuffle ->
+per-batch simulated train step) through the streaming pull plane against
+the staged path: staged pays produce-then-train serially every epoch,
+streaming feeds the loop through `StreamingIngest` so epoch N+1's
+production overlaps epoch N's training. Locality placement is measured on
+vs off, and the run's fetch-rung ledger rides along — reduce-side bytes
+fetched must ≈ bytes consumed per epoch (span pulls move partition bytes,
+never whole segments):
+
+    python scripts/bench_data.py --nodes 2 --record BENCH_DATA_r02.json
 """
 
 from __future__ import annotations
@@ -151,6 +166,165 @@ def run_mode(transport: str, shards: int, rows: int, seq: int,
     }
 
 
+# ----------------------------------------------------------- multi-node mode
+def run_e2e_stream(paths: list, batch_rows: int, streaming: bool,
+                   locality: bool, epochs: int, train_s: float,
+                   prefetch: int) -> dict:
+    """One end-to-end TRAINING-LOOP pass: ``epochs`` epochs of shard read →
+    preprocess → shuffle → per-batch train step (``train_s`` of simulated
+    accelerator time — the host thread waits on the device, it does not
+    compute). Staged pays produce-then-train serially every epoch; the
+    streaming row feeds the same loop through ``StreamingIngest``, whose
+    producer thread re-executes the plan for epoch N+1 WHILE epoch N
+    trains — the overlap this bench exists to price. For streaming passes
+    the pull plane's run stats (rung ledger, placements, residency) and the
+    ingest stall counters come along."""
+    from ray_tpu import data as rdata
+    from ray_tpu.data.context import DataContext
+    from ray_tpu.data import streaming as rstreaming
+    from ray_tpu.data.streaming.ingest import StreamingIngest
+
+    ctx = DataContext.get_current()
+    ctx.streaming_pull = streaming
+    ctx.locality_placement = locality
+    ds = rdata.read_numpy(paths, parallelism=len(paths)) \
+        .map_batches(_preprocess) \
+        .random_shuffle(seed=7)
+    rows = nbytes = 0
+    t0 = time.perf_counter()
+    if streaming:
+        ing = StreamingIngest(ds, batch_rows, epochs=epochs,
+                              prefetch=prefetch, drop_last=False, ctx=ctx)
+        for b in ing:
+            rows += len(b["label"])
+            nbytes += sum(v.nbytes for v in b.values())
+            time.sleep(train_s)
+    else:
+        for _ in range(epochs):
+            for b in ds.iter_batches(batch_size=batch_rows,
+                                     batch_format="numpy"):
+                rows += len(b["label"])
+                nbytes += sum(v.nbytes for v in b.values())
+                time.sleep(train_s)
+    t1 = time.perf_counter()
+    out = {
+        "seconds": round(t1 - t0, 3), "rows": rows, "bytes": nbytes,
+        "epochs": epochs, "train_s_per_batch": train_s,
+        "gib_per_s": round(nbytes / 2**30 / (t1 - t0), 3),
+    }
+    if streaming:
+        out["ingest"] = {
+            "backpressure_s": round(ing.backpressure_s, 3),
+            "starve_s": round(ing.starve_s, 3),
+            "batches": ing.batches_consumed,
+        }
+        st = rstreaming.last_run_stats()
+        out["stream_stats"] = st.snapshot() if st is not None else None
+    return out
+
+
+def run_nodes_mode(args) -> dict:
+    """`--nodes N`: staged vs streaming (and locality on/off) over a REAL
+    multi-node cluster plane, node-strict so the bulk-span TCP path carries
+    every cross-node read."""
+    os.environ["RAY_TPU_DATA_BLOCK_TRANSPORT"] = "1"
+    os.environ["RAY_TPU_DATA_NODE_STRICT"] = "1"
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    rows_mode = [
+        ("staged", False, True),
+        ("streaming", True, True),
+        ("streaming_no_locality", True, False),
+    ]
+    with tempfile.TemporaryDirectory(prefix="bench_data_lake_") as lake:
+        paths = make_shards(lake, args.shards, args.rows, args.seq)
+        shard_bytes = sum(os.path.getsize(p) for p in paths)
+        cluster = Cluster(initialize_head=True,
+                          head_node_args={"num_cpus": 0})
+        for _ in range(args.nodes):
+            cluster.add_node(num_cpus=args.num_cpus)
+        ray_tpu.init(address=cluster.address)
+        out = {}
+        train_s = args.train_ms / 1000.0
+        try:
+            for name, streaming, locality in rows_mode:
+                cold = run_e2e_stream(paths, args.batch_rows, streaming,
+                                      locality, args.epochs, train_s,
+                                      args.prefetch)
+                warm_runs = [
+                    run_e2e_stream(paths, args.batch_rows, streaming,
+                                   locality, args.epochs, train_s,
+                                   args.prefetch)
+                    for _ in range(3)
+                ]
+                warm_runs.sort(key=lambda r: r["seconds"])
+                warm = warm_runs[1]
+                warm["runs_seconds"] = [r["seconds"] for r in warm_runs]
+                out[name] = {"cache_cold": cold, "cache_warm": warm}
+                print(f"[nodes={args.nodes}] {name}: cold "
+                      f"{cold['seconds']}s, warm {warm['seconds']}s "
+                      f"({warm['gib_per_s']} GiB/s)", flush=True)
+        finally:
+            ray_tpu.shutdown()
+            cluster.shutdown()
+
+    warm_staged = out["staged"]["cache_warm"]["seconds"]
+    warm_stream = out["streaming"]["cache_warm"]["seconds"]
+    st = out["streaming"]["cache_warm"]["stream_stats"] or {}
+    reduce_fetch = (st.get("fetch_groups") or {}).get("exchange", {})
+    fetched = (reduce_fetch.get("local_bytes", 0)
+               + reduce_fetch.get("span_bytes", 0)
+               + reduce_fetch.get("get_bytes", 0))
+    # stream_stats covers the LAST epoch's executor run; compare against
+    # one epoch's worth of consumed bytes.
+    consumed = out["streaming"]["cache_warm"]["bytes"] // args.epochs
+    no_loc = out["streaming_no_locality"]["cache_warm"].get(
+        "stream_stats") or {}
+    no_loc_reduce = (no_loc.get("fetch_groups") or {}).get("exchange", {})
+    return {
+        "bench": ("multi-node streaming ingest: shard read -> preprocess -> "
+                  "shuffle -> iter_batches consume"),
+        "script": f"scripts/bench_data.py --nodes {args.nodes}",
+        "config": {
+            "nodes": args.nodes, "num_cpus_per_node": args.num_cpus,
+            "shards": args.shards, "rows_per_shard": args.rows,
+            "seq": args.seq, "batch_rows": args.batch_rows,
+            "epochs": args.epochs, "train_ms_per_batch": args.train_ms,
+            "ingest_prefetch_batches": args.prefetch,
+            "shard_bytes": shard_bytes,
+            "data_block_transport": True, "data_node_strict": True,
+        },
+        "rows": out,
+        "streaming_vs_staged_warm_speedup": round(
+            warm_staged / max(warm_stream, 1e-9), 2),
+        "reduce_side": {
+            # Spans move partition bytes, not whole segments: fetched ≈
+            # consumed is the no-amplification proof the smoke re-asserts.
+            "fetched_bytes": fetched,
+            "cross_node_bytes": reduce_fetch.get("cross_node_bytes", 0),
+            "consumed_bytes": consumed,
+            "fetched_over_consumed": round(fetched / max(consumed, 1), 3),
+            "rungs": {k: reduce_fetch.get(k, 0)
+                      for k in ("inline", "local", "span", "get", "empty")},
+        },
+        "locality": {
+            "with": {
+                "warm_seconds": warm_stream,
+                "cross_node_bytes": reduce_fetch.get("cross_node_bytes", 0),
+                "placements": st.get("placements", {}),
+            },
+            "without": {
+                "warm_seconds":
+                    out["streaming_no_locality"]["cache_warm"]["seconds"],
+                "cross_node_bytes":
+                    no_loc_reduce.get("cross_node_bytes", 0),
+                "placements": no_loc.get("placements", {}),
+            },
+        },
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--transport", choices=["on", "off"], default=None,
@@ -162,7 +336,30 @@ def main():
     ap.add_argument("--seq", type=int, default=256)
     ap.add_argument("--consumers", type=int, default=2)
     ap.add_argument("--num-cpus", type=int, default=4)
+    ap.add_argument("--nodes", type=int, default=None,
+                    help="multi-node mode: boot N node agents (>=2) and "
+                         "record staged-vs-streaming + locality rows")
+    ap.add_argument("--batch-rows", type=int, default=4096,
+                    help="iter_batches batch size in the --nodes e2e rows")
+    ap.add_argument("--epochs", type=int, default=4,
+                    help="training epochs per --nodes e2e pass")
+    ap.add_argument("--train-ms", type=float, default=100.0,
+                    help="simulated accelerator step per batch (--nodes mode)")
+    ap.add_argument("--prefetch", type=int, default=8,
+                    help="StreamingIngest bounded queue depth (--nodes mode)")
     args = ap.parse_args()
+
+    if args.nodes is not None:
+        assert args.nodes >= 2, "--nodes needs at least 2 node processes"
+        artifact = run_nodes_mode(args)
+        path = args.record or "BENCH_DATA_r02.json"
+        with open(path, "w") as f:
+            json.dump(artifact, f, indent=1)
+        print(f"wrote {path}: streaming vs staged warm = "
+              f"{artifact['streaming_vs_staged_warm_speedup']}x, "
+              f"reduce fetched/consumed = "
+              f"{artifact['reduce_side']['fetched_over_consumed']}")
+        return
 
     if args.transport is not None:
         res = run_mode(args.transport, args.shards, args.rows, args.seq,

@@ -1,0 +1,224 @@
+"""Streaming pull plane (`data/streaming/`): bounded-window backpressure,
+staged-vs-streaming parity, locality placement accounting, the
+StreamingIngest train bridge (epoch overlap + backpressure), and a
+SIGKILL-mid-stream chaos case parametrized over the block transport."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rdata
+from ray_tpu.core import config as rt_config
+from ray_tpu.data import transport
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.streaming import StreamingIngest, last_run_stats
+from ray_tpu.util.chaos import WorkerKiller
+
+
+@pytest.fixture
+def cluster_rt():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+    rt_config._reset_cache_for_tests()
+
+
+@pytest.fixture
+def ctx():
+    """The process DataContext, restored field-by-field after the test."""
+    c = DataContext.get_current()
+    saved = dict(c.__dict__)
+    yield c
+    c.__dict__.update(saved)
+
+
+def _mk_ds(n=8000, parallelism=8):
+    return rdata.range(n, parallelism=parallelism).map_batches(
+        lambda b: {"id": b["id"], "v": b["id"].astype(np.float64) * 2.0}
+    )
+
+
+# ---------------------------------------------------------------- pull plane
+class TestPullExecutor:
+    def test_streaming_matches_staged(self, cluster_rt, ctx):
+        """Same plan, same rows, same (seeded) order through both planes."""
+        def run():
+            return _mk_ds(4000, 6).random_shuffle(seed=7).take_all()
+
+        ctx.streaming_pull = True
+        on = run()
+        ctx.streaming_pull = False
+        off = run()
+        assert [r["id"] for r in on] == [r["id"] for r in off]
+        assert sorted(r["id"] for r in on) == list(range(4000))
+
+    def test_window_bounds_resident_blocks(self, cluster_rt, ctx):
+        """The backpressure contract, MEASURED: no windowed operator ever
+        holds more than `window` submitted-but-unconsumed task outputs,
+        even with a source much wider than the window."""
+        ctx.streaming_pull = True
+        ctx.streaming_window_blocks = 2
+        rows = _mk_ds(6000, 12).take_all()
+        assert len(rows) == 6000
+        st = last_run_stats()
+        assert st is not None
+        snap = st.snapshot()
+        windowed = {i: d for i, d in snap["ops"].items()
+                    if d["name"] in ("read", "map", "exchange")}
+        assert windowed, snap
+        for d in windowed.values():
+            assert d["window"] == 2
+            assert 0 < d["peak_resident"] <= d["window"], d
+        # Source width reached the stats even though residency stayed at 2.
+        read = next(d for d in snap["ops"].values() if d["name"] == "read")
+        assert read["submitted"] == 12
+
+    def test_limit_cuts_submission_short(self, cluster_rt, ctx):
+        """A limit() downstream stops pulling; the source must not have
+        launched the whole read front regardless."""
+        ctx.streaming_pull = True
+        ctx.streaming_window_blocks = 2
+        rows = rdata.range(100_000, parallelism=50).limit(500).take_all()
+        assert [r["id"] for r in rows] == list(range(500))
+        st = last_run_stats()
+        read = next(d for d in st.snapshot()["ops"].values()
+                    if d["name"] == "read")
+        # 500 rows = 1 block of 2000; window 2 overshoots by at most itself.
+        assert read["submitted"] <= 4, read
+
+    def test_locality_placements_recorded(self, cluster_rt, ctx):
+        """Descriptor-backed inputs carry their producer node; affine map
+        tasks and exchange reduces land in the placements ledger."""
+        if not transport.transport_enabled():
+            pytest.skip("block transport off")
+        ctx.streaming_pull = True
+        ctx.locality_placement = True
+        ds = _mk_ds(20_000, 4).materialize().map_batches(
+            lambda b: {"id": b["id"]}
+        )
+        rows = ds.take_all()
+        assert sorted(r["id"] for r in rows) == list(range(20_000))
+        st = last_run_stats()
+        placements = st.snapshot()["placements"]
+        assert placements.get(transport.local_node_id(), 0) >= 4, placements
+
+    def test_delivered_bundles_released_by_iteration(self, cluster_rt, ctx):
+        """iter_batches releases each bundle after its blocks are consumed:
+        consumer-held residency returns to ~zero, peak stays small."""
+        ctx.streaming_pull = True
+        n = 0
+        for batch in _mk_ds(6000, 8).iter_batches(batch_size=500,
+                                                  batch_format="numpy"):
+            n += len(batch["id"])
+        assert n == 6000
+        d = last_run_stats().snapshot()["delivered"]
+        assert d["total"] >= 8
+        assert d["resident"] <= 1
+        assert d["peak"] <= 3, d
+
+
+# ------------------------------------------------------------ train ingest
+class TestStreamingIngest:
+    def test_epoch_overlap_and_gap_free_epochs(self, cluster_rt, ctx):
+        """Epoch N+1 production overlaps epoch N consumption (the whole
+        point of the bridge), and across 3 epochs every row arrives exactly
+        3 times — no gaps, no duplicates, across epoch seams."""
+        ctx.streaming_pull = True
+        ds = _mk_ds(200, 4)
+        with StreamingIngest(ds, 50, epochs=3, prefetch=8) as ing:
+            deadline = time.monotonic() + 20
+            while ing.epochs_started < 2 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            # Nothing consumed yet, epoch 2 already producing: overlap.
+            assert ing.batches_consumed == 0
+            assert ing.epochs_started >= 2, ing.stats()
+            seen = []
+            for batch in ing:
+                assert len(batch["id"]) == 50
+                seen.extend(int(i) for i in batch["id"])
+            assert ing.batches_consumed == 3 * 4
+        counts = {i: 0 for i in range(200)}
+        for i in seen:
+            counts[i] += 1
+        assert set(counts.values()) == {3}, "gap or duplicate across epochs"
+
+    def test_backpressure_parks_producer_at_bounded_queue(self, cluster_rt,
+                                                          ctx):
+        """A slow consumer fills the bounded queue; the producer parks
+        (backpressure_s accrues) instead of buffering unboundedly."""
+        ctx.streaming_pull = True
+        ds = _mk_ds(2000, 8)
+        with StreamingIngest(ds, 100, epochs=2, prefetch=2) as ing:
+            time.sleep(1.0)  # consume nothing: queue must cap at prefetch
+            s = ing.stats()
+            assert s["queue_depth"] <= s["queue_cap"]
+            assert s["batches_produced"] <= s["queue_cap"] + 1
+            total = 0
+            for b in ing:  # slow trainer: the producer parks every few puts
+                total += len(b["id"])
+                time.sleep(0.02)
+        assert total == 2 * 2000
+        # Stall time accrues when a parked put finally lands.
+        assert ing.backpressure_s > 0.0, ing.stats()
+
+    def test_as_batch_fn_cycles_and_raises_at_exhaustion(self, cluster_rt,
+                                                         ctx):
+        ctx.streaming_pull = True
+        ds = _mk_ds(400, 2)
+        with StreamingIngest(ds, 100, epochs=2) as ing:
+            fn = ing.as_batch_fn(column="v")
+            got = [fn(step) for step in range(8)]  # 4 batches x 2 epochs
+            assert all(g.shape == (100,) for g in got)
+            with pytest.raises(StopIteration):
+                fn(8)
+
+    def test_producer_error_surfaces_to_consumer(self, cluster_rt, ctx):
+        ctx.streaming_pull = True
+
+        def boom(b):
+            raise ValueError("bad batch")
+
+        ds = rdata.range(100, parallelism=2).map_batches(boom)
+        with StreamingIngest(ds, 10, epochs=1) as ing:
+            with pytest.raises(RuntimeError, match="producer failed"):
+                for _ in ing:
+                    pass
+
+
+# ------------------------------------------------------------------- chaos
+@pytest.mark.chaos
+@pytest.mark.parametrize("transport_flag", ["1", "0"])
+def test_stream_survives_worker_kill(cluster_rt, ctx, transport_flag):
+    """SIGKILL busy workers while a streaming shuffle pipeline is being
+    pulled: lineage re-execution refills the windows and the consumed
+    stream stays gap-free — on both wire strategies."""
+    os.environ["RAY_TPU_DATA_BLOCK_TRANSPORT"] = transport_flag
+    rt_config._reset_cache_for_tests()
+    try:
+        ctx.streaming_pull = True
+        Killer = ray_tpu.remote(WorkerKiller)
+        killer = Killer.remote(interval_s=0.6, max_kills=2,
+                               include_actors=False)
+        ray_tpu.get(killer.run.remote(), timeout=30)
+        n = 40_000
+        ds = rdata.range(n, parallelism=8).map_batches(
+            lambda b: {
+                "id": b["id"],
+                "payload": np.repeat(b["id"], 64).reshape(-1, 64)
+                             .astype(np.float32),
+            }
+        ).random_shuffle(seed=5)
+        seen = []
+        for batch in ds.iter_batches(batch_size=2048, batch_format="numpy"):
+            seen.extend(int(i) for i in batch["id"])
+        ray_tpu.get(killer.stop.remote(), timeout=30)
+        kills = ray_tpu.get(killer.kills.remote(), timeout=30)
+        assert sorted(seen) == list(range(n)), (
+            f"stream gapped/duplicated under chaos (kills={kills})"
+        )
+    finally:
+        os.environ.pop("RAY_TPU_DATA_BLOCK_TRANSPORT", None)
+        rt_config._reset_cache_for_tests()

@@ -1,0 +1,161 @@
+"""Streaming data plane performance smoke (the runnable half of the
+regression gate behind `BENCH_DATA_r02.json`).
+
+Two layers, both smoke bounds rather than calibrated benchmarks:
+
+  * the RECORDED artifact must still say what the PR claimed — streaming
+    ingest ≥ 1.2x over the staged path on a real multi-node plane, and
+    reduce-side fetched bytes ≈ bytes consumed (span pulls move partition
+    bytes, never whole segments, and never silently fall back to whole-bundle
+    gets);
+  * a LIVE mini training loop re-proves the two load-bearing properties on
+    this machine: epoch-overlapped streaming ingest is not slower than the
+    staged produce-then-train loop (generous slack — shared-box noise must
+    not decide it), and the pull plane's bounded-memory contract holds
+    (peak resident blocks per operator ≤ the configured window, measured,
+    not trusted).
+
+Recording methodology for the artifact itself: scripts/bench_data.py
+--nodes 2 (see its docstring and scripts/bench_protocol.md).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rdata
+from ray_tpu.core import config as rt_config
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.streaming import StreamingIngest, last_run_stats
+
+pytestmark = pytest.mark.slow
+
+ARTIFACT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "BENCH_DATA_r02.json")
+
+
+# ------------------------------------------------------- recorded artifact
+class TestRecordedArtifact:
+    @pytest.fixture(scope="class")
+    def artifact(self):
+        if not os.path.exists(ARTIFACT):
+            pytest.skip("BENCH_DATA_r02.json not recorded on this checkout")
+        with open(ARTIFACT) as f:
+            return json.load(f)
+
+    def test_recorded_on_a_real_multi_node_plane(self, artifact):
+        cfg = artifact["config"]
+        assert cfg["nodes"] >= 2
+        assert cfg["data_block_transport"] is True
+        assert cfg["data_node_strict"] is True
+
+    def test_streaming_beats_staged_by_claimed_margin(self, artifact):
+        assert artifact["streaming_vs_staged_warm_speedup"] >= 1.2, artifact[
+            "streaming_vs_staged_warm_speedup"]
+
+    def test_reduce_side_fetches_exactly_what_it_consumes(self, artifact):
+        rs = artifact["reduce_side"]
+        # Span pulls move partition bytes: fetched ≈ consumed (framing
+        # overhead only), nothing near the ~Nx a whole-segment fallback
+        # would show.
+        assert 0.9 <= rs["fetched_over_consumed"] <= 1.15, rs
+        # Cross-node traffic is real and rode the span rung — zero silent
+        # whole-bundle gets anywhere on the reduce side.
+        assert rs["cross_node_bytes"] > 0
+        assert rs["rungs"]["span"] > 0
+        assert rs["rungs"]["get"] == 0, rs["rungs"]
+        assert rs["rungs"]["empty"] == 0, rs["rungs"]
+
+
+# ------------------------------------------------------------ live re-proof
+@pytest.fixture
+def cluster_rt():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+    rt_config._reset_cache_for_tests()
+
+
+@pytest.fixture
+def ctx():
+    c = DataContext.get_current()
+    saved = dict(c.__dict__)
+    yield c
+    c.__dict__.update(saved)
+
+
+def _plan(rows, parallelism):
+    return rdata.range(rows, parallelism=parallelism).map_batches(
+        lambda b: {"id": b["id"],
+                   "feat": np.repeat(b["id"], 32)
+                            .reshape(-1, 32).astype(np.float32)}
+    ).random_shuffle(seed=7)
+
+
+ROWS, PARALLELISM, BATCH = 24_576, 8, 4096
+EPOCHS, TRAIN_S = 3, 0.08
+
+
+def _train_loop_staged(ctx) -> float:
+    ctx.streaming_pull = False
+    ds = _plan(ROWS, PARALLELISM)
+    t0 = time.perf_counter()
+    n = 0
+    for _ in range(EPOCHS):
+        for b in ds.iter_batches(batch_size=BATCH, batch_format="numpy"):
+            n += len(b["id"])
+            time.sleep(TRAIN_S)
+    dt = time.perf_counter() - t0
+    assert n == ROWS * EPOCHS
+    return dt
+
+
+def _train_loop_streaming(ctx) -> float:
+    ctx.streaming_pull = True
+    ctx.streaming_window_blocks = 4
+    ing = StreamingIngest(_plan(ROWS, PARALLELISM), BATCH, epochs=EPOCHS,
+                          prefetch=8, drop_last=False, ctx=ctx)
+    t0 = time.perf_counter()
+    n = 0
+    for b in ing:
+        n += len(b["id"])
+        time.sleep(TRAIN_S)
+    dt = time.perf_counter() - t0
+    assert n == ROWS * EPOCHS
+    return dt
+
+
+def test_streaming_ingest_not_slower_and_stays_bounded(cluster_rt, ctx):
+    # Interleaved best-of-two per mode: one scheduling hiccup on a shared
+    # box must not decide the comparison.
+    staged, streaming = [], []
+    for _ in range(2):
+        staged.append(_train_loop_staged(ctx))
+        streaming.append(_train_loop_streaming(ctx))
+    t_staged, t_stream = min(staged), min(streaming)
+    # Smoke bound, not a benchmark: epoch overlap makes streaming ~1.2-1.4x
+    # FASTER here; 1.1x slack still catches the overlap breaking (producer
+    # serialized behind the consumer would land near (produce+train)/train
+    # ≈ 1.5x slower).
+    assert t_stream <= t_staged * 1.1, (
+        f"streaming ingest slower than staged: {t_stream:.2f}s vs "
+        f"{t_staged:.2f}s")
+    # Bounded-memory proof from the SAME run (stats cover the last epoch's
+    # executor): no windowed operator ever held more than its window.
+    st = last_run_stats()
+    assert st is not None
+    snap = st.snapshot()
+    windowed = [d for d in snap["ops"].values()
+                if d["name"] in ("read", "map", "exchange")]
+    assert windowed, snap
+    for d in windowed:
+        assert d["window"] == 4
+        assert 0 < d["peak_resident"] <= d["window"], d
+    read = next(d for d in snap["ops"].values() if d["name"] == "read")
+    assert read["submitted"] == PARALLELISM
+    print(f"staged {t_staged:.2f}s, streaming {t_stream:.2f}s "
+          f"({t_staged / t_stream:.2f}x)")

@@ -117,8 +117,12 @@ class TestSegmentLayout:
             raise ConnectionError("source gone")
 
         monkeypatch.setattr(transport, "_fetch_span", boom)
-        got = transport.fetch_partition(desc, 0)
+        with transport.track_fetch() as f:
+            got = transport.fetch_partition(desc, 0)
         np.testing.assert_array_equal(got[0]["a"], parts[0][0]["a"])
+        # The degradation is COUNTED, never silent: the failed span pull
+        # lands on the get rung, not the span rung.
+        assert f["span"] == 0 and f["get"] == 1 and f["get_bytes"] > 0
 
     def test_local_mode_backend_without_put_serialized(self):
         """LocalBackend has no put_serialized: the descriptor degrades to a
@@ -133,6 +137,102 @@ class TestSegmentLayout:
             )
         finally:
             ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------- fetch rung stats
+class TestFetchRungs:
+    """Every ONE-TO-ONE resolution must land on an accounted rung — inline /
+    same-node arena / bulk-span / batched get — with `get` reserved for real
+    degradations. A silent fallback to whole-object gets would erase the
+    transport's entire point, so these assert the ladder, not just results."""
+
+    def test_same_node_bundle_counts_local_not_get(self, cluster_rt):
+        blocks = [{"a": np.arange(60_000, dtype=np.int64),
+                   "b": np.ones((60_000, 2), dtype=np.float32)}]
+        desc = transport.put_bundle(blocks)
+        assert transport.is_descriptor(desc)
+        assert not desc.get("inline")
+        with transport.track_fetch() as f:
+            got = transport.fetch_bundle(desc)
+        np.testing.assert_array_equal(got[0]["a"], blocks[0]["a"])
+        assert f["local"] == 1 and f["local_bytes"] > 0
+        assert f["get"] == 0 and f["span"] == 0
+
+    def test_remote_bundle_counts_span_bytes_as_cross_node(self, cluster_rt,
+                                                           monkeypatch):
+        blocks = [{"a": np.arange(60_000, dtype=np.int64)}]
+        desc = transport.put_bundle(blocks)
+        monkeypatch.setattr(bulk_mod, "_local_addrs", lambda: set())
+        desc = dict(desc, name=None)
+        with transport.track_fetch() as f:
+            got = transport.fetch_bundle(desc)
+        np.testing.assert_array_equal(got[0]["a"], blocks[0]["a"])
+        assert f["span"] == 1 and f["get"] == 0 and f["local"] == 0
+        # Reduce-side cross-node traffic is exactly the span bytes pulled.
+        assert f["span_bytes"] > 0
+        assert f["cross_node_bytes"] == f["span_bytes"]
+
+    def test_inline_bundle_counts_inline_rung(self, cluster_rt):
+        desc = transport.put_bundle([{"a": np.arange(8, dtype=np.int64)}])
+        assert desc.get("inline") is True and desc.get("spans") is None
+        with transport.track_fetch() as f:
+            got = transport.fetch_bundle(desc)
+        np.testing.assert_array_equal(got[0]["a"], np.arange(8))
+        assert f["inline"] == 1 and f["get"] == 0
+
+    def test_node_strict_refuses_foreign_local_read(self, cluster_rt,
+                                                    monkeypatch):
+        """With `data_node_strict` on, a segment stamped with another
+        LOGICAL node id must not ride the /dev/shm fast path even though the
+        name would resolve (one-box multi-node cluster) — it takes the span
+        plane, like it would on real separate machines."""
+        blocks = [{"a": np.arange(60_000, dtype=np.int64)}]
+        desc = transport.put_bundle(blocks)
+        assert desc["node"] == transport.local_node_id()
+        foreign = dict(desc, node="node9")
+        from ray_tpu.core import api as core_api
+        backend = core_api._global_runtime().backend
+        real_sources = backend.object_sources
+
+        def foreign_sources(ids):
+            return [dict(s, node="node9") if s else s
+                    for s in real_sources(ids)]
+
+        monkeypatch.setattr(backend, "object_sources", foreign_sources)
+        monkeypatch.setenv("RAY_TPU_DATA_NODE_STRICT", "1")
+        rt_config._reset_cache_for_tests()
+        try:
+            with transport.track_fetch() as f:
+                got = transport.fetch_bundle(foreign)
+        finally:
+            monkeypatch.delenv("RAY_TPU_DATA_NODE_STRICT", raising=False)
+            rt_config._reset_cache_for_tests()
+        np.testing.assert_array_equal(got[0]["a"], blocks[0]["a"])
+        assert f["local"] == 0
+        assert f["span"] == 1 and f["cross_node_bytes"] > 0
+        assert f["get"] == 0
+
+    def test_streaming_run_ledger_has_no_silent_gets(self, cluster_rt):
+        """End-to-end ONE-TO-ONE path: read → segment bundles → chained map
+        (worker-side resolve) → shuffle exchange → driver iteration. The
+        run-wide rung ledger (worker deltas merged into StreamStats + the
+        driver's own counters) must show arena/span/inline traffic only —
+        `get` stays zero on the happy path."""
+        from ray_tpu.data import streaming
+
+        transport.reset_fetch_stats()
+        ds = _mk_ds(20_000, 4).materialize().map_batches(
+            lambda b: {"id": b["id"], "v": b["v"]}
+        ).random_shuffle(seed=3)
+        rows = ds.take_all()
+        assert sorted(r["id"] for r in rows) == list(range(20_000))
+        st = streaming.last_run_stats()
+        assert st is not None
+        ledger = dict(st.fetch)
+        transport.merge_fetch_stats(ledger, transport.fetch_stats())
+        assert ledger.get("get", 0) == 0, f"silent get fallback: {ledger}"
+        # Same-box run: traffic rides the arena (local) and/or inline rungs.
+        assert ledger.get("local", 0) + ledger.get("inline", 0) > 0, ledger
 
 
 # ------------------------------------------------------------ exchange parity

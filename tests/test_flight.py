@@ -166,6 +166,75 @@ def test_pipeline_report_decomposes_bubble():
         [_span("engine.step", 1.0, 0.1, lane="serve/engine")]) is None
 
 
+# -------------------------------------------------- data ingest attribution
+def test_ingest_report_attributes_data_stalls():
+    """The streaming-data half of the bubble story: stall seconds per
+    (data lane, kind), throughput from `data.bundle` markers, and the
+    bottleneck = the worst (lane, kind) pair."""
+    events = [
+        _span("data.bundle", 10.0, 0.0, lane="data/op0", rows=100, bytes=800),
+        _span("data.bundle", 10.5, 0.0, lane="data/op0", rows=100, bytes=800),
+        _span("data.wait", 10.0, 0.4, lane="data/op1"),
+        _span("data.drain", 10.5, 0.2, lane="data/op1"),
+        _span("data.backpressure", 10.2, 1.5, lane="data/ingest"),
+        _span("data.starve", 12.0, 0.1, lane="data/ingest"),
+        # Non-data spans stay out of the report entirely.
+        _span("mpmd.fwd", 10.0, 1.0, lane="mpmd/s0r0", step=1, mb=0),
+    ]
+    rep = flight.ingest_report(events)
+    assert rep is not None
+    assert set(rep["lanes"]) == {"data/op0", "data/op1", "data/ingest"}
+    op0 = rep["lanes"]["data/op0"]
+    assert op0["bundles"] == 2 and op0["rows"] == 200 and op0["bytes"] == 1600
+    stalls = rep["lanes"]["data/op1"]["stalls_s"]
+    assert stalls["data.wait"] == pytest.approx(0.4)
+    assert stalls["data.drain"] == pytest.approx(0.2)
+    assert rep["bottleneck"]["lane"] == "data/ingest"
+    assert rep["bottleneck"]["kind"] == "data.backpressure"
+    assert rep["bottleneck"]["stall_s"] == pytest.approx(1.5)
+    assert rep["window_s"] == pytest.approx(2.1)
+    # The shared export ships the same report on every flight surface.
+    assert flight.flight_payload(events)["ingest"] == rep
+    # No data spans -> no report, not a zero-filled one.
+    assert flight.ingest_report(
+        [_span("engine.step", 1.0, 0.1, lane="serve/engine")]) is None
+
+
+@pytest.mark.cluster
+def test_streaming_pipeline_records_data_lane_spans(cluster_runtime):
+    """A live pull-plane run + ingest bridge lands per-operator spans on
+    `data/op{i}` lanes and ingest spans on `data/ingest`, and the recorder
+    snapshot feeds ingest_report end to end."""
+    from ray_tpu import data as rdata
+    from ray_tpu.data.context import DataContext
+    from ray_tpu.data.streaming import StreamingIngest
+
+    ctx = DataContext.get_current()
+    saved = dict(ctx.__dict__)
+    flight._reset_for_tests()
+    try:
+        ctx.streaming_pull = True
+        ds = rdata.range(4000, parallelism=4).map_batches(
+            lambda b: {"id": b["id"]})
+        with StreamingIngest(ds, 500, epochs=1, prefetch=2) as ing:
+            n = sum(len(b["id"]) for b in ing)
+        assert n == 4000
+        evs = flight.recorder().snapshot()
+        data_lanes = {e["args"]["lane"] for e in evs
+                      if e.get("name", "").startswith("data.")}
+        assert any(l.startswith("data/op") for l in data_lanes), data_lanes
+        rep = flight.ingest_report(evs)
+        assert rep is not None
+        op_lanes = [l for l in rep["lanes"] if l.startswith("data/op")]
+        assert op_lanes
+        # Every consumed bundle left a throughput marker on its op lane.
+        assert sum(rep["lanes"][l]["bundles"] for l in op_lanes) >= 4
+        assert sum(rep["lanes"][l]["rows"] for l in op_lanes) >= 4000
+    finally:
+        ctx.__dict__.update(saved)
+        flight._reset_for_tests()
+
+
 # --------------------------------------------------------- merged export
 def test_merged_chrome_trace_lanes_flows_metadata():
     events = (
